@@ -1,0 +1,28 @@
+"""dgen-tpu: TPU-native agent-based market-adoption framework.
+
+A ground-up JAX/XLA re-design of the capabilities of NREL dGen
+(reference: tsgsteele/dgen, see SURVEY.md): annual simulation of
+rooftop-solar + behind-the-meter storage adoption by customer agents.
+
+Architecture (TPU-first, not a port):
+  - The agent population is a columnar pytree of dense arrays resident in
+    HBM (``dgen_tpu.models.agents.AgentTable``), not a pandas DataFrame.
+  - The per-agent economics hot loop (utility-bill engine, battery
+    dispatch, multi-year cashflow, NPV-optimal sizing search) — which the
+    reference runs one agent at a time through PySAM/SSC C++ modules
+    (reference financial_functions.py:96-568) — is a set of fused,
+    ``jax.vmap``-ed kernels in ``dgen_tpu.ops``.
+  - The market step (Bass diffusion, max-market-share, storage
+    attachment) is vectorized with segment reductions in
+    ``dgen_tpu.models.market``.
+  - Scale-out is ``jax.sharding.Mesh`` + ``shard_map`` over the agent
+    axis (``dgen_tpu.parallel``), replacing the reference's
+    one-GCP-Batch-task-per-state sharding (submit_all.sh).
+  - Host I/O (ingest, profile store, checkpoints) stays off the device
+    path in ``dgen_tpu.io``, replacing the reference's per-agent Postgres
+    round trips (agent_mutation/elec.py:508-558).
+"""
+
+__version__ = "0.1.0"
+
+from dgen_tpu import config, io, models, ops, parallel, utils  # noqa: F401

@@ -1,0 +1,97 @@
+"""Validated run/scenario configuration.
+
+The reference splits configuration across module constants (config.py),
+validated ``ModelSettings``/``ScenarioSettings`` property objects
+(settings.py:19,266), env-var overrides, and an Excel input workbook.
+Here a scenario is a single frozen dataclass validated at construction;
+there is no Excel/DB layer — inputs are files loaded by ``dgen_tpu.io``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence, Tuple
+
+SECTORS = ("res", "com", "ind")
+SECTOR_IDX = {s: i for i, s in enumerate(SECTORS)}
+
+#: Payback grid the max-market-share curves are tabulated on:
+#: 0.0..30.1 in steps of 0.1 (the reference discretizes payback to a
+#: x100 integer factor for its lookup, financial_functions.py:1290, and
+#: uses 30.1 as the "never pays back" sentinel, :1259).
+PAYBACK_GRID_MAX = 30.1
+PAYBACK_GRID_STEP = 0.1
+PAYBACK_GRID_N = int(round(PAYBACK_GRID_MAX / PAYBACK_GRID_STEP)) + 1  # 302
+PAYBACK_NEVER = 30.1
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(msg)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioConfig:
+    """Per-scenario settings (validated analogue of reference
+    settings.py:266 ``ScenarioSettings``)."""
+
+    name: str = "default"
+    start_year: int = 2014
+    end_year: int = 2050
+    #: solar diffusion steps forward two years per solve
+    #: (reference diffusion_functions_elec.py:285)
+    year_step: int = 2
+    sectors: Tuple[str, ...] = SECTORS
+    #: analysis horizon for bills/cashflow (reference financing terms
+    #: set economic_lifetime_yrs = 30)
+    economic_lifetime_yrs: int = 30
+    #: historical anchor years rescaled to observed deployment
+    #: (reference diffusion_functions_elec.py:99)
+    anchor_years: Tuple[int, ...] = (2014, 2016, 2018)
+    #: enable the battery-attachment post-diffusion step
+    storage_enabled: bool = True
+    annual_inflation: float = 0.025
+
+    def __post_init__(self) -> None:
+        _check(1990 <= self.start_year <= 2050, "start_year out of range")
+        _check(self.start_year <= self.end_year <= 2050,
+               "end_year must be in [start_year, 2050]")
+        _check(self.year_step in (1, 2), "year_step must be 1 or 2")
+        _check(all(s in SECTORS for s in self.sectors), "unknown sector")
+        _check(1 <= self.economic_lifetime_yrs <= 50, "bad lifetime")
+        _check(0.0 <= self.annual_inflation < 0.5, "bad inflation")
+
+    @property
+    def model_years(self) -> Sequence[int]:
+        return list(range(self.start_year, self.end_year + 1, self.year_step))
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Machine/run settings (analogue of reference settings.py:19
+    ``ModelSettings``). Env overrides mirror the reference's
+    ``LOCAL_CORES``-style hooks (settings.py:484-494)."""
+
+    #: pad the agent axis to a multiple of this (TPU lane friendliness)
+    agent_pad_multiple: int = 128
+    #: agents processed per device kernel invocation
+    block_size: int = 4096
+    #: golden-section iterations for the PV sizing search
+    sizing_iters: int = 12
+    #: number of devices to shard agents over (None = all available)
+    n_devices: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        _check(self.agent_pad_multiple >= 1, "bad pad multiple")
+        _check(self.block_size >= 1, "bad block size")
+        _check(4 <= self.sizing_iters <= 64, "sizing_iters out of range")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "RunConfig":
+        if "block_size" not in overrides and os.environ.get("DGEN_TPU_BLOCK"):
+            overrides["block_size"] = int(os.environ["DGEN_TPU_BLOCK"])
+        if "n_devices" not in overrides and os.environ.get("DGEN_TPU_DEVICES"):
+            overrides["n_devices"] = int(os.environ["DGEN_TPU_DEVICES"])
+        return cls(**overrides)

@@ -1,0 +1,6 @@
+"""Host-side I/O: trajectory ingest, synthetic population generation,
+the binary columnar profile store, and checkpointing. Replaces the
+reference's Postgres data plane (SURVEY.md §2.5) — nothing here runs on
+the device path."""
+
+from dgen_tpu.io import ingest, synth  # noqa: F401

@@ -1,0 +1,245 @@
+"""Trajectory/market-data ingest from the reference's input_data CSV
+formats into :class:`ScenarioInputs` arrays.
+
+The reference ingests these CSVs into Postgres tables and merges them
+onto the agent frame per year (input_data_functions.py:215
+``import_table`` + the shapers at :272-560). Here each loader parses the
+same on-disk schema directly to dense [year, ...] arrays on the model
+year grid (nearest-year forward fill past the trajectory's end).
+
+Supported formats (all observed under reference dgen_os/input_data/):
+  * "stacked sector" files: ``year,<field>_res,<field>_com,<field>_ind``
+    (pv_prices, pv_tech_performance, batt_prices via res/nonres,
+    financing_terms via res/nonres).
+  * load_growth: ``year,load_growth_res,load_growth_com,load_growth_ind,
+    census_division_abbr``.
+  * elec_prices: ``ba,year,elec_price_res,elec_price_com,elec_price_ind``.
+  * observed deployment: ``state_abbr,sector_abbr,year,observed_solar_mw,...``.
+  * attachment rates: ``state_abbr,metric,q2_24,...`` paired
+    attachment_rate / install_volume rows (attachment_rate_functions.py:7).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dgen_tpu.config import SECTORS
+
+
+def _read_csv(path: str) -> List[Dict[str, str]]:
+    with open(path, newline="", encoding="utf-8-sig") as f:
+        return list(csv.DictReader(f))
+
+
+def _year_grid_interp(years_avail: np.ndarray, values: np.ndarray,
+                      model_years: Sequence[int]) -> np.ndarray:
+    """Sample a [Ya, ...] trajectory onto the model-year grid with
+    nearest-neighbor-in-past semantics (forward fill; clamp at ends)."""
+    out = []
+    for y in model_years:
+        i = int(np.searchsorted(years_avail, y, side="right")) - 1
+        i = max(0, min(i, len(years_avail) - 1))
+        out.append(values[i])
+    return np.asarray(out)
+
+
+def load_stacked_sectors(
+    path: str,
+    field: str,
+    model_years: Sequence[int],
+    nonres_suffix: bool = False,
+) -> np.ndarray:
+    """[Y, 3] array for ``<field>_res/_com/_ind`` (or ``_res/_nonres``
+    when ``nonres_suffix``, duplicated to com+ind as the reference's
+    stacked_sectors shaper does for batt prices / financing)."""
+    rows = _read_csv(path)
+    years = np.asarray([int(float(r["year"])) for r in rows])
+    if nonres_suffix:
+        cols = [f"{field}_res", f"{field}_nonres", f"{field}_nonres"]
+    else:
+        cols = [f"{field}_{s}" for s in SECTORS]
+    vals = np.asarray([[float(r[c]) for c in cols] for r in rows], dtype=np.float32)
+    order = np.argsort(years)
+    return _year_grid_interp(years[order], vals[order], model_years).astype(np.float32)
+
+
+def load_financing_terms(path: str, model_years: Sequence[int]) -> Dict[str, np.ndarray]:
+    """financing_terms CSV -> dict of [Y, 3] arrays (+ economic lifetime)."""
+    out = {}
+    for field in ("loan_term_yrs", "loan_interest_rate", "down_payment_fraction",
+                  "real_discount_rate", "tax_rate"):
+        out[field] = load_stacked_sectors(path, field, model_years, nonres_suffix=True)
+    rows = _read_csv(path)
+    out["economic_lifetime_yrs"] = int(float(rows[0]["economic_lifetime_yrs"]))
+    return out
+
+
+def load_load_growth(
+    path: str,
+    model_years: Sequence[int],
+    regions: Sequence[str],
+) -> np.ndarray:
+    """load_growth CSV -> [Y, R, 3] multiplier array.
+
+    The reference stores growth as a delta vs the base year per census
+    division x sector; multiplier = 1 + growth.
+    """
+    rows = _read_csv(path)
+    region_idx = {r: i for i, r in enumerate(regions)}
+    by_region: Dict[int, Dict[int, List[float]]] = {}
+    for r in rows:
+        reg = r.get("census_division_abbr", "")
+        if reg not in region_idx:
+            continue
+        y = int(float(r["year"]))
+        by_region.setdefault(region_idx[reg], {})[y] = [
+            1.0 + float(r[f"load_growth_{s}"]) for s in SECTORS
+        ]
+    Y, R, S = len(model_years), len(regions), len(SECTORS)
+    out = np.ones((Y, R, S), dtype=np.float32)
+    for reg_i, by_year in by_region.items():
+        ys = np.asarray(sorted(by_year))
+        vals = np.asarray([by_year[y] for y in ys], dtype=np.float32)
+        out[:, reg_i, :] = _year_grid_interp(ys, vals, model_years)
+    return out
+
+
+def load_elec_prices(
+    path: str,
+    model_years: Sequence[int],
+    bas: Sequence[str],
+    base_year: Optional[int] = None,
+) -> np.ndarray:
+    """elec_prices CSV -> [Y, R, 3] retail price multiplier vs the base
+    year (reference input_data_functions.py:450
+    ``process_elec_price_trajectories`` normalizes to the 2016-equivalent
+    base)."""
+    rows = _read_csv(path)
+    ba_idx = {b: i for i, b in enumerate(bas)}
+    by_ba: Dict[int, Dict[int, List[float]]] = {}
+    for r in rows:
+        ba = r.get("ba", "")
+        if ba not in ba_idx:
+            continue
+        y = int(float(r["year"]))
+        by_ba.setdefault(ba_idx[ba], {})[y] = [
+            float(r[f"elec_price_{s}"]) for s in SECTORS
+        ]
+    Y, R, S = len(model_years), len(bas), len(SECTORS)
+    out = np.ones((Y, R, S), dtype=np.float32)
+    for ba_i, by_year in by_ba.items():
+        ys = np.asarray(sorted(by_year))
+        vals = np.asarray([by_year[y] for y in ys], dtype=np.float32)
+        b_year = base_year or int(ys[0])
+        base = by_year.get(b_year, vals[0].tolist())
+        traj = _year_grid_interp(ys, vals, model_years)
+        out[:, ba_i, :] = traj / np.maximum(np.asarray(base, np.float32), 1e-9)
+    return out
+
+
+def load_observed_deployment(
+    path: str,
+    model_years: Sequence[int],
+    states: Sequence[str],
+) -> np.ndarray:
+    """observed_deployment CSV -> [Y, G] cumulative observed PV kW,
+    G = state x sector groups (reference
+    diffusion_functions_elec.py:115-122 consumes observed_solar_mw)."""
+    rows = _read_csv(path)
+    st_idx = {s: i for i, s in enumerate(states)}
+    sec_idx = {s: i for i, s in enumerate(SECTORS)}
+    Y = len(model_years)
+    G = len(states) * len(SECTORS)
+    out = np.zeros((Y, G), dtype=np.float32)
+    year_pos = {y: i for i, y in enumerate(model_years)}
+    for r in rows:
+        st = r.get("state_abbr", "")
+        sec = r.get("sector_abbr", "")
+        y = int(float(r["year"]))
+        if st not in st_idx or sec not in sec_idx or y not in year_pos:
+            continue
+        g = st_idx[st] * len(SECTORS) + sec_idx[sec]
+        out[year_pos[y], g] = float(r["observed_solar_mw"]) * 1000.0
+    return out
+
+
+def load_attachment_rates(path: str, states: Sequence[str]) -> np.ndarray:
+    """ohm_attachment_rates CSV -> [n_states] install-volume-weighted
+    average attachment rate (reference attachment_rate_functions.py:7-55).
+    Falls back to the simple mean when volumes are missing/zero; clipped
+    to [0, 1]; missing states get 0."""
+    rows = _read_csv(path)
+    qcols = [c for c in (rows[0].keys() if rows else []) if c.startswith("q")]
+    rates: Dict[str, List[float]] = {}
+    vols: Dict[str, List[float]] = {}
+    for r in rows:
+        st = r["state_abbr"].strip("﻿ ")
+        vals = []
+        for c in qcols:
+            try:
+                vals.append(float(r[c]))
+            except (TypeError, ValueError):
+                vals.append(np.nan)
+        if r["metric"] == "attachment_rate":
+            rates[st] = vals
+        elif r["metric"] == "install_volume":
+            vols[st] = vals
+    out = np.zeros(len(states), dtype=np.float32)
+    for i, st in enumerate(states):
+        if st not in rates:
+            continue
+        rv = np.asarray(rates[st], dtype=float)
+        wv = np.asarray(vols.get(st, [0.0] * len(rv)), dtype=float)
+        wv = np.nan_to_num(wv)
+        wsum = wv.sum()
+        if wsum > 0:
+            avg = np.nansum(rv * wv) / wsum
+        else:
+            avg = np.nanmean(rv)
+        out[i] = float(np.clip(np.nan_to_num(avg), 0.0, 1.0))
+    return out
+
+
+def state_attachment_to_groups(per_state: np.ndarray, n_sectors: int = 3) -> np.ndarray:
+    """[n_states] -> [G] by repeating across sectors (the reference
+    merges the state-level rate onto every sector, dgen_model.py:408)."""
+    return np.repeat(per_state, n_sectors).astype(np.float32)
+
+
+def discover_reference_inputs(root: str) -> Dict[str, str]:
+    """Locate reference-format input files under an input_data directory."""
+    def first(sub: str, prefer: Optional[str] = None) -> Optional[str]:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            return None
+        names = sorted(n for n in os.listdir(d) if n.endswith(".csv"))
+        if prefer:
+            for n in names:
+                if prefer in n:
+                    return os.path.join(d, n)
+        return os.path.join(d, names[0]) if names else None
+
+    out = {}
+    for key, sub, prefer in (
+        ("pv_prices", "pv_prices", "mid"),
+        ("pv_tech", "pv_tech_performance", "FY19"),
+        ("batt_prices", "batt_prices", "mid"),
+        ("financing", "financing_terms", "FY19"),
+        ("load_growth", "load_growth", None),
+        ("elec_prices", "elec_prices", "Mid_Case"),
+    ):
+        p = first(sub, prefer)
+        if p:
+            out[key] = p
+    for key, name in (
+        ("observed", "observed_deployment_by_state_sector_2023.csv"),
+        ("attachment", "ohm_attachment_rates.csv"),
+    ):
+        p = os.path.join(root, name)
+        if os.path.exists(p):
+            out[key] = p
+    return out

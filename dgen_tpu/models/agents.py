@@ -1,0 +1,172 @@
+"""The columnar agent table: HBM-resident struct-of-arrays population.
+
+The reference wraps a pandas DataFrame (index = agent_id) in an
+``Agents`` container and funnels every transformation through
+``on_frame`` / ``chunk_on_row`` (reference agents.py:12,120-147). That
+dispatch seam is where its CPU process-pool parallelism lives. Here the
+population is a frozen pytree of dense arrays with a fixed schema —
+"transforms" are pure functions returning new pytrees, vmap/shard_map
+provide the parallelism, and the invariant harness
+(dgen_tpu.utils.invariants) replaces the runtime dataframe tests.
+
+Ragged structures the reference keeps in object cells are compiled to
+indices into shared banks at ingest (SURVEY.md §7 design stance):
+``tariff_dict`` -> ``tariff_idx`` into a TariffBank; 8760 load/solar
+profiles -> ``load_idx`` / ``cf_idx`` into a ProfileBank; nested
+incentive frames -> fixed-width IncentiveParams leaves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.config import SECTORS
+from dgen_tpu.ops.cashflow import IncentiveParams
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class AgentTable:
+    """Static per-agent attributes (the reference's ``cols_base``
+    columns that survive the per-year column reset,
+    dgen_model.py:245-248). All arrays share the leading agent axis N;
+    N is padded (``mask``) to a lane-friendly multiple.
+    """
+
+    agent_id: jax.Array        # [N] int32
+    mask: jax.Array            # [N] float32, 1 = real agent, 0 = padding
+    state_idx: jax.Array       # [N] int32
+    sector_idx: jax.Array      # [N] int32 (0 res, 1 com, 2 ind)
+    group_idx: jax.Array       # [N] int32 = state_idx * n_sectors + sector_idx
+    region_idx: jax.Array      # [N] int32 census-division / BA for trajectories
+    tariff_idx: jax.Array      # [N] int32 into TariffBank
+    load_idx: jax.Array        # [N] int32 into ProfileBank.load
+    cf_idx: jax.Array          # [N] int32 into ProfileBank.solar_cf
+    customers_in_bin: jax.Array            # [N] f32
+    load_kwh_per_customer_in_bin: jax.Array  # [N] f32 (base year)
+    developable_frac: jax.Array            # [N] f32
+    incentives: IncentiveParams            # leaves [N, 2]
+
+    n_states: int = dataclasses.field(metadata=dict(static=True), default=51)
+
+    @property
+    def n_agents(self) -> int:
+        return self.agent_id.shape[0]
+
+    @property
+    def n_sectors(self) -> int:
+        return len(SECTORS)
+
+    @property
+    def n_groups(self) -> int:
+        return self.n_states * self.n_sectors
+
+    def developable_agent_weight(self, customers: jax.Array) -> jax.Array:
+        """Developable customer weight (reference
+        agent_mutation/elec.py:414 ``calculate_developable_customers_and_load``)."""
+        return self.developable_frac * customers * self.mask
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ProfileBank:
+    """Shared 8760 profile banks; agents index into these instead of the
+    reference's per-agent SQL fetches (agent_mutation/elec.py:508-558 —
+    its biggest serial bottleneck, SURVEY.md §7)."""
+
+    load: jax.Array       # [L, 8760] normalized to sum 1.0
+    solar_cf: jax.Array   # [S, 8760] kWh per kW_dc per hour
+    wholesale: jax.Array  # [R, 8760] $/kWh wholesale price by region
+
+    @property
+    def hours(self) -> int:
+        return self.load.shape[1]
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def build_agent_table(
+    *,
+    state_idx: np.ndarray,
+    sector_idx: np.ndarray,
+    region_idx: np.ndarray,
+    tariff_idx: np.ndarray,
+    load_idx: np.ndarray,
+    cf_idx: np.ndarray,
+    customers_in_bin: np.ndarray,
+    load_kwh_per_customer_in_bin: np.ndarray,
+    developable_frac: np.ndarray,
+    n_states: int,
+    incentives: IncentiveParams | None = None,
+    pad_multiple: int = 128,
+) -> AgentTable:
+    """Assemble + pad an :class:`AgentTable` from host arrays.
+
+    Padding agents carry mask 0, zero customers/load, and point at
+    index 0 of every bank so gathers stay in-bounds; every kernel output
+    is masked before aggregation.
+    """
+    n = int(state_idx.shape[0])
+    n_pad = pad_to_multiple(max(n, 1), pad_multiple)
+
+    def pad_i(a, fill=0):
+        out = np.full(n_pad, fill, dtype=np.int32)
+        out[:n] = np.asarray(a, dtype=np.int32)
+        return jnp.asarray(out)
+
+    def pad_f(a, fill=0.0):
+        out = np.full(n_pad, fill, dtype=np.float32)
+        out[:n] = np.asarray(a, dtype=np.float32)
+        return jnp.asarray(out)
+
+    mask = np.zeros(n_pad, dtype=np.float32)
+    mask[:n] = 1.0
+
+    n_sectors = len(SECTORS)
+    group = np.asarray(state_idx, np.int32) * n_sectors + np.asarray(sector_idx, np.int32)
+
+    if incentives is None:
+        z2 = jnp.zeros((n_pad, 2), dtype=jnp.float32)
+        incentives = IncentiveParams(
+            cbi_usd_p_w=z2, cbi_max_usd=z2, ibi_frac=z2, ibi_max_usd=z2,
+            pbi_usd_p_kwh=z2, pbi_years=jnp.zeros((n_pad, 2), dtype=jnp.int32),
+        )
+    else:
+        def pad2(a, dtype):
+            a = np.asarray(a)
+            out = np.zeros((n_pad, 2), dtype=dtype)
+            out[:n] = a
+            return jnp.asarray(out)
+
+        incentives = IncentiveParams(
+            cbi_usd_p_w=pad2(incentives.cbi_usd_p_w, np.float32),
+            cbi_max_usd=pad2(incentives.cbi_max_usd, np.float32),
+            ibi_frac=pad2(incentives.ibi_frac, np.float32),
+            ibi_max_usd=pad2(incentives.ibi_max_usd, np.float32),
+            pbi_usd_p_kwh=pad2(incentives.pbi_usd_p_kwh, np.float32),
+            pbi_years=pad2(incentives.pbi_years, np.int32),
+        )
+
+    return AgentTable(
+        agent_id=pad_i(np.arange(n)),
+        mask=jnp.asarray(mask),
+        state_idx=pad_i(state_idx),
+        sector_idx=pad_i(sector_idx),
+        group_idx=pad_i(group),
+        region_idx=pad_i(region_idx),
+        tariff_idx=pad_i(tariff_idx),
+        load_idx=pad_i(load_idx),
+        cf_idx=pad_i(cf_idx),
+        customers_in_bin=pad_f(customers_in_bin),
+        load_kwh_per_customer_in_bin=pad_f(load_kwh_per_customer_in_bin),
+        developable_frac=pad_f(developable_frac),
+        incentives=incentives,
+        n_states=n_states,
+    )

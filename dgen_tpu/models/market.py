@@ -1,0 +1,297 @@
+"""Market step: max-market-share, Bass diffusion, historical anchoring,
+and integer storage-attachment allocation — all as vectorized segment
+ops over the agent axis.
+
+Replaces (reference file:line):
+  * ``calc_max_market_share``            financial_functions.py:1264
+  * ``calc_diffusion_solar``             diffusion_functions_elec.py:24
+  * ``bass_diffusion`` / ``calc_equiv_time``  diffusion_functions_elec.py:323,343
+  * historical anchoring                 diffusion_functions_elec.py:99-133
+  * ``_allocate_battery_adopters_integer``  attachment_rate_functions.py:58
+
+The reference implements these as pandas merges and per-group Python
+loops; here every step is a dense gather / segment_sum / segment-aware
+sort so the whole market update jits as one device program. Agent group
+membership (state x sector) is a precomputed ``group_idx`` with a static
+group count, so state-level reductions are ``segment_sum``s (and under
+sharding, psums — see dgen_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.config import PAYBACK_GRID_N, PAYBACK_GRID_STEP
+
+
+# ---------------------------------------------------------------------------
+# Max market share
+# ---------------------------------------------------------------------------
+
+def max_market_share(
+    payback_period: jax.Array,
+    sector_idx: jax.Array,
+    mms_table: jax.Array,
+) -> jax.Array:
+    """Look up max market share from the payback curve.
+
+    ``mms_table``: [n_sectors, PAYBACK_GRID_N] tabulated on the 0.1-year
+    payback grid. The reference discretizes payback to an integer
+    factor (x100) and merges against its lookup table
+    (financial_functions.py:1290-1307); a gather is the dense analogue.
+    """
+    idx = jnp.clip(
+        jnp.round(payback_period / PAYBACK_GRID_STEP).astype(jnp.int32),
+        0,
+        PAYBACK_GRID_N - 1,
+    )
+    return mms_table[sector_idx, idx]
+
+
+# ---------------------------------------------------------------------------
+# Bass diffusion
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MarketState:
+    """Cross-year carry per agent (the reference's ``market_last_year``
+    handoff frame, diffusion_functions_elec.py:136-156)."""
+
+    market_share: jax.Array          # [N]
+    max_market_share: jax.Array      # [N]
+    adopters_cum: jax.Array          # [N]
+    market_value: jax.Array          # [N]
+    system_kw_cum: jax.Array         # [N]
+    batt_kw_cum: jax.Array           # [N]
+    batt_kwh_cum: jax.Array          # [N]
+    initial_adopters: jax.Array      # [N]
+    initial_market_share: jax.Array  # [N]
+
+    @staticmethod
+    def zeros(n: int) -> "MarketState":
+        z = jnp.zeros(n, dtype=jnp.float32)
+        return MarketState(z, z, z, z, z, z, z, z, z)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DiffusionOutputs:
+    """Per-agent per-year adoption results."""
+
+    market_share: jax.Array
+    new_market_share: jax.Array
+    new_adopters: jax.Array
+    new_system_kw: jax.Array
+    new_market_value: jax.Array
+    number_of_adopters: jax.Array
+    system_kw_cum: jax.Array
+    market_value: jax.Array
+
+
+def bass_new_adopt_fraction(p: jax.Array, q: jax.Array, teq2: jax.Array) -> jax.Array:
+    """Cumulative Bass adoption fraction at equivalent time ``teq2``
+    (reference diffusion_functions_elec.py:336-337)."""
+    f = jnp.exp(-(p + q) * teq2)
+    return (1.0 - f) / (1.0 + (q / p) * f)
+
+
+def equivalent_time(
+    market_share_last_year: jax.Array,
+    mms: jax.Array,
+    p: jax.Array,
+    q: jax.Array,
+) -> jax.Array:
+    """Invert the Bass curve to find where last year's share sits
+    (reference diffusion_functions_elec.py:343-372)."""
+    mms_fz = jnp.where(mms == 0.0, 1e-9, mms)
+    ratio = jnp.where(
+        market_share_last_year > mms_fz, 0.0, market_share_last_year / mms_fz
+    )
+    return jnp.log((1.0 - ratio) / (1.0 + ratio * (q / p))) / (-(p + q))
+
+
+def diffusion_step(
+    state: MarketState,
+    mms: jax.Array,
+    system_kw: jax.Array,
+    system_capex_per_kw: jax.Array,
+    developable_agent_weight: jax.Array,
+    bass_p: jax.Array,
+    bass_q: jax.Array,
+    teq_yr1: jax.Array,
+    is_first_year: bool,
+    year_step: float = 2.0,
+) -> DiffusionOutputs:
+    """One Bass-diffusion solve (reference
+    diffusion_functions_elec.py:24-96 ``calc_diffusion_solar``; battery
+    flows deferred to :func:`allocate_battery_adopters`)."""
+    msly = state.market_share
+    teq = equivalent_time(msly, mms, bass_p, bass_q)
+    teq2 = teq + (teq_yr1 if is_first_year else year_step)
+    new_adopt_fraction = bass_new_adopt_fraction(bass_p, bass_q, teq2)
+
+    bass_ms = mms * new_adopt_fraction
+    diffusion_ms = jnp.maximum(msly, bass_ms)
+    market_share = jnp.maximum(diffusion_ms, msly)
+    new_ms = market_share - msly
+    # zero the step where share already exceeds the (possibly shrunken)
+    # max market share (reference diffusion_functions_elec.py:77)
+    new_ms = jnp.where(market_share > mms, 0.0, new_ms)
+
+    new_adopters = new_ms * developable_agent_weight
+    new_system_kw = new_adopters * system_kw
+    new_market_value = new_adopters * system_kw * system_capex_per_kw
+
+    return DiffusionOutputs(
+        market_share=market_share,
+        new_market_share=new_ms,
+        new_adopters=new_adopters,
+        new_system_kw=new_system_kw,
+        new_market_value=new_market_value,
+        number_of_adopters=state.adopters_cum + new_adopters,
+        system_kw_cum=state.system_kw_cum + new_system_kw,
+        market_value=state.market_value + new_market_value,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Historical anchoring
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def anchor_to_observed(
+    system_kw_cum: jax.Array,
+    group_idx: jax.Array,
+    observed_group_kw: jax.Array,
+    sector_is_res: jax.Array,
+    developable_agent_weight: jax.Array,
+    n_groups: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Rescale modeled cumulative PV to observed deployment in anchor
+    years (reference diffusion_functions_elec.py:99-133).
+
+    Returns (system_kw_cum, number_of_adopters, market_share), all
+    recomputed from the observed state x sector totals. Adopter counts
+    use the reference's per-system heuristic (5 kW res / 100 kW non-res,
+    :126).
+    """
+    group_kw = jax.ops.segment_sum(system_kw_cum, group_idx, n_groups)
+    group_count = jax.ops.segment_sum(
+        jnp.ones_like(system_kw_cum), group_idx, n_groups
+    )
+    per_agent_group_kw = group_kw[group_idx]
+    per_agent_count = jnp.maximum(group_count[group_idx], 1.0)
+    scale = jnp.where(
+        per_agent_group_kw == 0.0,
+        1.0 / per_agent_count,
+        system_kw_cum / jnp.maximum(per_agent_group_kw, 1e-30),
+    )
+    anchored_kw = scale * observed_group_kw[group_idx]
+    adopters = jnp.where(sector_is_res, anchored_kw / 5.0, anchored_kw / 100.0)
+    share = jnp.where(
+        developable_agent_weight == 0.0,
+        0.0,
+        adopters / jnp.maximum(developable_agent_weight, 1e-30),
+    )
+    return anchored_kw, adopters, share
+
+
+# ---------------------------------------------------------------------------
+# Integer battery-adopter allocation (largest remainders, on device)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def allocate_battery_adopters(
+    new_adopters: jax.Array,
+    group_idx: jax.Array,
+    attachment_rate: jax.Array,
+    agent_order_key: jax.Array,
+    n_groups: int,
+) -> jax.Array:
+    """Largest-remainders integer allocation of battery adopters within
+    each state x sector group (reference
+    attachment_rate_functions.py:58-148).
+
+    ``attachment_rate``: [n_groups] in [0, 1].
+    ``agent_order_key``: [N] deterministic tiebreak (agent id), matching
+    the reference's sort on (fraction desc, agent_id asc).
+
+    Device-native formulation: instead of a per-group Python loop, one
+    global sort on the composite key (group, -frac, id) plus a
+    segment-rank gives each agent its within-group remainder rank; the
+    top ``remainder[g]`` ranks in each group win the extra unit.
+    """
+    n = new_adopters.shape[0]
+    r = jnp.clip(attachment_rate, 0.0, 1.0)[group_idx]
+
+    f = r * jnp.maximum(new_adopters, 0.0)
+    base = jnp.floor(f)
+    frac = f - base
+
+    group_target = jnp.round(
+        jax.ops.segment_sum(f, group_idx, n_groups)
+    )
+    group_base = jax.ops.segment_sum(base, group_idx, n_groups)
+    remainder = jnp.maximum(group_target - group_base, 0.0)  # [G]
+
+    # sort agents by (group asc, frac desc, id asc)
+    order = jnp.lexsort((agent_order_key, -frac, group_idx))
+    sorted_group = group_idx[order]
+    # rank within group: position minus the group's first position
+    pos = jnp.arange(n)
+    group_start = jax.ops.segment_min(pos, sorted_group, n_groups)
+    rank_in_group = pos - group_start[sorted_group]
+    wins_sorted = rank_in_group < remainder[sorted_group]
+    wins = jnp.zeros(n, dtype=jnp.float32).at[order].set(
+        wins_sorted.astype(jnp.float32)
+    )
+    return base + wins
+
+
+# ---------------------------------------------------------------------------
+# Initial market shares (first model year)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_groups",))
+def initial_market_shares(
+    starting_group_kw: jax.Array,
+    starting_group_batt_kw: jax.Array,
+    starting_group_batt_kwh: jax.Array,
+    group_idx: jax.Array,
+    developable_agent_weight: jax.Array,
+    system_kw: jax.Array,
+    n_groups: int,
+) -> MarketState:
+    """Apportion state x sector starting capacity to agents by
+    developable weight (reference agent_mutation/elec.py:701
+    ``estimate_initial_market_shares``)."""
+    group_weight = jax.ops.segment_sum(
+        developable_agent_weight, group_idx, n_groups
+    )
+    w_frac = developable_agent_weight / jnp.maximum(group_weight[group_idx], 1e-30)
+
+    kw_cum = w_frac * starting_group_kw[group_idx]
+    batt_kw_cum = w_frac * starting_group_batt_kw[group_idx]
+    batt_kwh_cum = w_frac * starting_group_batt_kwh[group_idx]
+    adopters = kw_cum / jnp.maximum(system_kw, 1e-9)
+    share = jnp.where(
+        developable_agent_weight == 0.0,
+        0.0,
+        jnp.clip(adopters / jnp.maximum(developable_agent_weight, 1e-30), 0.0, 1.0),
+    )
+    return MarketState(
+        market_share=share,
+        max_market_share=share,
+        adopters_cum=adopters,
+        market_value=jnp.zeros_like(share),
+        system_kw_cum=kw_cum,
+        batt_kw_cum=batt_kw_cum,
+        batt_kwh_cum=batt_kwh_cum,
+        initial_adopters=adopters,
+        initial_market_share=share,
+    )

@@ -1,0 +1,227 @@
+"""Scenario inputs: every year-dependent trajectory and market parameter
+as small dense arrays, gathered per agent per year.
+
+Replaces the reference's per-year pandas merges (the 13 ``on_frame``
+mutations at dgen_model.py:252-292 backed by agent_mutation/elec.py) and
+the Excel-workbook -> Postgres input plumbing (SURVEY.md §2.5). A
+trajectory keyed (year, sector) in the reference becomes a
+``[n_years, n_sectors]`` array here; applying it to agents is one gather
+on ``(year_idx, sector_idx)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.config import PAYBACK_GRID_N, ScenarioConfig
+from dgen_tpu.models.agents import AgentTable
+from dgen_tpu.ops.cashflow import FinanceParams
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ScenarioInputs:
+    """All year-dependent model inputs. Axes: Y = model years,
+    S = sectors (res/com/ind), G = state x sector groups, R = regions
+    (census divisions / balancing areas), K = anchor years.
+    """
+
+    # --- technology & price trajectories (reference input_data/*) ---
+    pv_capex_per_kw: jax.Array            # [Y, S] (pv_prices)
+    pv_om_per_kw: jax.Array               # [Y, S]
+    pv_degradation: jax.Array             # [Y, S] (pv_tech_performance)
+    batt_capex_per_kwh: jax.Array         # [Y, S] (batt_prices)
+    batt_capex_per_kw: jax.Array          # [Y, S]
+    pv_capex_per_kw_combined: jax.Array   # [Y, S] (pv_plus_batt_prices)
+    batt_capex_per_kwh_combined: jax.Array  # [Y, S]
+    load_growth: jax.Array                # [Y, R, S] multiplier vs base year
+    elec_price_multiplier: jax.Array      # [Y, R, S] retail price vs base year
+    elec_price_escalator: jax.Array       # [Y, R, S] forward CAGR (clipped ±1%/yr)
+    # --- financing (financing_terms + itc schedule) ---
+    loan_term_yrs: jax.Array              # [Y, S] int32
+    loan_interest_rate: jax.Array         # [Y, S]
+    down_payment_fraction: jax.Array      # [Y, S]
+    real_discount_rate: jax.Array         # [Y, S]
+    tax_rate: jax.Array                   # [Y, S]
+    itc_fraction: jax.Array               # [Y, S]
+    # --- market ---
+    bass_p: jax.Array                     # [G]
+    bass_q: jax.Array                     # [G]
+    teq_yr1: jax.Array                    # [G]
+    mms_table: jax.Array                  # [S, PAYBACK_GRID_N]
+    attachment_rate: jax.Array            # [G] storage attachment in [0,1]
+    starting_kw: jax.Array                # [G] base-year installed PV kW
+    starting_batt_kw: jax.Array           # [G]
+    starting_batt_kwh: jax.Array          # [G]
+    # --- historical anchoring (diffusion_functions_elec.py:99) ---
+    anchor_years_mask: jax.Array          # [Y] 1.0 where year is an anchor year
+    observed_kw: jax.Array                # [Y, G] observed cumulative PV kW
+    # --- misc ---
+    value_of_resiliency: jax.Array        # [Y, S] $ per agent
+    cap_cost_multiplier: jax.Array        # [Y, S]
+    inflation: jax.Array                  # scalar
+
+    @property
+    def n_years(self) -> int:
+        return self.pv_capex_per_kw.shape[0]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class YearAgentInputs:
+    """Per-agent values for ONE model year (the result of applying all
+    trajectories — the dense analogue of the reference's 13 on_frame
+    mutations for the year)."""
+
+    load_kwh_per_customer: jax.Array
+    customers_in_bin: jax.Array
+    developable_agent_weight: jax.Array
+    elec_price_multiplier: jax.Array
+    elec_price_escalator: jax.Array
+    pv_degradation: jax.Array
+    system_capex_per_kw: jax.Array
+    system_capex_per_kw_combined: jax.Array
+    batt_capex_per_kwh_combined: jax.Array
+    cap_cost_multiplier: jax.Array
+    value_of_resiliency: jax.Array
+    fin: FinanceParams
+
+
+def apply_year(
+    table: AgentTable, inputs: ScenarioInputs, year_idx: jax.Array
+) -> YearAgentInputs:
+    """Gather all year-y trajectory values onto the agent axis.
+
+    Load growth follows the reference's sector split
+    (agent_mutation/elec.py:396-406): residential growth scales kWh per
+    customer; commercial/industrial growth scales customer count.
+    """
+    s = table.sector_idx
+    r = table.region_idx
+
+    growth = inputs.load_growth[year_idx, r, s]
+    is_res = (s == 0).astype(jnp.float32)
+    load_kwh = table.load_kwh_per_customer_in_bin * jnp.where(is_res > 0, growth, 1.0)
+    customers = table.customers_in_bin * jnp.where(is_res > 0, 1.0, growth)
+
+    fin = FinanceParams(
+        down_payment_fraction=inputs.down_payment_fraction[year_idx, s],
+        loan_interest_rate=inputs.loan_interest_rate[year_idx, s],
+        loan_term_yrs=inputs.loan_term_yrs[year_idx, s],
+        real_discount_rate=inputs.real_discount_rate[year_idx, s],
+        inflation_rate=jnp.broadcast_to(inputs.inflation, s.shape),
+        tax_rate=inputs.tax_rate[year_idx, s],
+        itc_fraction=inputs.itc_fraction[year_idx, s],
+        is_commercial=(s != 0).astype(jnp.float32),
+        om_per_year=jnp.zeros_like(load_kwh),  # reference zeroes O&M in the hot loop
+    )
+
+    return YearAgentInputs(
+        load_kwh_per_customer=load_kwh,
+        customers_in_bin=customers,
+        developable_agent_weight=table.developable_agent_weight(customers),
+        elec_price_multiplier=inputs.elec_price_multiplier[year_idx, r, s],
+        elec_price_escalator=inputs.elec_price_escalator[year_idx, r, s],
+        pv_degradation=inputs.pv_degradation[year_idx, s],
+        system_capex_per_kw=inputs.pv_capex_per_kw[year_idx, s],
+        system_capex_per_kw_combined=inputs.pv_capex_per_kw_combined[year_idx, s],
+        batt_capex_per_kwh_combined=inputs.batt_capex_per_kwh_combined[year_idx, s],
+        cap_cost_multiplier=inputs.cap_cost_multiplier[year_idx, s],
+        value_of_resiliency=inputs.value_of_resiliency[year_idx, s],
+        fin=fin,
+    )
+
+
+def escalator_from_multipliers(mult: np.ndarray, years: np.ndarray,
+                               horizon: int = 30, clip: float = 0.01) -> np.ndarray:
+    """Forward CAGR of the retail price multiplier over the analysis
+    horizon, clipped to ±1%/yr (reference agent_mutation/elec.py:29-89
+    ``apply_elec_price_multiplier_and_escalator``).
+
+    ``mult``: [Y, ...] multiplier trajectory on the model-year grid.
+    """
+    y_count = mult.shape[0]
+    out = np.zeros_like(mult)
+    for i in range(y_count):
+        j = min(y_count - 1, i + max(1, horizon // max(1, int(years[1] - years[0]) if y_count > 1 else 1)))
+        span_years = max(float(years[j] - years[i]), 1.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            cagr = (mult[j] / np.maximum(mult[i], 1e-9)) ** (1.0 / span_years) - 1.0
+        out[i] = np.clip(np.nan_to_num(cagr), -clip, clip)
+    return out
+
+
+def uniform_inputs(
+    config: ScenarioConfig,
+    n_groups: int,
+    n_regions: int,
+    overrides: Dict[str, object] | None = None,
+) -> ScenarioInputs:
+    """Build flat/constant scenario inputs (testing + synthetic runs).
+
+    Values default to the reference's shipped mid-case trajectories'
+    rough magnitudes; every field can be overridden.
+    """
+    years = np.asarray(config.model_years)
+    Y, S, G, R = len(years), len(config.sectors), n_groups, n_regions
+    f = np.float32
+
+    def yz(v):
+        return jnp.full((Y, S), v, dtype=f)
+
+    # simple declining capex trajectory (ATB-like shape)
+    decline = np.linspace(1.0, 0.45, Y, dtype=f)[:, None]
+    pv_capex = jnp.asarray(3000.0 * decline * np.ones((1, S), f))
+    batt_capex_kwh = jnp.asarray(900.0 * decline * np.ones((1, S), f))
+
+    # Max-market-share curve: smooth decay in payback (res faster than
+    # com/ind), tabulated on the 0.1yr grid — same shape family as the
+    # reference's NEMS-derived curves.
+    pb = np.arange(PAYBACK_GRID_N, dtype=f) * 0.1
+    curves = []
+    for s_i in range(S):
+        halflife = 4.0 if s_i == 0 else 6.0
+        curves.append(np.exp(-pb / halflife))
+    mms = jnp.asarray(np.stack(curves))
+
+    anchor_mask = np.isin(years, np.asarray(config.anchor_years)).astype(f)
+
+    vals = dict(
+        pv_capex_per_kw=pv_capex,
+        pv_om_per_kw=yz(15.0),
+        pv_degradation=yz(0.005),
+        batt_capex_per_kwh=batt_capex_kwh,
+        batt_capex_per_kw=yz(1000.0),
+        pv_capex_per_kw_combined=pv_capex * 1.05,
+        batt_capex_per_kwh_combined=batt_capex_kwh * 0.95,
+        load_growth=jnp.ones((Y, R, S), dtype=f),
+        elec_price_multiplier=jnp.ones((Y, R, S), dtype=f),
+        elec_price_escalator=jnp.zeros((Y, R, S), dtype=f),
+        loan_term_yrs=jnp.full((Y, S), 20, dtype=jnp.int32),
+        loan_interest_rate=yz(0.05),
+        down_payment_fraction=yz(1.0),
+        real_discount_rate=yz(0.027),
+        tax_rate=yz(0.257),
+        itc_fraction=yz(0.30),
+        bass_p=jnp.full(G, 0.0015, dtype=f),
+        bass_q=jnp.full(G, 0.35, dtype=f),
+        teq_yr1=jnp.full(G, 2.0, dtype=f),
+        mms_table=mms,
+        attachment_rate=jnp.zeros(G, dtype=f),
+        starting_kw=jnp.zeros(G, dtype=f),
+        starting_batt_kw=jnp.zeros(G, dtype=f),
+        starting_batt_kwh=jnp.zeros(G, dtype=f),
+        anchor_years_mask=jnp.asarray(anchor_mask),
+        observed_kw=jnp.zeros((Y, G), dtype=f),
+        value_of_resiliency=yz(0.0),
+        cap_cost_multiplier=yz(1.0),
+        inflation=jnp.asarray(config.annual_inflation, dtype=f),
+    )
+    if overrides:
+        vals.update(overrides)
+    return ScenarioInputs(**vals)

@@ -1,0 +1,9 @@
+"""Device-side compute kernels: tariff compilation, bill engine, battery
+dispatch, multi-year cashflow, and the NPV-optimal sizing search.
+
+These replace the reference's native PySAM/SSC C++ simulation core
+(reference financial_functions.py:26-32) with fused, vmappable JAX
+kernels (SURVEY.md §2.7).
+"""
+
+from dgen_tpu.ops import bill, cashflow, dispatch, sizing, tariff  # noqa: F401

@@ -1,0 +1,200 @@
+"""Utility-bill engine: the TPU replacement for PySAM ``Utilityrate5``.
+
+The reference evaluates every sizing-objective call by running the SSC
+C++ rate engine over an 8760 load/generation pair, one agent at a time
+(reference financial_functions.py:270 ``utilityrate.execute()``). Here
+the bill is a pure JAX function of dense arrays, vmappable over the
+whole agent table and differentiable-by-construction.
+
+Scope = exactly the subset the reference exercises (SURVEY.md §7):
+  * TOU energy charges with monthly tier accumulation, 12x24 schedules.
+  * Monthly fixed charges.
+  * Net metering (monthly netting at retail, signed monthly-period sums,
+    negative sums credited at the period's tier-1 price — semantics of
+    the reference's in-repo oracle ``bill_calculator``
+    tariff_functions.py:701 with ``full_retail_nem=True``, generalized to
+    correct multi-tier accumulation as in SSC).
+  * Net billing: imports billed on the TOU/tier structure, exports
+    credited hourly at either a time-series sell rate (wholesale price x
+    retail multiplier, reference financial_functions.py:182) or a TOU
+    sell price (the CA NEM3 0.25 x buy rule, financial_functions.py:186).
+  * Demand charges are intentionally absent: the reference globally skips
+    them (``SKIP_DEMAND_CHARGES=True``, financial_functions.py:35).
+
+TPU notes: the hour->month reduction is expressed as a masked matmul
+against a static [8760, 12] month one-hot so it rides the MXU instead of
+lowering to scatter-adds; the TOU-period loop is a static unrolled loop
+over the (small) padded period count.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.ops.tariff import (
+    HOURS,
+    MONTHS,
+    NET_BILLING,
+    TariffBank,
+    hour_month_map,
+)
+
+# Static [8760, 12] month one-hot, shared by every bill evaluation.
+_MONTH_ONEHOT = jnp.asarray(
+    np.eye(MONTHS, dtype=np.float32)[hour_month_map()]
+)
+
+
+class AgentTariff(NamedTuple):
+    """One agent's tariff slice, gathered from a :class:`TariffBank`."""
+
+    price: jax.Array        # [P, T]
+    tier_cap: jax.Array     # [T]
+    sell_price: jax.Array   # [P]
+    hour_period: jax.Array  # [8760] int32
+    fixed_monthly: jax.Array  # scalar
+    metering: jax.Array     # scalar int32
+
+
+def gather_tariff(bank: TariffBank, tariff_idx: jax.Array) -> AgentTariff:
+    """Index the bank for one agent (vmap over ``tariff_idx`` for many)."""
+    return AgentTariff(
+        price=bank.price[tariff_idx],
+        tier_cap=bank.tier_cap[tariff_idx],
+        sell_price=bank.sell_price[tariff_idx],
+        hour_period=bank.hour_period[tariff_idx],
+        fixed_monthly=bank.fixed_monthly[tariff_idx],
+        metering=bank.metering[tariff_idx],
+    )
+
+
+def monthly_period_sums(x: jax.Array, hour_period: jax.Array, n_periods: int) -> jax.Array:
+    """Sum an [8760] series into [12, P] month x TOU-period buckets.
+
+    Expressed as P masked [8760]x[8760,12] matmuls (MXU-friendly) rather
+    than a scatter-add segment sum.
+    """
+    per_period = []
+    for p in range(n_periods):
+        mask = (hour_period == p).astype(x.dtype)
+        per_period.append((x * mask) @ _MONTH_ONEHOT)  # [12]
+    return jnp.stack(per_period, axis=-1)  # [12, P]
+
+
+def tiered_charge(sums: jax.Array, price: jax.Array, tier_cap: jax.Array) -> jax.Array:
+    """Proper cumulative tiered energy charge.
+
+    ``sums``: [12, P] monthly energy per period (kWh, may be negative
+    under net metering). Positive energy is charged tier by tier against
+    the monthly caps; negative energy is credited at the period's tier-1
+    price (oracle semantics, reference tariff_functions.py:687).
+    Returns [12] monthly charges.
+    """
+    lower = jnp.concatenate([jnp.zeros_like(tier_cap[:1]), tier_cap[:-1]])  # [T]
+    width = tier_cap - lower
+    # [12, P, T]: energy falling inside each tier
+    seg = jnp.clip(sums[..., None] - lower, 0.0, width)
+    pos = jnp.einsum("mpt,pt->m", seg, price)
+    neg = jnp.einsum("mp,p->m", jnp.minimum(sums, 0.0), price[:, 0])
+    return pos + neg
+
+
+@partial(jax.jit, static_argnames=("n_periods",))
+def annual_bill(
+    net_load: jax.Array,
+    tariff: AgentTariff,
+    ts_sell: jax.Array,
+    n_periods: int,
+) -> jax.Array:
+    """Annual bill for one agent given a signed hourly net grid load.
+
+    ``net_load`` [8760]: load - system output at the meter (kW ~= kWh/h);
+    positive = import, negative = export.
+    ``ts_sell`` [8760]: time-series sell rate $/kWh used under net
+    billing when the tariff's TOU ``sell_price`` is all-zero.
+
+    Both metering styles are evaluated and selected per agent (the
+    metering option is data, not structure, so agents with different
+    compensation styles batch together under vmap).
+    """
+    hp = tariff.hour_period
+
+    # --- Net metering: signed monthly netting at retail ---
+    sums_signed = monthly_period_sums(net_load, hp, n_periods)
+    bill_nem = jnp.sum(tiered_charge(sums_signed, tariff.price, tariff.tier_cap))
+
+    # --- Net billing: imports billed, exports credited at sell rate ---
+    imports = jnp.maximum(net_load, 0.0)
+    exports = jnp.maximum(-net_load, 0.0)
+    sums_imp = monthly_period_sums(imports, hp, n_periods)
+    import_charges = jnp.sum(tiered_charge(sums_imp, tariff.price, tariff.tier_cap))
+    # Hourly sell rate: TOU sell if the tariff defines one, else the TS rate.
+    tou_sell_hourly = tariff.sell_price[hp]
+    has_tou_sell = jnp.any(tariff.sell_price > 0.0)
+    sell_hourly = jnp.where(has_tou_sell, tou_sell_hourly, ts_sell)
+    export_credit = jnp.sum(exports * sell_hourly)
+    bill_nb = import_charges - export_credit
+
+    energy_bill = jnp.where(tariff.metering == NET_BILLING, bill_nb, bill_nem)
+    return energy_bill + MONTHS * tariff.fixed_monthly
+
+
+def escalation_factors(n_years: int, inflation: jax.Array, escalation: jax.Array) -> jax.Array:
+    """[Y] nominal price factor per analysis year (year 1 = 1.0).
+
+    Utilityrate5 compounds inflation and the real rate escalation into
+    nominal retail prices (reference feeds ``rate_escalation`` and
+    ``inflation_rate`` separately, financial_functions.py:364-368).
+    """
+    y = jnp.arange(n_years, dtype=jnp.float32)
+    return ((1.0 + inflation) * (1.0 + escalation)) ** y
+
+
+def degradation_factors(n_years: int, degradation: jax.Array) -> jax.Array:
+    """[Y] PV output factor per analysis year (year 1 = 1.0)."""
+    y = jnp.arange(n_years, dtype=jnp.float32)
+    return (1.0 - degradation) ** y
+
+
+@partial(jax.jit, static_argnames=("n_periods", "n_years"))
+def bill_series(
+    load: jax.Array,
+    system_out: jax.Array,
+    tariff: AgentTariff,
+    ts_sell: jax.Array,
+    inflation: jax.Array,
+    escalation: jax.Array,
+    degradation: jax.Array,
+    n_periods: int,
+    n_years: int,
+) -> tuple[jax.Array, jax.Array]:
+    """(bills_with_sys [Y], bills_without_sys [Y]) in nominal dollars.
+
+    Replaces the reference's 25-pass SSC rate engine: PV output degrades
+    compounding annually, retail prices escalate nominally, load is held
+    constant across the analysis period (Utilityrate5 semantics with
+    ``system_use_lifetime_output=0``, reference
+    financial_functions.py:366).
+
+    The no-system bill is computed once and scaled by the price factor
+    (its net load never changes); the with-system bill re-evaluates the
+    import/export split every year because degradation shifts it
+    nonlinearly.
+    """
+    pf = escalation_factors(n_years, inflation, escalation)     # [Y]
+    df = degradation_factors(n_years, degradation)              # [Y]
+
+    bill_wo_y1 = annual_bill(load, tariff, ts_sell, n_periods)
+    bills_wo = bill_wo_y1 * pf
+
+    def year_bill(deg_f):
+        net = load - system_out * deg_f
+        return annual_bill(net, tariff, ts_sell, n_periods)
+
+    bills_w = jax.vmap(year_bill)(df) * pf
+    return bills_w, bills_wo

@@ -1,0 +1,270 @@
+"""Multi-year levered cashflow -> NPV / payback: the TPU replacement for
+PySAM ``Cashloan`` (reference financial_functions.py:287 ``loan.execute()``).
+
+Scope is the subset dGen exercises (SURVEY.md §2.7): host-owned systems,
+loan-or-cash purchase, federal ITC, fed+state income tax with MACRS-5
+depreciation for non-residential agents (reference
+financial_functions.py:416-421), state CBI/PBI/IBI incentives (reference
+financial_functions.py:1014 ``process_incentives``), and the
+bill-savings "energy value" stream produced by the bill engine. O&M is
+carried as an explicit parameter but the reference zeroes it in the hot
+loop (financial_functions.py:124-127,202).
+
+All functions are scalar-agent kernels meant to be ``jax.vmap``-ed over
+the agent axis; year axes are static-shaped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.config import PAYBACK_NEVER
+
+# MACRS 5-year half-year-convention schedule (what SAM's depr type 2
+# applies for commercial systems).
+MACRS_5 = jnp.array([0.20, 0.32, 0.192, 0.1152, 0.1152, 0.0576], dtype=jnp.float32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FinanceParams:
+    """Per-agent financing terms (reference financial_functions.py:385-394).
+
+    All leaves are scalars for a single agent; vmap for the table.
+    ``tax_rate`` is split 70/30 federal/state exactly as the reference
+    does (financial_functions.py:387,393).
+    """
+
+    down_payment_fraction: jax.Array
+    loan_interest_rate: jax.Array
+    loan_term_yrs: jax.Array        # int32
+    real_discount_rate: jax.Array
+    inflation_rate: jax.Array
+    tax_rate: jax.Array
+    itc_fraction: jax.Array
+    #: 1.0 for non-res agents -> MACRS-5 depreciation + deductible
+    #: interest (business expense); 0.0 for res.
+    is_commercial: jax.Array
+    #: annual O&M $ (year-1 dollars, inflates)
+    om_per_year: jax.Array
+
+    @staticmethod
+    def example() -> "FinanceParams":
+        f32 = jnp.float32
+        return FinanceParams(
+            down_payment_fraction=f32(1.0),
+            loan_interest_rate=f32(0.05),
+            loan_term_yrs=jnp.int32(20),
+            real_discount_rate=f32(0.027),
+            inflation_rate=f32(0.025),
+            tax_rate=f32(0.257),
+            itc_fraction=f32(0.30),
+            is_commercial=f32(0.0),
+            om_per_year=f32(0.0),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class IncentiveParams:
+    """Compiled state incentives for one agent.
+
+    The reference nests a per-(state, sector) DataFrame of incentive rows
+    into each agent cell (agent_mutation/elec.py:685-694) and re-sorts it
+    per sizing call (financial_functions.py:1014). Here incentives are
+    compiled at ingest to fixed-width scalars: the top-2 CBI/IBI/PBI rows
+    by value, exactly the number ``process_incentives`` consumes.
+    """
+
+    cbi_usd_p_w: jax.Array      # [2] $/W capacity-based
+    cbi_max_usd: jax.Array      # [2]
+    ibi_frac: jax.Array         # [2] fraction of installed cost
+    ibi_max_usd: jax.Array      # [2]
+    pbi_usd_p_kwh: jax.Array    # [2] $/kWh production-based
+    pbi_years: jax.Array        # [2] int32 duration
+
+    @staticmethod
+    def zeros() -> "IncentiveParams":
+        z2 = jnp.zeros(2, dtype=jnp.float32)
+        return IncentiveParams(
+            cbi_usd_p_w=z2, cbi_max_usd=z2, ibi_frac=z2, ibi_max_usd=z2,
+            pbi_usd_p_kwh=z2, pbi_years=jnp.zeros(2, dtype=jnp.int32),
+        )
+
+
+def nominal_discount_rate(real: jax.Array, inflation: jax.Array) -> jax.Array:
+    return (1.0 + real) * (1.0 + inflation) - 1.0
+
+
+def loan_schedule(principal: jax.Array, rate: jax.Array, term: jax.Array,
+                  n_years: int) -> tuple[jax.Array, jax.Array]:
+    """(payment [Y], interest [Y]) of a level-payment amortizing loan.
+
+    Payments run for ``term`` years then stop; ``n_years`` is the static
+    analysis horizon.
+    """
+    term_f = term.astype(jnp.float32)
+    # level payment; guard rate ~ 0
+    r = rate
+    annuity = jnp.where(
+        r > 1e-9,
+        r / (1.0 - (1.0 + r) ** (-term_f)),
+        1.0 / jnp.maximum(term_f, 1.0),
+    )
+    pmt = principal * annuity
+
+    def step(balance, y):
+        active = (y < term).astype(jnp.float32)
+        interest = balance * r * active
+        principal_paid = (pmt - interest) * active
+        new_balance = balance - principal_paid
+        return new_balance, (pmt * active, interest)
+
+    _, (payments, interests) = jax.lax.scan(
+        step, principal, jnp.arange(n_years, dtype=jnp.int32)
+    )
+    return payments, interests
+
+
+def incentive_cashflows(
+    inc: IncentiveParams,
+    system_kw: jax.Array,
+    installed_cost: jax.Array,
+    annual_kwh: jax.Array,
+    degradation: jax.Array,
+    n_years: int,
+) -> tuple[jax.Array, jax.Array]:
+    """(upfront $, pbi stream [Y]) from compiled state incentives.
+
+    CBI: $/W x kW x 1000, clamped to its max (reference
+    financial_functions.py:1317 ``check_incentive_constraints``).
+    IBI: fraction x installed cost, clamped. PBI: $/kWh x degraded
+    production for the row's duration.
+    """
+    cbi = jnp.sum(jnp.minimum(inc.cbi_usd_p_w * system_kw * 1000.0, inc.cbi_max_usd))
+    ibi = jnp.sum(jnp.minimum(inc.ibi_frac * installed_cost, inc.ibi_max_usd))
+
+    y = jnp.arange(n_years, dtype=jnp.float32)
+    prod = annual_kwh * (1.0 - degradation) ** y                       # [Y]
+    active = (y[None, :] < inc.pbi_years[:, None].astype(jnp.float32))  # [2, Y]
+    pbi = jnp.sum(inc.pbi_usd_p_kwh[:, None] * prod[None, :] * active, axis=0)
+    return cbi + ibi, pbi
+
+
+@partial(jax.jit, static_argnames=("n_years",))
+def cashflow(
+    energy_value: jax.Array,
+    installed_cost: jax.Array,
+    fin: FinanceParams,
+    n_years: int,
+    system_kw: jax.Array = None,
+    annual_kwh: jax.Array = None,
+    degradation: jax.Array = None,
+    inc: IncentiveParams = None,
+) -> dict:
+    """After-tax levered cashflow for one agent.
+
+    Inputs: ``energy_value`` [Y] nominal bill savings (bill engine),
+    ``installed_cost`` total upfront $ (already including the
+    cap-cost multiplier and any one-time interconnection charge,
+    reference financial_functions.py:280-282).
+
+    Returns dict with ``cf`` [Y+1] (year 0 = -equity), ``npv`` (nominal
+    discounting, matching Cashloan's ``Outputs.npv``), and the
+    tax/loan components for inspection.
+    """
+    f32 = jnp.float32
+    zero = jnp.zeros((), dtype=f32)
+    system_kw = zero if system_kw is None else system_kw
+    annual_kwh = zero if annual_kwh is None else annual_kwh
+    degradation = zero if degradation is None else degradation
+    inc = IncentiveParams.zeros() if inc is None else inc
+
+    down = installed_cost * fin.down_payment_fraction
+    principal = installed_cost - down
+    payments, interests = loan_schedule(
+        principal, fin.loan_interest_rate, fin.loan_term_yrs, n_years
+    )
+
+    fed_rate = fin.tax_rate * 0.7
+    sta_rate = fin.tax_rate * 0.3
+    # combined marginal rate with state tax deductible from federal
+    tax_eff = fed_rate + sta_rate - fed_rate * sta_rate
+
+    # Federal ITC, credited in year 1 (reference financial_functions.py:285).
+    itc = fin.itc_fraction * installed_cost
+    year1 = (jnp.arange(n_years) == 0).astype(f32)
+
+    # MACRS-5 depreciation for commercial, basis reduced by half the ITC
+    # (SAM convention for depr type 2).
+    basis = installed_cost * (1.0 - 0.5 * fin.itc_fraction)
+    depr = jnp.zeros(n_years, dtype=f32).at[: MACRS_5.shape[0]].set(
+        MACRS_5[: min(MACRS_5.shape[0], n_years)] * basis
+    )
+    depr_savings = depr * tax_eff * fin.is_commercial
+    interest_savings = interests * tax_eff * fin.is_commercial
+
+    upfront_inc, pbi = incentive_cashflows(
+        inc, system_kw, installed_cost, annual_kwh, degradation, n_years
+    )
+
+    y = jnp.arange(n_years, dtype=f32)
+    om = fin.om_per_year * (1.0 + fin.inflation_rate) ** y
+
+    cf_years = (
+        energy_value
+        - payments
+        - om
+        + interest_savings
+        + depr_savings
+        + itc * year1
+        + upfront_inc * year1
+        + pbi
+    )
+    cf0 = -down
+    cf = jnp.concatenate([cf0[None], cf_years])
+
+    dnom = nominal_discount_rate(fin.real_discount_rate, fin.inflation_rate)
+    disc = (1.0 + dnom) ** (-jnp.arange(n_years + 1, dtype=f32))
+    npv = jnp.sum(cf * disc)
+
+    return {
+        "cf": cf,
+        "npv": npv,
+        "payments": payments,
+        "interest": interests,
+        "itc": itc,
+        "depreciation": depr * fin.is_commercial,
+    }
+
+
+def payback_period(cf: jax.Array) -> jax.Array:
+    """Fractional payback year from a [Y+1] cashflow (year 0 = equity).
+
+    Semantics match the reference's vectorized implementation
+    (financial_functions.py:1241 ``calc_payback_vectorized``): first year
+    the cumulative cashflow turns positive, linearly interpolated within
+    that year; ``PAYBACK_NEVER`` (30.1) if it never does; 0 if the
+    cumulative flow is positive from year 0; rounded to 0.1.
+    """
+    cum = jnp.cumsum(cf)
+    n = cf.shape[0] - 1  # tech lifetime
+    years = jnp.arange(n, dtype=jnp.float32)
+
+    no_payback = jnp.logical_or(cum[-1] <= 0.0, jnp.all(cum <= 0.0))
+    instant = jnp.all(cum > 0.0)
+
+    crossed = jnp.diff(jnp.sign(cum)) > 0          # [n]
+    base_year = jnp.max(jnp.where(crossed, years, -1.0))
+    base_year = jnp.where(base_year == -1.0, n - 1.0, base_year)
+    bi = base_year.astype(jnp.int32)
+    base_val = cum[bi]
+    next_val = cum[bi + 1]
+    frac = base_val / (base_val - next_val + 1e-9)
+    pp = base_year + frac
+    pp = jnp.where(no_payback, PAYBACK_NEVER, jnp.where(instant, 0.0, pp))
+    return jnp.round(pp * 10.0) / 10.0

@@ -1,0 +1,272 @@
+"""NPV-optimal PV sizing search + the fused per-agent economics kernel.
+
+This is the hot loop of the whole framework: the reference sizes each
+agent with ``scipy.optimize.minimize_scalar(bounded)`` over PV kW, each
+objective call running three PySAM C++ modules (reference
+financial_functions.py:440-447, SURVEY.md §3.2), one agent at a time in
+a process pool. Here the objective — bill engine + cashflow (+ battery
+dispatch for the forward run) — is a pure JAX function and the optimizer
+is a fixed-iteration golden-section search, so the entire agent table
+sizes as ONE vmapped kernel on device.
+
+Fixed-iteration golden section vs the reference's adaptive Brent-style
+search: 14 iterations shrink the bracket by phi^-14 ~ 1.2e-3 of its
+width, comfortably inside the reference's ``xatol = max(2 kW,
+(hi-lo)*1e-3)`` tolerance (financial_functions.py:444), with a
+compile-time-static trip count (no data-dependent control flow under
+jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.ops import bill as bill_ops
+from dgen_tpu.ops import cashflow as cf_ops
+from dgen_tpu.ops import dispatch as dispatch_ops
+from dgen_tpu.ops.bill import AgentTariff
+
+INV_EFF = 0.96  # inverter efficiency (reference financial_functions.py:113)
+GOLDEN = 0.6180339887498949  # 1/phi
+
+# Sizing bracket relative to the load-implied max system size
+# (reference financial_functions.py:440-443).
+SIZE_LO_FRAC = 0.8
+SIZE_HI_FRAC = 1.25
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class AgentEconInputs:
+    """Everything one agent's economics evaluation needs, as dense leaves.
+
+    Built by the year step from the agent table + banks; vmap over the
+    leading axis for the whole population.
+    """
+
+    load: jax.Array            # [8760] hourly consumption (kWh/h)
+    gen_per_kw: jax.Array      # [8760] PV DC output per kW_dc
+    ts_sell: jax.Array         # [8760] $/kWh time-series sell rate
+    tariff: AgentTariff
+    fin: cf_ops.FinanceParams
+    inc: cf_ops.IncentiveParams
+    load_kwh_per_customer: jax.Array
+    elec_price_escalator: jax.Array
+    pv_degradation: jax.Array
+    system_capex_per_kw: jax.Array
+    system_capex_per_kw_combined: jax.Array
+    batt_capex_per_kwh_combined: jax.Array
+    cap_cost_multiplier: jax.Array
+    value_of_resiliency_usd: jax.Array
+    one_time_charge: jax.Array
+
+
+def _npv_given_system_out(
+    env: AgentEconInputs,
+    system_kw: jax.Array,
+    system_out: jax.Array,
+    installed_cost: jax.Array,
+    vor: jax.Array,
+    n_periods: int,
+    n_years: int,
+):
+    """Shared tail of the objective: bills -> energy value -> cashflow."""
+    bills_w, bills_wo = bill_ops.bill_series(
+        env.load, system_out, env.tariff, env.ts_sell,
+        env.fin.inflation_rate, env.elec_price_escalator, env.pv_degradation,
+        n_periods=n_periods, n_years=n_years,
+    )
+    # Value of resiliency is added to every year's energy value for the
+    # with-battery case (reference financial_functions.py:220,274-275).
+    energy_value = (bills_wo - bills_w) + vor
+    annual_kwh = jnp.sum(system_out)
+    out = cf_ops.cashflow(
+        energy_value, installed_cost, env.fin, n_years,
+        system_kw=system_kw, annual_kwh=annual_kwh,
+        degradation=env.pv_degradation, inc=env.inc,
+    )
+    out["energy_value"] = energy_value
+    out["bills_w"] = bills_w
+    out["bills_wo"] = bills_wo
+    return out
+
+
+def pv_only_npv(
+    kw: jax.Array, env: AgentEconInputs, n_periods: int, n_years: int
+) -> jax.Array:
+    """Objective for the sizing search (PV only, no battery)."""
+    gen = env.gen_per_kw * kw * INV_EFF
+    cost = env.system_capex_per_kw * kw * env.cap_cost_multiplier + env.one_time_charge
+    out = _npv_given_system_out(
+        env, kw, gen, cost, jnp.zeros(()), n_periods, n_years
+    )
+    return out["npv"]
+
+
+def golden_section_max(
+    f: Callable[[jax.Array], jax.Array],
+    lo: jax.Array,
+    hi: jax.Array,
+    n_iters: int,
+) -> jax.Array:
+    """Maximize a unimodal scalar function on [lo, hi].
+
+    Static trip count; returns the bracket midpoint after ``n_iters``
+    interval reductions. (The reference minimizes -NPV; we maximize NPV.)
+    """
+    a, b = lo, hi
+    c = b - (b - a) * GOLDEN
+    d = a + (b - a) * GOLDEN
+    fc = f(c)
+    fd = f(d)
+
+    def body(_, state):
+        a, b, c, d, fc, fd = state
+        # keep the half containing the larger value
+        take_left = fc > fd
+        a2 = jnp.where(take_left, a, c)
+        b2 = jnp.where(take_left, d, b)
+        c2 = b2 - (b2 - a2) * GOLDEN
+        d2 = a2 + (b2 - a2) * GOLDEN
+        # Golden-ratio identity: the surviving interior point IS one of
+        # the new ones (take_left -> d2 == c, else c2 == d), so only one
+        # fresh evaluation is needed per iteration.
+        x_new = jnp.where(take_left, c2, d2)
+        fx = f(x_new)
+        fc2 = jnp.where(take_left, fx, fd)
+        fd2 = jnp.where(take_left, fc, fx)
+        return a2, b2, c2, d2, fc2, fd2
+
+    a, b, c, d, fc, fd = jax.lax.fori_loop(
+        0, n_iters, body, (a, b, c, d, fc, fd)
+    )
+    return 0.5 * (a + b)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SizingResult:
+    """Per-agent sized economics (fields mirror what the reference writes
+    back onto the agent row, financial_functions.py:522-565)."""
+
+    system_kw: jax.Array
+    npv: jax.Array
+    payback_period: jax.Array
+    cash_flow: jax.Array                  # [Y+1]
+    naep: jax.Array
+    annual_energy_production_kwh: jax.Array
+    capacity_factor: jax.Array
+    first_year_bill_with_system: jax.Array
+    first_year_bill_without_system: jax.Array
+    batt_kw: jax.Array
+    batt_kwh: jax.Array
+    first_year_bill_with_batt: jax.Array
+    energy_value_pv_only: jax.Array       # [Y]
+    energy_value_pv_batt: jax.Array       # [Y]
+    baseline_net_hourly: jax.Array        # [8760]
+    adopter_net_hourly_pvonly: jax.Array  # [8760]
+    adopter_net_hourly_with_batt: jax.Array  # [8760]
+
+
+@partial(jax.jit, static_argnames=("n_periods", "n_years", "n_iters", "keep_hourly"))
+def size_one_agent(
+    env: AgentEconInputs,
+    n_periods: int,
+    n_years: int,
+    n_iters: int = 14,
+    keep_hourly: bool = True,
+) -> SizingResult:
+    """Full sizing pipeline for one agent (vmap for the table).
+
+    1. Golden-section search for NPV-optimal PV kW, no battery
+       (reference financial_functions.py:445).
+    2. PV-only outputs at kW*.
+    3. One forward run with a battery at the fixed PV ratio
+       (reference financial_functions.py:479).
+    """
+    naep = jnp.sum(env.gen_per_kw)
+    max_system = env.load_kwh_per_customer / jnp.maximum(naep, 1e-9)
+    lo = max_system * SIZE_LO_FRAC
+    hi = max_system * SIZE_HI_FRAC
+
+    obj = lambda kw: pv_only_npv(kw, env, n_periods, n_years)
+    kw_star = golden_section_max(obj, lo, hi, n_iters)
+
+    # --- PV-only outputs at kW* ---
+    gen_n = env.gen_per_kw * kw_star * INV_EFF
+    cost_n = (
+        env.system_capex_per_kw * kw_star * env.cap_cost_multiplier
+        + env.one_time_charge
+    )
+    out_n = _npv_given_system_out(
+        env, kw_star, gen_n, cost_n, jnp.zeros(()), n_periods, n_years
+    )
+    payback = cf_ops.payback_period(out_n["cf"])
+
+    # --- Forward run with battery at fixed ratio ---
+    batt_kw, batt_kwh = dispatch_ops.batt_size_from_pv(kw_star)
+    dr = dispatch_ops.dispatch_battery(env.load, gen_n, batt_kw, batt_kwh)
+    # Battery capex enters the cost basis at 0.7x for the ITC treatment
+    # (reference financial_functions.py:219).
+    batt_cost = env.batt_capex_per_kwh_combined * batt_kwh * 0.7
+    cost_w = (
+        env.system_capex_per_kw_combined * kw_star + batt_cost
+    ) * env.cap_cost_multiplier + env.one_time_charge
+    out_w = _npv_given_system_out(
+        env, kw_star, dr.system_out, cost_w, env.value_of_resiliency_usd,
+        n_periods, n_years,
+    )
+
+    annual_kwh = jnp.sum(gen_n)
+    naep_final = annual_kwh / jnp.maximum(kw_star, 1e-9)
+
+    if keep_hourly:
+        baseline_net = env.load
+        net_pvonly = jnp.maximum(env.load - gen_n, 0.0)
+        net_with_batt = jnp.maximum(env.load - dr.system_out, 0.0)
+    else:
+        empty = jnp.zeros((0,), dtype=env.load.dtype)
+        baseline_net = net_pvonly = net_with_batt = empty
+
+    return SizingResult(
+        system_kw=kw_star,
+        npv=out_n["npv"],
+        payback_period=payback,
+        cash_flow=out_n["cf"],
+        naep=naep_final,
+        annual_energy_production_kwh=annual_kwh,
+        capacity_factor=naep_final / 8760.0,
+        first_year_bill_with_system=out_n["bills_w"][0],
+        first_year_bill_without_system=out_n["bills_wo"][0],
+        batt_kw=batt_kw,
+        batt_kwh=batt_kwh,
+        first_year_bill_with_batt=out_w["bills_w"][0],
+        energy_value_pv_only=out_n["energy_value"],
+        energy_value_pv_batt=out_w["energy_value"],
+        baseline_net_hourly=baseline_net,
+        adopter_net_hourly_pvonly=net_pvonly,
+        adopter_net_hourly_with_batt=net_with_batt,
+    )
+
+
+def size_agents(
+    envs: AgentEconInputs,
+    n_periods: int,
+    n_years: int,
+    n_iters: int = 14,
+    keep_hourly: bool = True,
+) -> SizingResult:
+    """Vmapped sizing over the whole agent table (leading axis)."""
+    fn = partial(
+        size_one_agent,
+        n_periods=n_periods,
+        n_years=n_years,
+        n_iters=n_iters,
+        keep_hourly=keep_hourly,
+    )
+    return jax.vmap(fn)(envs)

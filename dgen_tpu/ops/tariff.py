@@ -1,0 +1,289 @@
+"""Dense tariff representation and the tariff compiler.
+
+The reference keeps each agent's retail tariff as a nested Python dict
+(``tariff_dict``) in a DataFrame cell, normalizes it per sizing call
+(reference financial_functions.py:962 ``normalize_tariff``), and feeds it
+to the PySAM ``Utilityrate5`` C++ engine. None of that can live on a TPU
+device path: strings, ragged period/tier structures, and per-call dict
+parsing all break XLA tracing.
+
+Here tariffs are compiled ONCE at ingest into a bank of dense, padded
+tensors (``TariffBank``) that every kernel indexes by ``tariff_idx``:
+
+  * ``price[K, P, T]``   — buy $/kWh for tariff k, TOU period p, tier t.
+  * ``tier_cap[K, T]``   — monthly kWh cap of each tier (harmonized
+                           across periods, reference
+                           financial_functions.py:919
+                           ``_harmonize_tier_caps_and_units``); unbounded
+                           tiers use ``BIG_CAP``.
+  * ``sell_price[K, P]`` — TOU sell $/kWh (column 6 of the reference's
+                           ``ur_ec_tou_mat``); used for CA-NEM3-style
+                           tariffs where sell = 0.25 x buy (reference
+                           financial_functions.py:180-191).
+  * ``hour_period[K, 8760]`` — hour-of-year -> TOU period map, flattened
+                           from the 12x24 weekday/weekend schedules
+                           (reference ``ur_ec_sched_weekday/weekend``).
+  * ``fixed_monthly[K]`` — monthly fixed charge.
+  * ``metering[K]``      — 0 = net metering (monthly netting at retail),
+                           2 = net billing (imports billed, exports
+                           credited at a sell rate). Demand charges are
+                           skipped, matching the reference's global
+                           ``SKIP_DEMAND_CHARGES=True``
+                           (financial_functions.py:35).
+  * ``n_periods[K]``, ``n_tiers[K]`` — true extents (padding beyond is
+                           priced 0 / capped BIG).
+
+Normalization semantics reproduced from the reference compiler
+(financial_functions.py:830-1007): period ids remapped contiguous,
+every period given the same tier count (padded with an unbounded clone
+of its last tier), a single per-tier cap across periods (min finite cap,
+else unbounded).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Unbounded-tier sentinel. The reference uses 1e38 (financial_functions.py:839);
+# we keep it finite and well inside float32 range.
+BIG_CAP = 1e38
+
+HOURS = 8760
+MONTHS = 12
+
+# Metering options (subset the reference exercises; Utilityrate5 codes).
+NET_METERING = 0
+NET_BILLING = 2
+
+# Cumulative hours at each month boundary for a non-leap year
+# (same table as reference tariff_functions.py:751).
+MONTH_HOURS = np.array(
+    [0, 744, 1416, 2160, 2880, 3624, 4344, 5088, 5832, 6552, 7296, 8016, 8760],
+    dtype=np.int64,
+)
+
+
+def hour_month_map() -> np.ndarray:
+    """[8760] int32: hour-of-year -> month index 0..11."""
+    out = np.zeros(HOURS, dtype=np.int32)
+    for m in range(MONTHS):
+        out[MONTH_HOURS[m]:MONTH_HOURS[m + 1]] = m
+    return out
+
+
+def hour_weekend_map(jan1_dow: int = 0) -> np.ndarray:
+    """[8760] bool: True where the hour falls on a weekend day.
+
+    The reference's schedule expansion needs a calendar convention; we fix
+    Jan 1 = Monday (``jan1_dow=0``) for determinism across runs.
+    """
+    day = np.arange(HOURS) // 24
+    dow = (day + jan1_dow) % 7
+    return dow >= 5
+
+
+_HOUR_MONTH = hour_month_map()
+_HOUR_WEEKEND = hour_weekend_map()
+_HOUR_OF_DAY = (np.arange(HOURS) % 24).astype(np.int32)
+
+
+def expand_schedule_8760(wkday_12x24: np.ndarray, wkend_12x24: np.ndarray) -> np.ndarray:
+    """Flatten 12x24 weekday/weekend period schedules to an [8760] map.
+
+    Period ids in the input are 0-based here (the reference uses 1-based
+    for PySAM; the compiler handles the shift).
+    """
+    wkday = np.asarray(wkday_12x24, dtype=np.int32)
+    wkend = np.asarray(wkend_12x24, dtype=np.int32)
+    by_day = np.where(_HOUR_WEEKEND, wkend[_HOUR_MONTH, _HOUR_OF_DAY], wkday[_HOUR_MONTH, _HOUR_OF_DAY])
+    return by_day.astype(np.int32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TariffBank:
+    """Bank of K compiled tariffs as dense padded device arrays."""
+
+    price: jax.Array        # [K, P, T] float32 buy $/kWh
+    tier_cap: jax.Array     # [K, T] float32 monthly kWh cap per tier
+    sell_price: jax.Array   # [K, P] float32 TOU sell $/kWh (0 if unused)
+    hour_period: jax.Array  # [K, 8760] int32 TOU period per hour
+    fixed_monthly: jax.Array  # [K] float32 $/month
+    metering: jax.Array     # [K] int32 (NET_METERING | NET_BILLING)
+    n_periods: jax.Array    # [K] int32
+    n_tiers: jax.Array      # [K] int32
+
+    @property
+    def n_tariffs(self) -> int:
+        return self.price.shape[0]
+
+    @property
+    def max_periods(self) -> int:
+        return self.price.shape[1]
+
+    @property
+    def max_tiers(self) -> int:
+        return self.price.shape[2]
+
+
+def _coerce_12x24(mat: Optional[Sequence[Sequence[int]]]) -> np.ndarray:
+    """Pad/trim an arbitrary schedule to a strict 12x24 int array of 0s
+    where missing (reference financial_functions.py:719 ``_sched_12x24``)."""
+    out = np.zeros((12, 24), dtype=np.int32)
+    if mat is None:
+        return out
+    a = np.asarray(mat)
+    if a.ndim != 2:
+        return out
+    r = min(12, a.shape[0])
+    c = min(24, a.shape[1])
+    out[:r, :c] = a[:r, :c].astype(np.int32)
+    return out
+
+
+def normalize_tariff_spec(spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize a raw tariff spec dict into contiguous-period,
+    equal-tier, harmonized-cap dense numpy form.
+
+    Accepted keys (a tpu-friendly distillation of the reference's
+    ``tariff_dict`` after its own normalization — see
+    financial_functions.py:962 ``normalize_tariff``):
+
+      - ``e_prices``: [T][P] buy price per tier x period (legacy layout) OR
+        ``price``: [P][T].
+      - ``e_levels``: [T][P] tier caps (legacy) OR ``tier_cap``: [T].
+      - ``e_wkday_12by24`` / ``e_wkend_12by24``: 12x24 0-based period ids.
+      - ``fixed_charge``: $/month.
+      - ``metering``: 0 net-metering | 2 net-billing (default 0).
+      - ``sell_frac_of_buy``: scalar; if >0 the TOU sell price is this
+        fraction of the tier-1 buy price (the CA NEM3 rule, reference
+        financial_functions.py:186-191 uses 0.25).
+
+    Returns dict with keys price [P,T], tier_cap [T], sell_price [P],
+    wkday/wkend 12x24 (0-based contiguous), fixed_monthly, metering.
+    """
+    if "price" in spec:
+        price = np.asarray(spec["price"], dtype=np.float64)  # [P, T]
+    else:
+        e_prices = np.asarray(spec.get("e_prices", [[0.1]]), dtype=np.float64)  # [T, P]
+        price = e_prices.T
+    n_periods, n_tiers = price.shape
+
+    if "tier_cap" in spec:
+        caps = np.asarray(spec["tier_cap"], dtype=np.float64)
+    else:
+        e_levels = spec.get("e_levels")
+        if e_levels is None:
+            caps = np.full(n_tiers, BIG_CAP)
+        else:
+            lv = np.asarray(e_levels, dtype=np.float64)  # [T, P]
+            # Harmonize: one cap per tier = min finite cap across periods,
+            # else unbounded (reference financial_functions.py:948-953).
+            caps = np.empty(n_tiers)
+            for t in range(n_tiers):
+                row = lv[t]
+                finite = row[(row > 0) & (row < 1e37)]
+                caps[t] = finite.min() if finite.size else BIG_CAP
+    caps = np.maximum.accumulate(caps)  # enforce nondecreasing
+    caps[-1] = BIG_CAP  # top tier always unbounded
+
+    wkday = _coerce_12x24(spec.get("e_wkday_12by24"))
+    wkend = _coerce_12x24(spec.get("e_wkend_12by24"))
+
+    # Remap period ids used by schedules+price rows to contiguous 0..P-1
+    # (reference financial_functions.py:853-862).
+    used = np.unique(np.concatenate([wkday.ravel(), wkend.ravel()]))
+    used = used[(used >= 0) & (used < n_periods)]
+    if used.size == 0:
+        used = np.array([0])
+    remap = np.zeros(max(n_periods, int(used.max()) + 1), dtype=np.int32)
+    remap[used] = np.arange(used.size, dtype=np.int32)
+    wkday = remap[np.clip(wkday, 0, remap.size - 1)]
+    wkend = remap[np.clip(wkend, 0, remap.size - 1)]
+    price = price[used, :]
+    n_periods = used.size
+
+    sell_frac = float(spec.get("sell_frac_of_buy", 0.0))
+    sell_price = price[:, 0] * sell_frac if sell_frac > 0 else np.zeros(n_periods)
+
+    return {
+        "price": price,
+        "tier_cap": caps,
+        "sell_price": sell_price,
+        "wkday": wkday,
+        "wkend": wkend,
+        "fixed_monthly": float(spec.get("fixed_charge", 0.0)),
+        "metering": int(spec.get("metering", NET_METERING)),
+    }
+
+
+def compile_tariffs(
+    specs: List[Dict[str, Any]],
+    max_periods: Optional[int] = None,
+    max_tiers: Optional[int] = None,
+) -> TariffBank:
+    """Compile raw tariff specs into a padded :class:`TariffBank`.
+
+    Padding beyond a tariff's true extents is priced at the tariff's
+    top-tier price with unbounded caps, so padded entries never alter a
+    bill (monthly energy can't reach them / schedules never select them).
+    """
+    normed = [normalize_tariff_spec(s) for s in specs]
+    P = max_periods or max(n["price"].shape[0] for n in normed)
+    T = max_tiers or max(n["price"].shape[1] for n in normed)
+    K = len(normed)
+
+    price = np.zeros((K, P, T), dtype=np.float32)
+    tier_cap = np.full((K, T), BIG_CAP, dtype=np.float32)
+    sell_price = np.zeros((K, P), dtype=np.float32)
+    hour_period = np.zeros((K, HOURS), dtype=np.int32)
+    fixed_monthly = np.zeros(K, dtype=np.float32)
+    metering = np.zeros(K, dtype=np.int32)
+    n_periods = np.zeros(K, dtype=np.int32)
+    n_tiers = np.zeros(K, dtype=np.int32)
+
+    for k, n in enumerate(normed):
+        p, t = n["price"].shape
+        if p > P or t > T:
+            raise ValueError(f"tariff {k} exceeds bank shape ({p}x{t} > {P}x{T})")
+        price[k, :p, :t] = n["price"]
+        # pad tiers with the last tier's price (unbounded cap -> inert)
+        if t < T:
+            price[k, :p, t:] = n["price"][:, -1:]
+        # pad periods with period 0's prices (schedules never select them)
+        if p < P:
+            price[k, p:, :] = price[k, 0:1, :]
+        tier_cap[k, :t] = n["tier_cap"]
+        sell_price[k, :p] = n["sell_price"]
+        hour_period[k] = expand_schedule_8760(n["wkday"], n["wkend"])
+        fixed_monthly[k] = n["fixed_monthly"]
+        metering[k] = n["metering"]
+        n_periods[k] = p
+        n_tiers[k] = t
+
+    return TariffBank(
+        price=jnp.asarray(price),
+        tier_cap=jnp.asarray(tier_cap),
+        sell_price=jnp.asarray(sell_price),
+        hour_period=jnp.asarray(hour_period),
+        fixed_monthly=jnp.asarray(fixed_monthly),
+        metering=jnp.asarray(metering),
+        n_periods=jnp.asarray(n_periods),
+        n_tiers=jnp.asarray(n_tiers),
+    )
+
+
+def flat_tariff(price: float, fixed: float = 0.0, metering: int = NET_METERING,
+                sell_frac_of_buy: float = 0.0) -> Dict[str, Any]:
+    """Convenience: single-period single-tier flat-rate tariff spec."""
+    return {
+        "price": [[price]],
+        "fixed_charge": fixed,
+        "metering": metering,
+        "sell_frac_of_buy": sell_frac_of_buy,
+    }

@@ -1,0 +1,6 @@
+"""Multi-device execution: mesh construction, state-balanced agent
+partitioning, and shard_map-ped kernels with ICI collectives — the
+TPU-native replacement for the reference's one-GCP-Batch-task-per-state
+scale-out (SURVEY.md §2.6)."""
+
+from dgen_tpu.parallel import mesh, partition  # noqa: F401

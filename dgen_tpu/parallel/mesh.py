@@ -1,0 +1,27 @@
+"""Device mesh construction.
+
+One 1-D mesh axis ("agents") carries all data parallelism: the agent
+population is embarrassingly parallel within a year (SURVEY.md §2.6) and
+the only cross-agent communication is small state x sector reductions,
+so a single axis with psum collectives over ICI is the whole comms
+design. Multi-slice (DCN) national runs reuse the same axis — XLA routes
+the (tiny) psums appropriately.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AGENT_AXIS = "agents"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (AGENT_AXIS,))

@@ -1,0 +1,92 @@
+"""State-balanced agent partitioning across devices.
+
+The reference load-balances its national run by binning states into
+four size classes and submitting each as a separate GCP Batch job
+(state_input_csvs/{small,mid,mid_large,large}_states.csv +
+submit_all.sh:8-46). The TPU equivalent: order agents so that each
+device shard holds (nearly) whole states, via greedy
+largest-first bin packing of states onto devices, then pad each shard
+to equal length. Keeping states shard-local makes the state x sector
+segment reductions mostly local, with a single psum combining the few
+states that straddle a boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """Host-side description of an agent->device assignment."""
+
+    order: np.ndarray          # [N] permutation: new position -> old index
+    shard_sizes: np.ndarray    # [D] real agents per shard
+    shard_len: int             # padded per-shard length
+    device_of_state: np.ndarray  # [n_states] -> device (primary shard)
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.shard_sizes)
+
+    @property
+    def total_padded(self) -> int:
+        return self.n_devices * self.shard_len
+
+
+def partition_by_state(
+    state_idx: np.ndarray,
+    n_states: int,
+    n_devices: int,
+    pad_multiple: int = 8,
+) -> Partition:
+    """Greedy largest-first packing of states onto devices.
+
+    Returns a permutation placing each device's agents contiguously.
+    Agents of one state always land on one device (states bigger than a
+    balanced share still go to the currently-lightest device — matching
+    the reference's whole-state-per-task granularity).
+    """
+    state_idx = np.asarray(state_idx)
+    counts = np.bincount(state_idx, minlength=n_states)
+    device_load = np.zeros(n_devices, dtype=np.int64)
+    device_of_state = np.zeros(n_states, dtype=np.int32)
+    for s in np.argsort(-counts):
+        if counts[s] == 0:
+            device_of_state[s] = 0
+            continue
+        d = int(np.argmin(device_load))
+        device_of_state[s] = d
+        device_load[d] += counts[s]
+
+    agent_device = device_of_state[state_idx]
+    order = np.argsort(agent_device, kind="stable")
+    shard_sizes = np.bincount(agent_device, minlength=n_devices)
+
+    shard_len = int(shard_sizes.max()) if len(state_idx) else 0
+    shard_len = ((shard_len + pad_multiple - 1) // pad_multiple) * pad_multiple
+    shard_len = max(shard_len, pad_multiple)
+    return Partition(
+        order=order,
+        shard_sizes=shard_sizes,
+        shard_len=shard_len,
+        device_of_state=device_of_state,
+    )
+
+
+def apply_partition_indices(part: Partition, n_agents: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(gather_index [D*shard_len], valid_mask [D*shard_len]) mapping the
+    padded, device-ordered layout back to original agent rows (index 0
+    used for padding rows, masked out)."""
+    gather = np.zeros(part.total_padded, dtype=np.int64)
+    mask = np.zeros(part.total_padded, dtype=np.float32)
+    starts = np.concatenate([[0], np.cumsum(part.shard_sizes)[:-1]])
+    for d in range(part.n_devices):
+        seg = part.order[starts[d]: starts[d] + part.shard_sizes[d]]
+        off = d * part.shard_len
+        gather[off: off + len(seg)] = seg
+        mask[off: off + len(seg)] = 1.0
+    return gather, mask

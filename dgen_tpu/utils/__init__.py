@@ -1,0 +1,5 @@
+"""Host-side utilities: logging, timing/tracing, and the pytree
+invariant harness (the analogue of the reference's runtime-test safety
+net, agents.py:149-262)."""
+
+from dgen_tpu.utils import invariants, logging, timing  # noqa: F401

@@ -1,0 +1,79 @@
+"""Pytree invariant harness — the TPU analogue of the reference's
+runtime dataframe tests (agents.py:149-262 ``run_with_runtime_tests``),
+which check after every agent-table transform that no columns were
+dropped, no NaNs appeared, row count/ids are unchanged, and dtypes
+didn't drift.
+
+Here the agent table is a pytree of fixed-schema dense arrays, so most
+of those failure modes are impossible by construction; what remains
+worth checking after each year step is: leaf set unchanged, shapes
+unchanged on the agent axis, dtypes unchanged, and no non-finite values
+in updated leaves (with an allowlist, mirroring config.py:50-53).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Set
+
+import jax
+import numpy as np
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+def _leaf_paths(tree) -> dict:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+
+def check_transform(
+    before,
+    after,
+    allow_nonfinite: Optional[Iterable[str]] = None,
+    context: str = "",
+) -> None:
+    """Validate an agent-table transform preserved the schema.
+
+    ``allow_nonfinite``: leaf-path substrings exempt from the finiteness
+    check (the reference keeps a similar exception list for columns that
+    legitimately carry NaNs, config.py:50-53).
+    """
+    allow: Set[str] = set(allow_nonfinite or ())
+    b = _leaf_paths(before)
+    a = _leaf_paths(after)
+
+    missing = set(b) - set(a)
+    added = set(a) - set(b)
+    if missing or added:
+        raise InvariantViolation(
+            f"{context}: leaf set changed (missing={sorted(missing)}, added={sorted(added)})"
+        )
+    for path, leaf_b in b.items():
+        leaf_a = a[path]
+        if getattr(leaf_b, "shape", None) != getattr(leaf_a, "shape", None):
+            raise InvariantViolation(
+                f"{context}: shape of {path} changed {leaf_b.shape} -> {leaf_a.shape}"
+            )
+        if getattr(leaf_b, "dtype", None) != getattr(leaf_a, "dtype", None):
+            raise InvariantViolation(
+                f"{context}: dtype of {path} changed {leaf_b.dtype} -> {leaf_a.dtype}"
+            )
+
+
+def check_finite(tree, allow_nonfinite: Optional[Iterable[str]] = None,
+                 context: str = "") -> None:
+    """Assert every float leaf is finite (allowlist by path substring).
+
+    Host-side check — call sparingly (it syncs device values)."""
+    allow = tuple(allow_nonfinite or ())
+    for path, leaf in _leaf_paths(tree).items():
+        if any(s in path for s in allow):
+            continue
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            n_bad = int((~np.isfinite(arr)).sum())
+            raise InvariantViolation(
+                f"{context}: {n_bad} non-finite values in {path}"
+            )

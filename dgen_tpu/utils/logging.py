@@ -1,0 +1,30 @@
+"""Structured logging (analogue of reference utility_functions.py:36
+``get_logger`` + the ``[BATCH_STATE]`` stateful adapter at :24-34)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_LOGGER_NAME = "dgen_tpu"
+
+
+def get_logger(prefix: Optional[str] = None) -> logging.Logger:
+    """Process-wide logger; ``prefix`` (e.g. a shard/state tag) is added
+    to every record so interleaved multi-host logs stay attributable,
+    mirroring the reference's ``BATCH_STATE`` adapter."""
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        logger.addHandler(handler)
+        level = os.environ.get("DGEN_TPU_LOGLEVEL", "INFO").upper()
+        logger.setLevel(getattr(logging, level, logging.INFO))
+        logger.propagate = False
+    if prefix:
+        return logging.LoggerAdapter(logger, {})  # type: ignore[return-value]
+    return logger

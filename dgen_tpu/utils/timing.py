@@ -1,0 +1,56 @@
+"""Tracing/profiling decorators (analogue of reference decorators.py:28
+``fn_timer`` and utility_functions.py:112 ``Timer``)."""
+
+from __future__ import annotations
+
+import functools
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, List
+
+from dgen_tpu.utils.logging import get_logger
+
+#: accumulated (name -> [durations]) for the profiler report, the
+#: in-memory analogue of the reference's ``code_profiler.csv`` scrape
+#: (utility_functions.py:89-102).
+_TIMINGS: Dict[str, List[float]] = {}
+
+
+def fn_timer(tab_level: int = 0) -> Callable:
+    """Decorator logging wall time per call and accumulating stats."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            dt = time.perf_counter() - t0
+            _TIMINGS.setdefault(fn.__qualname__, []).append(dt)
+            get_logger().debug("%s%s took: %.3fs", "\t" * tab_level, fn.__qualname__, dt)
+            return out
+
+        return wrapper
+
+    return deco
+
+
+@contextmanager
+def timer(name: str):
+    t0 = time.perf_counter()
+    yield
+    dt = time.perf_counter() - t0
+    _TIMINGS.setdefault(name, []).append(dt)
+    get_logger().debug("%s took: %.3fs", name, dt)
+
+
+def timing_report() -> Dict[str, Dict[str, float]]:
+    """Per-name {count, total, mean} summary."""
+    return {
+        k: {"count": len(v), "total": sum(v), "mean": sum(v) / len(v)}
+        for k, v in _TIMINGS.items()
+        if v
+    }
+
+
+def reset_timings() -> None:
+    _TIMINGS.clear()

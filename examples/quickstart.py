@@ -1,0 +1,84 @@
+"""User-style quickstart: size a synthetic population and run one market
+step through dgen_tpu's public API (what a reference user would do)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import dgen_tpu
+from dgen_tpu.io import synth
+from dgen_tpu.models import market
+from dgen_tpu.ops import bill as bill_ops
+from dgen_tpu.ops import cashflow as cf_ops
+from dgen_tpu.ops import sizing
+
+print("dgen_tpu", dgen_tpu.__version__, "| devices:", jax.devices())
+
+# 1. population
+pop = synth.generate_population(512, states=["DE", "CA", "TX"], seed=7)
+t = pop.table
+print(f"agents: {t.n_agents} (mask sum {float(t.mask.sum()):.0f}), "
+      f"tariff bank: {pop.tariffs.n_tariffs} tariffs, "
+      f"P={pop.tariffs.max_periods} T={pop.tariffs.max_tiers}")
+
+# 2. assemble econ inputs (as the year step will)
+load = pop.profiles.load[t.load_idx] * t.load_kwh_per_customer_in_bin[:, None]
+gen_per_kw = pop.profiles.solar_cf[t.cf_idx]
+ts_sell = pop.profiles.wholesale[t.region_idx]
+n = t.n_agents
+f32 = jnp.float32
+fin = jax.tree.map(lambda x: jnp.broadcast_to(x, (n,)), cf_ops.FinanceParams.example())
+envs = sizing.AgentEconInputs(
+    load=load, gen_per_kw=gen_per_kw, ts_sell=ts_sell,
+    tariff=jax.vmap(lambda k: bill_ops.gather_tariff(pop.tariffs, k))(t.tariff_idx),
+    fin=fin, inc=jax.tree.map(lambda x: x, t.incentives),
+    load_kwh_per_customer=t.load_kwh_per_customer_in_bin,
+    elec_price_escalator=jnp.full(n, 0.005, f32),
+    pv_degradation=jnp.full(n, 0.005, f32),
+    system_capex_per_kw=jnp.full(n, 2500.0, f32),
+    system_capex_per_kw_combined=jnp.full(n, 2600.0, f32),
+    batt_capex_per_kwh_combined=jnp.full(n, 800.0, f32),
+    cap_cost_multiplier=jnp.ones(n, f32),
+    value_of_resiliency_usd=jnp.zeros(n, f32),
+    one_time_charge=jnp.zeros(n, f32),
+)
+
+# 3. size the whole fleet on device
+t0 = time.time()
+res = sizing.size_agents(envs, n_periods=pop.tariffs.max_periods, n_years=25)
+jax.block_until_ready(res.npv)
+t1 = time.time()
+res2 = sizing.size_agents(envs, n_periods=pop.tariffs.max_periods, n_years=25)
+jax.block_until_ready(res2.npv)
+t2 = time.time()
+kw = np.asarray(res.system_kw)
+pb = np.asarray(res.payback_period)
+print(f"sized {n} agents: compile+run {t1-t0:.1f}s, cached run {t2-t1:.3f}s "
+      f"({n/(t2-t1):.0f} agents/sec)")
+print(f"system_kw: min {kw.min():.2f} med {np.median(kw):.2f} max {kw.max():.1f}")
+print(f"payback:   min {pb.min():.1f} med {np.median(pb):.1f} max {pb.max():.1f}")
+print(f"npv finite: {np.isfinite(np.asarray(res.npv)).all()}, "
+      f"batt_kwh med {np.median(np.asarray(res.batt_kwh)):.2f}")
+
+# 4. market step: mms -> diffusion -> integer battery allocation
+mms_table = jnp.asarray(np.stack([np.exp(-np.arange(302) * 0.1 / 4.0)] * 3))
+mms = market.max_market_share(jnp.asarray(pb), t.sector_idx, mms_table)
+state = market.MarketState.zeros(n)
+out = market.diffusion_step(
+    state, mms * t.mask, np.asarray(res.system_kw), jnp.full(n, 2500.0),
+    developable_agent_weight=t.developable_agent_weight(t.customers_in_bin),
+    bass_p=jnp.full(n, 0.0015), bass_q=jnp.full(n, 0.35),
+    teq_yr1=jnp.full(n, 2.0), is_first_year=True,
+)
+alloc = market.allocate_battery_adopters(
+    out.new_adopters, t.group_idx, jnp.full(t.n_groups, 0.25),
+    t.agent_id, t.n_groups,
+)
+na = np.asarray(out.new_adopters)
+print(f"diffusion: new adopters total {na.sum():.1f}, share med "
+      f"{np.median(np.asarray(out.market_share)):.4f}")
+print(f"battery alloc: {np.asarray(alloc).sum():.0f} integer adopters "
+      f"(~25% of {na.sum():.0f})")
+assert np.all(np.asarray(alloc) == np.round(np.asarray(alloc))), "non-integer alloc"
+print("QUICKSTART OK")

@@ -1,0 +1,137 @@
+"""Plain-NumPy oracle implementations used to validate the JAX kernels.
+
+These are written directly from the documented tariff/cashflow
+semantics (simple per-month loops, no vectorization tricks) so they
+serve as an independent second implementation — the same role the
+reference's deprecated ``tariff_functions.bill_calculator`` plays for
+PySAM (SURVEY.md §4 numerical oracles).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MONTH_HOURS = [0, 744, 1416, 2160, 2880, 3624, 4344, 5088, 5832, 6552, 7296, 8016, 8760]
+
+
+def tier_charge_scalar(x: float, caps: np.ndarray, prices: np.ndarray) -> float:
+    """Cumulative tiered charge for one monthly (period) energy sum."""
+    if x < 0:
+        return x * prices[0]
+    total = 0.0
+    lower = 0.0
+    for cap, price in zip(caps, prices):
+        seg = min(x, cap) - lower
+        if seg > 0:
+            total += seg * price
+        lower = cap
+        if x <= cap:
+            break
+    return total
+
+
+def oracle_annual_bill(
+    net_load: np.ndarray,
+    hour_period: np.ndarray,
+    price: np.ndarray,       # [P, T]
+    tier_cap: np.ndarray,    # [T]
+    fixed_monthly: float,
+    metering: int,
+    ts_sell: np.ndarray | None = None,
+    sell_price: np.ndarray | None = None,  # [P] TOU sell
+) -> float:
+    """Reference-free annual bill (hour loops + per-month tier math)."""
+    n_periods = price.shape[0]
+    total = 12.0 * fixed_monthly
+    for m in range(12):
+        sl = slice(MONTH_HOURS[m], MONTH_HOURS[m + 1])
+        net_m = net_load[sl]
+        per_m = hour_period[sl]
+        if metering == 0:  # net metering: signed monthly netting
+            for p in range(n_periods):
+                x = float(net_m[per_m == p].sum())
+                total += tier_charge_scalar(x, tier_cap, price[p])
+        else:  # net billing
+            imports = np.maximum(net_m, 0.0)
+            exports = np.maximum(-net_m, 0.0)
+            for p in range(n_periods):
+                x = float(imports[per_m == p].sum())
+                total += tier_charge_scalar(x, tier_cap, price[p])
+            if sell_price is not None and np.any(sell_price > 0):
+                sell_h = sell_price[per_m]
+            elif ts_sell is not None:
+                sell_h = ts_sell[sl]
+            else:
+                sell_h = np.zeros_like(net_m)
+            total -= float((exports * sell_h).sum())
+    return total
+
+
+def oracle_cashflow_cash_purchase(
+    energy_value: np.ndarray,
+    installed_cost: float,
+    itc_fraction: float,
+    real_discount: float,
+    inflation: float,
+) -> tuple[np.ndarray, float]:
+    """Cash purchase (100% down): cf and NPV, straight loops."""
+    n = len(energy_value)
+    cf = np.zeros(n + 1)
+    cf[0] = -installed_cost
+    cf[1:] = energy_value
+    cf[1] += itc_fraction * installed_cost
+    dnom = (1 + real_discount) * (1 + inflation) - 1
+    npv = sum(cf[y] / (1 + dnom) ** y for y in range(n + 1))
+    return cf, npv
+
+
+def oracle_dispatch(
+    load: np.ndarray,
+    gen: np.ndarray,
+    batt_kw: float,
+    batt_kwh: float,
+    soc_min_frac: float = 0.10,
+    soc_init_frac: float = 0.30,
+    eta_c: float = 0.96,
+    eta_d: float = 0.96,
+) -> np.ndarray:
+    """Greedy self-consumption dispatch; returns system_out[8760]."""
+    soc = batt_kwh * soc_init_frac
+    soc_min = batt_kwh * soc_min_frac
+    out = np.zeros_like(load)
+    for h in range(len(load)):
+        surplus = max(gen[h] - load[h], 0.0)
+        deficit = max(load[h] - gen[h], 0.0)
+        charge = min(surplus, batt_kw, max(batt_kwh - soc, 0.0) / eta_c)
+        discharge = min(deficit, batt_kw, max(soc - soc_min, 0.0) * eta_d)
+        soc = soc + charge * eta_c - discharge / eta_d
+        out[h] = gen[h] - charge + discharge
+    return out
+
+
+def oracle_largest_remainders(
+    new_adopters: np.ndarray,
+    group_idx: np.ndarray,
+    rates: np.ndarray,
+    agent_ids: np.ndarray,
+) -> np.ndarray:
+    """Per-group largest-remainders integer allocation (python loops,
+    same tie-breaking as the reference: fraction desc, agent id asc)."""
+    alloc = np.zeros(len(new_adopters))
+    for g in np.unique(group_idx):
+        sel = np.where(group_idx == g)[0]
+        r = float(np.clip(rates[g], 0, 1))
+        n = new_adopters[sel]
+        if n.sum() <= 0 or r <= 0:
+            continue
+        target = int(round(r * n.sum()))
+        f = r * n
+        base = np.floor(f).astype(int)
+        rem = target - base.sum()
+        if rem > 0:
+            frac = f - base
+            order = sorted(range(len(sel)), key=lambda i: (-frac[i], agent_ids[sel][i]))
+            for i in order[:rem]:
+                base[i] += 1
+        alloc[sel] = base
+    return alloc

@@ -1,0 +1,142 @@
+"""Bill engine vs the NumPy oracle across tariff styles."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dgen_tpu.io import synth
+from dgen_tpu.ops import bill as bill_ops
+from dgen_tpu.ops import tariff as tariff_ops
+
+HOURS = tariff_ops.HOURS
+
+
+def _net_load(seed=0):
+    rng = np.random.default_rng(seed)
+    load = 1.0 + 0.5 * np.sin(np.arange(HOURS) / 24.0) + 0.2 * rng.random(HOURS)
+    gen = np.zeros(HOURS)
+    hod = np.arange(HOURS) % 24
+    day = (hod > 6) & (hod < 18)
+    gen[day] = 3.0 * np.sin(np.pi * (hod[day] - 6) / 12.0)
+    return (load - gen).astype(np.float32)
+
+
+def _bank():
+    return synth.make_tariff_bank()
+
+
+@pytest.mark.parametrize("k", range(6))
+def test_annual_bill_matches_oracle(k):
+    from tests.oracles import oracle_annual_bill
+
+    bank = _bank()
+    net = _net_load(seed=k)
+    ts_sell = np.full(HOURS, 0.04, dtype=np.float32)
+
+    at = bill_ops.gather_tariff(bank, jnp.asarray(k))
+    got = float(
+        bill_ops.annual_bill(
+            jnp.asarray(net), at, jnp.asarray(ts_sell), bank.max_periods
+        )
+    )
+    want = oracle_annual_bill(
+        net_load=net,
+        hour_period=np.asarray(bank.hour_period)[k],
+        price=np.asarray(bank.price)[k],
+        tier_cap=np.asarray(bank.tier_cap)[k],
+        fixed_monthly=float(bank.fixed_monthly[k]),
+        metering=int(bank.metering[k]),
+        ts_sell=ts_sell,
+        sell_price=np.asarray(bank.sell_price)[k],
+    )
+    assert got == pytest.approx(want, rel=1e-4), f"tariff {k}"
+
+
+def test_tier_cap_binds():
+    """Monthly energy crossing the tier-1 cap is billed at tier-2."""
+    bank = _bank()  # tariff 2: tiers at 0.10/0.16, cap 500
+    k = 2
+    # constant 1 kW import -> ~730 kWh/month
+    net = np.ones(HOURS, dtype=np.float32)
+    at = bill_ops.gather_tariff(bank, jnp.asarray(k))
+    got = float(bill_ops.annual_bill(jnp.asarray(net), at, jnp.zeros(HOURS), bank.max_periods))
+    # expected: per month, 500*0.10 + (hours-500)*0.16 + fixed 12
+    expect = 0.0
+    for m in range(12):
+        h = tariff_ops.MONTH_HOURS[m + 1] - tariff_ops.MONTH_HOURS[m]
+        expect += 500 * 0.10 + (h - 500) * 0.16 + 12.0
+    assert got == pytest.approx(expect, rel=1e-5)
+
+
+def test_net_metering_credits_exports_at_retail():
+    bank = _bank()
+    k = 0  # flat NEM @ 0.12, fixed 10
+    net = np.ones(HOURS, dtype=np.float32)
+    net[: HOURS // 2] = -1.0  # export half the year
+    at = bill_ops.gather_tariff(bank, jnp.asarray(k))
+    got = float(bill_ops.annual_bill(jnp.asarray(net), at, jnp.zeros(HOURS), bank.max_periods))
+    # signed monthly sums: first half-year months net negative (credited),
+    # second half positive — exact mirror -> energy charges cancel
+    assert got == pytest.approx(12 * 10.0, abs=1e-2)
+
+
+def test_net_billing_asymmetry():
+    """Net billing buys at retail, sells at the TS rate."""
+    bank = _bank()
+    k = 1  # flat NB @ 0.13, fixed 8
+    net = np.ones(HOURS, dtype=np.float32)
+    net[: HOURS // 2] = -1.0
+    ts_sell = np.full(HOURS, 0.05, dtype=np.float32)
+    at = bill_ops.gather_tariff(bank, jnp.asarray(k))
+    got = float(bill_ops.annual_bill(jnp.asarray(net), at, jnp.asarray(ts_sell), bank.max_periods))
+    imports = float(np.maximum(net, 0).sum())
+    exports = float(np.maximum(-net, 0).sum())
+    want = imports * 0.13 - exports * 0.05 + 12 * 8.0
+    assert got == pytest.approx(want, rel=1e-4)
+
+
+def test_bill_series_escalation_and_degradation():
+    bank = _bank()
+    k = 1
+    rng = np.random.default_rng(0)
+    load = rng.uniform(0.5, 2.0, HOURS).astype(np.float32)
+    gen = np.zeros(HOURS, dtype=np.float32)
+    hod = np.arange(HOURS) % 24
+    gen[(hod > 7) & (hod < 17)] = 2.0
+    at = bill_ops.gather_tariff(bank, jnp.asarray(k))
+    ts_sell = np.full(HOURS, 0.03, dtype=np.float32)
+
+    bills_w, bills_wo = bill_ops.bill_series(
+        jnp.asarray(load), jnp.asarray(gen), at, jnp.asarray(ts_sell),
+        inflation=jnp.asarray(0.025), escalation=jnp.asarray(0.01),
+        degradation=jnp.asarray(0.005), n_periods=bank.max_periods, n_years=5,
+    )
+    bills_w, bills_wo = np.asarray(bills_w), np.asarray(bills_wo)
+    # no-system bill grows at the combined nominal escalation
+    ratio = bills_wo[1:] / bills_wo[:-1]
+    np.testing.assert_allclose(ratio, (1.025 * 1.01), rtol=1e-5)
+    # with-system bill is lower, and the gap narrows as PV degrades
+    savings = bills_wo - bills_w
+    deflated = savings / bills_wo
+    assert np.all(savings > 0)
+    assert deflated[-1] < deflated[0]
+
+
+def test_vmapped_bill_over_agents():
+    import jax
+
+    bank = _bank()
+    n = 8
+    rng = np.random.default_rng(1)
+    nets = rng.uniform(-1, 2, (n, HOURS)).astype(np.float32)
+    idxs = jnp.asarray(np.arange(n) % bank.n_tariffs)
+    ts_sell = jnp.zeros((n, HOURS), dtype=jnp.float32)
+
+    def one(net, k, ts):
+        at = bill_ops.gather_tariff(bank, k)
+        return bill_ops.annual_bill(net, at, ts, bank.max_periods)
+
+    out = jax.vmap(one)(jnp.asarray(nets), idxs, ts_sell)
+    assert out.shape == (n,)
+    assert np.all(np.isfinite(np.asarray(out)))

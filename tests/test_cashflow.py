@@ -1,0 +1,136 @@
+"""Cashflow kernel: golden cases + oracle comparison."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.ops import cashflow as cf
+
+
+def _fin(**kw):
+    base = dict(
+        down_payment_fraction=1.0, loan_interest_rate=0.05, loan_term_yrs=20,
+        real_discount_rate=0.027, inflation_rate=0.025, tax_rate=0.257,
+        itc_fraction=0.30, is_commercial=0.0, om_per_year=0.0,
+    )
+    base.update(kw)
+    return cf.FinanceParams(
+        down_payment_fraction=jnp.float32(base["down_payment_fraction"]),
+        loan_interest_rate=jnp.float32(base["loan_interest_rate"]),
+        loan_term_yrs=jnp.int32(base["loan_term_yrs"]),
+        real_discount_rate=jnp.float32(base["real_discount_rate"]),
+        inflation_rate=jnp.float32(base["inflation_rate"]),
+        tax_rate=jnp.float32(base["tax_rate"]),
+        itc_fraction=jnp.float32(base["itc_fraction"]),
+        is_commercial=jnp.float32(base["is_commercial"]),
+        om_per_year=jnp.float32(base["om_per_year"]),
+    )
+
+
+def test_cash_purchase_matches_oracle():
+    from tests.oracles import oracle_cashflow_cash_purchase
+
+    n_years = 25
+    ev = np.linspace(900.0, 1400.0, n_years).astype(np.float32)
+    cost = 12000.0
+    out = cf.cashflow(jnp.asarray(ev), jnp.float32(cost), _fin(), n_years)
+    want_cf, want_npv = oracle_cashflow_cash_purchase(ev, cost, 0.30, 0.027, 0.025)
+    np.testing.assert_allclose(np.asarray(out["cf"]), want_cf, rtol=1e-5)
+    assert float(out["npv"]) == pytest.approx(want_npv, rel=1e-4)
+
+
+def test_loan_schedule_amortizes_exactly():
+    pmt, interest = cf.loan_schedule(
+        jnp.float32(10000.0), jnp.float32(0.06), jnp.int32(10), 15
+    )
+    pmt, interest = np.asarray(pmt), np.asarray(interest)
+    # payments stop after the term
+    assert np.all(pmt[10:] == 0.0)
+    # principal repaid sums to the loan
+    assert float((pmt - interest).sum()) == pytest.approx(10000.0, rel=1e-4)
+    # level payment matches the annuity formula
+    want = 10000.0 * 0.06 / (1 - 1.06 ** -10)
+    np.testing.assert_allclose(pmt[:10], want, rtol=1e-5)
+
+
+def test_loan_raises_npv_vs_cash_when_rate_below_discount():
+    n_years = 25
+    ev = np.full(n_years, 1500.0, dtype=np.float32)
+    cost = 15000.0
+    npv_cash = float(cf.cashflow(jnp.asarray(ev), jnp.float32(cost), _fin(), n_years)["npv"])
+    npv_loan = float(
+        cf.cashflow(
+            jnp.asarray(ev), jnp.float32(cost),
+            _fin(down_payment_fraction=0.2, loan_interest_rate=0.01),
+            n_years,
+        )["npv"]
+    )
+    # borrowing at 1% while discounting at ~5.3% nominal is NPV-positive
+    assert npv_loan > npv_cash
+
+
+def test_commercial_depreciation_adds_value():
+    n_years = 25
+    ev = np.full(n_years, 1500.0, dtype=np.float32)
+    cost = 15000.0
+    npv_res = float(cf.cashflow(jnp.asarray(ev), jnp.float32(cost), _fin(), n_years)["npv"])
+    npv_com = float(
+        cf.cashflow(jnp.asarray(ev), jnp.float32(cost), _fin(is_commercial=1.0), n_years)["npv"]
+    )
+    assert npv_com > npv_res
+    # MACRS-5 on basis reduced by half the ITC, at the effective rate
+    fed, sta = 0.257 * 0.7, 0.257 * 0.3
+    tau = fed + sta - fed * sta
+    want_gain_undisc = cost * (1 - 0.15) * tau
+    assert npv_com - npv_res < want_gain_undisc  # discounting shrinks it
+    assert npv_com - npv_res > 0.75 * want_gain_undisc
+
+
+def test_payback_semantics():
+    # instant: positive from year 0
+    cf0 = jnp.asarray(np.array([1.0, 1.0, 1.0], dtype=np.float32))
+    assert float(cf.payback_period(cf0)) == 0.0
+    # never
+    cf1 = jnp.asarray(np.array([-10.0, 1.0, 1.0], dtype=np.float32))
+    assert float(cf.payback_period(cf1)) == pytest.approx(30.1)
+    # crosses between year 2 and 3: cum = [-10, -4, 2] -> 1 + 4/6 = 1.7
+    cf2 = jnp.asarray(np.array([-10.0, 6.0, 6.0], dtype=np.float32))
+    assert float(cf.payback_period(cf2)) == pytest.approx(1.7)
+
+
+def test_pbi_incentive_stream():
+    n_years = 10
+    inc = cf.IncentiveParams(
+        cbi_usd_p_w=jnp.asarray([0.5, 0.0], jnp.float32),
+        cbi_max_usd=jnp.asarray([1000.0, 0.0], jnp.float32),
+        ibi_frac=jnp.asarray([0.1, 0.0], jnp.float32),
+        ibi_max_usd=jnp.asarray([500.0, 0.0], jnp.float32),
+        pbi_usd_p_kwh=jnp.asarray([0.02, 0.0], jnp.float32),
+        pbi_years=jnp.asarray([5, 0], jnp.int32),
+    )
+    upfront, pbi = cf.incentive_cashflows(
+        inc, jnp.float32(5.0), jnp.float32(15000.0), jnp.float32(7000.0),
+        jnp.float32(0.005), n_years,
+    )
+    # CBI: 0.5 $/W * 5 kW * 1000 = 2500 -> clamped to 1000
+    # IBI: 0.1 * 15000 = 1500 -> clamped to 500
+    assert float(upfront) == pytest.approx(1500.0)
+    pbi = np.asarray(pbi)
+    assert np.all(pbi[:5] > 0) and np.all(pbi[5:] == 0)
+    assert float(pbi[0]) == pytest.approx(0.02 * 7000.0, rel=1e-5)
+
+
+def test_vmap_over_agents():
+    n_years = 20
+    n = 16
+    rng = np.random.default_rng(0)
+    ev = jnp.asarray(rng.uniform(500, 2000, (n, n_years)).astype(np.float32))
+    cost = jnp.asarray(rng.uniform(5000, 30000, n).astype(np.float32))
+    fin = jax.tree.map(lambda x: jnp.broadcast_to(x, (n,)), _fin())
+    out = jax.vmap(lambda e, c, f: cf.cashflow(e, c, f, n_years))(ev, cost, fin)
+    assert out["npv"].shape == (n,)
+    assert out["cf"].shape == (n, n_years + 1)
+    pb = jax.vmap(cf.payback_period)(out["cf"])
+    assert np.all((np.asarray(pb) >= 0) & (np.asarray(pb) <= 30.1))

@@ -1,0 +1,83 @@
+"""Battery dispatch kernel vs the NumPy oracle + physical invariants."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.ops import dispatch as dp
+
+HOURS = 8760
+
+
+def _profiles(seed=0):
+    rng = np.random.default_rng(seed)
+    hod = np.arange(HOURS) % 24
+    load = 1.0 + 0.6 * np.exp(-0.5 * ((hod - 19) / 2.5) ** 2) + 0.1 * rng.random(HOURS)
+    gen = np.where((hod > 6) & (hod < 18), 2.5 * np.sin(np.pi * (hod - 6) / 12.0), 0.0)
+    return load.astype(np.float32), gen.astype(np.float32)
+
+
+def test_matches_oracle():
+    from tests.oracles import oracle_dispatch
+
+    load, gen = _profiles()
+    res = dp.dispatch_battery(jnp.asarray(load), jnp.asarray(gen),
+                              jnp.float32(2.0), jnp.float32(4.0))
+    want = oracle_dispatch(load, gen, 2.0, 4.0)
+    np.testing.assert_allclose(np.asarray(res.system_out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_soc_bounds_and_energy_balance():
+    load, gen = _profiles(seed=1)
+    kw, kwh = 3.0, 6.0
+    res = dp.dispatch_battery(jnp.asarray(load), jnp.asarray(gen),
+                              jnp.float32(kw), jnp.float32(kwh))
+    soc = np.asarray(res.soc)
+    assert soc.min() >= kwh * dp.SOC_MIN_FRAC - 1e-4
+    assert soc.max() <= kwh + 1e-4
+    charge = np.asarray(res.charge)
+    discharge = np.asarray(res.discharge)
+    assert charge.max() <= kw + 1e-5 and discharge.max() <= kw + 1e-5
+    # battery only charges from surplus, discharges into deficit
+    surplus = np.maximum(gen - load, 0)
+    deficit = np.maximum(load - gen, 0)
+    assert np.all(charge <= surplus + 1e-5)
+    assert np.all(discharge <= deficit + 1e-5)
+    # round-trip losses: discharged energy < charged energy
+    assert discharge.sum() < charge.sum()
+    assert discharge.sum() > 0.5 * charge.sum()
+
+
+def test_self_consumption_reduces_imports():
+    load, gen = _profiles(seed=2)
+    res = dp.dispatch_battery(jnp.asarray(load), jnp.asarray(gen),
+                              jnp.float32(2.0), jnp.float32(4.0))
+    imports_no_batt = np.maximum(load - gen, 0).sum()
+    imports_with = np.maximum(load - np.asarray(res.system_out), 0).sum()
+    assert imports_with < imports_no_batt
+
+
+def test_zero_battery_is_identity():
+    load, gen = _profiles(seed=3)
+    res = dp.dispatch_battery(jnp.asarray(load), jnp.asarray(gen),
+                              jnp.float32(0.0), jnp.float32(0.0))
+    np.testing.assert_allclose(np.asarray(res.system_out), gen, atol=1e-6)
+
+
+def test_vmap_over_agents():
+    load, gen = _profiles(seed=4)
+    n = 4
+    loads = jnp.asarray(np.stack([load * (1 + 0.1 * i) for i in range(n)]))
+    gens = jnp.asarray(np.stack([gen * (1 + 0.05 * i) for i in range(n)]))
+    kws = jnp.asarray(np.linspace(1.0, 3.0, n), dtype=jnp.float32)
+    res = jax.vmap(dp.dispatch_battery)(loads, gens, kws, 2.0 * kws)
+    assert res.system_out.shape == (n, HOURS)
+    assert np.all(np.isfinite(np.asarray(res.system_out)))
+
+
+def test_batt_size_from_pv_reference_ratios():
+    kw, kwh = dp.batt_size_from_pv(jnp.float32(8.0))
+    assert float(kwh) == pytest.approx(10.0)   # 8 / 0.8
+    assert float(kw) == pytest.approx(5.0)     # 10 / 2

@@ -1,0 +1,151 @@
+"""Market step: Bass diffusion, mms lookup, anchoring, integer
+battery-adopter allocation."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dgen_tpu.config import PAYBACK_GRID_N
+from dgen_tpu.models import market
+
+
+def test_bass_inversion_roundtrip():
+    """equivalent_time inverts bass_new_adopt_fraction."""
+    p = jnp.float32(0.005)
+    q = jnp.float32(0.4)
+    for t in (1.0, 5.0, 12.0):
+        frac = market.bass_new_adopt_fraction(p, q, jnp.float32(t))
+        mms = jnp.float32(0.6)
+        share = mms * frac
+        teq = market.equivalent_time(share, mms, p, q)
+        assert float(teq) == pytest.approx(t, rel=5e-4)  # float32
+
+
+def test_diffusion_monotone_and_capped():
+    n = 64
+    rng = np.random.default_rng(0)
+    state = market.MarketState.zeros(n)
+    state = market.MarketState(
+        market_share=jnp.asarray(rng.uniform(0, 0.05, n).astype(np.float32)),
+        max_market_share=jnp.zeros(n, jnp.float32),
+        adopters_cum=jnp.asarray(rng.uniform(0, 10, n).astype(np.float32)),
+        market_value=jnp.zeros(n, jnp.float32),
+        system_kw_cum=jnp.zeros(n, jnp.float32),
+        batt_kw_cum=jnp.zeros(n, jnp.float32),
+        batt_kwh_cum=jnp.zeros(n, jnp.float32),
+        initial_adopters=jnp.zeros(n, jnp.float32),
+        initial_market_share=jnp.zeros(n, jnp.float32),
+    )
+    mms = jnp.asarray(rng.uniform(0.1, 0.8, n).astype(np.float32))
+    out = market.diffusion_step(
+        state, mms,
+        system_kw=jnp.full(n, 5.0), system_capex_per_kw=jnp.full(n, 3000.0),
+        developable_agent_weight=jnp.full(n, 100.0),
+        bass_p=jnp.full(n, 0.005), bass_q=jnp.full(n, 0.4),
+        teq_yr1=jnp.full(n, 2.0), is_first_year=False,
+    )
+    ms = np.asarray(out.market_share)
+    msly = np.asarray(state.market_share)
+    assert np.all(ms >= msly - 1e-7)          # market-share floor
+    assert np.all(np.asarray(out.new_adopters) >= 0)
+    # market share approaches but respects the shape of mms-scaled Bass
+    assert np.all(ms <= np.maximum(np.asarray(mms), msly) + 1e-6)
+
+
+def test_diffusion_converges_to_mms():
+    """Iterating the yearly step drives share toward max market share."""
+    n = 4
+    state = market.MarketState.zeros(n)
+    mms = jnp.full(n, 0.5)
+    kw = jnp.full(n, 5.0)
+    capex = jnp.full(n, 3000.0)
+    w = jnp.full(n, 100.0)
+    p, q, teq1 = jnp.full(n, 0.005), jnp.full(n, 0.5), jnp.full(n, 2.0)
+    for i in range(40):
+        out = market.diffusion_step(state, mms, kw, capex, w, p, q, teq1, i == 0)
+        state = market.MarketState(
+            market_share=out.market_share,
+            max_market_share=mms,
+            adopters_cum=out.number_of_adopters,
+            market_value=out.market_value,
+            system_kw_cum=out.system_kw_cum,
+            batt_kw_cum=state.batt_kw_cum,
+            batt_kwh_cum=state.batt_kwh_cum,
+            initial_adopters=state.initial_adopters,
+            initial_market_share=state.initial_market_share,
+        )
+    assert np.all(np.asarray(state.market_share) > 0.45)
+    assert np.all(np.asarray(state.market_share) <= 0.5 + 1e-5)
+
+
+def test_mms_lookup():
+    table = np.zeros((3, PAYBACK_GRID_N), dtype=np.float32)
+    table[0] = np.linspace(1.0, 0.0, PAYBACK_GRID_N)
+    got = market.max_market_share(
+        jnp.asarray([0.0, 30.1, 5.0]), jnp.asarray([0, 0, 0]), jnp.asarray(table)
+    )
+    assert float(got[0]) == pytest.approx(1.0)
+    assert float(got[1]) == pytest.approx(0.0)
+    assert 0.0 < float(got[2]) < 1.0
+
+
+def test_largest_remainders_matches_oracle():
+    from tests.oracles import oracle_largest_remainders
+
+    rng = np.random.default_rng(42)
+    n, n_groups = 200, 12
+    new_adopters = rng.uniform(0, 8, n).astype(np.float32)
+    group_idx = rng.integers(0, n_groups, n)
+    rates = rng.uniform(0, 0.6, n_groups).astype(np.float32)
+    ids = np.arange(n)
+
+    got = np.asarray(
+        market.allocate_battery_adopters(
+            jnp.asarray(new_adopters), jnp.asarray(group_idx),
+            jnp.asarray(rates), jnp.asarray(ids), n_groups,
+        )
+    )
+    want = oracle_largest_remainders(new_adopters, group_idx, rates, ids)
+    np.testing.assert_array_equal(got, want)
+    # group totals hit the rounded targets exactly
+    for g in range(n_groups):
+        sel = group_idx == g
+        target = int(round(rates[g] * new_adopters[sel].sum()))
+        assert int(got[sel].sum()) == target
+
+
+def test_anchoring_rescales_to_observed():
+    n, n_groups = 30, 6
+    rng = np.random.default_rng(1)
+    kw_cum = rng.uniform(10, 100, n).astype(np.float32)
+    group_idx = rng.integers(0, n_groups, n)
+    observed = rng.uniform(1000, 5000, n_groups).astype(np.float32)
+    is_res = np.ones(n, dtype=np.float32)
+    weight = rng.uniform(50, 200, n).astype(np.float32)
+
+    anchored, adopters, share = market.anchor_to_observed(
+        jnp.asarray(kw_cum), jnp.asarray(group_idx), jnp.asarray(observed),
+        jnp.asarray(is_res), jnp.asarray(weight), n_groups,
+    )
+    anchored = np.asarray(anchored)
+    for g in range(n_groups):
+        sel = group_idx == g
+        if sel.any():
+            assert anchored[sel].sum() == pytest.approx(observed[g], rel=1e-3)
+    np.testing.assert_allclose(np.asarray(adopters), anchored / 5.0, rtol=1e-5)
+
+
+def test_initial_market_shares_apportions_by_weight():
+    n, n_groups = 16, 2
+    group_idx = jnp.asarray(np.arange(n) % n_groups)
+    weight = jnp.asarray(np.linspace(1, 4, n).astype(np.float32))
+    start_kw = jnp.asarray([1000.0, 500.0], dtype=jnp.float32)
+    z = jnp.zeros(n_groups, jnp.float32)
+    state = market.initial_market_shares(
+        start_kw, z, z, group_idx, weight, jnp.full(n, 5.0), n_groups
+    )
+    kw = np.asarray(state.system_kw_cum)
+    for g in range(n_groups):
+        sel = np.asarray(group_idx) == g
+        assert kw[sel].sum() == pytest.approx(float(start_kw[g]), rel=1e-4)

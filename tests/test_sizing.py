@@ -1,0 +1,108 @@
+"""Sizing search: golden-section optimality and full-kernel sanity."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.io import synth
+from dgen_tpu.ops import bill as bill_ops
+from dgen_tpu.ops import cashflow as cf_ops
+from dgen_tpu.ops import sizing
+
+HOURS = 8760
+
+
+def test_golden_section_finds_max():
+    f = lambda x: -((x - 3.7) ** 2)
+    got = float(sizing.golden_section_max(f, jnp.float32(0.0), jnp.float32(10.0), 20))
+    assert got == pytest.approx(3.7, abs=1e-3)
+
+    # works vmapped with per-element brackets
+    g = lambda x: -((x - jnp.asarray([1.0, 5.0])) ** 2).sum()  # not used
+    fs = lambda x: -((x - jnp.asarray([1.0, 5.0])) ** 2)
+    lo = jnp.asarray([0.0, 0.0])
+    hi = jnp.asarray([10.0, 10.0])
+    out = jax.vmap(lambda l, h, t: sizing.golden_section_max(
+        lambda x: -((x - t) ** 2), l, h, 20))(lo, hi, jnp.asarray([1.0, 5.0]))
+    np.testing.assert_allclose(np.asarray(out), [1.0, 5.0], atol=1e-3)
+
+
+def _make_env(seed=0, tariff_k=1, load_kwh=9000.0):
+    pop = synth.generate_population(8, states=["DE"], seed=seed, pad_multiple=8)
+    bank = pop.tariffs
+    load_prof = np.asarray(pop.profiles.load)[0]
+    cf_prof = np.asarray(pop.profiles.solar_cf)[4]
+    load = load_prof * load_kwh
+    ts_sell = np.full(HOURS, 0.04, dtype=np.float32)
+
+    return sizing.AgentEconInputs(
+        load=jnp.asarray(load, dtype=jnp.float32),
+        gen_per_kw=jnp.asarray(cf_prof, dtype=jnp.float32),
+        ts_sell=jnp.asarray(ts_sell),
+        tariff=bill_ops.gather_tariff(bank, jnp.asarray(tariff_k)),
+        fin=cf_ops.FinanceParams.example(),
+        inc=cf_ops.IncentiveParams.zeros(),
+        load_kwh_per_customer=jnp.float32(load_kwh),
+        elec_price_escalator=jnp.float32(0.005),
+        pv_degradation=jnp.float32(0.005),
+        system_capex_per_kw=jnp.float32(2500.0),
+        system_capex_per_kw_combined=jnp.float32(2600.0),
+        batt_capex_per_kwh_combined=jnp.float32(800.0),
+        cap_cost_multiplier=jnp.float32(1.0),
+        value_of_resiliency_usd=jnp.float32(0.0),
+        one_time_charge=jnp.float32(0.0),
+    ), bank
+
+
+def test_size_one_agent_outputs_consistent():
+    env, bank = _make_env()
+    res = sizing.size_one_agent(env, n_periods=bank.max_periods, n_years=25)
+
+    kw = float(res.system_kw)
+    naep = float(jnp.sum(env.gen_per_kw))
+    max_system = 9000.0 / naep
+    assert max_system * 0.8 <= kw <= max_system * 1.25
+
+    assert float(res.npv) == pytest.approx(
+        float(sizing.pv_only_npv(res.system_kw, env, bank.max_periods, 25)), rel=1e-3
+    )
+    # battery at the reference ratio
+    assert float(res.batt_kwh) == pytest.approx(kw / 0.8, rel=1e-5)
+    assert float(res.batt_kw) == pytest.approx(kw / 1.6, rel=1e-5)
+    # bills: system reduces the bill
+    assert float(res.first_year_bill_with_system) < float(res.first_year_bill_without_system)
+    # payback in valid range
+    assert 0.0 <= float(res.payback_period) <= 30.1
+    assert res.cash_flow.shape == (26,)
+    assert res.adopter_net_hourly_pvonly.shape == (HOURS,)
+    # net import never negative, never above load
+    net = np.asarray(res.adopter_net_hourly_pvonly)
+    assert net.min() >= 0.0
+    assert np.all(net <= np.asarray(env.load) + 1e-5)
+
+
+def test_kw_star_beats_neighbors():
+    """The found size is at least as good as nearby alternatives."""
+    env, bank = _make_env(tariff_k=0)
+    res = sizing.size_one_agent(env, n_periods=bank.max_periods, n_years=25, n_iters=20)
+    kw = float(res.system_kw)
+    npv_star = float(sizing.pv_only_npv(jnp.float32(kw), env, bank.max_periods, 25))
+    naep = float(jnp.sum(env.gen_per_kw))
+    lo, hi = 9000.0 / naep * 0.8, 9000.0 / naep * 1.25
+    for alt in np.linspace(lo, hi, 9):
+        npv_alt = float(sizing.pv_only_npv(jnp.float32(alt), env, bank.max_periods, 25))
+        assert npv_star >= npv_alt - max(abs(npv_star) * 5e-3, 2.0)
+
+
+def test_size_agents_vmapped():
+    envs = []
+    for i in range(4):
+        env, bank = _make_env(seed=i, tariff_k=i % 3, load_kwh=6000.0 + 2000.0 * i)
+        envs.append(env)
+    batched = jax.tree.map(lambda *xs: jnp.stack(xs), *envs)
+    res = sizing.size_agents(batched, n_periods=bank.max_periods, n_years=25)
+    assert res.system_kw.shape == (4,)
+    assert np.all(np.isfinite(np.asarray(res.npv)))
+    assert np.all(np.asarray(res.system_kw) > 0)

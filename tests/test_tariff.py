@@ -1,0 +1,103 @@
+"""Tariff compiler: normalization, padding, schedule expansion."""
+
+import numpy as np
+import pytest
+
+from dgen_tpu.ops import tariff as tf
+
+
+def test_flat_tariff_compiles():
+    bank = tf.compile_tariffs([tf.flat_tariff(0.12, fixed=5.0)])
+    assert bank.n_tariffs == 1
+    assert float(bank.price[0, 0, 0]) == pytest.approx(0.12)
+    assert float(bank.fixed_monthly[0]) == pytest.approx(5.0)
+    assert int(bank.n_periods[0]) == 1
+    # schedule maps every hour to period 0
+    assert np.all(np.asarray(bank.hour_period[0]) == 0)
+
+
+def test_legacy_e_parts_layout():
+    """e_prices is [tier][period] (reference legacy layout,
+    financial_functions.py:763 ``_build_ur_ec_from_e_parts``)."""
+    spec = {
+        "e_prices": [[0.10, 0.20], [0.15, 0.25]],   # 2 tiers x 2 periods
+        "e_levels": [[300.0, 300.0], [1e38, 1e38]],
+        "e_wkday_12by24": np.concatenate(
+            [np.zeros((12, 12), int), np.ones((12, 12), int)], axis=1
+        ),
+    }
+    bank = tf.compile_tariffs([spec])
+    assert int(bank.n_periods[0]) == 2
+    assert int(bank.n_tiers[0]) == 2
+    # price[period, tier]
+    assert float(bank.price[0, 0, 0]) == pytest.approx(0.10)
+    assert float(bank.price[0, 1, 0]) == pytest.approx(0.20)
+    assert float(bank.price[0, 0, 1]) == pytest.approx(0.15)
+    assert float(bank.tier_cap[0, 0]) == pytest.approx(300.0)
+    # afternoon hours map to period 1 on weekdays
+    hp = np.asarray(bank.hour_period[0])
+    assert hp[14] == 1 and hp[2] == 0
+
+
+def test_tier_caps_harmonized_to_min_finite():
+    spec = {
+        "e_prices": [[0.10, 0.20], [0.15, 0.25]],
+        "e_levels": [[500.0, 300.0], [1e38, 1e38]],  # differing caps per period
+        "e_wkday_12by24": np.zeros((12, 24), int),
+    }
+    bank = tf.compile_tariffs([spec])
+    # harmonized cap = min finite across periods (reference :948-953)
+    assert float(bank.tier_cap[0, 0]) == pytest.approx(300.0)
+    assert float(bank.tier_cap[0, 1]) == pytest.approx(tf.BIG_CAP)
+
+
+def test_period_remap_contiguous():
+    """Schedules referencing a sparse period set get remapped 0..P-1."""
+    wkday = np.zeros((12, 24), int)
+    wkday[:, 12:] = 2  # only periods 0 and 2 used out of 3
+    spec = {
+        "price": [[0.10], [0.99], [0.30]],
+        "e_wkday_12by24": wkday,
+        "e_wkend_12by24": wkday,
+    }
+    bank = tf.compile_tariffs([spec])
+    assert int(bank.n_periods[0]) == 2
+    # period 2 became period 1 with its price preserved
+    assert float(bank.price[0, 1, 0]) == pytest.approx(0.30)
+    hp = np.asarray(bank.hour_period[0])
+    assert set(np.unique(hp)) == {0, 1}
+
+
+def test_padding_is_inert():
+    """A 1-period tariff padded into a 4-period bank bills identically."""
+    import jax.numpy as jnp
+    from dgen_tpu.ops import bill as bill_ops
+
+    spec = tf.flat_tariff(0.11, fixed=3.0)
+    small = tf.compile_tariffs([spec])
+    padded = tf.compile_tariffs([spec], max_periods=4, max_tiers=3)
+    rng = np.random.default_rng(0)
+    net = jnp.asarray(rng.uniform(-1, 2, tf.HOURS).astype(np.float32))
+    zs = jnp.zeros(tf.HOURS, dtype=jnp.float32)
+    b_small = float(bill_ops.annual_bill(
+        net, bill_ops.gather_tariff(small, jnp.asarray(0)), zs, small.max_periods))
+    b_pad = float(bill_ops.annual_bill(
+        net, bill_ops.gather_tariff(padded, jnp.asarray(0)), zs, padded.max_periods))
+    assert b_small == pytest.approx(b_pad, rel=1e-5)
+
+
+def test_weekend_schedule_differs():
+    wkday = np.zeros((12, 24), int)
+    wkday[:, 16:21] = 1
+    spec = {
+        "price": [[0.10], [0.30]],
+        "e_wkday_12by24": wkday,
+        "e_wkend_12by24": np.zeros((12, 24), int),
+    }
+    bank = tf.compile_tariffs([spec])
+    hp = np.asarray(bank.hour_period[0])
+    weekend = tf.hour_weekend_map()
+    # weekday evening hours in period 1, weekend evenings period 0
+    evening = (np.arange(tf.HOURS) % 24 == 18)
+    assert np.all(hp[evening & ~weekend] == 1)
+    assert np.all(hp[evening & weekend] == 0)

@@ -1,0 +1,122 @@
+"""Headline benchmark: full multi-year scenario throughput on the
+default accelerator, reported as agent-years/sec.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "agent-years/sec", "vs_baseline": N}
+
+``vs_baseline`` compares against the reference's execution model — a
+process pool of per-agent sequential sizing calls (reference
+dgen_model.py:309-384 with LOCAL_CORES=8, the per-task shape of its
+cloud runs, batch_job_yamls/dgen-batch-job-small-states.yaml:73-75) —
+measured here as: (one agent sized sequentially on CPU) x 8 workers.
+The baseline runs the same economics kernel, so the comparison isolates
+the architectural win (vmapped table-resident batching on the MXU vs
+one-agent-at-a-time dispatch), not kernel implementation differences.
+
+Knobs (env):
+  DGEN_TPU_BENCH_AGENTS   population size            (default 8192)
+  DGEN_TPU_BENCH_END      end model year             (default 2050)
+  DGEN_TPU_BENCH_SKIP_CPU skip CPU baseline, use cached constant
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Measured on this image's CPU (sequential per-agent sizing x 8 workers,
+# see _cpu_baseline). Used when DGEN_TPU_BENCH_SKIP_CPU is set.
+FALLBACK_BASELINE_AGENT_YEARS_PER_SEC = 25.0
+
+
+def _build(n_agents: int, end_year: int):
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.simulation import Simulation
+
+    cfg = ScenarioConfig(name="bench", start_year=2014, end_year=end_year,
+                         anchor_years=())
+    pop = synth.generate_population(n_agents, seed=42, pad_multiple=256)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+        overrides={"attachment_rate": jnp.full((pop.table.n_groups,), 0.3)},
+    )
+    sim = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+        RunConfig(sizing_iters=10), with_hourly=False,
+    )
+    return sim, pop
+
+
+def _cpu_baseline(sim, pop) -> float:
+    """Reference-architecture baseline: sequential one-agent sizing on
+    CPU, scaled by the reference's 8-worker pool."""
+    from dgen_tpu.models.simulation import SimCarry
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        return FALLBACK_BASELINE_AGENT_YEARS_PER_SEC
+
+    # one-agent slice of the population
+    take = lambda x: x[:1] if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == pop.table.n_agents else x
+    table1 = jax.tree.map(take, pop.table)
+    carry1 = SimCarry.zeros(1)
+    with jax.default_device(cpu):
+        from dgen_tpu.models.simulation import year_step
+        args = (table1, sim.profiles, sim.tariffs, sim.inputs, carry1,
+                jnp.asarray(1, dtype=jnp.int32))
+        kw = sim._step_kwargs(first_year=False)
+        out = year_step(*args, **kw)   # compile
+        jax.block_until_ready(out)
+        n_rep = 8
+        t0 = time.time()
+        for _ in range(n_rep):
+            out = year_step(*args, **kw)
+            jax.block_until_ready(out)
+        dt = (time.time() - t0) / n_rep
+    return 8.0 / dt  # 8 workers, 1 agent-year per sizing call
+
+
+def main() -> None:
+    n_agents = int(os.environ.get("DGEN_TPU_BENCH_AGENTS", "8192"))
+    end_year = int(os.environ.get("DGEN_TPU_BENCH_END", "2050"))
+
+    sim, pop = _build(n_agents, end_year)
+    n_real = int(np.asarray(pop.table.mask).sum())
+    n_years = len(sim.years)
+
+    # warm up both compiled variants (first year + carry year)
+    carry = sim.init_carry()
+    carry_w, _ = sim.step(carry, 0, first_year=True)
+    carry_w, out_w = sim.step(carry_w, 1, first_year=False)
+    jax.block_until_ready(out_w.system_kw_cum)
+
+    t0 = time.time()
+    res = sim.run(collect=False)
+    elapsed = time.time() - t0
+
+    agent_years_per_sec = n_real * n_years / elapsed
+
+    if os.environ.get("DGEN_TPU_BENCH_SKIP_CPU"):
+        baseline = FALLBACK_BASELINE_AGENT_YEARS_PER_SEC
+    else:
+        baseline = _cpu_baseline(sim, pop)
+
+    print(json.dumps({
+        "metric": "sizing+market agent-years/sec "
+                  f"({n_real} agents, {n_years} model years, "
+                  f"{jax.devices()[0].platform})",
+        "value": round(agent_years_per_sec, 2),
+        "unit": "agent-years/sec",
+        "vs_baseline": round(agent_years_per_sec / max(baseline, 1e-9), 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

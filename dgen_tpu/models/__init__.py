@@ -1,4 +1,4 @@
 """Model layer: the columnar agent table, scenario inputs, the market
 (diffusion/attachment) step, and the multi-year driver."""
 
-from dgen_tpu.models import agents, market, scenario  # noqa: F401
+from dgen_tpu.models import agents, market, scenario, simulation  # noqa: F401

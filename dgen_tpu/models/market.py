@@ -136,8 +136,8 @@ def diffusion_step(
     new_adopt_fraction = bass_new_adopt_fraction(bass_p, bass_q, teq2)
 
     bass_ms = mms * new_adopt_fraction
-    diffusion_ms = jnp.maximum(msly, bass_ms)
-    market_share = jnp.maximum(diffusion_ms, msly)
+    # market-share floor vs last year (reference diffusion_functions_elec.py:75)
+    market_share = jnp.maximum(msly, bass_ms)
     new_ms = market_share - msly
     # zero the step where share already exceeds the (possibly shrunken)
     # max market share (reference diffusion_functions_elec.py:77)

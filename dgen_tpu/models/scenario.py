@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dgen_tpu.config import PAYBACK_GRID_N, ScenarioConfig
+from dgen_tpu.config import PAYBACK_GRID_N, SECTORS, ScenarioConfig
 from dgen_tpu.models.agents import AgentTable
 from dgen_tpu.ops.cashflow import FinanceParams
 
@@ -61,6 +61,12 @@ class ScenarioInputs:
     # --- historical anchoring (diffusion_functions_elec.py:99) ---
     anchor_years_mask: jax.Array          # [Y] 1.0 where year is an anchor year
     observed_kw: jax.Array                # [Y, G] observed cumulative PV kW
+    # --- NEM policy state machine (agent_mutation/elec.py:449-505) ---
+    #: [Y, n_states] installed-PV-kW cap under which net metering remains
+    #: available; 0 encodes a sunset year (NEM off), 1e30 = no cap. The
+    #: gate compares against the *previous* year's state cumulative
+    #: capacity (reference calc_state_capacity_by_year, elec.py:788).
+    nem_cap_kw: jax.Array
     # --- misc ---
     value_of_resiliency: jax.Array        # [Y, S] $ per agent
     cap_cost_multiplier: jax.Array        # [Y, S]
@@ -218,6 +224,9 @@ def uniform_inputs(
         starting_batt_kwh=jnp.zeros(G, dtype=f),
         anchor_years_mask=jnp.asarray(anchor_mask),
         observed_kw=jnp.zeros((Y, G), dtype=f),
+        # group layout is always state x len(SECTORS) (AgentTable.group_idx),
+        # regardless of which sectors the scenario enables
+        nem_cap_kw=jnp.full((Y, max(G // len(SECTORS), 1)), 1e30, dtype=f),
         value_of_resiliency=yz(0.0),
         cap_cost_multiplier=yz(1.0),
         inflation=jnp.asarray(config.annual_inflation, dtype=f),

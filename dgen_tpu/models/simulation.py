@@ -1,0 +1,493 @@
+"""The simulation driver: the full scenario -> year pipeline as one
+jitted, shardable device program per model year.
+
+Replaces the reference's driver loop (reference dgen_model.py:242-463):
+per year it (1) applies the 13 on_frame trajectory mutations, (2) sizes
+every agent through the bill/cashflow/dispatch hot loop, (3) runs the
+max-market-share -> Bass-diffusion market step with historical
+anchoring, (4) allocates integer battery adopters, and (5) aggregates
+state-hourly net load — but where the reference round-trips a pandas
+frame through a spawn pool and Postgres (dgen_model.py:309-384), here a
+whole model year is ONE compiled XLA program over the HBM-resident
+agent table, and the cross-year carry (the reference's
+``market_last_year_df`` handoff, diffusion_functions_elec.py:136-156)
+is a small pytree threaded between year invocations.
+
+Sharding: pass a :class:`jax.sharding.Mesh` and the driver lays the
+agent axis over it (NamedSharding); the only cross-device traffic is
+the state x sector segment reductions (tiny psums over ICI), matching
+the reference's per-state GCP-Batch sharding (SURVEY.md §2.6) but
+within one program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.models.agents import AgentTable, ProfileBank
+from dgen_tpu.models.market import (
+    MarketState,
+    allocate_battery_adopters,
+    anchor_to_observed,
+    diffusion_step,
+    initial_market_shares,
+    max_market_share,
+)
+from dgen_tpu.models.scenario import ScenarioInputs, apply_year
+from dgen_tpu.ops import bill as bill_ops
+from dgen_tpu.ops import sizing as sizing_ops
+from dgen_tpu.ops.tariff import NET_BILLING, TariffBank
+from dgen_tpu.parallel.mesh import AGENT_AXIS
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+# ---------------------------------------------------------------------------
+# Carry and per-year outputs
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SimCarry:
+    """Cross-year device state: the reference's ``market_last_year_df``
+    plus the battery-adopter cumulative it tracks alongside
+    (dgen_model.py:420-427)."""
+
+    market: MarketState
+    batt_adopters_cum: jax.Array  # [N]
+
+    @staticmethod
+    def zeros(n: int) -> "SimCarry":
+        return SimCarry(
+            market=MarketState.zeros(n),
+            batt_adopters_cum=jnp.zeros(n, dtype=jnp.float32),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class YearOutputs:
+    """Per-agent results for one model year (the dense analogue of the
+    columns the reference writes to ``agent_outputs`` per year,
+    dgen_model.py:441-463)."""
+
+    # sizing / economics (financial_functions.py:522-565)
+    system_kw: jax.Array
+    npv: jax.Array
+    payback_period: jax.Array
+    cash_flow: jax.Array                  # [N, Y+1]
+    first_year_bill_with_system: jax.Array
+    first_year_bill_without_system: jax.Array
+    batt_kw: jax.Array
+    batt_kwh: jax.Array
+    # market step (diffusion_functions_elec.py:24-156)
+    max_market_share: jax.Array
+    market_share: jax.Array
+    new_adopters: jax.Array
+    number_of_adopters: jax.Array
+    new_system_kw: jax.Array
+    system_kw_cum: jax.Array
+    market_value: jax.Array
+    # storage attachment (attachment_rate_functions.py:58-148)
+    new_batt_adopters: jax.Array
+    batt_adopters_cum: jax.Array
+    batt_kw_cum: jax.Array
+    batt_kwh_cum: jax.Array
+    # state-hourly aggregate (attachment_rate_functions.py:151-201);
+    # shape [n_states, 8760] MW, or [0, 0] when hourly export is off
+    state_hourly_net_mw: jax.Array
+
+
+# ---------------------------------------------------------------------------
+# The year step
+# ---------------------------------------------------------------------------
+
+def build_econ_inputs(
+    table: AgentTable,
+    profiles: ProfileBank,
+    tariffs: TariffBank,
+    ya,
+    nem_allowed: jax.Array,
+    incentives,
+) -> sizing_ops.AgentEconInputs:
+    """Assemble the per-agent economics environment for one year.
+
+    Gathers the 8760 banks (replacing the reference's per-agent SQL
+    profile fetches, agent_mutation/elec.py:508-558), applies the retail
+    price multiplier to the tariff (elec.py:29
+    ``apply_elec_price_multiplier_and_escalator`` scales agent prices),
+    and forces net billing where the NEM policy gate has closed
+    (elec.py:449-505 ``get_nem_settings``/``filter_nem_year``).
+    """
+    mult = ya.elec_price_multiplier
+
+    at = jax.vmap(lambda k: bill_ops.gather_tariff(tariffs, k))(table.tariff_idx)
+    at = at._replace(
+        price=at.price * mult[:, None, None],
+        sell_price=at.sell_price * mult[:, None],
+        metering=jnp.where(
+            nem_allowed > 0, at.metering, jnp.full_like(at.metering, NET_BILLING)
+        ),
+    )
+
+    load = profiles.load[table.load_idx] * ya.load_kwh_per_customer[:, None]
+    gen_per_kw = profiles.solar_cf[table.cf_idx]
+    # Net-billing sell rate = wholesale price x retail multiplier
+    # (reference financial_functions.py:182).
+    ts_sell = profiles.wholesale[table.region_idx] * mult[:, None]
+
+    n = table.n_agents
+    return sizing_ops.AgentEconInputs(
+        load=load,
+        gen_per_kw=gen_per_kw,
+        ts_sell=ts_sell,
+        tariff=at,
+        fin=ya.fin,
+        inc=incentives,
+        load_kwh_per_customer=ya.load_kwh_per_customer,
+        elec_price_escalator=ya.elec_price_escalator,
+        pv_degradation=ya.pv_degradation,
+        system_capex_per_kw=ya.system_capex_per_kw,
+        system_capex_per_kw_combined=ya.system_capex_per_kw_combined,
+        batt_capex_per_kwh_combined=ya.batt_capex_per_kwh_combined,
+        cap_cost_multiplier=ya.cap_cost_multiplier,
+        value_of_resiliency_usd=ya.value_of_resiliency,
+        one_time_charge=jnp.zeros(n, dtype=jnp.float32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_periods", "econ_years", "sizing_iters", "first_year",
+        "with_hourly", "storage_enabled", "year_step_len",
+    ),
+)
+def year_step(
+    table: AgentTable,
+    profiles: ProfileBank,
+    tariffs: TariffBank,
+    inputs: ScenarioInputs,
+    carry: SimCarry,
+    year_idx: jax.Array,
+    *,
+    n_periods: int,
+    econ_years: int,
+    sizing_iters: int,
+    first_year: bool,
+    with_hourly: bool,
+    storage_enabled: bool,
+    year_step_len: float,
+) -> tuple[SimCarry, YearOutputs]:
+    """One model year as a single device program.
+
+    Mirrors the reference's per-year sequence (dgen_model.py:242-438):
+    trajectory application -> sizing -> max market share -> (initial
+    shares | diffusion) -> anchoring -> battery allocation -> carry.
+    """
+    n_states = table.n_states
+    n_groups = table.n_groups
+    g = table.group_idx
+
+    ya = apply_year(table, inputs, year_idx)
+
+    # --- NEM gate on last year's state cumulative capacity; in the
+    # first year that is the starting installed capacity, not the
+    # (zeroed) carry (reference calc_state_capacity_by_year,
+    # agent_mutation/elec.py:788) ---
+    if first_year:
+        group_state = jnp.arange(n_groups, dtype=jnp.int32) // table.n_sectors
+        state_kw_last = jax.ops.segment_sum(
+            inputs.starting_kw, group_state, n_states
+        )
+    else:
+        state_kw_last = jax.ops.segment_sum(
+            carry.market.system_kw_cum, table.state_idx, n_states
+        )
+    cap = inputs.nem_cap_kw[year_idx]                       # [n_states]
+    nem_allowed = (state_kw_last < cap).astype(jnp.float32)[table.state_idx]
+
+    envs = build_econ_inputs(
+        table, profiles, tariffs, ya, nem_allowed, table.incentives
+    )
+
+    # --- hot loop: size every agent (financial_functions.py:291) ---
+    res = sizing_ops.size_agents(
+        envs, n_periods=n_periods, n_years=econ_years,
+        n_iters=sizing_iters, keep_hourly=with_hourly,
+    )
+
+    # --- market step ---
+    mms = max_market_share(
+        res.payback_period, table.sector_idx, inputs.mms_table
+    ) * table.mask
+
+    if first_year:
+        mstate = initial_market_shares(
+            inputs.starting_kw, inputs.starting_batt_kw,
+            inputs.starting_batt_kwh, g, ya.developable_agent_weight,
+            res.system_kw, n_groups,
+        )
+        batt_adopters_prev = mstate.batt_kw_cum / jnp.maximum(res.batt_kw, 1e-9)
+    else:
+        mstate = carry.market
+        batt_adopters_prev = carry.batt_adopters_cum
+
+    out = diffusion_step(
+        mstate, mms, res.system_kw, ya.system_capex_per_kw,
+        ya.developable_agent_weight,
+        inputs.bass_p[g], inputs.bass_q[g], inputs.teq_yr1[g],
+        is_first_year=first_year, year_step=year_step_len,
+    )
+
+    # --- historical anchoring (blend; anchor_years_mask selects) ---
+    am = inputs.anchor_years_mask[year_idx]
+    kw_anch, adopt_anch, share_anch = anchor_to_observed(
+        out.system_kw_cum, g, inputs.observed_kw[year_idx],
+        (table.sector_idx == 0), ya.developable_agent_weight, n_groups,
+    )
+    kw_cum = am * kw_anch + (1.0 - am) * out.system_kw_cum
+    adopters = am * adopt_anch + (1.0 - am) * out.number_of_adopters
+    share = am * share_anch + (1.0 - am) * out.market_share
+    new_adopters = jnp.maximum(adopters - mstate.adopters_cum, 0.0)
+    new_kw = jnp.maximum(kw_cum - mstate.system_kw_cum, 0.0)
+
+    # --- integer battery-adopter allocation ---
+    if storage_enabled:
+        new_batt = allocate_battery_adopters(
+            new_adopters, g, inputs.attachment_rate, table.agent_id, n_groups
+        ) * table.mask
+    else:
+        new_batt = jnp.zeros_like(new_adopters)
+    batt_adopters_cum = batt_adopters_prev + new_batt
+    batt_kw_cum = mstate.batt_kw_cum + new_batt * res.batt_kw
+    batt_kwh_cum = mstate.batt_kwh_cum + new_batt * res.batt_kwh
+
+    # --- state-hourly aggregate (attachment_rate_functions.py:177-201):
+    # mix baseline / PV-only / PV+batt profiles by adopter counts ---
+    if with_hourly:
+        # integer allocation can grant a battery unit to an agent whose
+        # fractional adopter count is below 1; cap the battery-profile
+        # weight at the agent's adopter count so households aren't
+        # counted twice in the mix
+        batt_mix = jnp.minimum(batt_adopters_cum, adopters)
+        pv_only = jnp.maximum(adopters - batt_mix, 0.0)
+        base_cnt = jnp.maximum(ya.customers_in_bin - adopters, 0.0)
+        net = (
+            base_cnt[:, None] * res.baseline_net_hourly
+            + pv_only[:, None] * res.adopter_net_hourly_pvonly
+            + batt_mix[:, None] * res.adopter_net_hourly_with_batt
+        ) * table.mask[:, None]
+        state_hourly = jax.ops.segment_sum(
+            net, table.state_idx, n_states
+        ) / 1000.0  # kW -> MW
+    else:
+        state_hourly = jnp.zeros((0, 0), dtype=jnp.float32)
+
+    new_market = MarketState(
+        market_share=share,
+        max_market_share=mms,
+        adopters_cum=adopters,
+        market_value=out.market_value,
+        system_kw_cum=kw_cum,
+        batt_kw_cum=batt_kw_cum,
+        batt_kwh_cum=batt_kwh_cum,
+        initial_adopters=mstate.initial_adopters,
+        initial_market_share=mstate.initial_market_share,
+    )
+    new_carry = SimCarry(market=new_market, batt_adopters_cum=batt_adopters_cum)
+
+    outputs = YearOutputs(
+        system_kw=res.system_kw,
+        npv=res.npv,
+        payback_period=res.payback_period,
+        cash_flow=res.cash_flow,
+        first_year_bill_with_system=res.first_year_bill_with_system,
+        first_year_bill_without_system=res.first_year_bill_without_system,
+        batt_kw=res.batt_kw,
+        batt_kwh=res.batt_kwh,
+        max_market_share=mms,
+        market_share=share,
+        new_adopters=new_adopters,
+        number_of_adopters=adopters,
+        new_system_kw=new_kw,
+        system_kw_cum=kw_cum,
+        market_value=out.market_value,
+        new_batt_adopters=new_batt,
+        batt_adopters_cum=batt_adopters_cum,
+        batt_kw_cum=batt_kw_cum,
+        batt_kwh_cum=batt_kwh_cum,
+        state_hourly_net_mw=state_hourly,
+    )
+    return new_carry, outputs
+
+
+# ---------------------------------------------------------------------------
+# Host-side driver
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SimResults:
+    """Host-side stacked run outputs: dict of [n_years, ...] numpy
+    arrays keyed by YearOutputs field, plus the year list."""
+
+    years: List[int]
+    agent: Dict[str, np.ndarray]          # per-agent fields [Y, N, ...]
+    state_hourly_net_mw: Optional[np.ndarray]  # [Y, n_states, 8760]
+
+    def summary(self, mask: np.ndarray) -> Dict[str, np.ndarray]:
+        """National per-year aggregates (the headline adoption curves)."""
+        m = mask[None, :]
+        return {
+            "adopters": (self.agent["number_of_adopters"] * m).sum(axis=1),
+            "system_kw_cum": (self.agent["system_kw_cum"] * m).sum(axis=1),
+            "batt_kwh_cum": (self.agent["batt_kwh_cum"] * m).sum(axis=1),
+            "new_adopters": (self.agent["new_adopters"] * m).sum(axis=1),
+        }
+
+
+class Simulation:
+    """Scenario runner (the analogue of reference dgen_model.main(),
+    dgen_model.py:50, minus the Postgres plumbing).
+
+    Parameters
+    ----------
+    table, profiles, tariffs : the ingested population and banks.
+    inputs : ScenarioInputs (all year-dependent trajectories).
+    scenario : ScenarioConfig.
+    run_config : RunConfig (block/pad/search iteration settings).
+    mesh : optional jax Mesh; agent axis is sharded over it.
+    with_hourly : also aggregate state-hourly net load (more HBM).
+    """
+
+    def __init__(
+        self,
+        table: AgentTable,
+        profiles: ProfileBank,
+        tariffs: TariffBank,
+        inputs: ScenarioInputs,
+        scenario: ScenarioConfig,
+        run_config: Optional[RunConfig] = None,
+        mesh: Optional[Mesh] = None,
+        with_hourly: bool = False,
+        econ_years: int = 25,
+    ) -> None:
+        self.scenario = scenario
+        self.run_config = run_config or RunConfig()
+        self.mesh = mesh
+        self.with_hourly = with_hourly
+        self.econ_years = econ_years
+        self.years = list(scenario.model_years)
+        if len(self.years) != inputs.n_years:
+            raise ValueError(
+                f"inputs cover {inputs.n_years} years but scenario has "
+                f"{len(self.years)}"
+            )
+
+        if mesh is not None:
+            shard = NamedSharding(mesh, P(AGENT_AXIS))
+            repl = NamedSharding(mesh, P())
+
+            def place_agent_axis(x):
+                # shard leading (agent) axis; leave small leaves replicated
+                if hasattr(x, "ndim") and x.ndim >= 1 and (
+                    x.shape[0] == table.n_agents
+                ):
+                    return jax.device_put(
+                        x, NamedSharding(mesh, P(AGENT_AXIS, *([None] * (x.ndim - 1))))
+                    )
+                return jax.device_put(x, repl)
+
+            table = jax.tree.map(place_agent_axis, table)
+            profiles = jax.tree.map(lambda x: jax.device_put(x, repl), profiles)
+            tariffs = jax.tree.map(lambda x: jax.device_put(x, repl), tariffs)
+            inputs = jax.tree.map(lambda x: jax.device_put(x, repl), inputs)
+            self._shard = shard
+        else:
+            self._shard = None
+
+        self.table = table
+        self.profiles = profiles
+        self.tariffs = tariffs
+        self.inputs = inputs
+
+    def _step_kwargs(self, first_year: bool) -> dict:
+        return dict(
+            n_periods=self.tariffs.max_periods,
+            econ_years=self.econ_years,
+            sizing_iters=self.run_config.sizing_iters,
+            first_year=first_year,
+            with_hourly=self.with_hourly,
+            storage_enabled=self.scenario.storage_enabled,
+            year_step_len=float(self.scenario.year_step),
+        )
+
+    def init_carry(self) -> SimCarry:
+        carry = SimCarry.zeros(self.table.n_agents)
+        if self._shard is not None:
+            carry = jax.tree.map(
+                lambda x: jax.device_put(x, self._shard), carry
+            )
+        return carry
+
+    def step(
+        self, carry: SimCarry, year_idx: int, first_year: bool
+    ) -> tuple[SimCarry, YearOutputs]:
+        return year_step(
+            self.table, self.profiles, self.tariffs, self.inputs, carry,
+            jnp.asarray(year_idx, dtype=jnp.int32),
+            **self._step_kwargs(first_year),
+        )
+
+    def run(
+        self,
+        callback: Optional[Callable[[int, int, YearOutputs], None]] = None,
+        collect: bool = True,
+    ) -> SimResults:
+        """Run every model year; returns stacked host results.
+
+        ``callback(year, year_idx, outputs)`` fires after each year with
+        the device outputs (use for exports/checkpoints — the analogue
+        of the reference's per-year pickle + ``agent_outputs`` append,
+        dgen_model.py:459-462).
+        """
+        carry = self.init_carry()
+        agent_fields = [
+            f.name for f in dataclasses.fields(YearOutputs)
+            if f.name != "state_hourly_net_mw"
+        ]
+        collected: Dict[str, list] = {k: [] for k in agent_fields}
+        hourly: List[np.ndarray] = []
+
+        for yi, year in enumerate(self.years):
+            t0 = time.time()
+            carry, outs = self.step(carry, yi, first_year=(yi == 0))
+            jax.block_until_ready(carry.market.market_share)
+            logger.info("year %d (%d/%d) %.2fs", year, yi + 1,
+                        len(self.years), time.time() - t0)
+            if callback is not None:
+                callback(year, yi, outs)
+            if collect:
+                for k in agent_fields:
+                    collected[k].append(np.asarray(getattr(outs, k)))
+                if self.with_hourly:
+                    hourly.append(np.asarray(outs.state_hourly_net_mw))
+
+        agent = (
+            {k: np.stack(v) for k, v in collected.items()} if collect else {}
+        )
+        return SimResults(
+            years=self.years,
+            agent=agent,
+            state_hourly_net_mw=np.stack(hourly) if hourly else None,
+        )

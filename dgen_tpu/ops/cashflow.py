@@ -253,15 +253,16 @@ def payback_period(cf: jax.Array) -> jax.Array:
     """
     cum = jnp.cumsum(cf)
     n = cf.shape[0] - 1  # tech lifetime
-    years = jnp.arange(n, dtype=jnp.float32)
 
     no_payback = jnp.logical_or(cum[-1] <= 0.0, jnp.all(cum <= 0.0))
     instant = jnp.all(cum > 0.0)
 
     crossed = jnp.diff(jnp.sign(cum)) > 0          # [n]
-    base_year = jnp.max(jnp.where(crossed, years, -1.0))
-    base_year = jnp.where(base_year == -1.0, n - 1.0, base_year)
-    bi = base_year.astype(jnp.int32)
+    # FIRST positive crossing (non-monotone cashflows — e.g. a year-1
+    # ITC inflow followed by loan-payment years — can cross repeatedly)
+    bi = jnp.argmax(crossed).astype(jnp.int32)
+    bi = jnp.where(jnp.any(crossed), bi, n - 1)
+    base_year = bi.astype(jnp.float32)
     base_val = cum[bi]
     next_val = cum[bi + 1]
     frac = base_val / (base_val - next_val + 1e-9)

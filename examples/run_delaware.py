@@ -1,0 +1,47 @@
+"""Delaware residential 2014-2024 — BASELINE.json config #1, the
+minimum end-to-end slice (SURVEY.md §7 build order step 4): synthetic
+DE population -> multi-year Simulation driver -> adoption curve."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+
+cfg = ScenarioConfig(name="delaware-res", start_year=2014, end_year=2024,
+                     anchor_years=())
+pop = synth.generate_population(
+    1024, states=["DE"], seed=1, sector_weights=(1.0, 0.0, 0.0)
+)
+inputs = scen.uniform_inputs(
+    cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+    overrides={"attachment_rate": jnp.full((pop.table.n_groups,), 0.25)},
+)
+sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                 RunConfig(sizing_iters=10), with_hourly=True)
+
+t0 = time.time()
+res = sim.run()
+elapsed = time.time() - t0
+
+m = np.asarray(pop.table.mask)
+s = res.summary(m)
+n_real = int(m.sum())
+print(f"{n_real} DE residential agents x {len(res.years)} years "
+      f"in {elapsed:.1f}s ({n_real * len(res.years) / elapsed:.0f} agent-years/sec)")
+print(f"{'year':>6} {'adopters':>10} {'MW_cum':>8} {'batt_MWh':>9} {'med_payback':>11}")
+for i, y in enumerate(res.years):
+    print(f"{y:>6} {s['adopters'][i]:>10.0f} {s['system_kw_cum'][i] / 1e3:>8.1f} "
+          f"{s['batt_kwh_cum'][i] / 1e3:>9.2f} "
+          f"{np.median(res.agent['payback_period'][i][m > 0]):>11.1f}")
+
+h = res.state_hourly_net_mw
+de_peak = h[:, synth.STATE_IDX['DE'], :].max(axis=1)
+print(f"DE hourly peak net load by year (MW): {np.round(de_peak, 1)}")
+assert np.all(np.diff(s["system_kw_cum"]) >= -1e-3)
+assert s["batt_kwh_cum"][-1] > 0
+print("DELAWARE RUN OK")

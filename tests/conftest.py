@@ -1,13 +1,18 @@
 """Test configuration: force an 8-device virtual CPU platform so every
 sharding test runs without TPU hardware (SURVEY.md §4 implication —
-multi-device testing via device-count flags, no pod needed)."""
+multi-device testing via device-count flags, no pod needed).
+
+Env vars are not enough here: the environment's site hook imports jax at
+interpreter startup (before conftest runs), so ``JAX_PLATFORMS`` from
+the environment is already baked in. ``jax.config.update`` after import
+is the reliable override.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

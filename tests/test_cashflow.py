@@ -98,6 +98,11 @@ def test_payback_semantics():
     # crosses between year 2 and 3: cum = [-10, -4, 2] -> 1 + 4/6 = 1.7
     cf2 = jnp.asarray(np.array([-10.0, 6.0, 6.0], dtype=np.float32))
     assert float(cf.payback_period(cf2)) == pytest.approx(1.7)
+    # non-monotone (loan + year-1 ITC inflow): cum = [-1, 4, -2, 4] crosses
+    # up twice; the FIRST crossing wins (reference
+    # financial_functions.py:1241 takes the first positive cumulative year)
+    cf3 = jnp.asarray(np.array([-1.0, 5.0, -6.0, 6.0], dtype=np.float32))
+    assert float(cf.payback_period(cf3)) == pytest.approx(0.2)
 
 
 def test_pbi_incentive_stream():

@@ -1,0 +1,156 @@
+"""End-to-end driver tests: full multi-year runs, sharded-vs-unsharded
+parity on the 8-device CPU mesh, anchoring, NEM gate, and storage
+attachment behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import SimCarry, Simulation
+from dgen_tpu.parallel.mesh import make_mesh
+
+
+def make_sim(n_agents=190, states=("DE", "CA", "TX"), end_year=2022,
+             mesh=None, overrides=None, anchor_years=(), **kw):
+    cfg = ScenarioConfig(name="t", start_year=2014, end_year=end_year,
+                         anchor_years=anchor_years)
+    pop = synth.generate_population(
+        n_agents, states=list(states), seed=11, pad_multiple=64
+    )
+    ov = {"attachment_rate": jnp.full((pop.table.n_groups,), 0.4)}
+    if overrides:
+        ov.update(overrides)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions, overrides=ov
+    )
+    sim = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+        RunConfig(sizing_iters=8), mesh=mesh, **kw,
+    )
+    return sim, pop
+
+
+@pytest.fixture(scope="module")
+def base_run():
+    sim, pop = make_sim()
+    res = sim.run()
+    return sim, pop, res
+
+
+def test_run_shapes_and_finiteness(base_run):
+    sim, pop, res = base_run
+    n_years = len(res.years)
+    n = pop.table.n_agents
+    assert res.agent["system_kw_cum"].shape == (n_years, n)
+    for k, v in res.agent.items():
+        assert np.all(np.isfinite(v)), f"non-finite values in {k}"
+
+
+def test_adoption_monotone_and_positive(base_run):
+    sim, pop, res = base_run
+    s = res.summary(np.asarray(pop.table.mask))
+    assert s["system_kw_cum"][-1] > 0, "nobody adopted"
+    assert np.all(np.diff(s["system_kw_cum"]) >= -1e-3)
+    assert np.all(np.diff(s["adopters"]) >= -1e-3)
+
+
+def test_market_share_bounded(base_run):
+    sim, pop, res = base_run
+    ms = res.agent["market_share"]
+    assert np.all(ms >= -1e-6)
+    assert np.all(ms <= 1.0 + 1e-6)
+
+
+def test_battery_attachment_integer_and_bounded(base_run):
+    sim, pop, res = base_run
+    nb = res.agent["new_batt_adopters"]
+    assert np.allclose(nb, np.round(nb), atol=1e-4), "non-integer allocation"
+    # cumulative battery adopters can't exceed cumulative PV adopters
+    # (attachment rate <= 1, reference attachment_rate_functions.py:107)
+    assert np.all(
+        res.agent["batt_adopters_cum"] <= res.agent["number_of_adopters"] + 1.0
+    )
+    assert res.agent["batt_kwh_cum"][-1].sum() > 0, "no storage attached"
+
+
+def test_padding_agents_stay_zero(base_run):
+    sim, pop, res = base_run
+    pad = np.asarray(pop.table.mask) == 0.0
+    assert pad.any(), "fixture should have padding rows"
+    assert np.all(res.agent["new_adopters"][:, pad] == 0.0)
+    assert np.all(res.agent["new_batt_adopters"][:, pad] == 0.0)
+
+
+def test_sharded_matches_unsharded():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8, "conftest should provide 8 CPU devices"
+    sim_s, pop = make_sim(mesh=mesh)
+    sim_u, _ = make_sim(mesh=None)
+    res_s = sim_s.run()
+    res_u = sim_u.run()
+    m = np.asarray(pop.table.mask)
+    s, u = res_s.summary(m), res_u.summary(m)
+    np.testing.assert_allclose(s["adopters"], u["adopters"], rtol=2e-4)
+    np.testing.assert_allclose(s["system_kw_cum"], u["system_kw_cum"], rtol=2e-4)
+    np.testing.assert_allclose(s["batt_kwh_cum"], u["batt_kwh_cum"], rtol=2e-4)
+
+
+def test_anchoring_rescales_to_observed():
+    # observe 5000 kW in every group in the 2016 anchor year; the model
+    # must land exactly on the observed state x sector totals
+    # (reference diffusion_functions_elec.py:99-133)
+    sim0, pop = make_sim(end_year=2018)
+    g = pop.table.n_groups
+    years = ScenarioConfig(name="t", start_year=2014, end_year=2018).model_years
+    observed = np.zeros((len(years), g), np.float32)
+    observed[1] = 5000.0  # 2016
+    sim, pop = make_sim(
+        end_year=2018, anchor_years=(2016,),
+        overrides={"observed_kw": jnp.asarray(observed)},
+    )
+    res = sim.run()
+    kw_2016 = res.agent["system_kw_cum"][1]
+    group_kw = np.zeros(g)
+    np.add.at(group_kw, np.asarray(pop.table.group_idx), kw_2016)
+    present = np.zeros(g, bool)
+    np.add.at(present, np.asarray(pop.table.group_idx)[np.asarray(pop.table.mask) > 0], True)
+    np.testing.assert_allclose(group_kw[present], 5000.0, rtol=1e-3)
+
+
+def test_nem_cap_gate_reduces_value():
+    # with NEM shut off from the start (cap 0), bills savings fall ->
+    # fewer adopters than with NEM available
+    sim_nem, pop = make_sim()
+    n_states = pop.table.n_states
+    n_years = len(sim_nem.years)
+    sim_no, _ = make_sim(
+        overrides={"nem_cap_kw": jnp.zeros((n_years, n_states), jnp.float32)}
+    )
+    res_nem = sim_nem.run()
+    res_no = sim_no.run()
+    m = np.asarray(pop.table.mask)
+    a_nem = res_nem.summary(m)["system_kw_cum"][-1]
+    a_no = res_no.summary(m)["system_kw_cum"][-1]
+    assert a_no < a_nem, f"NEM-off should adopt less ({a_no} !< {a_nem})"
+
+
+def test_hourly_aggregation_consistency():
+    sim, pop = make_sim(with_hourly=True)
+    res = sim.run()
+    h = res.state_hourly_net_mw
+    assert h is not None and h.shape[1:] == (pop.table.n_states, 8760)
+    assert np.all(np.isfinite(h))
+    # total energy must be positive and decline as PV+storage grows
+    annual = h.sum(axis=(1, 2))
+    assert annual[0] > 0
+    assert annual[-1] < annual[0]
+
+
+def test_carry_zeros_shape():
+    c = SimCarry.zeros(64)
+    assert c.market.market_share.shape == (64,)
+    assert c.batt_adopters_cum.shape == (64,)

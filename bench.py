@@ -72,6 +72,7 @@ def _cpu_baseline(sim, pop) -> float:
         args = (table1, sim.profiles, sim.tariffs, sim.inputs, carry1,
                 jnp.asarray(1, dtype=jnp.int32))
         kw = sim._step_kwargs(first_year=False)
+        kw["sizing_impl"] = "xla"  # Pallas kernel is TPU-only
         out = year_step(*args, **kw)   # compile
         jax.block_until_ready(out)
         n_rep = 8

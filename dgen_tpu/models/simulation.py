@@ -170,7 +170,7 @@ def build_econ_inputs(
     jax.jit,
     static_argnames=(
         "n_periods", "econ_years", "sizing_iters", "first_year",
-        "with_hourly", "storage_enabled", "year_step_len",
+        "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
     ),
 )
 def year_step(
@@ -188,6 +188,7 @@ def year_step(
     with_hourly: bool,
     storage_enabled: bool,
     year_step_len: float,
+    sizing_impl: str = "auto",
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
 
@@ -224,7 +225,7 @@ def year_step(
     # --- hot loop: size every agent (financial_functions.py:291) ---
     res = sizing_ops.size_agents(
         envs, n_periods=n_periods, n_years=econ_years,
-        n_iters=sizing_iters, keep_hourly=with_hourly,
+        n_iters=sizing_iters, keep_hourly=with_hourly, impl=sizing_impl,
     )
 
     # --- market step ---
@@ -422,6 +423,14 @@ class Simulation:
         self.inputs = inputs
 
     def _step_kwargs(self, first_year: bool) -> dict:
+        # The Pallas bucket-sums kernel is not partition-aware; under a
+        # real multi-device TPU mesh fall back to its XLA formulation
+        # (virtual CPU meshes hit the XLA path via backend detection).
+        multi_tpu = (
+            self.mesh is not None
+            and jax.default_backend() == "tpu"
+            and self.mesh.devices.size > 1
+        )
         return dict(
             n_periods=self.tariffs.max_periods,
             econ_years=self.econ_years,
@@ -430,6 +439,7 @@ class Simulation:
             with_hourly=self.with_hourly,
             storage_enabled=self.scenario.storage_enabled,
             year_step_len=float(self.scenario.year_step),
+            sizing_impl="xla" if multi_tpu else "auto",
         )
 
     def init_carry(self) -> SimCarry:

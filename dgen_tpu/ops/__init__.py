@@ -6,4 +6,11 @@ These replace the reference's native PySAM/SSC C++ simulation core
 kernels (SURVEY.md §2.7).
 """
 
-from dgen_tpu.ops import bill, cashflow, dispatch, sizing, tariff  # noqa: F401
+from dgen_tpu.ops import (  # noqa: F401
+    bill,
+    billpallas,
+    cashflow,
+    dispatch,
+    sizing,
+    tariff,
+)

@@ -1,0 +1,359 @@
+"""Pallas TPU kernels for the bill engine's hour-axis reductions.
+
+The sizing hot loop needs, for every agent and a batch of R net-load
+scales (search candidates x analysis years), reductions over the
+8760-hour axis of ``net = load - s * gen_shape``:
+
+  * signed (month x TOU-period) sums      -> net-metering bills
+  * positive-part (import) bucket sums    -> net-billing import charges
+  * sell-rate-weighted sums               -> net-billing export credit
+
+Three structural facts make this cheap on a TPU:
+
+1. **Signed sums are linear in s**: ``signed(s) = S_load - s * S_gen``,
+   so net-metering bills need NO hourly work per candidate — just two
+   precomputed bucket-sum vectors per agent (:func:`linear_sums`).
+2. **Export credit is linear given the import sums**: with
+   ``exp = relu(net) - net`` (elementwise identity),
+   ``credit(s) = imp_sell(s) - (S_load_sell - s * S_gen_sell)`` — so the
+   nonlinear kernel only ever computes ONE matmul: ``relu(net) @ M``.
+3. **Candidates batch into MXU rows**: packing (candidate, year) pairs
+   into the matmul row axis (R = K x Y ~ 400) fills the MXU's 128-row
+   tiles, where a per-candidate loop would run 32-row matmuls at 25%
+   utilization and 14x the launch count.
+
+``M`` is the per-agent [H, 128] bucket one-hot with the hourly sell
+rate folded into column 127, built in VMEM from the bucket-id row. HBM
+traffic per sizing-objective evaluation is O(N * 8760) — the
+straightforward XLA formulation (dgen_tpu.ops.bill.bill_series)
+materializes O(N * Y * 8760), the measured v5e bottleneck; the
+reference re-runs its C++ rate engine per (agent, candidate)
+(financial_functions.py:270).
+
+The pure-XLA twins (``impl="xla"``) keep CPU tests and
+virtually-sharded runs working; parity is asserted in
+tests/test_billpallas.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.ops.bill import tiered_charge
+from dgen_tpu.ops.tariff import HOURS, MONTHS, NET_BILLING, hour_month_map
+
+H_PAD = 8832          # 8760 rounded up to 69 * 128 lanes
+B_PAD = 128           # bucket axis = MXU-friendly output width
+SELL_COL = B_PAD - 1  # column of M carrying the hourly sell rate
+PAD_BUCKET = B_PAD - 2  # bucket id for padding hours (values are 0 anyway)
+
+_HOUR_MONTH = hour_month_map()
+
+
+def _kernel(scales_ref, load_ref, gen_ref, sell_ref, bucket_ref,
+            *out_refs, r_pad, h_chunk, with_signed, bf16):
+    """One agent per program: [r_pad, B_PAD] bucket sums.
+
+    Outputs: (imports,) or (imports, signed) when ``with_signed``.
+    ``bf16`` runs the MXU contraction in bfloat16 with f32 accumulation
+    (~4x the f32 MXU rate on v5e) — used for search rounds, where only
+    the candidate RANKING matters; final/battery evaluations stay f32.
+    """
+    scales = scales_ref[0, 0, :]                           # [r_pad]
+    acc_i = jnp.zeros((r_pad, B_PAD), jnp.float32)
+    acc_s = jnp.zeros((r_pad, B_PAD), jnp.float32) if with_signed else None
+    mm_dtype = jnp.bfloat16 if bf16 else jnp.float32
+
+    for h0 in range(0, H_PAD, h_chunk):
+        load = load_ref[0, 0, h0:h0 + h_chunk]             # [Hc]
+        gen = gen_ref[0, 0, h0:h0 + h_chunk]
+        sell = sell_ref[0, 0, h0:h0 + h_chunk]
+        bucket = bucket_ref[0, 0, h0:h0 + h_chunk]
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (h_chunk, B_PAD), 1)
+        onehot = (bucket[:, None] == col).astype(mm_dtype)
+        m = jnp.where(col == SELL_COL, sell[:, None].astype(mm_dtype), onehot)
+
+        net = load[None, :] - scales[:, None] * gen[None, :]  # [r_pad, Hc]
+        acc_i = acc_i + jnp.dot(
+            jnp.maximum(net, 0.0).astype(mm_dtype), m,
+            preferred_element_type=jnp.float32,
+        )
+        if with_signed:
+            acc_s = acc_s + jnp.dot(
+                net.astype(mm_dtype), m, preferred_element_type=jnp.float32
+            )
+
+    out_refs[0][0] = acc_i
+    if with_signed:
+        out_refs[1][0] = acc_s
+
+
+def _pad_hours(x: jax.Array, fill=0.0) -> jax.Array:
+    n, h = x.shape
+    if h == H_PAD:
+        return x
+    return jnp.pad(x, ((0, 0), (0, H_PAD - h)), constant_values=fill)
+
+
+def _round8(r: int) -> int:
+    return ((r + 7) // 8) * 8
+
+
+def _sums_pallas(load, gen, sell, bucket_id, scales, with_signed, bf16=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = load.shape[0]
+    r = scales.shape[1]
+    r_pad = _round8(r)
+    # keep VMEM bounded: net is [r_pad, h_chunk] f32 (+ its relu copy)
+    h_chunk = 2208 if r_pad <= 64 else 1104
+
+    load_p = _pad_hours(load)[:, None, :]
+    gen_p = _pad_hours(gen)[:, None, :]
+    sell_p = _pad_hours(sell)[:, None, :]
+    bucket_p = _pad_hours(bucket_id, fill=PAD_BUCKET).astype(jnp.int32)[:, None, :]
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
+
+    out3 = lambda i: (i, 0, 0)
+    n_out = 2 if with_signed else 1
+    outs = pl.pallas_call(
+        partial(_kernel, r_pad=r_pad, h_chunk=h_chunk,
+                with_signed=with_signed, bf16=bf16),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H_PAD), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H_PAD), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H_PAD), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H_PAD), out3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, r_pad, B_PAD), out3, memory_space=pltpu.VMEM)
+        ] * n_out,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, r_pad, B_PAD), jnp.float32)
+        ] * n_out,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_out * n * r_pad * H_PAD * B_PAD,
+            bytes_accessed=4 * n * H_PAD * 4,
+            transcendentals=0,
+        ),
+    )(scales_p, load_p, gen_p, sell_p, bucket_p)
+    return tuple(o[:, :r] for o in outs)
+
+
+def _sums_xla(load, gen, sell, bucket_id, scales, n_buckets, with_signed):
+    """Pure-XLA twin (CPU tests, sharded runs): one [N, H] pass per
+    scale via lax.map, bucketed with per-period masked matmuls against
+    the SHARED month one-hot — no per-agent [H, B] one-hot is ever
+    materialized, so memory stays O(N*H) at any agent count.
+
+    ``bucket_id = month * P + period`` implies
+    ``period = bucket_id mod P`` (P = n_buckets // 12), so the period
+    mask is recovered without needing the tariff here.
+    """
+    from dgen_tpu.ops.bill import monthly_period_sums
+
+    n_periods = n_buckets // MONTHS
+    hour_period = (bucket_id % n_periods).astype(jnp.int32)
+    n = load.shape[0]
+
+    def bucketize(x):  # [N, H] -> [N, B] month-major
+        mp = jax.vmap(
+            lambda row, hp: monthly_period_sums(row, hp, n_periods)
+        )(x, hour_period)                                    # [N, 12, P]
+        return mp.reshape(n, n_buckets)
+
+    def per_scale(s_r):
+        net = load - s_r[:, None] * gen                      # [N, H]
+        pos = jnp.maximum(net, 0.0)
+        imports = bucketize(pos)
+        imp_sell = jnp.sum(pos * sell, axis=1)
+        if with_signed:
+            return (imports, imp_sell), (bucketize(net),
+                                         jnp.sum(net * sell, axis=1))
+        return ((imports, imp_sell),)
+
+    outs = jax.lax.map(per_scale, jnp.swapaxes(scales, 0, 1))
+    result = []
+    for buckets, sell_sum in outs:
+        o = jnp.swapaxes(buckets, 0, 1)                      # [N, R, B]
+        o = jnp.pad(o, ((0, 0), (0, 0), (0, B_PAD - n_buckets)))
+        o = o.at[:, :, SELL_COL].set(jnp.swapaxes(sell_sum, 0, 1))
+        result.append(o)
+    return tuple(result)
+
+
+def _resolve_impl(impl: str) -> str:
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return impl
+
+
+def _check_buckets(n_buckets: int) -> None:
+    # ids >= PAD_BUCKET would collide with the padding id / sell column
+    # of the kernel's M matrix and silently corrupt bills
+    if n_buckets > PAD_BUCKET - 1:
+        raise ValueError(
+            f"{n_buckets} buckets (12 x n_periods) exceeds the kernel "
+            f"layout limit of {PAD_BUCKET - 1} (tariffs with more than "
+            f"{(PAD_BUCKET - 1) // 12} TOU periods are unsupported)"
+        )
+
+
+@partial(jax.jit, static_argnames=("n_buckets", "impl", "bf16"))
+def import_sums(
+    load: jax.Array,      # [N, 8760]
+    gen: jax.Array,       # [N, 8760]
+    sell: jax.Array,      # [N, 8760]
+    bucket_id: jax.Array,  # [N, 8760] int32 in [0, n_buckets)
+    scales: jax.Array,    # [N, R]
+    n_buckets: int,
+    impl: str = "auto",
+    bf16: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """(imports [N,R,B], imp_sell [N,R]): positive-part bucket sums and
+    the sell-weighted positive-part sum for R net-load scales."""
+    _check_buckets(n_buckets)
+    if _resolve_impl(impl) == "pallas":
+        (imp,) = _sums_pallas(load, gen, sell, bucket_id, scales,
+                              with_signed=False, bf16=bf16)
+    else:
+        (imp,) = _sums_xla(load, gen, sell, bucket_id, scales, n_buckets,
+                           with_signed=False)
+    return imp[:, :, :n_buckets], imp[:, :, SELL_COL]
+
+
+@partial(jax.jit, static_argnames=("n_buckets", "impl"))
+def bucket_sums(
+    load: jax.Array,
+    gen: jax.Array,
+    sell: jax.Array,
+    bucket_id: jax.Array,
+    scales: jax.Array,
+    n_buckets: int,
+    impl: str = "auto",
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(signed [N,R,B], imports [N,R,B], export_credit [N,R]) — the full
+    reduction set (battery forward runs, tests)."""
+    _check_buckets(n_buckets)
+    if _resolve_impl(impl) == "pallas":
+        imp, signed = _sums_pallas(load, gen, sell, bucket_id, scales,
+                                   with_signed=True)
+    else:
+        imp, signed = _sums_xla(load, gen, sell, bucket_id, scales,
+                                n_buckets, with_signed=True)
+    # exports = relu(-net) reductions = imports - signed (columnwise)
+    credit = imp[:, :, SELL_COL] - signed[:, :, SELL_COL]
+    return signed[:, :, :n_buckets], imp[:, :, :n_buckets], credit
+
+
+@partial(jax.jit, static_argnames=("n_periods",))
+def linear_sums(
+    load: jax.Array,         # [N, 8760]
+    gen: jax.Array,          # [N, 8760]
+    sell: jax.Array,         # [N, 8760]
+    hour_period: jax.Array,  # [N, 8760] int32 TOU period per hour
+    n_periods: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-agent linear bill structure, computed once per year step:
+    (S_load [N,B], S_gen [N,B], S_load_sell [N], S_gen_sell [N]).
+
+    ``signed(s) = S_load - s * S_gen`` gives exact NEM monthly sums for
+    any scale; the ``*_sell`` scalars close the export-credit identity.
+
+    Pure XLA: per TOU period, one [N, 8760] x [8760, 12] matmul against
+    the SHARED month one-hot — full MXU row tiles over the agent axis,
+    no per-agent kernel program needed.
+    """
+    from dgen_tpu.ops.bill import monthly_period_sums
+
+    n = load.shape[0]
+
+    def bucketize(x):  # vmapped shared-month-one-hot bucketing
+        mp = jax.vmap(
+            lambda row, hp: monthly_period_sums(row, hp, n_periods)
+        )(x, hour_period)                                    # [N, 12, P]
+        return mp.reshape(n, MONTHS * n_periods)
+
+    s_l = bucketize(load)
+    s_g = bucketize(gen)
+    s_l_sell = jnp.sum(load * sell, axis=1)
+    s_g_sell = jnp.sum(gen * sell, axis=1)
+    return s_l, s_g, s_l_sell, s_g_sell
+
+
+def hourly_bucket_ids(hour_period: jax.Array, n_periods: int) -> jax.Array:
+    """[N, 8760] month-major bucket ids from per-agent TOU period maps."""
+    month = jnp.asarray(_HOUR_MONTH, jnp.int32)[None, :]
+    return month * n_periods + hour_period
+
+
+def sell_rate_hourly(tariff, ts_sell: jax.Array) -> jax.Array:
+    """Hourly sell rate per agent, matching bill.annual_bill's choice:
+    the tariff's TOU sell price when defined, else the time-series rate."""
+    tou = jnp.take_along_axis(tariff.sell_price, tariff.hour_period, axis=1)
+    has_tou = jnp.any(tariff.sell_price > 0.0, axis=1, keepdims=True)
+    return jnp.where(has_tou, tou, ts_sell)
+
+
+def _tier_charge_batched(sums_mp, tariff):
+    """[N, R, 12, P] monthly sums -> [N, R] annual tiered charges."""
+    return jax.vmap(  # over agents
+        lambda s_ry, p, c: jax.vmap(  # over scales
+            lambda s_m: jnp.sum(tiered_charge(s_m, p, c))
+        )(s_ry)
+    )(sums_mp, tariff.price, tariff.tier_cap)
+
+
+def bills_from_sums(
+    signed: jax.Array,    # [N, R, B]
+    imports: jax.Array,   # [N, R, B]
+    credit: jax.Array,    # [N, R]
+    tariff,               # batched AgentTariff (leaves [N, ...])
+    n_periods: int,
+) -> jax.Array:
+    """Annual bills [N, R] from full bucket sums (tier structure +
+    metering selection + fixed charges; bill.annual_bill semantics)."""
+    n, r, _ = signed.shape
+    bill_nem = _tier_charge_batched(
+        signed.reshape(n, r, MONTHS, n_periods), tariff)
+    bill_nb = _tier_charge_batched(
+        imports.reshape(n, r, MONTHS, n_periods), tariff) - credit
+
+    is_nb = (tariff.metering == NET_BILLING)[:, None]
+    energy_bill = jnp.where(is_nb, bill_nb, bill_nem)
+    return energy_bill + MONTHS * tariff.fixed_monthly[:, None]
+
+
+def bills_linear_nb(
+    lin: tuple[jax.Array, jax.Array, jax.Array, jax.Array],
+    imports: jax.Array,   # [N, R, B]
+    imp_sell: jax.Array,  # [N, R]
+    scales: jax.Array,    # [N, R]
+    tariff,
+    n_periods: int,
+) -> jax.Array:
+    """Annual bills [N, R] from the search path's reduced outputs:
+    NEM via the linear identity, net billing via import sums + the
+    linear export-credit identity."""
+    s_load, s_gen, s_l_sell, s_g_sell = lin
+    n, r, _ = imports.shape
+
+    signed = s_load[:, None, :] - scales[:, :, None] * s_gen[:, None, :]
+    bill_nem = _tier_charge_batched(
+        signed.reshape(n, r, MONTHS, n_periods), tariff)
+
+    credit = imp_sell - (s_l_sell[:, None] - scales * s_g_sell[:, None])
+    bill_nb = _tier_charge_batched(
+        imports.reshape(n, r, MONTHS, n_periods), tariff) - credit
+
+    is_nb = (tariff.metering == NET_BILLING)[:, None]
+    energy_bill = jnp.where(is_nb, bill_nb, bill_nem)
+    return energy_bill + MONTHS * tariff.fixed_monthly[:, None]

@@ -105,28 +105,35 @@ def loan_schedule(principal: jax.Array, rate: jax.Array, term: jax.Array,
     """(payment [Y], interest [Y]) of a level-payment amortizing loan.
 
     Payments run for ``term`` years then stop; ``n_years`` is the static
-    analysis horizon.
+    analysis horizon. Closed form (no scan): the start-of-year balance
+    of a level-payment loan is
+    ``B_t = P*(1+r)^t - pmt*((1+r)^t - 1)/r``, so every year's interest
+    is one vectorized expression — keeps the cashflow kernel free of
+    sequential steps under large-batch vmap.
     """
     term_f = term.astype(jnp.float32)
-    # level payment; guard rate ~ 0
     r = rate
+    y = jnp.arange(n_years, dtype=jnp.float32)
+    active = (y < term_f).astype(jnp.float32)
+
+    # level payment; guard rate ~ 0
+    small_r = r <= 1e-9
+    r_safe = jnp.where(small_r, 1.0, r)
     annuity = jnp.where(
-        r > 1e-9,
-        r / (1.0 - (1.0 + r) ** (-term_f)),
+        small_r,
         1.0 / jnp.maximum(term_f, 1.0),
+        r_safe / (1.0 - (1.0 + r_safe) ** (-term_f)),
     )
     pmt = principal * annuity
 
-    def step(balance, y):
-        active = (y < term).astype(jnp.float32)
-        interest = balance * r * active
-        principal_paid = (pmt - interest) * active
-        new_balance = balance - principal_paid
-        return new_balance, (pmt * active, interest)
-
-    _, (payments, interests) = jax.lax.scan(
-        step, principal, jnp.arange(n_years, dtype=jnp.int32)
+    growth = (1.0 + r) ** y                                   # [Y]
+    balance_start = jnp.where(
+        small_r,
+        principal - pmt * y,
+        principal * growth - pmt * (growth - 1.0) / r_safe,
     )
+    interests = balance_start * r * active
+    payments = pmt * active
     return payments, interests
 
 

@@ -96,6 +96,30 @@ def test_kw_star_beats_neighbors():
         assert npv_star >= npv_alt - max(abs(npv_star) * 5e-3, 2.0)
 
 
+def test_fast_path_matches_slow_path():
+    """The scale-parameterized fast path must agree with the direct
+    hourly path on every output of the full kernel."""
+    envs = []
+    for i in range(4):
+        env, bank = _make_env(seed=10 + i, tariff_k=i % 4, load_kwh=5000.0 + 3000.0 * i)
+        envs.append(env)
+    batched = jax.tree.map(lambda *xs: jnp.stack(xs), *envs)
+    rf = sizing.size_agents(batched, n_periods=bank.max_periods, n_years=25, fast=True)
+    rs = sizing.size_agents(batched, n_periods=bank.max_periods, n_years=25, fast=False)
+    # kW* tolerance covers the fast path's grid discretization
+    # (~2/n_iters^2 of the bracket), not engine disagreement
+    np.testing.assert_allclose(np.asarray(rf.system_kw), np.asarray(rs.system_kw), rtol=6e-3)
+    np.testing.assert_allclose(np.asarray(rf.npv), np.asarray(rs.npv), rtol=2e-3, atol=10.0)
+    np.testing.assert_allclose(
+        np.asarray(rf.payback_period), np.asarray(rs.payback_period), atol=0.21)
+    np.testing.assert_allclose(
+        np.asarray(rf.first_year_bill_with_system),
+        np.asarray(rs.first_year_bill_with_system), rtol=1e-3, atol=1.0)
+    np.testing.assert_allclose(
+        np.asarray(rf.first_year_bill_with_batt),
+        np.asarray(rs.first_year_bill_with_batt), rtol=1e-3, atol=1.0)
+
+
 def test_size_agents_vmapped():
     envs = []
     for i in range(4):

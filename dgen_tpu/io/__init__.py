@@ -3,4 +3,10 @@ the binary columnar profile store, and checkpointing. Replaces the
 reference's Postgres data plane (SURVEY.md §2.5) — nothing here runs on
 the device path."""
 
-from dgen_tpu.io import ingest, synth  # noqa: F401
+from dgen_tpu.io import (  # noqa: F401
+    checkpoint,
+    export,
+    ingest,
+    reference_inputs,
+    synth,
+)

@@ -1,0 +1,94 @@
+"""Per-year checkpoint / resume via orbax.
+
+The reference checkpoints by pickling the full agent DataFrame every
+model year (``agent_df_{year}.pkl``, reference dgen_model.py:459) and
+exposes a ``resume_year`` CLI stub that nothing consumes
+(utility_functions.py:318-355, SURVEY.md §5 — resume is vestigial
+there). Here resume is real: the only cross-year state is the
+:class:`~dgen_tpu.models.simulation.SimCarry` pytree (the
+``market_last_year_df`` analogue), so a checkpoint is one small orbax
+save per year and a restore is one restore + re-entering the year loop
+at the right index.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from dgen_tpu.models.simulation import SimCarry
+
+
+def _mgr(directory: str) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(create=True, max_to_keep=None),
+    )
+
+
+class Writer:
+    """Per-run checkpoint writer holding ONE orbax manager (creating a
+    manager per save re-scans the directory and restarts worker threads
+    every year). ``force=True`` overwrites an existing step — without
+    it orbax silently skips the save and a later resume would restore
+    stale carries from a previous run into the same directory."""
+
+    def __init__(self, directory: str) -> None:
+        self._mgr = _mgr(directory)
+
+    def save(self, year: int, carry: SimCarry) -> None:
+        if year in self._mgr.all_steps():
+            # drop the stale step: this orbax version refuses to save
+            # over an existing step (StepAlreadyExistsError) rather than
+            # overwriting, and skipping would resurrect a previous
+            # run's carry on resume
+            self._mgr.delete(year)
+        self._mgr.save(
+            year,
+            args=ocp.args.StandardSave(jax.tree.map(np.asarray, carry)),
+            force=True,
+        )
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self) -> "Writer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def save_year(directory: str, year: int, carry: SimCarry) -> None:
+    """One-shot save (prefer :class:`Writer` inside run loops)."""
+    with Writer(directory) as w:
+        w.save(year, carry)
+
+
+def latest_year(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    with _mgr(directory) as mgr:
+        step = mgr.latest_step()
+    return int(step) if step is not None else None
+
+
+def restore_year(
+    directory: str, n_agents: int, year: Optional[int] = None
+) -> Tuple[int, SimCarry]:
+    """(year, carry) for ``year`` (default: latest checkpointed year)."""
+    with _mgr(directory) as mgr:
+        step = year if year is not None else mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+        template = jax.tree.map(np.asarray, SimCarry.zeros(n_agents))
+        restored = mgr.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+    carry = jax.tree.map(jax.numpy.asarray, restored)
+    return int(step), carry

@@ -1,0 +1,140 @@
+"""Per-year run outputs: parquet-based equivalents of the reference's
+result tables.
+
+The reference writes three result surfaces per model year into its
+Postgres output schema (SURVEY.md §2.5): the wide ``agent_outputs``
+table (dgen_model.py:441-463), the state-hourly net-load aggregate
+``state_hourly_agg`` (attachment_rate_functions.py:151-201), and the
+25-element per-agent cashflow/bill arrays in ``agent_finance_series``
+(finance_series_export.py:22). Here each becomes a partitioned parquet
+dataset under the run directory — the TPU path's data plane is files,
+not a database (SURVEY.md §2.6: no per-agent SQL round trips) — and a
+loader on the other side reassembles cross-year frames.
+
+Layout:
+    <run_dir>/agent_outputs/year=<Y>.parquet
+    <run_dir>/state_hourly/year=<Y>.parquet     (hour-major long format)
+    <run_dir>/finance_series/year=<Y>.parquet
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+#: YearOutputs fields exported to agent_outputs (the reference drops
+#: its heavy intermediate columns before writing, dgen_model.py:441-456;
+#: hourly arrays and cashflow get their own surfaces here).
+AGENT_OUTPUT_FIELDS = (
+    "system_kw", "npv", "payback_period", "max_market_share",
+    "market_share", "new_adopters", "number_of_adopters",
+    "new_system_kw", "system_kw_cum", "market_value",
+    "first_year_bill_with_system", "first_year_bill_without_system",
+    "batt_kw", "batt_kwh", "new_batt_adopters", "batt_adopters_cum",
+    "batt_kw_cum", "batt_kwh_cum",
+)
+
+
+def _dir(run_dir: str, name: str) -> str:
+    d = os.path.join(run_dir, name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class RunExporter:
+    """Host-side per-year writer, used as a Simulation.run callback.
+
+    ``mask`` drops padding agents; ``agent_id`` restores stable ids.
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        agent_id: np.ndarray,
+        mask: np.ndarray,
+        state_names: Optional[Sequence[str]] = None,
+        finance_series: bool = True,
+    ) -> None:
+        self.run_dir = run_dir
+        self.keep = np.asarray(mask) > 0
+        self.agent_id = np.asarray(agent_id)[self.keep]
+        self.state_names = list(state_names) if state_names else None
+        self.finance_series = finance_series
+        os.makedirs(run_dir, exist_ok=True)
+
+    def _check_state_names(self, n_states: int) -> None:
+        if self.state_names is not None and len(self.state_names) != n_states:
+            raise ValueError(
+                f"state_names has {len(self.state_names)} entries but the "
+                f"hourly aggregate covers {n_states} states"
+            )
+
+    def __call__(self, year: int, year_idx: int, outs) -> None:
+        self.write_agent_outputs(year, outs)
+        if self.finance_series:
+            self.write_finance_series(year, outs)
+        hourly = np.asarray(outs.state_hourly_net_mw)
+        if hourly.size:
+            self.write_state_hourly(year, hourly)
+
+    # --- agent_outputs (reference dgen_model.py:460-462) ---
+    def write_agent_outputs(self, year: int, outs) -> None:
+        cols: Dict[str, np.ndarray] = {"agent_id": self.agent_id}
+        for f in AGENT_OUTPUT_FIELDS:
+            cols[f] = np.asarray(getattr(outs, f))[self.keep]
+        df = pd.DataFrame(cols)
+        df.insert(1, "year", year)
+        df.to_parquet(
+            os.path.join(_dir(self.run_dir, "agent_outputs"),
+                         f"year={year}.parquet")
+        )
+
+    # --- agent_finance_series (reference finance_series_export.py:22) ---
+    def write_finance_series(self, year: int, outs) -> None:
+        cf = np.asarray(outs.cash_flow)[self.keep]          # [n, Y+1]
+        ev = np.asarray(outs.energy_value_pv_only)[self.keep] \
+            if hasattr(outs, "energy_value_pv_only") else None
+        df = pd.DataFrame({
+            "agent_id": self.agent_id,
+            "year": year,
+            "cash_flow": list(cf),
+        })
+        if ev is not None:
+            df["energy_value"] = list(ev)
+        df.to_parquet(
+            os.path.join(_dir(self.run_dir, "finance_series"),
+                         f"year={year}.parquet")
+        )
+
+    # --- state_hourly_agg (reference attachment_rate_functions.py:151) ---
+    def write_state_hourly(self, year: int, hourly: np.ndarray) -> None:
+        n_states, hours = hourly.shape
+        self._check_state_names(n_states)
+        names = (
+            self.state_names if self.state_names
+            else [str(i) for i in range(n_states)]
+        )
+        # wide format: one row per state, hourly MW as a list column
+        df = pd.DataFrame({
+            "state": names,
+            "year": year,
+            "net_load_mw": list(hourly.astype(np.float32)),
+        })
+        df.to_parquet(
+            os.path.join(_dir(self.run_dir, "state_hourly"),
+                         f"year={year}.parquet")
+        )
+
+
+def load_surface(run_dir: str, name: str) -> pd.DataFrame:
+    """Reassemble a cross-year frame from a run's parquet partitions."""
+    d = os.path.join(run_dir, name)
+    parts = sorted(
+        os.path.join(d, f) for f in os.listdir(d) if f.endswith(".parquet")
+    )
+    if not parts:
+        raise FileNotFoundError(f"no parquet partitions under {d}")
+    return pd.concat([pd.read_parquet(p) for p in parts], ignore_index=True)

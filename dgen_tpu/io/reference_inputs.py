@@ -1,0 +1,243 @@
+"""Build a full :class:`ScenarioInputs` from a reference-format
+``input_data/`` directory.
+
+This is the TPU framework's replacement for the reference's
+Excel-workbook -> Postgres -> 13-pandas-merges input pipeline
+(SURVEY.md §2.5): every trajectory CSV the reference ships is parsed
+straight to dense device arrays on the model-year grid by
+``dgen_tpu.io.ingest``, and this module assembles them into one
+scenario pytree.
+
+Sourced per reference table (reference file -> field):
+  * pv_prices/*                -> pv_capex_per_kw, pv_om_per_kw
+  * pv_tech_performance/*      -> pv_degradation
+  * batt_prices/*              -> batt_capex_per_kwh / _per_kw
+  * pv_plus_batt_prices/*      -> *_combined fields
+  * financing_terms/*          -> FinanceParams trajectories
+  * load_growth/*              -> load_growth [Y, R, S]
+  * elec_prices/*              -> elec_price_multiplier + escalator
+  * wholesale_electricity_prices/* -> flat hourly sell-rate base [R]
+  * installed_capacity_mw_by_state_sector.csv -> starting_kw [G]
+  * observed_deployment_by_state_sector_*.csv -> observed_kw [Y, G]
+  * ohm_attachment_rates.csv   -> attachment_rate [G]
+
+Not in the reference's CSVs (they live only in its Postgres dump):
+Bass p/q/teq and the max-market-share curves — those keep the
+:func:`dgen_tpu.models.scenario.uniform_inputs` defaults unless
+overridden. ITC fraction likewise comes from the scenario workbook;
+the default schedule here mirrors the federal ITC (30%).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.config import SECTORS, ScenarioConfig
+from dgen_tpu.io import ingest
+from dgen_tpu.io.ingest import _read_csv
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.scenario import ScenarioInputs
+
+#: census divisions (the reference's load-growth region key)
+CENSUS_DIVISIONS = ("NE", "MA", "ENC", "WNC", "SA", "ESC", "WSC", "MTN", "PAC")
+
+
+def load_pv_plus_batt_prices(
+    path: str, model_years: Sequence[int]
+) -> Dict[str, np.ndarray]:
+    """pv_plus_batt_prices CSV -> combined-system cost trajectories
+    [Y, 3] (res/nonres columns duplicated to com+ind, the reference's
+    stacked_sectors shaper convention)."""
+    out = {}
+    for field, key in (
+        ("system_capex_per_kw", "pv_capex_per_kw_combined"),
+        ("batt_capex_per_kwh", "batt_capex_per_kwh_combined"),
+    ):
+        out[key] = ingest.load_stacked_sectors(
+            path, field, model_years, nonres_suffix=True
+        )
+    return out
+
+
+def load_starting_capacities(
+    path: str, start_year: int, states: Sequence[str]
+) -> np.ndarray:
+    """installed_capacity_mw_by_state_sector.csv -> starting PV kW [G]
+    at the scenario start year (reference
+    agent_mutation/elec.py:621 ``get_state_starting_capacities``)."""
+    rows = _read_csv(path)
+    st_idx = {s: i for i, s in enumerate(states)}
+    sec_idx = {s: i for i, s in enumerate(SECTORS)}
+    g = len(states) * len(SECTORS)
+    # use the closest year at or before start_year present in the file
+    years = sorted({int(float(r["year"])) for r in rows})
+    usable = [y for y in years if y <= start_year] or years[:1]
+    pick = usable[-1]
+    out = np.zeros(g, dtype=np.float32)
+    for r in rows:
+        if int(float(r["year"])) != pick:
+            continue
+        st, sec = r.get("state_abbr", ""), r.get("sector_abbr", "")
+        if st in st_idx and sec in sec_idx:
+            gi = st_idx[st] * len(SECTORS) + sec_idx[sec]
+            out[gi] = float(r["observed_capacity_mw"]) * 1000.0
+    return out
+
+
+def load_wholesale_base(
+    path: str, base_year: int
+) -> Tuple[List[str], np.ndarray]:
+    """wholesale CSV (ba, <year columns>) -> (ba names, $/kWh at the
+    base year). The reference feeds annual wholesale prices as the
+    net-billing sell rate (financial_functions.py:182,372)."""
+    rows = _read_csv(path)
+    bas, vals = [], []
+    for r in rows:
+        bas.append(r["ba"])
+        years = sorted(int(c) for c in r.keys() if c.isdigit())
+        pick = max([y for y in years if y <= base_year] or years[:1])
+        vals.append(float(r[str(pick)]))
+    return bas, np.asarray(vals, dtype=np.float32)
+
+
+def scenario_inputs_from_reference(
+    input_root: str,
+    config: ScenarioConfig,
+    states: Sequence[str],
+    region_kind: str = "census_division",
+    overrides: Optional[Dict[str, object]] = None,
+) -> Tuple[ScenarioInputs, Dict[str, object]]:
+    """(ScenarioInputs, meta) from a reference input_data directory.
+
+    ``region_kind`` picks what the agent ``region_idx`` axis means:
+      * "census_division" (9 regions): load growth is regional
+        (reference resolution); retail-price trajectories are averaged
+        over ReEDS BAs onto every region.
+      * "ba": retail prices are per ReEDS BA (reference resolution);
+        load growth is the national mean.
+
+    ``meta`` carries the region list and the per-region flat wholesale
+    sell rate base [R] ($/kWh) for ProfileBank construction.
+    """
+    files = ingest.discover_reference_inputs(input_root)
+    years = list(config.model_years)
+    n_states = len(states)
+    g = n_states * len(SECTORS)
+
+    wholesale_path = None
+    wdir = os.path.join(input_root, "wholesale_electricity_prices")
+    if os.path.isdir(wdir):
+        cands = sorted(f for f in os.listdir(wdir) if f.endswith(".csv"))
+        prefer = [c for c in cands if "Mid_Case" in c]
+        wholesale_path = os.path.join(wdir, (prefer or cands)[-1]) if cands else None
+
+    bas: List[str] = []
+    wholesale_base = np.zeros(0, np.float32)
+    if wholesale_path:
+        bas, wholesale_base = load_wholesale_base(wholesale_path, config.start_year)
+
+    if region_kind == "census_division":
+        regions = list(CENSUS_DIVISIONS)
+    elif region_kind == "ba":
+        regions = bas or list(CENSUS_DIVISIONS)
+    else:
+        raise ValueError(f"unknown region_kind {region_kind!r}")
+    n_regions = len(regions)
+
+    ov: Dict[str, object] = {}
+
+    # --- cost / tech trajectories ---
+    if "pv_prices" in files:
+        ov["pv_capex_per_kw"] = jnp.asarray(ingest.load_stacked_sectors(
+            files["pv_prices"], "system_capex_per_kw", years))
+        ov["pv_om_per_kw"] = jnp.asarray(ingest.load_stacked_sectors(
+            files["pv_prices"], "system_om_per_kw", years))
+    if "pv_tech" in files:
+        ov["pv_degradation"] = jnp.asarray(ingest.load_stacked_sectors(
+            files["pv_tech"], "pv_degradation_factor", years))
+    if "batt_prices" in files:
+        ov["batt_capex_per_kwh"] = jnp.asarray(ingest.load_stacked_sectors(
+            files["batt_prices"], "batt_capex_per_kwh", years,
+            nonres_suffix=True))
+        ov["batt_capex_per_kw"] = jnp.asarray(ingest.load_stacked_sectors(
+            files["batt_prices"], "batt_capex_per_kw", years,
+            nonres_suffix=True))
+    pb_dir = os.path.join(input_root, "pv_plus_batt_prices")
+    if os.path.isdir(pb_dir):
+        cands = sorted(f for f in os.listdir(pb_dir) if f.endswith(".csv"))
+        prefer = [c for c in cands if "mid" in c]
+        if cands:
+            pb = load_pv_plus_batt_prices(
+                os.path.join(pb_dir, (prefer or cands)[-1]), years)
+            ov["pv_capex_per_kw_combined"] = jnp.asarray(
+                pb["pv_capex_per_kw_combined"])
+            ov["batt_capex_per_kwh_combined"] = jnp.asarray(
+                pb["batt_capex_per_kwh_combined"])
+
+    # --- financing ---
+    if "financing" in files:
+        fin = ingest.load_financing_terms(files["financing"], years)
+        ov["loan_term_yrs"] = jnp.asarray(fin["loan_term_yrs"].astype(np.int32))
+        ov["loan_interest_rate"] = jnp.asarray(fin["loan_interest_rate"])
+        ov["down_payment_fraction"] = jnp.asarray(fin["down_payment_fraction"])
+        ov["real_discount_rate"] = jnp.asarray(fin["real_discount_rate"])
+        ov["tax_rate"] = jnp.asarray(fin["tax_rate"])
+
+    # --- regional trajectories ---
+    if "load_growth" in files:
+        lg = ingest.load_load_growth(files["load_growth"], years,
+                                     CENSUS_DIVISIONS)
+        if region_kind == "census_division":
+            ov["load_growth"] = jnp.asarray(lg)
+        else:
+            ov["load_growth"] = jnp.asarray(
+                np.broadcast_to(lg.mean(axis=1, keepdims=True),
+                                (len(years), n_regions, len(SECTORS))).copy())
+    if "elec_prices" in files and bas:
+        ep = ingest.load_elec_prices(files["elec_prices"], years, bas,
+                                     base_year=config.start_year)
+        if region_kind == "ba":
+            mult = ep
+        else:
+            mult = np.broadcast_to(
+                ep.mean(axis=1, keepdims=True),
+                (len(years), n_regions, len(SECTORS))).copy()
+        ov["elec_price_multiplier"] = jnp.asarray(mult)
+        esc = scen.escalator_from_multipliers(mult, np.asarray(years))
+        ov["elec_price_escalator"] = jnp.asarray(esc.astype(np.float32))
+
+    # --- market data ---
+    if "observed" in files:
+        ov["observed_kw"] = jnp.asarray(ingest.load_observed_deployment(
+            files["observed"], years, states))
+    if "attachment" in files:
+        per_state = ingest.load_attachment_rates(files["attachment"], states)
+        ov["attachment_rate"] = jnp.asarray(
+            ingest.state_attachment_to_groups(per_state))
+    cap_path = os.path.join(input_root,
+                            "installed_capacity_mw_by_state_sector.csv")
+    if os.path.exists(cap_path):
+        ov["starting_kw"] = jnp.asarray(load_starting_capacities(
+            cap_path, config.start_year, states))
+
+    if overrides:
+        ov.update(overrides)
+
+    inputs = scen.uniform_inputs(config, n_groups=g, n_regions=n_regions,
+                                 overrides=ov)
+    meta = {
+        "regions": regions,
+        "bas": bas,
+        "wholesale_base_usd_per_kwh": (
+            wholesale_base if region_kind == "ba" and len(wholesale_base)
+            else np.full(n_regions,
+                         float(wholesale_base.mean()) if len(wholesale_base)
+                         else 0.04, np.float32)
+        ),
+        "files": files,
+    }
+    return inputs, meta

@@ -1,0 +1,115 @@
+"""Checkpoint/resume and per-year export surfaces."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import checkpoint as ckpt
+from dgen_tpu.io import export as exp
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import SimCarry, Simulation
+
+
+def make_sim(with_hourly=False):
+    cfg = ScenarioConfig(name="ck", start_year=2014, end_year=2020,
+                         anchor_years=())
+    pop = synth.generate_population(96, states=["DE", "CA"], seed=2,
+                                    pad_multiple=32)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+        overrides={"attachment_rate": jnp.full((pop.table.n_groups,), 0.3)},
+    )
+    return Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                      RunConfig(sizing_iters=6), with_hourly=with_hourly), pop
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    c = SimCarry.zeros(32)
+    c = SimCarry(
+        market=c.market.__class__(
+            **{f: c.market.__dict__[f] + i
+               for i, f in enumerate(c.market.__dataclass_fields__)}
+        ),
+        batt_adopters_cum=c.batt_adopters_cum + 7.0,
+    )
+    ckpt.save_year(str(tmp_path), 2016, c)
+    assert ckpt.latest_year(str(tmp_path)) == 2016
+    year, restored = ckpt.restore_year(str(tmp_path), 32)
+    assert year == 2016
+    np.testing.assert_array_equal(
+        np.asarray(restored.batt_adopters_cum), np.asarray(c.batt_adopters_cum))
+    np.testing.assert_array_equal(
+        np.asarray(restored.market.system_kw_cum),
+        np.asarray(c.market.system_kw_cum))
+
+
+def test_checkpoint_overwrite_not_stale(tmp_path):
+    # re-running into an existing checkpoint dir must overwrite, not
+    # silently keep the previous run's carry (orbax skips existing
+    # steps unless forced)
+    a = SimCarry.zeros(8)
+    b = SimCarry(market=a.market, batt_adopters_cum=a.batt_adopters_cum + 5.0)
+    ckpt.save_year(str(tmp_path), 2020, a)
+    ckpt.save_year(str(tmp_path), 2020, b)
+    _, restored = ckpt.restore_year(str(tmp_path), 8)
+    np.testing.assert_array_equal(
+        np.asarray(restored.batt_adopters_cum), np.full(8, 5.0))
+
+
+def test_exporter_rejects_wrong_state_names(tmp_path):
+    ex = exp.RunExporter(str(tmp_path), agent_id=np.arange(4),
+                         mask=np.ones(4), state_names=["DE", "CA"])
+    with pytest.raises(ValueError):
+        ex.write_state_hourly(2014, np.zeros((49, 8760), np.float32))
+
+
+def test_resume_matches_uninterrupted(tmp_path):
+    sim, pop = make_sim()
+    full = sim.run()
+
+    # run years 1-2 with checkpoints, then resume for the rest
+    ckdir = str(tmp_path / "ck")
+    sim2, _ = make_sim()
+    carry = sim2.init_carry()
+    for yi in (0, 1):
+        carry, _ = sim2.step(carry, yi, first_year=(yi == 0))
+        ckpt.save_year(ckdir, sim2.years[yi], carry)
+
+    sim3, _ = make_sim()
+    resumed = sim3.run(checkpoint_dir=ckdir, resume=True)
+
+    m = np.asarray(pop.table.mask)
+    f = full.summary(m)
+    # resumed results only cover years after the checkpoint
+    n_resumed = len(resumed.agent["system_kw_cum"])
+    assert n_resumed == len(sim.years) - 2
+    r_last = (resumed.agent["system_kw_cum"][-1] * m).sum()
+    np.testing.assert_allclose(r_last, f["system_kw_cum"][-1], rtol=1e-5)
+
+
+def test_exporter_surfaces(tmp_path):
+    sim, pop = make_sim(with_hourly=True)
+    exporter = exp.RunExporter(
+        str(tmp_path / "run"),
+        agent_id=np.asarray(pop.table.agent_id),
+        mask=np.asarray(pop.table.mask),
+        state_names=list(synth.STATES),
+    )
+    sim.run(callback=exporter, collect=False)
+
+    ao = exp.load_surface(str(tmp_path / "run"), "agent_outputs")
+    n_real = int(np.asarray(pop.table.mask).sum())
+    assert len(ao) == n_real * len(sim.years)
+    assert set(exp.AGENT_OUTPUT_FIELDS) <= set(ao.columns)
+    assert (ao.groupby("year")["system_kw_cum"].sum().diff().dropna() >= -1e-3).all()
+
+    fs = exp.load_surface(str(tmp_path / "run"), "finance_series")
+    assert len(fs) == n_real * len(sim.years)
+    assert len(fs["cash_flow"].iloc[0]) == 26
+
+    sh = exp.load_surface(str(tmp_path / "run"), "state_hourly")
+    assert len(sh) == pop.table.n_states * len(sim.years)
+    assert len(sh["net_load_mw"].iloc[0]) == 8760

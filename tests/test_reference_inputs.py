@@ -1,0 +1,113 @@
+"""Scenario ingest from the actual reference input_data directory
+(mounted read-only): shapes, ranges, and spot-checked values."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import synth
+from dgen_tpu.io.reference_inputs import (
+    CENSUS_DIVISIONS,
+    scenario_inputs_from_reference,
+)
+from dgen_tpu.models.simulation import Simulation
+
+REF_INPUTS = "/root/reference/dgen_os/input_data"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF_INPUTS), reason="reference inputs not mounted"
+)
+
+
+@pytest.fixture(scope="module")
+def ref_scenario():
+    cfg = ScenarioConfig(name="ref", start_year=2014, end_year=2030,
+                         anchor_years=(2014, 2016, 2018))
+    states = list(synth.STATES)
+    inputs, meta = scenario_inputs_from_reference(REF_INPUTS, cfg, states)
+    return cfg, states, inputs, meta
+
+
+def test_shapes_and_ranges(ref_scenario):
+    cfg, states, inputs, meta = ref_scenario
+    y = len(cfg.model_years)
+    g = len(states) * 3
+    assert inputs.pv_capex_per_kw.shape == (y, 3)
+    assert inputs.load_growth.shape == (y, len(CENSUS_DIVISIONS), 3)
+    assert inputs.observed_kw.shape == (y, g)
+    assert inputs.starting_kw.shape == (g,)
+
+    # capex declines over the ATB trajectory and stays positive
+    capex = np.asarray(inputs.pv_capex_per_kw)
+    assert capex.min() > 100.0
+    assert capex[-1].mean() < capex[0].mean()
+    # degradation is a small positive fraction
+    deg = np.asarray(inputs.pv_degradation)
+    assert np.all(deg >= 0.0) and np.all(deg < 0.05)
+    # financing sane
+    assert np.all(np.asarray(inputs.loan_interest_rate) < 0.25)
+    assert np.all(np.asarray(inputs.tax_rate) > 0.0)
+    # attachment rates are probabilities
+    ar = np.asarray(inputs.attachment_rate)
+    assert np.all((ar >= 0.0) & (ar <= 1.0))
+    assert ar.max() > 0.05, "some state should have storage attachment"
+
+
+def test_observed_deployment_spot_value(ref_scenario):
+    cfg, states, inputs, meta = ref_scenario
+    # CA residential 2014 observed deployment must be large (>1 GW was
+    # not yet reached; several hundred MW) and strictly less than 2018
+    ca = states.index("CA")
+    g = ca * 3 + 0  # res
+    y14 = cfg.model_years.index(2014)
+    y18 = cfg.model_years.index(2018)
+    kw14 = float(np.asarray(inputs.observed_kw)[y14, g])
+    kw18 = float(np.asarray(inputs.observed_kw)[y18, g])
+    assert kw14 > 1e5, "CA res 2014 should exceed 100 MW"
+    assert kw18 > kw14
+
+
+def test_starting_capacity_matches_csv(ref_scenario):
+    cfg, states, inputs, meta = ref_scenario
+    import csv
+    with open(os.path.join(
+            REF_INPUTS, "installed_capacity_mw_by_state_sector.csv")) as f:
+        rows = [r for r in csv.DictReader(f)
+                if int(r["year"]) == 2014 and r["state_abbr"] == "AZ"
+                and r["sector_abbr"] == "com"]
+    want_kw = float(rows[0]["observed_capacity_mw"]) * 1000.0
+    az = states.index("AZ")
+    got = float(np.asarray(inputs.starting_kw)[az * 3 + 1])
+    assert got == pytest.approx(want_kw, rel=1e-6)
+
+
+def test_end_to_end_with_reference_inputs(ref_scenario):
+    cfg, states, inputs, meta = ref_scenario
+    pop = synth.generate_population(
+        128, states=["CA", "AZ", "NY"], seed=9, pad_multiple=32,
+        n_regions=len(meta["regions"]),
+    )
+    # wholesale sell-rate base from the reference trajectory
+    base = np.asarray(meta["wholesale_base_usd_per_kwh"])
+    assert base.shape[0] == len(meta["regions"])
+    assert 0.005 < base.mean() < 0.2
+    profiles = pop.profiles.__class__(
+        load=pop.profiles.load,
+        solar_cf=pop.profiles.solar_cf,
+        wholesale=jnp.asarray(
+            np.broadcast_to(base[:, None], (len(base), 8760)).copy()),
+    )
+    sim = Simulation(pop.table, profiles, pop.tariffs, inputs, cfg,
+                     RunConfig(sizing_iters=6))
+    res = sim.run()
+    m = np.asarray(pop.table.mask)
+    s = res.summary(m)
+    assert np.all(np.isfinite(s["system_kw_cum"]))
+    assert s["system_kw_cum"][-1] > 0
+    # anchor years rescale to observed state totals: CA res agents in
+    # 2014 must carry nonzero anchored capacity
+    assert s["system_kw_cum"][0] > 0

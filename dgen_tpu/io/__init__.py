@@ -7,6 +7,8 @@ from dgen_tpu.io import (  # noqa: F401
     checkpoint,
     export,
     ingest,
+    package,
     reference_inputs,
+    store,
     synth,
 )

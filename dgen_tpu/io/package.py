@@ -1,0 +1,167 @@
+"""Agent-package format: a self-contained on-disk population.
+
+The reference distributes its population as an out-of-band pandas
+pickle plus per-agent Postgres profile rows (reference
+input_data_functions.py:389 ``import_agent_file``; agent generation is
+unsupported in the OS release, :444). The TPU framework's equivalent is
+a directory package:
+
+    <pkg>/agents.parquet      per-agent attributes (one row per agent)
+    <pkg>/load_profiles.dgpb  [L, 8760] normalized load shapes (store)
+    <pkg>/solar_cf.dgpb       [S, 8760] PV CF profiles (store)
+    <pkg>/wholesale.dgpb      [R, 8760] $/kWh sell-rate profiles
+    <pkg>/tariffs.json        list of tariff spec dicts (ops.tariff)
+    <pkg>/meta.json           states, n_states, format version
+
+``save_population`` / ``load_population`` roundtrip the exact pytree
+the Simulation consumes; a converter from the reference's pickle format
+runs offline once (agents.parquet column names below mirror the
+reference's agent columns where they exist).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from dgen_tpu.io import store
+from dgen_tpu.models.agents import AgentTable, ProfileBank, build_agent_table
+from dgen_tpu.ops.tariff import TariffBank, compile_tariffs
+
+FORMAT_VERSION = 1
+
+#: agents.parquet schema (reference agent-pickle column analogue)
+AGENT_COLUMNS = (
+    "state_idx", "sector_idx", "region_idx", "tariff_idx", "load_idx",
+    "cf_idx", "customers_in_bin", "load_kwh_per_customer_in_bin",
+    "developable_frac",
+)
+
+
+#: IncentiveParams leaves serialized as agents.parquet columns
+#: (``<leaf>_<slot>`` for the two incentive slots)
+INCENTIVE_LEAVES = (
+    "cbi_usd_p_w", "cbi_max_usd", "ibi_frac", "ibi_max_usd",
+    "pbi_usd_p_kwh", "pbi_years",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Population:
+    table: AgentTable
+    profiles: ProfileBank
+    tariffs: TariffBank
+    states: List[str]
+    tariff_specs: List[dict]
+
+
+def save_population(
+    pkg_dir: str,
+    table: AgentTable,
+    profiles: ProfileBank,
+    tariff_specs: Sequence[dict],
+    states: Sequence[str],
+) -> None:
+    """Write a population package (unpadded rows only)."""
+    os.makedirs(pkg_dir, exist_ok=True)
+    keep = np.asarray(table.mask) > 0
+
+    cols = {c: np.asarray(getattr(table, c))[keep] for c in AGENT_COLUMNS}
+    for leaf in INCENTIVE_LEAVES:
+        vals = np.asarray(getattr(table.incentives, leaf))[keep]  # [n, 2]
+        for slot in range(vals.shape[1]):
+            cols[f"{leaf}_{slot}"] = vals[:, slot]
+    pd.DataFrame(cols).to_parquet(os.path.join(pkg_dir, "agents.parquet"))
+
+    store.write_bank(os.path.join(pkg_dir, "load_profiles.dgpb"),
+                     np.asarray(profiles.load))
+    store.write_bank(os.path.join(pkg_dir, "solar_cf.dgpb"),
+                     np.asarray(profiles.solar_cf))
+    store.write_bank(os.path.join(pkg_dir, "wholesale.dgpb"),
+                     np.asarray(profiles.wholesale))
+
+    def jsonable(spec: dict) -> dict:
+        out = {}
+        for k, v in spec.items():
+            out[k] = v.tolist() if isinstance(v, np.ndarray) else v
+        return out
+
+    with open(os.path.join(pkg_dir, "tariffs.json"), "w") as f:
+        json.dump([jsonable(s) for s in tariff_specs], f)
+    with open(os.path.join(pkg_dir, "meta.json"), "w") as f:
+        json.dump({
+            "format_version": FORMAT_VERSION,
+            "states": list(states),
+            "n_states": int(table.n_states),
+            "n_agents": int(keep.sum()),
+        }, f)
+
+
+def load_population(pkg_dir: str, pad_multiple: int = 128) -> Population:
+    """Load a package into the device pytrees the Simulation consumes."""
+    with open(os.path.join(pkg_dir, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("format_version") != FORMAT_VERSION:
+        raise ValueError(
+            f"package format {meta.get('format_version')} != {FORMAT_VERSION}"
+        )
+
+    df = pd.read_parquet(os.path.join(pkg_dir, "agents.parquet"))
+    missing = set(AGENT_COLUMNS) - set(df.columns)
+    if missing:
+        raise ValueError(f"agents.parquet missing columns: {sorted(missing)}")
+
+    incentives = None
+    if all(f"{leaf}_0" in df.columns for leaf in INCENTIVE_LEAVES):
+        from dgen_tpu.ops.cashflow import IncentiveParams
+
+        def leaf(name, dtype):
+            return np.stack(
+                [df[f"{name}_0"].to_numpy(), df[f"{name}_1"].to_numpy()],
+                axis=1,
+            ).astype(dtype)
+
+        incentives = IncentiveParams(
+            cbi_usd_p_w=leaf("cbi_usd_p_w", np.float32),
+            cbi_max_usd=leaf("cbi_max_usd", np.float32),
+            ibi_frac=leaf("ibi_frac", np.float32),
+            ibi_max_usd=leaf("ibi_max_usd", np.float32),
+            pbi_usd_p_kwh=leaf("pbi_usd_p_kwh", np.float32),
+            pbi_years=leaf("pbi_years", np.int32),
+        )
+
+    table = build_agent_table(
+        incentives=incentives,
+        state_idx=df["state_idx"].to_numpy(),
+        sector_idx=df["sector_idx"].to_numpy(),
+        region_idx=df["region_idx"].to_numpy(),
+        tariff_idx=df["tariff_idx"].to_numpy(),
+        load_idx=df["load_idx"].to_numpy(),
+        cf_idx=df["cf_idx"].to_numpy(),
+        customers_in_bin=df["customers_in_bin"].to_numpy(),
+        load_kwh_per_customer_in_bin=df["load_kwh_per_customer_in_bin"].to_numpy(),
+        developable_frac=df["developable_frac"].to_numpy(),
+        n_states=int(meta["n_states"]),
+        pad_multiple=pad_multiple,
+    )
+    profiles = ProfileBank(
+        load=jnp.asarray(store.read_bank(
+            os.path.join(pkg_dir, "load_profiles.dgpb"))),
+        solar_cf=jnp.asarray(store.read_bank(
+            os.path.join(pkg_dir, "solar_cf.dgpb"))),
+        wholesale=jnp.asarray(store.read_bank(
+            os.path.join(pkg_dir, "wholesale.dgpb"))),
+    )
+    with open(os.path.join(pkg_dir, "tariffs.json")) as f:
+        specs = json.load(f)
+    tariffs = compile_tariffs(specs)
+    return Population(
+        table=table, profiles=profiles, tariffs=tariffs,
+        states=list(meta["states"]), tariff_specs=specs,
+    )

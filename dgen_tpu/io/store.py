@@ -1,0 +1,208 @@
+"""Python face of the native profile store (native/profile_store.cpp).
+
+Large dense matrices — the 8760-hour load and solar-CF profile banks,
+agent attribute blocks — live in flat DGPB1 binary files. Reads are one
+``mmap`` in C++ (zero copy until first touch); CSV ingestion parses on
+all cores once and persists the binary bank every later run reuses.
+This replaces the reference's per-agent Postgres profile fetches
+(reference agent_mutation/elec.py:508-558, its serial bottleneck per
+SURVEY.md §7).
+
+The shared library is built on demand with g++ (no pybind11 in this
+environment — plain C ABI via ctypes). ``HAVE_NATIVE`` is False when no
+compiler is available; the pure-NumPy fallbacks keep everything
+working, just slower on ingest.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                    "profile_store.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(_SRC)),
+                         "libdgen_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+HAVE_NATIVE = False
+
+_MAGIC = b"DGPB1\x00"
+_HEADER = 24
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-pthread", "-o", _LIB_PATH, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed, HAVE_NATIVE
+    if _lib is not None:
+        return _lib
+    if _load_failed:  # don't re-attempt a failing compile on every call
+        return None
+    src_ok = os.path.exists(_SRC)
+    stale = (
+        src_ok and os.path.exists(_LIB_PATH)
+        and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+    )
+    if (not os.path.exists(_LIB_PATH) or stale) and not _build():
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        _load_failed = True
+        return None
+    lib.dg_last_error.restype = ctypes.c_char_p
+    lib.dg_store_write.restype = ctypes.c_int
+    lib.dg_store_write.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.dg_store_open.restype = ctypes.c_void_p
+    lib.dg_store_open.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.dg_store_data.restype = ctypes.POINTER(ctypes.c_float)
+    lib.dg_store_data.argtypes = [ctypes.c_void_p]
+    lib.dg_store_close.argtypes = [ctypes.c_void_p]
+    lib.dg_csv_shape.restype = ctypes.c_int
+    lib.dg_csv_shape.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.dg_csv_parse.restype = ctypes.c_int
+    lib.dg_csv_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_int,
+    ]
+    _lib = lib
+    HAVE_NATIVE = True
+    return lib
+
+
+def _err(lib) -> str:
+    return lib.dg_last_error().decode()
+
+
+def write_bank(path: str, data: np.ndarray) -> None:
+    """Persist a row-major f32 matrix as a DGPB1 bank file."""
+    data = np.ascontiguousarray(data, dtype=np.float32)
+    if data.ndim != 2:
+        raise ValueError("bank must be 2-D [rows, cols]")
+    lib = _load()
+    if lib is not None:
+        rc = lib.dg_store_write(
+            path.encode(), data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            data.shape[0], data.shape[1],
+        )
+        if rc != 0:
+            raise IOError(f"native write failed: {_err(lib)}")
+        return
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write((0).to_bytes(2, "little"))
+        f.write(int(data.shape[0]).to_bytes(8, "little"))
+        f.write(int(data.shape[1]).to_bytes(8, "little"))
+        f.write(data.tobytes())
+
+
+def read_bank(path: str) -> np.ndarray:
+    """Load a DGPB1 bank. Native path: one mmap + zero-copy view
+    (copied into an owned array before the handle closes)."""
+    lib = _load()
+    if lib is not None:
+        rows = ctypes.c_uint64()
+        cols = ctypes.c_uint64()
+        h = lib.dg_store_open(path.encode(), ctypes.byref(rows),
+                              ctypes.byref(cols))
+        if not h:
+            raise IOError(f"native open failed: {_err(lib)}")
+        try:
+            ptr = lib.dg_store_data(ctypes.c_void_p(h))
+            arr = np.ctypeslib.as_array(
+                ptr, shape=(rows.value, cols.value)
+            ).copy()
+        finally:
+            lib.dg_store_close(ctypes.c_void_p(h))
+        return arr
+    with open(path, "rb") as f:
+        head = f.read(_HEADER)
+        if head[:6] != _MAGIC:
+            raise IOError("bad magic (not a DGPB1 file)")
+        rows = int.from_bytes(head[8:16], "little")
+        cols = int.from_bytes(head[16:24], "little")
+        data = np.frombuffer(f.read(rows * cols * 4), dtype=np.float32)
+    return data.reshape(rows, cols).copy()
+
+
+def csv_to_bank(
+    csv_path: str,
+    bank_path: Optional[str] = None,
+    skip_header: bool = True,
+    skip_cols: int = 0,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Parse a numeric CSV into an f32 matrix (all cores, native) and
+    optionally persist it as a bank file.
+
+    ``skip_cols`` drops leading id columns; ``n_threads=0`` uses every
+    hardware thread.
+    """
+    lib = _load()
+    if lib is not None:
+        rows = ctypes.c_uint64()
+        cols = ctypes.c_uint64()
+        if lib.dg_csv_shape(csv_path.encode(), int(skip_header),
+                            ctypes.byref(rows), ctypes.byref(cols)) != 0:
+            raise IOError(f"csv shape scan failed: {_err(lib)}")
+        out_cols = cols.value - skip_cols
+        if out_cols <= 0:
+            raise ValueError("skip_cols leaves no data columns")
+        out = np.empty((rows.value, out_cols), dtype=np.float32)
+        rc = lib.dg_csv_parse(
+            csv_path.encode(), int(skip_header), skip_cols,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows.value, out_cols, n_threads,
+        )
+        if rc != 0:
+            raise IOError(f"csv parse failed: {_err(lib)}")
+    else:
+        usecols = None
+        if skip_cols:
+            # skip id columns BEFORE parsing (they may be non-numeric)
+            with open(csv_path) as f:
+                first = f.readline()
+            n_cols = first.count(",") + 1
+            if n_cols - skip_cols <= 0:
+                raise ValueError("skip_cols leaves no data columns")
+            usecols = range(skip_cols, n_cols)
+        out = np.loadtxt(
+            csv_path, delimiter=",", skiprows=1 if skip_header else 0,
+            dtype=np.float32, ndmin=2, usecols=usecols,
+        )
+    if bank_path:
+        write_bank(bank_path, out)
+    return out
+
+
+def bank_available() -> bool:
+    """True when the native library is built/loadable."""
+    return _load() is not None

@@ -113,10 +113,11 @@ def make_wholesale_prices(n_regions: int, seed: int = 2) -> np.ndarray:
     return np.asarray(out, dtype=np.float32)
 
 
-def make_tariff_bank(seed: int = 3) -> TariffBank:
-    """A small representative tariff corpus: flat, tiered, and TOU
-    tariffs under both net metering and net billing, plus one
-    CA-NEM3-style TOU-sell tariff."""
+def make_tariff_specs() -> list:
+    """The synthetic tariff corpus as raw spec dicts (flat, tiered, TOU
+    under both metering styles, plus a CA-NEM3-style TOU-sell tariff) —
+    exposed separately so populations can be packaged with their tariff
+    definitions (io.package)."""
     specs = []
     # 0: flat NEM
     specs.append({"price": [[0.12]], "fixed_charge": 10.0, "metering": NET_METERING})
@@ -147,7 +148,12 @@ def make_tariff_bank(seed: int = 3) -> TariffBank:
         "e_wkend_12by24": np.zeros((12, 24), dtype=int),
         "fixed_charge": 40.0, "metering": NET_METERING,
     })
-    return compile_tariffs(specs)
+    return specs
+
+
+def make_tariff_bank(seed: int = 3) -> TariffBank:
+    """Compiled synthetic tariff corpus (see :func:`make_tariff_specs`)."""
+    return compile_tariffs(make_tariff_specs())
 
 
 @dataclasses.dataclass(frozen=True)

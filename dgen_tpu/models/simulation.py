@@ -486,7 +486,14 @@ class Simulation:
             from dgen_tpu.io import checkpoint as ckpt
 
             last = ckpt.latest_year(checkpoint_dir)
-            if last is not None and last in self.years:
+            if last is not None and last not in self.years:
+                # silently restarting from scratch would also overwrite
+                # the existing (incompatible) checkpoints
+                raise ValueError(
+                    f"checkpointed year {last} is not on this scenario's "
+                    f"year grid {self.years}; refusing to resume"
+                )
+            if last is not None:
                 _, restored = ckpt.restore_year(
                     checkpoint_dir, self.table.n_agents, last
                 )

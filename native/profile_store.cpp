@@ -1,0 +1,282 @@
+// dgen-tpu native profile store: memory-mapped binary matrix bank +
+// multithreaded CSV ingestion.
+//
+// Role in the framework: the host-side data plane for 8760-hour load /
+// solar-capacity-factor profile banks and other large dense matrices.
+// The reference system keeps these rows in Postgres and fetches them
+// with one SQL round trip per agent (reference
+// agent_mutation/elec.py:508-558) — its measured serial bottleneck
+// (SURVEY.md §7 "data gravity"). Here profiles live in a flat binary
+// file; loads are a single mmap (zero-copy until first touch) and CSV
+// ingestion parses chunks on all cores once, writing the binary bank
+// that every later run reuses.
+//
+// File format "DGPB1\0":
+//   [0:6)   magic "DGPB1\0"
+//   [6:8)   dtype code (u16 little-endian): 0 = f32
+//   [8:16)  rows (u64 LE)
+//   [16:24) cols (u64 LE)
+//   [24:..) row-major payload
+//
+// C ABI only (consumed via ctypes; no pybind11 in this image).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[6] = {'D', 'G', 'P', 'B', '1', '\0'};
+constexpr size_t kHeader = 24;
+
+struct Handle {
+  void* map = nullptr;
+  size_t map_len = 0;
+  uint64_t rows = 0;
+  uint64_t cols = 0;
+};
+
+thread_local std::string g_err;
+
+void set_err(const std::string& e) { g_err = e; }
+
+}  // namespace
+
+extern "C" {
+
+const char* dg_last_error() { return g_err.c_str(); }
+
+// Write a row-major f32 matrix as a DGPB1 file. Returns 0 on success.
+int dg_store_write(const char* path, const float* data, uint64_t rows,
+                   uint64_t cols) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) {
+    set_err(std::string("open for write failed: ") + std::strerror(errno));
+    return -1;
+  }
+  uint16_t dtype = 0;
+  bool ok = std::fwrite(kMagic, 1, 6, f) == 6 &&
+            std::fwrite(&dtype, 2, 1, f) == 1 &&
+            std::fwrite(&rows, 8, 1, f) == 1 &&
+            std::fwrite(&cols, 8, 1, f) == 1 &&
+            std::fwrite(data, sizeof(float), rows * cols, f) == rows * cols;
+  if (std::fclose(f) != 0) ok = false;
+  if (!ok) {
+    set_err("short write");
+    return -1;
+  }
+  return 0;
+}
+
+// mmap a DGPB1 file; fills rows/cols; returns an opaque handle or null.
+void* dg_store_open(const char* path, uint64_t* rows, uint64_t* cols) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) {
+    set_err(std::string("open failed: ") + std::strerror(errno));
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < kHeader) {
+    set_err("stat failed or file too small");
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (map == MAP_FAILED) {
+    set_err(std::string("mmap failed: ") + std::strerror(errno));
+    return nullptr;
+  }
+  const char* base = static_cast<const char*>(map);
+  if (std::memcmp(base, kMagic, 6) != 0) {
+    set_err("bad magic (not a DGPB1 file)");
+    munmap(map, st.st_size);
+    return nullptr;
+  }
+  auto* h = new Handle();
+  h->map = map;
+  h->map_len = st.st_size;
+  std::memcpy(&h->rows, base + 8, 8);
+  std::memcpy(&h->cols, base + 16, 8);
+  if (kHeader + h->rows * h->cols * sizeof(float) > h->map_len) {
+    set_err("truncated payload");
+    munmap(map, st.st_size);
+    delete h;
+    return nullptr;
+  }
+  *rows = h->rows;
+  *cols = h->cols;
+  return h;
+}
+
+const float* dg_store_data(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  return reinterpret_cast<const float*>(
+      static_cast<const char*>(h->map) + kHeader);
+}
+
+void dg_store_close(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  if (h->map) munmap(h->map, h->map_len);
+  delete h;
+}
+
+// ---------------------------------------------------------------------------
+// Multithreaded CSV -> matrix parse.
+//
+// Parses a numeric CSV (optional header; optional leading id column to
+// skip) into a caller-allocated row-major f32 buffer. Rows are
+// discovered by a newline pre-scan, then parsed in parallel chunks —
+// all cores touch the file once.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Mapped {
+  const char* data = nullptr;
+  size_t len = 0;
+  void* map = nullptr;
+};
+
+bool map_file(const char* path, Mapped* out) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return false;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    ::close(fd);
+    return false;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (map == MAP_FAILED) return false;
+  out->map = map;
+  out->data = static_cast<const char*>(map);
+  out->len = st.st_size;
+  return true;
+}
+
+}  // namespace
+
+// Count data rows and columns. Returns 0 on success.
+int dg_csv_shape(const char* path, int skip_header, uint64_t* rows,
+                 uint64_t* cols) {
+  Mapped m;
+  if (!map_file(path, &m)) {
+    set_err("csv open/mmap failed");
+    return -1;
+  }
+  // columns: commas in the first (non-header) line
+  size_t pos = 0;
+  if (skip_header) {
+    while (pos < m.len && m.data[pos] != '\n') pos++;
+    pos++;
+  }
+  uint64_t c = 1;
+  size_t line_start = pos;
+  while (pos < m.len && m.data[pos] != '\n') {
+    if (m.data[pos] == ',') c++;
+    pos++;
+  }
+  if (pos == line_start) {
+    set_err("empty csv body");
+    munmap(m.map, m.len);
+    return -1;
+  }
+  uint64_t r = 0;
+  for (size_t i = line_start; i < m.len; i++) {
+    if (m.data[i] == '\n') r++;
+  }
+  if (m.len > 0 && m.data[m.len - 1] != '\n') r++;  // no trailing newline
+  munmap(m.map, m.len);
+  *rows = r;
+  *cols = c;
+  return 0;
+}
+
+// Parse into out[rows * (cols - skip_cols)]. Returns 0 on success.
+int dg_csv_parse(const char* path, int skip_header, int skip_cols, float* out,
+                 uint64_t rows, uint64_t out_cols, int n_threads) {
+  Mapped m;
+  if (!map_file(path, &m)) {
+    set_err("csv open/mmap failed");
+    return -1;
+  }
+  size_t body = 0;
+  if (skip_header) {
+    while (body < m.len && m.data[body] != '\n') body++;
+    body++;
+  }
+
+  // row start offsets (newline scan)
+  std::vector<size_t> starts;
+  starts.reserve(rows + 1);
+  starts.push_back(body);
+  for (size_t i = body; i < m.len; i++) {
+    if (m.data[i] == '\n' && i + 1 < m.len) starts.push_back(i + 1);
+  }
+  if (starts.size() != rows) {
+    set_err("row count mismatch: expected " + std::to_string(rows) + " got " +
+            std::to_string(starts.size()));
+    munmap(m.map, m.len);
+    return -1;
+  }
+
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int nt = n_threads > 0 ? n_threads : (hw > 0 ? hw : 1);
+  if (static_cast<uint64_t>(nt) > rows) nt = static_cast<int>(rows);
+
+  std::vector<int> errs(nt, 0);
+  auto worker = [&](int t) {
+    uint64_t lo = rows * t / nt, hi = rows * (t + 1) / nt;
+    for (uint64_t r = lo; r < hi; r++) {
+      const char* p = m.data + starts[r];
+      // strtof treats '\n' as skippable whitespace, so a short row
+      // would silently consume the next row's first value; bound every
+      // field to this row's extent instead.
+      const char* row_end =
+          (r + 1 < rows) ? m.data + starts[r + 1] : m.data + m.len;
+      for (int c = 0; c < skip_cols; c++) {
+        while (p < row_end && *p != ',' && *p != '\n') p++;
+        if (p < row_end) p++;
+      }
+      for (uint64_t c = 0; c < out_cols; c++) {
+        char* next = nullptr;
+        out[r * out_cols + c] = std::strtof(p, &next);
+        if (next == p || next > row_end) {
+          errs[t] = 1;
+          return;
+        }
+        p = next;
+        if (p < row_end && (*p == ',' || *p == '\r')) p++;
+      }
+      // anything but a line terminator here means extra fields /
+      // malformed data
+      if (p < row_end && *p != '\n' && *p != '\r') {
+        errs[t] = 1;
+        return;
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  for (int t = 0; t < nt; t++) threads.emplace_back(worker, t);
+  for (auto& th : threads) th.join();
+  munmap(m.map, m.len);
+  for (int e : errs) {
+    if (e) {
+      set_err("parse error (non-numeric cell)");
+      return -1;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

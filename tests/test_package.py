@@ -1,0 +1,99 @@
+"""Agent-package roundtrip: saved + reloaded populations must produce
+identical simulation results."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import package, synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+
+
+def test_roundtrip_identical_results(tmp_path):
+    pop = synth.generate_population(70, states=["DE", "TX"], seed=4,
+                                    pad_multiple=32)
+    pkg = str(tmp_path / "pkg")
+    package.save_population(
+        pkg, pop.table, pop.profiles, synth.make_tariff_specs(), synth.STATES
+    )
+    loaded = package.load_population(pkg, pad_multiple=32)
+
+    assert loaded.table.n_agents == pop.table.n_agents
+    np.testing.assert_array_equal(
+        np.asarray(loaded.table.state_idx), np.asarray(pop.table.state_idx))
+    np.testing.assert_allclose(
+        np.asarray(loaded.profiles.load), np.asarray(pop.profiles.load))
+    np.testing.assert_allclose(
+        np.asarray(loaded.tariffs.price), np.asarray(pop.tariffs.price))
+
+    cfg = ScenarioConfig(name="pkg", start_year=2014, end_year=2018,
+                         anchor_years=())
+    inputs = scen.uniform_inputs(cfg, n_groups=pop.table.n_groups,
+                                 n_regions=pop.n_regions)
+    r1 = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                    RunConfig(sizing_iters=6)).run()
+    r2 = Simulation(loaded.table, loaded.profiles, loaded.tariffs, inputs,
+                    cfg, RunConfig(sizing_iters=6)).run()
+    np.testing.assert_allclose(
+        r1.agent["system_kw_cum"], r2.agent["system_kw_cum"], rtol=1e-6)
+    np.testing.assert_allclose(
+        r1.agent["payback_period"], r2.agent["payback_period"], atol=1e-6)
+
+
+def test_incentives_roundtrip(tmp_path):
+    from dgen_tpu.models.agents import build_agent_table
+    from dgen_tpu.ops.cashflow import IncentiveParams
+
+    n = 12
+    rng = np.random.default_rng(3)
+    inc = IncentiveParams(
+        cbi_usd_p_w=rng.random((n, 2)).astype(np.float32),
+        cbi_max_usd=rng.random((n, 2)).astype(np.float32) * 1e4,
+        ibi_frac=rng.random((n, 2)).astype(np.float32) * 0.3,
+        ibi_max_usd=rng.random((n, 2)).astype(np.float32) * 1e4,
+        pbi_usd_p_kwh=rng.random((n, 2)).astype(np.float32) * 0.05,
+        pbi_years=rng.integers(0, 10, (n, 2)).astype(np.int32),
+    )
+    pop = synth.generate_population(n, states=["DE"], seed=2, pad_multiple=8)
+    t = pop.table
+    keep = np.asarray(t.mask) > 0
+    table = build_agent_table(
+        state_idx=np.asarray(t.state_idx)[keep],
+        sector_idx=np.asarray(t.sector_idx)[keep],
+        region_idx=np.asarray(t.region_idx)[keep],
+        tariff_idx=np.asarray(t.tariff_idx)[keep],
+        load_idx=np.asarray(t.load_idx)[keep],
+        cf_idx=np.asarray(t.cf_idx)[keep],
+        customers_in_bin=np.asarray(t.customers_in_bin)[keep],
+        load_kwh_per_customer_in_bin=np.asarray(
+            t.load_kwh_per_customer_in_bin)[keep],
+        developable_frac=np.asarray(t.developable_frac)[keep],
+        n_states=t.n_states, incentives=inc, pad_multiple=8,
+    )
+    pkg = str(tmp_path / "pkg")
+    package.save_population(pkg, table, pop.profiles,
+                            synth.make_tariff_specs(), synth.STATES)
+    loaded = package.load_population(pkg, pad_multiple=8)
+    np.testing.assert_allclose(
+        np.asarray(loaded.table.incentives.ibi_frac)[:n],
+        np.asarray(inc.ibi_frac))
+    np.testing.assert_array_equal(
+        np.asarray(loaded.table.incentives.pbi_years)[:n],
+        np.asarray(inc.pbi_years))
+
+
+def test_version_check(tmp_path):
+    pop = synth.generate_population(16, states=["DE"], seed=1, pad_multiple=8)
+    pkg = str(tmp_path / "pkg")
+    package.save_population(pkg, pop.table, pop.profiles,
+                            synth.make_tariff_specs(), synth.STATES)
+    import json, os
+    meta_path = os.path.join(pkg, "meta.json")
+    meta = json.load(open(meta_path))
+    meta["format_version"] = 99
+    json.dump(meta, open(meta_path, "w"))
+    with pytest.raises(ValueError):
+        package.load_population(pkg)

@@ -1,0 +1,103 @@
+"""Native profile store: bank roundtrip, CSV ingest parity, and the
+pure-Python fallback path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dgen_tpu.io import store
+
+
+@pytest.fixture(scope="module")
+def matrix():
+    rng = np.random.default_rng(7)
+    return (rng.random((257, 123)) * 100.0 - 20.0).astype(np.float32)
+
+
+def test_bank_roundtrip(tmp_path, matrix):
+    p = str(tmp_path / "bank.dgpb")
+    store.write_bank(p, matrix)
+    got = store.read_bank(p)
+    np.testing.assert_array_equal(got, matrix)
+
+
+def test_bank_rejects_garbage(tmp_path):
+    p = str(tmp_path / "junk.dgpb")
+    with open(p, "wb") as f:
+        f.write(b"NOTDGPB" + b"\x00" * 64)
+    with pytest.raises(IOError):
+        store.read_bank(p)
+
+
+def test_csv_parse_matches_numpy(tmp_path, matrix):
+    p = str(tmp_path / "m.csv")
+    np.savetxt(p, matrix, delimiter=",",
+               header=",".join(f"c{i}" for i in range(matrix.shape[1])),
+               comments="", fmt="%.7g")
+    got = store.csv_to_bank(p)
+    ref = np.loadtxt(p, delimiter=",", skiprows=1, dtype=np.float32, ndmin=2)
+    assert got.shape == matrix.shape
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_csv_skip_cols(tmp_path):
+    p = str(tmp_path / "ids.csv")
+    with open(p, "w") as f:
+        f.write("id,a,b\n")
+        f.write("101,1.5,2.5\n")
+        f.write("102,3.5,4.5\n")
+    got = store.csv_to_bank(p, skip_cols=1)
+    np.testing.assert_allclose(got, [[1.5, 2.5], [3.5, 4.5]])
+
+
+def test_csv_to_bank_persists(tmp_path, matrix):
+    csvp = str(tmp_path / "m.csv")
+    bankp = str(tmp_path / "m.dgpb")
+    np.savetxt(csvp, matrix, delimiter=",", comments="", fmt="%.7g")
+    got = store.csv_to_bank(csvp, bank_path=bankp, skip_header=False)
+    again = store.read_bank(bankp)
+    np.testing.assert_allclose(again, got)
+
+
+def test_csv_short_row_rejected(tmp_path):
+    if not store.bank_available():
+        pytest.skip("no native build")
+    p = str(tmp_path / "short.csv")
+    with open(p, "w") as f:
+        f.write("a,b,c\n")
+        f.write("1.0,2.0,3.0\n")
+        f.write("4.0,5.0\n")          # short row
+        f.write("6.0,7.0,8.0\n")
+    with pytest.raises(IOError):
+        store.csv_to_bank(p)
+
+
+def test_fallback_skip_cols_with_string_ids(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "_load", lambda: None)
+    p = str(tmp_path / "ids.csv")
+    with open(p, "w") as f:
+        f.write("id,a,b\n")
+        f.write("bldg_001,1.5,2.5\n")
+        f.write("bldg_002,3.5,4.5\n")
+    got = store.csv_to_bank(p, skip_cols=1)
+    np.testing.assert_allclose(got, [[1.5, 2.5], [3.5, 4.5]])
+
+
+def test_python_fallback_roundtrip(tmp_path, matrix, monkeypatch):
+    # force the no-compiler path: same file format must roundtrip
+    monkeypatch.setattr(store, "_load", lambda: None)
+    p = str(tmp_path / "fallback.dgpb")
+    store.write_bank(p, matrix)
+    got = store.read_bank(p)
+    np.testing.assert_array_equal(got, matrix)
+
+
+def test_native_and_fallback_files_interchange(tmp_path, matrix, monkeypatch):
+    if not store.bank_available():
+        pytest.skip("no native build")
+    p_native = str(tmp_path / "n.dgpb")
+    store.write_bank(p_native, matrix)  # native write
+    monkeypatch.setattr(store, "_load", lambda: None)
+    got = store.read_bank(p_native)     # python read
+    np.testing.assert_array_equal(got, matrix)

@@ -38,9 +38,9 @@ FORMAT_VERSION = 1
 
 #: agents.parquet schema (reference agent-pickle column analogue)
 AGENT_COLUMNS = (
-    "state_idx", "sector_idx", "region_idx", "tariff_idx", "load_idx",
-    "cf_idx", "customers_in_bin", "load_kwh_per_customer_in_bin",
-    "developable_frac",
+    "state_idx", "sector_idx", "region_idx", "tariff_idx",
+    "tariff_switch_idx", "load_idx", "cf_idx", "customers_in_bin",
+    "load_kwh_per_customer_in_bin", "developable_frac", "one_time_charge",
 )
 
 
@@ -142,6 +142,8 @@ def load_population(pkg_dir: str, pad_multiple: int = 128) -> Population:
         sector_idx=df["sector_idx"].to_numpy(),
         region_idx=df["region_idx"].to_numpy(),
         tariff_idx=df["tariff_idx"].to_numpy(),
+        tariff_switch_idx=df["tariff_switch_idx"].to_numpy(),
+        one_time_charge=df["one_time_charge"].to_numpy(),
         load_idx=df["load_idx"].to_numpy(),
         cf_idx=df["cf_idx"].to_numpy(),
         customers_in_bin=df["customers_in_bin"].to_numpy(),

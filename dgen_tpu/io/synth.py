@@ -148,6 +148,12 @@ def make_tariff_specs() -> list:
         "e_wkend_12by24": np.zeros((12, 24), dtype=int),
         "fixed_charge": 40.0, "metering": NET_METERING,
     })
+    # 6: DG rate for post-adoption switching (reference
+    # apply_rate_switch, agent_mutation/elec.py:838): NEM with a higher
+    # fixed charge and slightly lower volumetric price
+    specs.append({
+        "price": [[0.115]], "fixed_charge": 18.0, "metering": NET_METERING,
+    })
     return specs
 
 
@@ -171,6 +177,7 @@ def generate_population(
     pad_multiple: int = 128,
     sector_weights: Tuple[float, float, float] = (0.7, 0.2, 0.1),
     n_regions: int = 10,
+    rate_switch_frac: float = 0.0,
 ) -> SynthPopulation:
     """Deterministic synthetic population over the given states.
 
@@ -218,6 +225,14 @@ def generate_population(
         np.where(sector_idx == 1, rng.choice([1, 3, 5], n_agents), 5),
     )
 
+    # a fraction of residential agents switch to the DG rate (tariff 6)
+    # on adoption, paying a one-time interconnection charge
+    switch = (rng.random(n_agents) < rate_switch_frac) & (sector_idx == 0)
+    tariff_switch_idx = np.where(switch, 6, tariff_idx)
+    one_time_charge = np.where(
+        switch, rng.uniform(100.0, 800.0, n_agents), 0.0
+    ).astype(np.float32)
+
     table = build_agent_table(
         state_idx=global_state_idx,
         sector_idx=sector_idx,
@@ -229,6 +244,8 @@ def generate_population(
         load_kwh_per_customer_in_bin=load_kwh,
         developable_frac=developable,
         n_states=N_STATES,
+        tariff_switch_idx=tariff_switch_idx,
+        one_time_charge=one_time_charge,
         pad_multiple=pad_multiple,
     )
     profiles = ProfileBank(

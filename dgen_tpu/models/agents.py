@@ -45,11 +45,17 @@ class AgentTable:
     group_idx: jax.Array       # [N] int32 = state_idx * n_sectors + sector_idx
     region_idx: jax.Array      # [N] int32 census-division / BA for trajectories
     tariff_idx: jax.Array      # [N] int32 into TariffBank
+    #: post-adoption DG rate (reference agent_mutation/elec.py:838
+    #: ``apply_rate_switch``); equals tariff_idx when no switch applies
+    tariff_switch_idx: jax.Array  # [N] int32 into TariffBank
     load_idx: jax.Array        # [N] int32 into ProfileBank.load
     cf_idx: jax.Array          # [N] int32 into ProfileBank.solar_cf
     customers_in_bin: jax.Array            # [N] f32
     load_kwh_per_customer_in_bin: jax.Array  # [N] f32 (base year)
     developable_frac: jax.Array            # [N] f32
+    #: one-time interconnection charge on adoption (reference
+    #: elec.py:850-860), added to the installed cost
+    one_time_charge: jax.Array             # [N] f32
     incentives: IncentiveParams            # leaves [N, 2]
 
     n_states: int = dataclasses.field(metadata=dict(static=True), default=51)
@@ -105,6 +111,8 @@ def build_agent_table(
     developable_frac: np.ndarray,
     n_states: int,
     incentives: IncentiveParams | None = None,
+    tariff_switch_idx: np.ndarray | None = None,
+    one_time_charge: np.ndarray | None = None,
     pad_multiple: int = 128,
 ) -> AgentTable:
     """Assemble + pad an :class:`AgentTable` from host arrays.
@@ -154,6 +162,11 @@ def build_agent_table(
             pbi_years=pad2(incentives.pbi_years, np.int32),
         )
 
+    if tariff_switch_idx is None:
+        tariff_switch_idx = np.asarray(tariff_idx)
+    if one_time_charge is None:
+        one_time_charge = np.zeros(n, dtype=np.float32)
+
     return AgentTable(
         agent_id=pad_i(np.arange(n)),
         mask=jnp.asarray(mask),
@@ -162,11 +175,13 @@ def build_agent_table(
         group_idx=pad_i(group),
         region_idx=pad_i(region_idx),
         tariff_idx=pad_i(tariff_idx),
+        tariff_switch_idx=pad_i(tariff_switch_idx),
         load_idx=pad_i(load_idx),
         cf_idx=pad_i(cf_idx),
         customers_in_bin=pad_f(customers_in_bin),
         load_kwh_per_customer_in_bin=pad_f(load_kwh_per_customer_in_bin),
         developable_frac=pad_f(developable_frac),
+        one_time_charge=pad_f(one_time_charge),
         incentives=incentives,
         n_states=n_states,
     )

@@ -119,6 +119,7 @@ def build_econ_inputs(
     ya,
     nem_allowed: jax.Array,
     incentives,
+    rate_switch: bool = False,
 ) -> sizing_ops.AgentEconInputs:
     """Assemble the per-agent economics environment for one year.
 
@@ -131,14 +132,23 @@ def build_econ_inputs(
     """
     mult = ya.elec_price_multiplier
 
-    at = jax.vmap(lambda k: bill_ops.gather_tariff(tariffs, k))(table.tariff_idx)
-    at = at._replace(
-        price=at.price * mult[:, None, None],
-        sell_price=at.sell_price * mult[:, None],
-        metering=jnp.where(
-            nem_allowed > 0, at.metering, jnp.full_like(at.metering, NET_BILLING)
-        ),
-    )
+    def gather(idx):
+        at = jax.vmap(lambda k: bill_ops.gather_tariff(tariffs, k))(idx)
+        return at._replace(
+            price=at.price * mult[:, None, None],
+            sell_price=at.sell_price * mult[:, None],
+            metering=jnp.where(
+                nem_allowed > 0, at.metering,
+                jnp.full_like(at.metering, NET_BILLING),
+            ),
+        )
+
+    at = gather(table.tariff_idx)
+    # DG-rate switch on adoption (reference apply_rate_switch,
+    # agent_mutation/elec.py:838): with-system bills price on the
+    # switched tariff. ``rate_switch`` is static (decided host-side)
+    # so no-switch populations skip the second gather entirely.
+    at_w = gather(table.tariff_switch_idx) if rate_switch else None
 
     load = profiles.load[table.load_idx] * ya.load_kwh_per_customer[:, None]
     gen_per_kw = profiles.solar_cf[table.cf_idx]
@@ -146,12 +156,12 @@ def build_econ_inputs(
     # (reference financial_functions.py:182).
     ts_sell = profiles.wholesale[table.region_idx] * mult[:, None]
 
-    n = table.n_agents
     return sizing_ops.AgentEconInputs(
         load=load,
         gen_per_kw=gen_per_kw,
         ts_sell=ts_sell,
         tariff=at,
+        tariff_w=at_w,
         fin=ya.fin,
         inc=incentives,
         load_kwh_per_customer=ya.load_kwh_per_customer,
@@ -162,7 +172,7 @@ def build_econ_inputs(
         batt_capex_per_kwh_combined=ya.batt_capex_per_kwh_combined,
         cap_cost_multiplier=ya.cap_cost_multiplier,
         value_of_resiliency_usd=ya.value_of_resiliency,
-        one_time_charge=jnp.zeros(n, dtype=jnp.float32),
+        one_time_charge=table.one_time_charge,
     )
 
 
@@ -171,6 +181,7 @@ def build_econ_inputs(
     static_argnames=(
         "n_periods", "econ_years", "sizing_iters", "first_year",
         "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
+        "rate_switch",
     ),
 )
 def year_step(
@@ -189,6 +200,7 @@ def year_step(
     storage_enabled: bool,
     year_step_len: float,
     sizing_impl: str = "auto",
+    rate_switch: bool = False,
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
 
@@ -219,7 +231,8 @@ def year_step(
     nem_allowed = (state_kw_last < cap).astype(jnp.float32)[table.state_idx]
 
     envs = build_econ_inputs(
-        table, profiles, tariffs, ya, nem_allowed, table.incentives
+        table, profiles, tariffs, ya, nem_allowed, table.incentives,
+        rate_switch=rate_switch,
     )
 
     # --- hot loop: size every agent (financial_functions.py:291) ---
@@ -421,6 +434,11 @@ class Simulation:
         self.profiles = profiles
         self.tariffs = tariffs
         self.inputs = inputs
+        # static: whether any agent's post-adoption DG rate differs
+        # (skips the second tariff gather + bill structure when not)
+        self._rate_switch = bool(np.any(
+            np.asarray(table.tariff_switch_idx) != np.asarray(table.tariff_idx)
+        ))
 
     def _step_kwargs(self, first_year: bool) -> dict:
         # The Pallas bucket-sums kernel is not partition-aware; under a
@@ -440,6 +458,7 @@ class Simulation:
             storage_enabled=self.scenario.storage_enabled,
             year_step_len=float(self.scenario.year_step),
             sizing_impl="xla" if multi_tpu else "auto",
+            rate_switch=self._rate_switch,
         )
 
     def init_carry(self) -> SimCarry:

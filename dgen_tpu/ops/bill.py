@@ -172,6 +172,7 @@ def bill_series(
     degradation: jax.Array,
     n_periods: int,
     n_years: int,
+    tariff_wo: AgentTariff | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(bills_with_sys [Y], bills_without_sys [Y]) in nominal dollars.
 
@@ -185,11 +186,18 @@ def bill_series(
     (its net load never changes); the with-system bill re-evaluates the
     import/export split every year because degradation shifts it
     nonlinearly.
+
+    ``tariff_wo`` prices the counterfactual no-system bill when the
+    adopter switches to a DG rate on adoption (reference
+    agent_mutation/elec.py:838 ``apply_rate_switch``: with-system on the
+    switched rate, baseline on the original).
     """
     pf = escalation_factors(n_years, inflation, escalation)     # [Y]
     df = degradation_factors(n_years, degradation)              # [Y]
 
-    bill_wo_y1 = annual_bill(load, tariff, ts_sell, n_periods)
+    bill_wo_y1 = annual_bill(
+        load, tariff if tariff_wo is None else tariff_wo, ts_sell, n_periods
+    )
     bills_wo = bill_wo_y1 * pf
 
     def year_bill(deg_f):

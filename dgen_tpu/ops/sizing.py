@@ -54,6 +54,10 @@ class AgentEconInputs:
     gen_per_kw: jax.Array      # [8760] PV DC output per kW_dc
     ts_sell: jax.Array         # [8760] $/kWh time-series sell rate
     tariff: AgentTariff
+    #: post-adoption (DG-rate-switched) tariff used for WITH-system
+    #: bills (reference apply_rate_switch, agent_mutation/elec.py:838);
+    #: None = no switch, with-system bills use ``tariff``
+    tariff_w: "AgentTariff | None"
     fin: cf_ops.FinanceParams
     inc: cf_ops.IncentiveParams
     load_kwh_per_customer: jax.Array
@@ -77,10 +81,12 @@ def _npv_given_system_out(
     n_years: int,
 ):
     """Shared tail of the objective: bills -> energy value -> cashflow."""
+    tw = env.tariff if env.tariff_w is None else env.tariff_w
     bills_w, bills_wo = bill_ops.bill_series(
-        env.load, system_out, env.tariff, env.ts_sell,
+        env.load, system_out, tw, env.ts_sell,
         env.fin.inflation_rate, env.elec_price_escalator, env.pv_degradation,
         n_periods=n_periods, n_years=n_years,
+        tariff_wo=None if env.tariff_w is None else env.tariff,
     )
     # Value of resiliency is added to every year's energy value for the
     # with-battery case (reference financial_functions.py:220,274-275).
@@ -291,8 +297,12 @@ def _size_agents_fast(
 
     gen_shape = envs.gen_per_kw * INV_EFF                         # [N, H]
     n_buckets = 12 * n_periods
-    bucket = billpallas.hourly_bucket_ids(envs.tariff.hour_period, n_periods)
-    sell = billpallas.sell_rate_hourly(envs.tariff, envs.ts_sell)
+    # with-system bills price on the (possibly DG-rate-switched)
+    # tariff_w; the counterfactual stays on the original tariff
+    # (reference apply_rate_switch, agent_mutation/elec.py:838)
+    tw = envs.tariff if envs.tariff_w is None else envs.tariff_w
+    bucket = billpallas.hourly_bucket_ids(tw.hour_period, n_periods)
+    sell = billpallas.sell_rate_hourly(tw, envs.ts_sell)
 
     yr = jnp.arange(n_years, dtype=f32)[None, :]                  # [1, Y]
     pf = (
@@ -302,15 +312,24 @@ def _size_agents_fast(
     df = (1.0 - envs.pv_degradation[:, None]) ** yr               # [N, Y]
 
     # once per call: the linear bill structure (NEM + export credit)
+    # on the with-system tariff
     lin = billpallas.linear_sums(
-        envs.load, gen_shape, sell, envs.tariff.hour_period, n_periods
+        envs.load, gen_shape, sell, tw.hour_period, n_periods
     )
 
-    # no-system bills: scale 0 through the linear path — no kernel call
+    # no-system bills: scale 0 through the linear path on the ORIGINAL
+    # tariff — no kernel call
     zeros1 = jnp.zeros((n, 1), f32)
-    imp0 = lin[0][:, None, :]          # imports at s=0 == S_load buckets
+    if envs.tariff_w is None:
+        lin_wo, sell_wo = lin, sell
+    else:
+        sell_wo = billpallas.sell_rate_hourly(envs.tariff, envs.ts_sell)
+        lin_wo = billpallas.linear_sums(
+            envs.load, gen_shape, sell_wo, envs.tariff.hour_period, n_periods
+        )
+    imp0 = lin_wo[0][:, None, :]       # imports at s=0 == S_load buckets
     bills_wo = billpallas.bills_linear_nb(
-        lin, imp0, lin[2][:, None], zeros1, envs.tariff, n_periods
+        lin_wo, imp0, lin_wo[2][:, None], zeros1, envs.tariff, n_periods
     )[:, 0:1] * pf                                                # [N, Y]
 
     cashflow_v = jax.vmap(
@@ -352,7 +371,7 @@ def _size_agents_fast(
             bf16=False,
         )
         bills = billpallas.bills_linear_nb(
-            lin, imports, imp_sell, scales, envs.tariff, n_periods
+            lin, imports, imp_sell, scales, tw, n_periods
         ).reshape(n, k, n_years) * pf[:, None, :]                 # [N, K, Y]
 
         rep = lambda x: jnp.repeat(x, k, axis=0)
@@ -410,7 +429,7 @@ def _size_agents_fast(
         envs.load, dr.system_out, sell, bucket, df, n_buckets, impl
     )
     bills_w_b = billpallas.bills_from_sums(
-        s_b, i_b, c_b, envs.tariff, n_periods
+        s_b, i_b, c_b, tw, n_periods
     ) * pf
     out_w = econ(bills_w_b, kw_star, cost_w, envs.value_of_resiliency_usd,
                  jnp.sum(dr.system_out, axis=1))

@@ -32,6 +32,7 @@ fin = jax.tree.map(lambda x: jnp.broadcast_to(x, (n,)), cf_ops.FinanceParams.exa
 envs = sizing.AgentEconInputs(
     load=load, gen_per_kw=gen_per_kw, ts_sell=ts_sell,
     tariff=jax.vmap(lambda k: bill_ops.gather_tariff(pop.tariffs, k))(t.tariff_idx),
+    tariff_w=None,
     fin=fin, inc=jax.tree.map(lambda x: x, t.incentives),
     load_kwh_per_customer=t.load_kwh_per_customer_in_bin,
     elec_price_escalator=jnp.full(n, 0.005, f32),

@@ -72,7 +72,7 @@ def test_fast_sizing_matches_oracle(setup):
     )
     envs = sizing.AgentEconInputs(
         load=load, gen_per_kw=pop.profiles.solar_cf[t.cf_idx], ts_sell=ts,
-        tariff=at, fin=fin, inc=t.incentives,
+        tariff=at, tariff_w=None, fin=fin, inc=t.incentives,
         load_kwh_per_customer=t.load_kwh_per_customer_in_bin,
         elec_price_escalator=jnp.full(n, 0.005, f32),
         pv_degradation=jnp.full(n, 0.005, f32),

@@ -1,0 +1,101 @@
+"""DG-rate switch on adoption (reference apply_rate_switch,
+agent_mutation/elec.py:838): with-system bills price on the switched
+tariff, the counterfactual stays on the original, and the one-time
+interconnection charge lands in the installed cost."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+from dgen_tpu.ops import bill as bill_ops
+from dgen_tpu.ops import cashflow as cf_ops
+from dgen_tpu.ops import sizing
+
+
+def _envs(n=16, switch=True, seed=5):
+    pop = synth.generate_population(n, states=["DE"], seed=seed,
+                                    pad_multiple=8, rate_switch_frac=0.0)
+    t = pop.table
+    f32 = jnp.float32
+    fin = jax.tree.map(lambda x: jnp.broadcast_to(x, (t.n_agents,)),
+                       cf_ops.FinanceParams.example())
+    at = jax.vmap(lambda k: bill_ops.gather_tariff(pop.tariffs, k))(t.tariff_idx)
+    at_w = jax.vmap(lambda k: bill_ops.gather_tariff(pop.tariffs, k))(
+        jnp.full_like(t.tariff_idx, 6)) if switch else None
+    n_pad = t.n_agents
+    return sizing.AgentEconInputs(
+        load=pop.profiles.load[t.load_idx] * t.load_kwh_per_customer_in_bin[:, None],
+        gen_per_kw=pop.profiles.solar_cf[t.cf_idx],
+        ts_sell=pop.profiles.wholesale[t.region_idx],
+        tariff=at, tariff_w=at_w, fin=fin, inc=t.incentives,
+        load_kwh_per_customer=t.load_kwh_per_customer_in_bin,
+        elec_price_escalator=jnp.full(n_pad, 0.005, f32),
+        pv_degradation=jnp.full(n_pad, 0.005, f32),
+        system_capex_per_kw=jnp.full(n_pad, 2500.0, f32),
+        system_capex_per_kw_combined=jnp.full(n_pad, 2600.0, f32),
+        batt_capex_per_kwh_combined=jnp.full(n_pad, 800.0, f32),
+        cap_cost_multiplier=jnp.ones(n_pad, f32),
+        value_of_resiliency_usd=jnp.zeros(n_pad, f32),
+        one_time_charge=jnp.full(n_pad, 300.0 if switch else 0.0, f32),
+    ), pop
+
+
+def test_switch_changes_with_bill_not_counterfactual():
+    envs_sw, pop = _envs(switch=True)
+    envs_no, _ = _envs(switch=False)
+    p = pop.tariffs.max_periods
+    r_sw = sizing.size_agents(envs_sw, n_periods=p, n_years=25, n_iters=8)
+    r_no = sizing.size_agents(envs_no, n_periods=p, n_years=25, n_iters=8)
+    # counterfactual identical (same original tariff)
+    np.testing.assert_allclose(
+        np.asarray(r_sw.first_year_bill_without_system),
+        np.asarray(r_no.first_year_bill_without_system), rtol=1e-5)
+    # with-system bills differ for agents whose DG rate differs
+    db = np.abs(np.asarray(r_sw.first_year_bill_with_system)
+                - np.asarray(r_no.first_year_bill_with_system))
+    assert db.max() > 1.0, "rate switch should move some with-system bill"
+    # the interconnection charge + rate change shift NPV
+    assert np.abs(np.asarray(r_sw.npv) - np.asarray(r_no.npv)).max() > 100.0
+
+
+def test_fast_matches_slow_under_switch():
+    envs, pop = _envs(switch=True)
+    p = pop.tariffs.max_periods
+    rf = sizing.size_agents(envs, n_periods=p, n_years=25, n_iters=10, fast=True)
+    rs = sizing.size_agents(envs, n_periods=p, n_years=25, n_iters=10, fast=False)
+    np.testing.assert_allclose(
+        np.asarray(rf.system_kw), np.asarray(rs.system_kw), rtol=6e-3)
+    # with-system bills inherit the kW* grid discretization (exports
+    # scale with kW); bound by the bill's gross flow, not its net value
+    flow = np.abs(np.asarray(rs.first_year_bill_without_system)) + 1.0
+    dbill = np.abs(np.asarray(rf.first_year_bill_with_system)
+                   - np.asarray(rs.first_year_bill_with_system))
+    assert np.all(dbill <= 6e-3 * flow + 1.0), f"max {dbill.max()}"
+    np.testing.assert_allclose(
+        np.asarray(rf.first_year_bill_without_system),
+        np.asarray(rs.first_year_bill_without_system), rtol=1e-3, atol=1.0)
+    np.testing.assert_allclose(
+        np.asarray(rf.payback_period), np.asarray(rs.payback_period), atol=0.21)
+
+
+def test_simulation_with_rate_switch_population():
+    cfg = ScenarioConfig(name="rs", start_year=2014, end_year=2018,
+                         anchor_years=())
+    pop = synth.generate_population(96, states=["DE", "CA"], seed=7,
+                                    pad_multiple=32, rate_switch_frac=0.5)
+    assert bool(np.any(np.asarray(pop.table.tariff_switch_idx)
+                       != np.asarray(pop.table.tariff_idx)))
+    inputs = scen.uniform_inputs(cfg, n_groups=pop.table.n_groups,
+                                 n_regions=pop.n_regions)
+    sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                     RunConfig(sizing_iters=6))
+    assert sim._rate_switch
+    res = sim.run()
+    s = res.summary(np.asarray(pop.table.mask))
+    assert np.all(np.isfinite(s["system_kw_cum"]))
+    assert s["system_kw_cum"][-1] > 0

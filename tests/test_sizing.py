@@ -42,6 +42,7 @@ def _make_env(seed=0, tariff_k=1, load_kwh=9000.0):
         gen_per_kw=jnp.asarray(cf_prof, dtype=jnp.float32),
         ts_sell=jnp.asarray(ts_sell),
         tariff=bill_ops.gather_tariff(bank, jnp.asarray(tariff_k)),
+        tariff_w=None,
         fin=cf_ops.FinanceParams.example(),
         inc=cf_ops.IncentiveParams.zeros(),
         load_kwh_per_customer=jnp.float32(load_kwh),

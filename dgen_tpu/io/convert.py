@@ -1,0 +1,454 @@
+"""Convert the reference's agent population into an agent package.
+
+The reference distributes its population as a pandas pickle whose rows
+carry object cells — a ``tariff_dict`` per agent, profile keys that
+resolve through per-agent Postgres SQL (reference
+input_data_functions.py:389 ``import_agent_file``,
+agent_mutation/elec.py:508-558) — none of which can live on a TPU
+device path. This module runs ONCE, offline, and compiles that pickle
+into the dense package format of :mod:`dgen_tpu.io.package`:
+
+  * raw/stringified ``tariff_dict`` cells are parsed, deduplicated and
+    compiled into a TariffBank spec list
+    (semantics: financial_functions.py:655 ``_parse_tariff_dict`` and
+    :962 ``normalize_tariff``);
+  * known-bad tariff ids are reassigned before compilation (the
+    converter-time analogue of agent_mutation/elec.py:868
+    ``reassign_agent_tariffs``; bad ids at :993);
+  * per-agent profile keys — (bldg_id, sector_abbr, state_abbr) for
+    load, (solar_re_9809_gid, tilt, azimuth) for solar CF — are
+    resolved against profile tables, deduplicated into shared banks and
+    replaced by integer bank indices;
+  * the optional state-incentive table is compiled to top-2 fixed-width
+    slots per agent (financial_functions.py:1014 ``process_incentives``
+    consumes exactly two CBI/PBI/IBI rows).
+
+The output directory round-trips through
+:func:`dgen_tpu.io.package.load_population` into the pytrees the
+Simulation consumes.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from dgen_tpu.config import SECTORS
+from dgen_tpu.io import package
+from dgen_tpu.io.reference_inputs import CENSUS_DIVISIONS
+from dgen_tpu.models.agents import build_agent_table, ProfileBank
+from dgen_tpu.ops.cashflow import IncentiveParams
+from dgen_tpu.ops.tariff import (
+    BIG_CAP, NET_BILLING, NET_METERING, compile_tariffs,
+)
+
+#: tariff ids the reference replaces wholesale (agent_mutation/elec.py:993)
+BAD_TARIFF_IDS = (4145, 7111, 8498, 10953, 10954, 12003)
+
+HOURS = 8760
+
+
+# ---------------------------------------------------------------------------
+# tariff_dict parsing + conversion
+# ---------------------------------------------------------------------------
+
+def parse_tariff_dict(raw: Any) -> Dict[str, Any]:
+    """Coerce a pickle cell into a tariff dict.
+
+    The reference tolerates dicts, JSON-ish strings and Python-literal
+    strings with embedded nan/none (financial_functions.py:655
+    ``_parse_tariff_dict``); the converter must accept the same inputs
+    since pickles in the wild carry all three.
+    """
+    if isinstance(raw, dict):
+        return raw
+    if not isinstance(raw, str):
+        return {}
+    s = raw.replace("'", '"')
+    s = re.sub(r"\b(nan|none|null)\b", "null", s, flags=re.IGNORECASE)
+    try:
+        return json.loads(s)
+    except json.JSONDecodeError:
+        try:
+            out = ast.literal_eval(raw)
+            return out if isinstance(out, dict) else {}
+        except Exception:
+            return {}
+
+
+def _metering_code(td: Dict[str, Any]) -> int:
+    """Reference metering codes 0=NM / 1,2=net-billing-style -> bank codes.
+
+    The reference forces net billing globally (FORCE_NET_BILLING,
+    financial_functions.py:37,590); the converter preserves the raw
+    option and leaves forcing to the scenario config, which owns that
+    policy switch in this framework.
+    """
+    mo = int(td.get("ur_metering_option", 0) or 0)
+    return NET_METERING if mo == 0 else NET_BILLING
+
+
+def reference_tariff_to_spec(td: Dict[str, Any]) -> Dict[str, Any]:
+    """One reference ``tariff_dict`` -> one compiler spec dict.
+
+    Handles both shapes found in agent pickles: the legacy URDB-style
+    e_* fields ([T][P] prices/levels + 0-based 12x24 schedules) and the
+    already-normalized PySAM fields (``ur_ec_tou_mat`` rows
+    [period(1..P), tier(1..T), max_usage, unit, price, sell] with
+    1-based 12x24 schedules) — the same two shapes
+    financial_functions.py:962 ``normalize_tariff`` accepts. Demand
+    charges are dropped, matching the reference's global
+    SKIP_DEMAND_CHARGES=True (financial_functions.py:35).
+    """
+    spec: Dict[str, Any] = {
+        "fixed_charge": float(
+            td.get("ur_monthly_fixed_charge", td.get("fixed_charge", 0.0))
+            or 0.0),
+        "metering": _metering_code(td),
+    }
+
+    ec_tou = td.get("ur_ec_tou_mat")
+    if ec_tou:
+        rows = np.asarray(ec_tou, dtype=np.float64)
+        periods = rows[:, 0].astype(int)
+        tiers = rows[:, 1].astype(int)
+        P, T = int(periods.max()), int(tiers.max())
+        price = np.zeros((P, T))
+        caps = np.full(T, BIG_CAP)
+        for r in rows:
+            p, t = int(r[0]) - 1, int(r[1]) - 1
+            price[p, t] = r[4]
+            caps[t] = min(caps[t], r[2]) if r[2] > 0 else caps[t]
+        spec["price"] = price.tolist()
+        spec["tier_cap"] = caps.tolist()
+        # ur schedules are 1-based; the compiler wants 0-based
+        for src, dst in (("ur_ec_sched_weekday", "e_wkday_12by24"),
+                         ("ur_ec_sched_weekend", "e_wkend_12by24")):
+            sched = td.get(src)
+            if sched is not None:
+                spec[dst] = (np.asarray(sched, dtype=np.int64) - 1).clip(
+                    0).tolist()
+        return spec
+
+    for key in ("e_prices", "e_levels", "e_wkday_12by24", "e_wkend_12by24"):
+        if td.get(key) is not None:
+            spec[key] = td[key]
+    if "e_prices" not in spec:
+        # degenerate/empty dict -> inert flat tariff so compilation
+        # never fails on a malformed cell (the reference's parser
+        # likewise degrades to {} and PySAM defaults)
+        spec["price"] = [[0.1]]
+    return spec
+
+
+def _canonical_key(spec: Dict[str, Any]) -> str:
+    return json.dumps(spec, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# bad-tariff reassignment
+# ---------------------------------------------------------------------------
+
+def reassign_bad_tariffs(
+    df: pd.DataFrame,
+    bad_ids: Sequence[int] = BAD_TARIFF_IDS,
+) -> pd.DataFrame:
+    """Replace known-bad tariffs before compilation.
+
+    The reference swaps six corrupt URDB ids for hardcoded per-state
+    defaults pulled from its Postgres tariff store
+    (agent_mutation/elec.py:868-988). Without that store, the converter
+    reassigns each bad-tariff agent to the modal good tariff of its
+    (state_abbr, sector_abbr) cell, falling back to the sector's modal
+    tariff, then to any good tariff — preserving the invariant the
+    reference cares about (no agent sizes against a corrupt rate) with
+    a data-driven default.
+    """
+    bad = df["tariff_id"].isin(list(bad_ids))
+    if not bad.any():
+        return df
+    good = df[~bad]
+    if good.empty:
+        raise ValueError("every agent has a bad tariff id; cannot reassign")
+
+    df = df.copy()
+
+    def modal(frame: pd.DataFrame) -> Optional[pd.Series]:
+        if frame.empty:
+            return None
+        tid = frame["tariff_id"].mode().iloc[0]
+        return frame[frame["tariff_id"] == tid].iloc[0]
+
+    for idx in df.index[bad]:
+        row = df.loc[idx]
+        donor = modal(good[(good["state_abbr"] == row["state_abbr"])
+                           & (good["sector_abbr"] == row["sector_abbr"])])
+        if donor is None:
+            donor = modal(good[good["sector_abbr"] == row["sector_abbr"]])
+        if donor is None:
+            donor = good.iloc[0]
+        df.at[idx, "tariff_id"] = donor["tariff_id"]
+        df.at[idx, "tariff_dict"] = donor["tariff_dict"]
+    return df
+
+
+# ---------------------------------------------------------------------------
+# profile resolution
+# ---------------------------------------------------------------------------
+
+def _as_frame(src: Union[str, pd.DataFrame]) -> pd.DataFrame:
+    if isinstance(src, pd.DataFrame):
+        return src
+    if str(src).endswith(".parquet"):
+        return pd.read_parquet(src)
+    return pd.read_pickle(src)
+
+
+def _profile_bank(
+    df: pd.DataFrame,
+    key_cols: Sequence[str],
+    value_col: str,
+    used_keys: Sequence[Tuple],
+    scale: float = 1.0,
+    normalize_sum: bool = False,
+) -> Tuple[np.ndarray, Dict[Tuple, int]]:
+    """Dedup profiles by key into an [n, 8760] bank + key->row map."""
+    lut: Dict[Tuple, int] = {}
+    by_key = {}
+    for _, row in df.iterrows():
+        k = tuple(row[c] for c in key_cols)
+        by_key[k] = row[value_col]
+    rows = []
+    for k in used_keys:
+        if k in lut:
+            continue
+        if k not in by_key:
+            raise KeyError(f"profile key {k!r} not found in profile table "
+                           f"(keys {list(key_cols)})")
+        arr = np.asarray(by_key[k], dtype=np.float64).ravel()
+        if arr.size != HOURS:
+            raise ValueError(f"profile {k!r} has {arr.size} hours != {HOURS}")
+        arr = arr * scale
+        if normalize_sum:
+            s = arr.sum()
+            arr = arr / s if s > 0 else np.full(HOURS, 1.0 / HOURS)
+        lut[k] = len(rows)
+        rows.append(arr.astype(np.float32))
+    return np.stack(rows), lut
+
+
+# ---------------------------------------------------------------------------
+# incentives
+# ---------------------------------------------------------------------------
+
+def compile_incentives(
+    state_incentives: Optional[pd.DataFrame],
+    state_abbr: pd.Series,
+    sector_abbr: pd.Series,
+) -> Optional[IncentiveParams]:
+    """Reference state-incentive rows -> top-2 per-agent slots.
+
+    Row schema follows the reference table consumed by
+    agent_mutation/elec.py:656 ``apply_state_incentives`` /
+    financial_functions.py:1014 ``process_incentives``: state_abbr,
+    sector_abbr, cbi_usd_p_w, ibi_pct, pbi_usd_p_kwh,
+    max_incentive_usd, incentive_duration_yrs. The reference fills
+    missing duration/max with 5 yrs / $10k (:1025).
+    """
+    if state_incentives is None or state_incentives.empty:
+        return None
+    si = state_incentives.fillna(
+        value={"incentive_duration_yrs": 5.0, "max_incentive_usd": 10000.0})
+
+    n = len(state_abbr)
+    out = {k: np.zeros((n, 2), np.float32)
+           for k in ("cbi_usd_p_w", "cbi_max_usd", "ibi_frac", "ibi_max_usd",
+                     "pbi_usd_p_kwh")}
+    pbi_years = np.zeros((n, 2), np.int32)
+
+    grouped = {k: g for k, g in si.groupby(["state_abbr", "sector_abbr"])}
+    for i, (st, sec) in enumerate(zip(state_abbr, sector_abbr)):
+        g = grouped.get((st, sec))
+        if g is None:
+            continue
+        cbi = g[g.get("cbi_usd_p_w", pd.Series(dtype=float)).notna()] \
+            .sort_values("cbi_usd_p_w", ascending=False)
+        for s, (_, row) in enumerate(cbi.head(2).iterrows()):
+            out["cbi_usd_p_w"][i, s] = row["cbi_usd_p_w"]
+            out["cbi_max_usd"][i, s] = row["max_incentive_usd"]
+        if "ibi_pct" in g:
+            ibi = g[g["ibi_pct"].notna()].sort_values(
+                "ibi_pct", ascending=False)
+            for s, (_, row) in enumerate(ibi.head(2).iterrows()):
+                out["ibi_frac"][i, s] = row["ibi_pct"]
+                out["ibi_max_usd"][i, s] = row["max_incentive_usd"]
+        if "pbi_usd_p_kwh" in g:
+            pbi = g[g["pbi_usd_p_kwh"].notna()].sort_values(
+                "pbi_usd_p_kwh", ascending=False)
+            for s, (_, row) in enumerate(pbi.head(2).iterrows()):
+                out["pbi_usd_p_kwh"][i, s] = row["pbi_usd_p_kwh"]
+                pbi_years[i, s] = int(row["incentive_duration_yrs"])
+    return IncentiveParams(pbi_years=pbi_years, **out)
+
+
+# ---------------------------------------------------------------------------
+# the converter
+# ---------------------------------------------------------------------------
+
+def _col(df: pd.DataFrame, name: str, default=None):
+    """Column with the reference's ``*_initial`` fallback convention
+    (apply_load_growth rewrites the non-initial columns every year,
+    elec.py:396-406, so pickles may carry either)."""
+    if name in df.columns:
+        return df[name]
+    if f"{name}_initial" in df.columns:
+        return df[f"{name}_initial"]
+    if default is not None:
+        return pd.Series(np.full(len(df), default), index=df.index)
+    raise KeyError(f"agent frame missing required column {name!r}")
+
+
+def _developable_frac(df: pd.DataFrame) -> np.ndarray:
+    """Developable fraction per agent.
+
+    This fork weights by raw customers (elec.py:418
+    ``developable_agent_weight = customers_in_bin`` -> frac 1.0); older
+    pickles carry ``pct_of_bldgs_developable`` on a 0-100 scale, which
+    is detected and rescaled.
+    """
+    if "pct_of_bldgs_developable" not in df.columns:
+        return np.ones(len(df), np.float32)
+    v = df["pct_of_bldgs_developable"].to_numpy(np.float32)
+    if np.nanmax(v, initial=0.0) > 1.0:
+        v = v / 100.0
+    return np.clip(np.nan_to_num(v, nan=1.0), 0.0, 1.0)
+
+
+def from_reference_pickle(
+    agents: Union[str, pd.DataFrame],
+    out_dir: str,
+    load_profiles: Union[str, pd.DataFrame],
+    solar_profiles: Union[str, pd.DataFrame],
+    wholesale_by_region: Optional[Dict[str, np.ndarray]] = None,
+    state_incentives: Optional[pd.DataFrame] = None,
+    states: Optional[Sequence[str]] = None,
+    bad_tariff_ids: Sequence[int] = BAD_TARIFF_IDS,
+) -> package.Population:
+    """Compile a reference-format agent pickle into a package at
+    ``out_dir`` and return the loaded :class:`Population`.
+
+    Parameters mirror what the reference pipeline resolves at load
+    time: ``load_profiles`` replaces the per-agent SQL of
+    elec.py:508 (columns bldg_id/sector_abbr/state_abbr +
+    ``consumption_hourly``), ``solar_profiles`` replaces elec.py:535
+    (solar_re_9809_gid/tilt/azimuth + ``cf`` at the reference's 1e6
+    scale offset), ``wholesale_by_region`` maps census division -> an
+    [8760] $/kWh sell-rate profile (flat arrays accepted).
+    """
+    df = _as_frame(agents)
+    if df.index.name == "agent_id":
+        df = df.reset_index()
+
+    required = ("state_abbr", "sector_abbr", "tariff_id", "tariff_dict",
+                "bldg_id", "solar_re_9809_gid", "tilt", "azimuth")
+    missing = [c for c in required if c not in df.columns]
+    if missing:
+        raise ValueError(f"agent frame missing columns: {missing}")
+
+    if states:
+        df = df[df["state_abbr"].isin(list(states))].reset_index(drop=True)
+        if df.empty:
+            raise ValueError("state filter removed every agent "
+                             "(reference input_data_functions.py:436)")
+    df = reassign_bad_tariffs(df, bad_tariff_ids)
+
+    state_list = sorted(df["state_abbr"].unique()) if states is None \
+        else list(states)
+    st_idx = {s: i for i, s in enumerate(state_list)}
+    sec_idx = {s: i for i, s in enumerate(SECTORS)}
+    cd_idx = {c: i for i, c in enumerate(CENSUS_DIVISIONS)}
+
+    # --- tariffs: parse, convert, dedup ---
+    specs: List[Dict[str, Any]] = []
+    spec_lut: Dict[str, int] = {}
+    tariff_idx = np.zeros(len(df), np.int32)
+    for i, raw in enumerate(df["tariff_dict"]):
+        spec = reference_tariff_to_spec(parse_tariff_dict(raw))
+        key = _canonical_key(spec)
+        if key not in spec_lut:
+            spec_lut[key] = len(specs)
+            specs.append(spec)
+        tariff_idx[i] = spec_lut[key]
+
+    # --- profiles: dedup into banks ---
+    load_keys = [tuple(r) for r in
+                 df[["bldg_id", "sector_abbr", "state_abbr"]].itertuples(
+                     index=False)]
+    load_bank, load_lut = _profile_bank(
+        _as_frame(load_profiles),
+        ("bldg_id", "sector_abbr", "state_abbr"), "consumption_hourly",
+        load_keys, normalize_sum=True)
+    load_idx = np.asarray([load_lut[k] for k in load_keys], np.int32)
+
+    cf_keys = [tuple(r) for r in
+               df[["solar_re_9809_gid", "tilt", "azimuth"]].itertuples(
+                   index=False)]
+    # reference stores CF at a 1e6 scale offset (elec.py:546-551,
+    # financial_functions.py:350 divides by 1e-6-implied offset)
+    cf_bank, cf_lut = _profile_bank(
+        _as_frame(solar_profiles),
+        ("solar_re_9809_gid", "tilt", "azimuth"), "cf",
+        cf_keys, scale=1e-6)
+    cf_idx = np.asarray([cf_lut[k] for k in cf_keys], np.int32)
+
+    # --- regions + wholesale sell-rate bank ---
+    if "census_division_abbr" in df.columns:
+        region_idx = np.asarray(
+            [cd_idx.get(c, 0) for c in df["census_division_abbr"]], np.int32)
+        region_names = list(CENSUS_DIVISIONS)
+    else:
+        region_idx = np.zeros(len(df), np.int32)
+        region_names = ["ALL"]
+    wholesale = np.zeros((len(region_names), HOURS), np.float32)
+    if wholesale_by_region:
+        for r, name in enumerate(region_names):
+            prof = wholesale_by_region.get(name)
+            if prof is None:
+                continue
+            arr = np.asarray(prof, dtype=np.float32).ravel()
+            wholesale[r] = arr if arr.size == HOURS else np.full(
+                HOURS, float(arr.mean()), np.float32)
+
+    incentives = compile_incentives(
+        state_incentives, df["state_abbr"], df["sector_abbr"])
+
+    table = build_agent_table(
+        state_idx=np.asarray([st_idx[s] for s in df["state_abbr"]], np.int32),
+        sector_idx=np.asarray([sec_idx[s] for s in df["sector_abbr"]],
+                              np.int32),
+        region_idx=region_idx,
+        tariff_idx=tariff_idx,
+        load_idx=load_idx,
+        cf_idx=cf_idx,
+        customers_in_bin=_col(df, "customers_in_bin").to_numpy(np.float32),
+        load_kwh_per_customer_in_bin=_col(
+            df, "load_kwh_per_customer_in_bin").to_numpy(np.float32),
+        developable_frac=_developable_frac(df),
+        n_states=len(state_list),
+        incentives=incentives,
+    )
+
+    import jax.numpy as jnp
+    profiles = ProfileBank(load=jnp.asarray(load_bank),
+                           solar_cf=jnp.asarray(cf_bank),
+                           wholesale=jnp.asarray(wholesale))
+    package.save_population(out_dir, table, profiles, specs, state_list)
+    return package.Population(
+        table=table, profiles=profiles, tariffs=compile_tariffs(specs),
+        states=state_list, tariff_specs=specs,
+    )

@@ -1,0 +1,205 @@
+"""Reference-pickle converter tests.
+
+Builds a small agent population in the reference's EXACT pickle schema
+(index agent_id; object tariff_dict cells in both the legacy e_* and
+the normalized ur_* shapes, some stringified; profile keys resolved via
+bldg/solar tables replacing the per-agent SQL of elec.py:508-558) and
+proves it round-trips through the package format into a running
+simulation.
+"""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import convert, package
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+
+HOURS = 8760
+
+
+def _legacy_tariff(price, fixed=8.0, tiers=False, stringify=False):
+    """Legacy URDB-style dict (e_prices [T][P] etc.)."""
+    if tiers:
+        td = {
+            "e_prices": [[price, price * 1.4], [price * 1.2, price * 1.7]],
+            "e_levels": [[500.0, 500.0], [1e9, 1e9]],
+            "e_wkday_12by24": [[0] * 12 + [1] * 12 for _ in range(12)],
+            "e_wkend_12by24": [[0] * 24 for _ in range(12)],
+            "fixed_charge": fixed,
+            "ur_metering_option": 0,
+        }
+    else:
+        td = {
+            "e_prices": [[price]],
+            "e_levels": [[1e9]],
+            "e_wkday_12by24": [[0] * 24 for _ in range(12)],
+            "e_wkend_12by24": [[0] * 24 for _ in range(12)],
+            "fixed_charge": fixed,
+            "ur_metering_option": 0,
+        }
+    return json.dumps(td) if stringify else td
+
+
+def _ur_tariff(price, fixed=5.0):
+    """Normalized PySAM-style dict (ur_ec_tou_mat rows, 1-based)."""
+    return {
+        "ur_ec_tou_mat": [
+            [1, 1, 1e38, 0, price, 0.0],
+            [2, 1, 1e38, 0, price * 1.5, 0.0],
+        ],
+        "ur_ec_sched_weekday": [[1] * 16 + [2] * 8 for _ in range(12)],
+        "ur_ec_sched_weekend": [[1] * 24 for _ in range(12)],
+        "ur_monthly_fixed_charge": fixed,
+        "ur_metering_option": 2,
+    }
+
+
+def make_reference_frame(n=50, seed=0):
+    rng = np.random.default_rng(seed)
+    states = ["DE", "MD"]
+    sectors = ["res", "com", "ind"]
+    cds = ["SA", "SA"]
+
+    rows = []
+    for i in range(n):
+        s = i % 2
+        sector = sectors[i % 3]
+        # three tariff families + one known-bad id (reassigned at convert)
+        if i % 7 == 3:
+            tid, td = 4145, _legacy_tariff(9.99)  # bad id (elec.py:993)
+        elif i % 3 == 0:
+            tid, td = 100 + s, _legacy_tariff(0.11 + 0.02 * s,
+                                              stringify=(i % 2 == 0))
+        elif i % 3 == 1:
+            tid, td = 200 + s, _legacy_tariff(0.13, tiers=True)
+        else:
+            tid, td = 300 + s, _ur_tariff(0.12)
+        rows.append({
+            "agent_id": i,
+            "state_abbr": states[s],
+            "census_division_abbr": cds[s],
+            "county_id": 1000 + s,
+            "sector_abbr": sector,
+            "customers_in_bin": float(rng.integers(50, 4000)),
+            "load_kwh_per_customer_in_bin": float(rng.uniform(4e3, 2e5)),
+            "load_kwh_in_bin": 0.0,
+            "max_demand_kw": float(rng.uniform(2, 200)),
+            "tariff_id": tid,
+            "tariff_dict": td,
+            "bldg_id": int(i % 5),
+            "solar_re_9809_gid": int(100 + (i % 4)),
+            "tilt": 25,
+            "azimuth": "S",
+        })
+    return pd.DataFrame(rows).set_index("agent_id")
+
+
+def make_profile_tables(frame, seed=0):
+    rng = np.random.default_rng(seed + 1)
+    hours = np.arange(HOURS)
+    day = np.sin(np.pi * ((hours % 24) - 6) / 12).clip(0)
+
+    load_rows = []
+    for key, _ in frame.groupby(["bldg_id", "sector_abbr", "state_abbr"]):
+        b, sec, st = key
+        shape = 0.5 + rng.random(HOURS) + 0.3 * day
+        load_rows.append({"bldg_id": b, "sector_abbr": sec, "state_abbr": st,
+                          "consumption_hourly": shape.tolist()})
+    cf_rows = []
+    for key, _ in frame.groupby(["solar_re_9809_gid", "tilt", "azimuth"]):
+        g, t, a = key
+        cf = day * rng.uniform(0.6, 1.0) * 1e6  # reference 1e6 scale offset
+        cf_rows.append({"solar_re_9809_gid": g, "tilt": t, "azimuth": a,
+                        "cf": cf.tolist()})
+    return pd.DataFrame(load_rows), pd.DataFrame(cf_rows)
+
+
+@pytest.fixture(scope="module")
+def converted(tmp_path_factory):
+    frame = make_reference_frame()
+    load_df, cf_df = make_profile_tables(frame)
+    out = str(tmp_path_factory.mktemp("pkg") / "ref_pkg")
+    incentives = pd.DataFrame([
+        {"state_abbr": "DE", "sector_abbr": "res", "cbi_usd_p_w": 0.4,
+         "ibi_pct": np.nan, "pbi_usd_p_kwh": np.nan,
+         "max_incentive_usd": 5000.0, "incentive_duration_yrs": np.nan},
+        {"state_abbr": "MD", "sector_abbr": "com", "cbi_usd_p_w": np.nan,
+         "ibi_pct": np.nan, "pbi_usd_p_kwh": 0.02,
+         "max_incentive_usd": np.nan, "incentive_duration_yrs": 10.0},
+    ])
+    pop = convert.from_reference_pickle(
+        frame, out, load_df, cf_df,
+        wholesale_by_region={"SA": np.full(HOURS, 0.03)},
+        state_incentives=incentives,
+    )
+    return frame, out, pop
+
+
+def test_bad_tariffs_reassigned(converted):
+    frame, _, pop = converted
+    # the bad id's 9.99 $/kWh price must not survive conversion
+    assert float(np.asarray(pop.tariffs.price).max()) < 1.0
+
+
+def test_tariff_dedup_and_parse(converted):
+    frame, _, pop = converted
+    # 50 agents share a handful of tariff structures; dedup must collapse
+    assert pop.tariffs.n_tariffs <= 8
+    # stringified + dict forms of the same tariff collapse to one spec
+    a = convert.reference_tariff_to_spec(
+        convert.parse_tariff_dict(_legacy_tariff(0.11)))
+    b = convert.reference_tariff_to_spec(
+        convert.parse_tariff_dict(_legacy_tariff(0.11, stringify=True)))
+    assert convert._canonical_key(a) == convert._canonical_key(b)
+
+
+def test_ur_tariff_semantics():
+    spec = convert.reference_tariff_to_spec(_ur_tariff(0.12))
+    assert spec["metering"] == 2
+    price = np.asarray(spec["price"])
+    assert price.shape == (2, 1)
+    np.testing.assert_allclose(price[:, 0], [0.12, 0.18])
+    # 1-based ur schedules shifted to 0-based
+    assert spec["e_wkday_12by24"][0][0] == 0
+    assert spec["e_wkday_12by24"][0][20] == 1
+
+
+def test_profiles_resolved(converted):
+    frame, _, pop = converted
+    load = np.asarray(pop.profiles.load)
+    assert load.shape == (5 * 1, HOURS) or load.shape[1] == HOURS
+    np.testing.assert_allclose(load.sum(axis=1), 1.0, rtol=1e-5)
+    cf = np.asarray(pop.profiles.solar_cf)
+    assert cf.max() <= 1.0  # scale offset applied
+    assert cf.max() > 0.1
+
+
+def test_incentives_compiled(converted):
+    frame, _, pop = converted
+    keep = np.asarray(pop.table.mask) > 0
+    st = np.asarray(pop.table.state_idx)[keep]
+    sec = np.asarray(pop.table.sector_idx)[keep]
+    cbi = np.asarray(pop.table.incentives.cbi_usd_p_w)[keep]
+    de_res = (st == pop.states.index("DE")) & (sec == 0)
+    assert np.all(cbi[de_res, 0] == np.float32(0.4))
+    assert np.all(cbi[~de_res, 0] == 0.0)
+
+
+def test_roundtrip_runs_simulation(converted):
+    frame, out, _ = converted
+    pop = package.load_population(out, pad_multiple=32)
+    cfg = ScenarioConfig(name="conv", start_year=2014, end_year=2018,
+                         anchor_years=())
+    inputs = scen.uniform_inputs(cfg, n_groups=pop.table.n_groups,
+                                 n_regions=np.asarray(
+                                     pop.profiles.wholesale).shape[0])
+    res = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                     RunConfig(sizing_iters=6)).run()
+    kw = res.agent["system_kw_cum"]
+    assert np.all(np.isfinite(kw))
+    assert kw.sum() > 0.0

@@ -1,0 +1,35 @@
+"""Regression tests for the driver entry points.
+
+Round-1 failure mode: ``dryrun_multichip`` built its mesh from
+``jax.devices()`` and picked up the real TPU instead of a virtual CPU
+mesh (MULTICHIP_r01.json, rc=1). These tests run the dry run in-process
+on the conftest-forced 8-device CPU platform and also verify the
+single-chip ``entry()`` contract.
+"""
+
+import jax
+import numpy as np
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
+
+
+def test_dryrun_uses_cpu_devices():
+    import __graft_entry__ as ge
+
+    devs = ge._force_virtual_cpu(8)
+    assert len(devs) == 8
+    assert all(d.platform == "cpu" for d in devs)
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    carry, outs = out
+    assert np.all(np.isfinite(np.asarray(outs.system_kw_cum)))

@@ -95,15 +95,13 @@ class RunExporter:
     # --- agent_finance_series (reference finance_series_export.py:22) ---
     def write_finance_series(self, year: int, outs) -> None:
         cf = np.asarray(outs.cash_flow)[self.keep]          # [n, Y+1]
-        ev = np.asarray(outs.energy_value_pv_only)[self.keep] \
-            if hasattr(outs, "energy_value_pv_only") else None
+        ev = np.asarray(outs.energy_value_pv_only)[self.keep]  # [n, Y]
         df = pd.DataFrame({
             "agent_id": self.agent_id,
             "year": year,
             "cash_flow": list(cf),
+            "energy_value": list(ev),
         })
-        if ev is not None:
-            df["energy_value"] = list(ev)
         df.to_parquet(
             os.path.join(_dir(self.run_dir, "finance_series"),
                          f"year={year}.parquet")

@@ -86,6 +86,7 @@ class YearOutputs:
     npv: jax.Array
     payback_period: jax.Array
     cash_flow: jax.Array                  # [N, Y+1]
+    energy_value_pv_only: jax.Array       # [N, Y] nominal bill savings
     first_year_bill_with_system: jax.Array
     first_year_bill_without_system: jax.Array
     batt_kw: jax.Array
@@ -252,7 +253,11 @@ def year_step(
             inputs.starting_batt_kwh, g, ya.developable_agent_weight,
             res.system_kw, n_groups,
         )
-        batt_adopters_prev = mstate.batt_kw_cum / jnp.maximum(res.batt_kw, 1e-9)
+        # starting batt capacity -> adopter count at this year's sized
+        # batt_kw; agents sized to ~0 kW get 0 adopters, not a blow-up
+        batt_adopters_prev = jnp.where(
+            res.batt_kw > 1e-6, mstate.batt_kw_cum / jnp.maximum(res.batt_kw, 1e-6), 0.0
+        )
     else:
         mstate = carry.market
         batt_adopters_prev = carry.batt_adopters_cum
@@ -326,6 +331,7 @@ def year_step(
         npv=res.npv,
         payback_period=res.payback_period,
         cash_flow=res.cash_flow,
+        energy_value_pv_only=res.energy_value_pv_only,
         first_year_bill_with_system=res.first_year_bill_with_system,
         first_year_bill_without_system=res.first_year_bill_without_system,
         batt_kw=res.batt_kw,

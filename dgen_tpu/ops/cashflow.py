@@ -253,9 +253,12 @@ def payback_period(cf: jax.Array) -> jax.Array:
     """Fractional payback year from a [Y+1] cashflow (year 0 = equity).
 
     Semantics match the reference's vectorized implementation
-    (financial_functions.py:1241 ``calc_payback_vectorized``): first year
-    the cumulative cashflow turns positive, linearly interpolated within
-    that year; ``PAYBACK_NEVER`` (30.1) if it never does; 0 if the
+    (financial_functions.py:1241 ``calc_payback_vectorized``): the LAST
+    negative-to-positive crossing of the cumulative cashflow (its
+    ``np.amax`` over ``neg_to_pos_years``, :1252 — the docstring there
+    says "first" but the code takes the last, and the implementation is
+    the parity target), linearly interpolated within that year;
+    ``PAYBACK_NEVER`` (30.1) if it never turns positive; 0 if the
     cumulative flow is positive from year 0; rounded to 0.1.
     """
     cum = jnp.cumsum(cf)
@@ -265,9 +268,9 @@ def payback_period(cf: jax.Array) -> jax.Array:
     instant = jnp.all(cum > 0.0)
 
     crossed = jnp.diff(jnp.sign(cum)) > 0          # [n]
-    # FIRST positive crossing (non-monotone cashflows — e.g. a year-1
+    # LAST positive crossing (non-monotone cashflows — e.g. a year-1
     # ITC inflow followed by loan-payment years — can cross repeatedly)
-    bi = jnp.argmax(crossed).astype(jnp.int32)
+    bi = (n - 1 - jnp.argmax(crossed[::-1])).astype(jnp.int32)
     bi = jnp.where(jnp.any(crossed), bi, n - 1)
     base_year = bi.astype(jnp.float32)
     base_val = cum[bi]

@@ -14,5 +14,31 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# DGEN_TPU_TESTS=1 keeps the real accelerator visible so hardware-marked
+# tests (e.g. Pallas-vs-XLA kernel parity in test_billpallas.py) run;
+# the default run forces the virtual 8-CPU platform for sharding tests.
+_TPU_HW_RUN = bool(os.environ.get("DGEN_TPU_TESTS"))
+if not _TPU_HW_RUN:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu_hw: needs the real TPU (run with DGEN_TPU_TESTS=1)"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    # Under DGEN_TPU_TESTS the virtual 8-CPU platform is NOT pinned, so
+    # only hardware-marked tests are valid — everything else assumes the
+    # 8-device CPU mesh and CPU numerics. Deselect rather than fail.
+    if _TPU_HW_RUN:
+        import pytest as _pytest
+
+        skip = _pytest.mark.skip(
+            reason="non-hardware test skipped under DGEN_TPU_TESTS=1"
+        )
+        for item in items:
+            if "tpu_hw" not in item.keywords:
+                item.add_marker(skip)

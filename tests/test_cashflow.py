@@ -99,10 +99,39 @@ def test_payback_semantics():
     cf2 = jnp.asarray(np.array([-10.0, 6.0, 6.0], dtype=np.float32))
     assert float(cf.payback_period(cf2)) == pytest.approx(1.7)
     # non-monotone (loan + year-1 ITC inflow): cum = [-1, 4, -2, 4] crosses
-    # up twice; the FIRST crossing wins (reference
-    # financial_functions.py:1241 takes the first positive cumulative year)
+    # up twice; the LAST crossing wins, matching the reference's np.amax
+    # over neg_to_pos_years (financial_functions.py:1252):
+    # base_year 2, frac = -2 / (-2 - 4) = 1/3 -> 2.3
     cf3 = jnp.asarray(np.array([-1.0, 5.0, -6.0, 6.0], dtype=np.float32))
-    assert float(cf.payback_period(cf3)) == pytest.approx(0.2)
+    assert float(cf.payback_period(cf3)) == pytest.approx(2.3)
+
+
+def test_payback_matches_reference_semantics_randomized():
+    """Row-by-row oracle of the reference's calc_payback_vectorized
+    (financial_functions.py:1241-1261): last neg->pos crossing of the
+    cumulative flow, interpolated, 30.1 never, 0 instant, round to 0.1."""
+
+    def oracle(row):
+        cum = np.cumsum(row)
+        n = len(row) - 1
+        if cum[-1] <= 0 or np.all(cum <= 0):
+            return 30.1
+        if np.all(cum > 0):
+            return 0.0
+        cross = np.diff(np.sign(cum)) > 0
+        base = np.max(np.where(cross, np.arange(n), -1))
+        if base == -1:
+            base = n - 1
+        frac = cum[base] / (cum[base] - cum[base + 1] + 1e-9)
+        return round(base + frac, 1)
+
+    rng = np.random.default_rng(42)
+    cfs = rng.normal(0.0, 5.0, (200, 26)).astype(np.float32)
+    cfs[:, 0] = -np.abs(cfs[:, 0]) * 3  # equity outlay
+    got = np.asarray(jax.vmap(cf.payback_period)(jnp.asarray(cfs)))
+    want = np.array([oracle(r) for r in cfs])
+    # 0.05 covers f32-vs-f64 cumsum ties at the rounding boundary
+    np.testing.assert_allclose(got, want, atol=0.051)
 
 
 def test_pbi_incentive_stream():

@@ -182,7 +182,7 @@ def build_econ_inputs(
     static_argnames=(
         "n_periods", "econ_years", "sizing_iters", "first_year",
         "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
-        "rate_switch",
+        "rate_switch", "mesh",
     ),
 )
 def year_step(
@@ -202,6 +202,7 @@ def year_step(
     year_step_len: float,
     sizing_impl: str = "auto",
     rate_switch: bool = False,
+    mesh: Optional[Mesh] = None,
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
 
@@ -240,6 +241,7 @@ def year_step(
     res = sizing_ops.size_agents(
         envs, n_periods=n_periods, n_years=econ_years,
         n_iters=sizing_iters, keep_hourly=with_hourly, impl=sizing_impl,
+        mesh=mesh,
     )
 
     # --- market step ---
@@ -447,14 +449,9 @@ class Simulation:
         ))
 
     def _step_kwargs(self, first_year: bool) -> dict:
-        # The Pallas bucket-sums kernel is not partition-aware; under a
-        # real multi-device TPU mesh fall back to its XLA formulation
-        # (virtual CPU meshes hit the XLA path via backend detection).
-        multi_tpu = (
-            self.mesh is not None
-            and jax.default_backend() == "tpu"
-            and self.mesh.devices.size > 1
-        )
+        # Under a >1-device mesh the bucket-sums engine runs per-shard
+        # via shard_map (billpallas._maybe_shard_agents), so the Pallas
+        # kernel stays live on multi-chip TPU meshes.
         return dict(
             n_periods=self.tariffs.max_periods,
             econ_years=self.econ_years,
@@ -463,8 +460,9 @@ class Simulation:
             with_hourly=self.with_hourly,
             storage_enabled=self.scenario.storage_enabled,
             year_step_len=float(self.scenario.year_step),
-            sizing_impl="xla" if multi_tpu else "auto",
+            sizing_impl="auto",
             rate_switch=self._rate_switch,
+            mesh=self.mesh,
         )
 
     def init_carry(self) -> SimCarry:

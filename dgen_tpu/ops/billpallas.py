@@ -43,8 +43,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import PartitionSpec
+
 from dgen_tpu.ops.bill import tiered_charge
 from dgen_tpu.ops.tariff import HOURS, MONTHS, NET_BILLING, hour_month_map
+from dgen_tpu.parallel.mesh import AGENT_AXIS
 
 H_PAD = 8832          # 8760 rounded up to 69 * 128 lanes
 B_PAD = 128           # bucket axis = MXU-friendly output width
@@ -196,6 +199,27 @@ def _resolve_impl(impl: str) -> str:
     return impl
 
 
+def _maybe_shard_agents(fn, mesh, n_out: int):
+    """Run a bucket-sums engine per-shard over the agent axis.
+
+    Every input/output carries the agent dim leading and the computation
+    is fully per-agent (grid=(n,)), so under a >1-device mesh the engine
+    runs unchanged on each shard — this is what lets the Pallas kernel
+    (not partition-aware by itself) live inside the sharded year step
+    instead of downgrading to the XLA twin.
+    """
+    if mesh is None or mesh.devices.size <= 1:
+        return fn
+    spec = PartitionSpec(AGENT_AXIS)
+    # check_vma=False: pallas_call's out_shape ShapeDtypeStructs carry no
+    # varying-manual-axes info, so the default vma check rejects the
+    # kernel at trace time
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec,) * 5, out_specs=(spec,) * n_out,
+        check_vma=False,
+    )
+
+
 def _check_buckets(n_buckets: int) -> None:
     # ids >= PAD_BUCKET would collide with the padding id / sell column
     # of the kernel's M matrix and silently corrupt bills
@@ -207,7 +231,7 @@ def _check_buckets(n_buckets: int) -> None:
         )
 
 
-@partial(jax.jit, static_argnames=("n_buckets", "impl", "bf16"))
+@partial(jax.jit, static_argnames=("n_buckets", "impl", "bf16", "mesh"))
 def import_sums(
     load: jax.Array,      # [N, 8760]
     gen: jax.Array,       # [N, 8760]
@@ -217,20 +241,22 @@ def import_sums(
     n_buckets: int,
     impl: str = "auto",
     bf16: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
     """(imports [N,R,B], imp_sell [N,R]): positive-part bucket sums and
     the sell-weighted positive-part sum for R net-load scales."""
     _check_buckets(n_buckets)
     if _resolve_impl(impl) == "pallas":
-        (imp,) = _sums_pallas(load, gen, sell, bucket_id, scales,
-                              with_signed=False, bf16=bf16)
+        fn = partial(_sums_pallas, with_signed=False, bf16=bf16)
     else:
-        (imp,) = _sums_xla(load, gen, sell, bucket_id, scales, n_buckets,
-                           with_signed=False)
+        fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=False)
+    (imp,) = _maybe_shard_agents(fn, mesh, 1)(
+        load, gen, sell, bucket_id, scales
+    )
     return imp[:, :, :n_buckets], imp[:, :, SELL_COL]
 
 
-@partial(jax.jit, static_argnames=("n_buckets", "impl"))
+@partial(jax.jit, static_argnames=("n_buckets", "impl", "mesh"))
 def bucket_sums(
     load: jax.Array,
     gen: jax.Array,
@@ -239,16 +265,18 @@ def bucket_sums(
     scales: jax.Array,
     n_buckets: int,
     impl: str = "auto",
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """(signed [N,R,B], imports [N,R,B], export_credit [N,R]) — the full
     reduction set (battery forward runs, tests)."""
     _check_buckets(n_buckets)
     if _resolve_impl(impl) == "pallas":
-        imp, signed = _sums_pallas(load, gen, sell, bucket_id, scales,
-                                   with_signed=True)
+        fn = partial(_sums_pallas, with_signed=True)
     else:
-        imp, signed = _sums_xla(load, gen, sell, bucket_id, scales,
-                                n_buckets, with_signed=True)
+        fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=True)
+    imp, signed = _maybe_shard_agents(fn, mesh, 2)(
+        load, gen, sell, bucket_id, scales
+    )
     # exports = relu(-net) reductions = imports - signed (columnwise)
     credit = imp[:, :, SELL_COL] - signed[:, :, SELL_COL]
     return signed[:, :, :n_buckets], imp[:, :, :n_buckets], credit

@@ -264,7 +264,8 @@ def size_one_agent(
 
 @partial(
     jax.jit,
-    static_argnames=("n_periods", "n_years", "n_iters", "keep_hourly", "impl"),
+    static_argnames=("n_periods", "n_years", "n_iters", "keep_hourly", "impl",
+                     "mesh"),
 )
 def _size_agents_fast(
     envs: AgentEconInputs,
@@ -273,6 +274,7 @@ def _size_agents_fast(
     n_iters: int,
     keep_hourly: bool,
     impl: str,
+    mesh=None,
 ) -> SizingResult:
     """Table-level sizing via two refining candidate-grid rounds.
 
@@ -368,7 +370,7 @@ def _size_agents_fast(
         # bf16 layout if the search matmul becomes the bottleneck again
         imports, imp_sell = billpallas.import_sums(
             envs.load, gen_shape, sell, bucket, scales, n_buckets, impl,
-            bf16=False,
+            bf16=False, mesh=mesh,
         )
         bills = billpallas.bills_linear_nb(
             lin, imports, imp_sell, scales, tw, n_periods
@@ -426,7 +428,8 @@ def _size_agents_fast(
     # battery-modified output is not a scale of gen_shape; use the full
     # bucket-sums kernel with per-year degradation scales
     s_b, i_b, c_b = billpallas.bucket_sums(
-        envs.load, dr.system_out, sell, bucket, df, n_buckets, impl
+        envs.load, dr.system_out, sell, bucket, df, n_buckets, impl,
+        mesh=mesh,
     )
     bills_w_b = billpallas.bills_from_sums(
         s_b, i_b, c_b, tw, n_periods
@@ -475,18 +478,22 @@ def size_agents(
     keep_hourly: bool = True,
     fast: bool = True,
     impl: str = "auto",
+    mesh=None,
 ) -> SizingResult:
     """Sizing over the whole agent table (leading axis).
 
     ``fast=True`` (default) runs the table-level bucket-sums path — the
     Pallas kernel on TPU, its XLA equivalent elsewhere (``impl``
     overrides). ``fast=False`` vmaps the direct per-agent hourly kernel
-    (the oracle; ~100x more HBM traffic).
+    (the oracle; ~100x more HBM traffic). ``mesh``: a >1-device Mesh
+    runs the bucket-sums engine per-shard over the agent axis
+    (shard_map), keeping the Pallas kernel live under real multi-chip
+    sharding.
     """
     if fast:
         return _size_agents_fast(
             envs, n_periods=n_periods, n_years=n_years, n_iters=n_iters,
-            keep_hourly=keep_hourly, impl=impl,
+            keep_hourly=keep_hourly, impl=impl, mesh=mesh,
         )
     fn = partial(
         size_one_agent,

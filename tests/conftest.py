@@ -17,7 +17,7 @@ import jax  # noqa: E402
 # DGEN_TPU_TESTS=1 keeps the real accelerator visible so hardware-marked
 # tests (e.g. Pallas-vs-XLA kernel parity in test_billpallas.py) run;
 # the default run forces the virtual 8-CPU platform for sharding tests.
-_TPU_HW_RUN = bool(os.environ.get("DGEN_TPU_TESTS"))
+_TPU_HW_RUN = os.environ.get("DGEN_TPU_TESTS", "") not in ("", "0", "false")
 if not _TPU_HW_RUN:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)

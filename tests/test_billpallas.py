@@ -64,6 +64,41 @@ def test_zero_scale_is_no_system_bill(setup):
     assert np.allclose(np.asarray(c)[:, 0], 0.0, atol=1e-3)
 
 
+def test_sharded_engine_matches_unsharded(setup):
+    """The shard_map wrapper (what keeps the Pallas kernel live on
+    multi-chip meshes) must be a no-op on results: xla twin on the
+    8-device virtual mesh vs plain."""
+    from dgen_tpu.parallel.mesh import make_mesh
+
+    pop, load, gen, ts, at = setup
+    p = pop.tariffs.max_periods
+    b = 12 * p
+    bucket = bp.hourly_bucket_ids(at.hour_period, p)
+    sell = bp.sell_rate_hourly(at, ts)
+    rng = np.random.default_rng(5)
+    scales = jnp.asarray(
+        np.abs(rng.normal(2.0, 1.5, (load.shape[0], 6))).astype(np.float32)
+    )
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    plain = bp.bucket_sums(load, gen, sell, bucket, scales, b, impl="xla")
+    sharded = bp.bucket_sums(
+        load, gen, sell, bucket, scales, b, impl="xla", mesh=mesh
+    )
+    for a, bb in zip(plain, sharded):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=1e-5, atol=1e-3
+        )
+    i_plain = bp.import_sums(load, gen, sell, bucket, scales, b, impl="xla")
+    i_sharded = bp.import_sums(
+        load, gen, sell, bucket, scales, b, impl="xla", mesh=mesh
+    )
+    for a, bb in zip(i_plain, i_sharded):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=1e-5, atol=1e-3
+        )
+
+
 @pytest.mark.tpu_hw
 @pytest.mark.skipif(
     jax.default_backend() != "tpu",

@@ -75,23 +75,27 @@ class RunConfig:
 
     #: pad the agent axis to a multiple of this (TPU lane friendliness)
     agent_pad_multiple: int = 128
-    #: agents processed per device kernel invocation
-    block_size: int = 4096
     #: golden-section iterations for the PV sizing search
     sizing_iters: int = 12
     #: number of devices to shard agents over (None = all available)
     n_devices: Optional[int] = None
+    #: reorder agents so states are shard-local under a multi-device
+    #: mesh (parallel.partition, the reference's per-state task binning)
+    partition_by_state: bool = True
+    #: run the invariant harness every year step (utils.invariants —
+    #: the reference's run_with_runtime_tests analogue; host sync cost)
+    debug_invariants: bool = False
     seed: int = 0
 
     def __post_init__(self) -> None:
         _check(self.agent_pad_multiple >= 1, "bad pad multiple")
-        _check(self.block_size >= 1, "bad block size")
         _check(4 <= self.sizing_iters <= 64, "sizing_iters out of range")
 
     @classmethod
     def from_env(cls, **overrides) -> "RunConfig":
-        if "block_size" not in overrides and os.environ.get("DGEN_TPU_BLOCK"):
-            overrides["block_size"] = int(os.environ["DGEN_TPU_BLOCK"])
         if "n_devices" not in overrides and os.environ.get("DGEN_TPU_DEVICES"):
             overrides["n_devices"] = int(os.environ["DGEN_TPU_DEVICES"])
+        if "debug_invariants" not in overrides and \
+                os.environ.get("DGEN_TPU_DEBUG"):
+            overrides["debug_invariants"] = True
         return cls(**overrides)

@@ -46,6 +46,7 @@ from dgen_tpu.ops.cashflow import IncentiveParams
 from dgen_tpu.ops.tariff import (
     BIG_CAP, NET_BILLING, NET_METERING, compile_tariffs,
 )
+from dgen_tpu.utils.timing import fn_timer
 
 #: tariff ids the reference replaces wholesale (agent_mutation/elec.py:993)
 BAD_TARIFF_IDS = (4145, 7111, 8498, 10953, 10954, 12003)
@@ -329,6 +330,7 @@ def _developable_frac(df: pd.DataFrame) -> np.ndarray:
     return np.clip(np.nan_to_num(v, nan=1.0), 0.0, 1.0)
 
 
+@fn_timer()
 def from_reference_pickle(
     agents: Union[str, pd.DataFrame],
     out_dir: str,
@@ -338,6 +340,8 @@ def from_reference_pickle(
     state_incentives: Optional[pd.DataFrame] = None,
     states: Optional[Sequence[str]] = None,
     bad_tariff_ids: Sequence[int] = BAD_TARIFF_IDS,
+    nem_state_by_sector: Optional[pd.DataFrame] = None,
+    nem_utility_by_sector: Optional[pd.DataFrame] = None,
 ) -> package.Population:
     """Compile a reference-format agent pickle into a package at
     ``out_dir`` and return the loaded :class:`Population`.
@@ -427,6 +431,21 @@ def from_reference_pickle(
     incentives = compile_incentives(
         state_incentives, df["state_abbr"], df["sector_abbr"])
 
+    # --- per-agent NEM policy (utility overrides state, elec.py:92-119);
+    # without tables, keep the unlimited-NEM defaults ---
+    nem_fields: Dict[str, np.ndarray] = {}
+    if nem_state_by_sector is not None or nem_utility_by_sector is not None:
+        from dgen_tpu.io.nem import resolve_agent_nem_policy
+
+        eia = df["eia_id"].astype(str).tolist() if "eia_id" in df.columns \
+            else None
+        nem_fields = resolve_agent_nem_policy(
+            nem_state_by_sector, nem_utility_by_sector,
+            agent_state=df["state_abbr"].tolist(),
+            agent_sector=df["sector_abbr"].tolist(),
+            agent_eia_id=eia,
+        )
+
     table = build_agent_table(
         state_idx=np.asarray([st_idx[s] for s in df["state_abbr"]], np.int32),
         sector_idx=np.asarray([sec_idx[s] for s in df["sector_abbr"]],
@@ -441,6 +460,7 @@ def from_reference_pickle(
         developable_frac=_developable_frac(df),
         n_states=len(state_list),
         incentives=incentives,
+        **nem_fields,
     )
 
     import jax.numpy as jnp

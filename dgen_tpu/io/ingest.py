@@ -67,6 +67,58 @@ def load_stacked_sectors(
     return _year_grid_interp(years[order], vals[order], model_years).astype(np.float32)
 
 
+def load_batt_tech(path: str, model_years: Sequence[int]) -> Dict[str, np.ndarray]:
+    """batt_tech_performance CSV -> {"batt_eff": [Y, 3],
+    "batt_lifetime_yrs": [Y, 3]} (columns ``batt_eff_res/com/ind`` +
+    ``batt_lifetime_yrs_*``; reference apply_batt_tech_performance,
+    agent_mutation/elec.py:319)."""
+    return {
+        "batt_eff": load_stacked_sectors(path, "batt_eff", model_years),
+        "batt_lifetime_yrs": load_stacked_sectors(
+            path, "batt_lifetime_yrs", model_years),
+    }
+
+
+def load_depreciation_schedules(
+    path: str, model_years: Sequence[int], n_frac: int = 6
+) -> np.ndarray:
+    """depreciation_schedules CSV -> [Y, 3, D] fractions.
+
+    Reference shape: one row per (year, sector_abbr) with columns
+    ``1..D`` (agent_mutation/elec.py:157 ``apply_depreciation_schedule``
+    merges the resulting list per agent). Sectors absent from the file
+    (typically res) take the com schedule — depreciation only reaches
+    non-commercial agents through ``is_commercial`` gating anyway.
+    """
+    rows = _read_csv(path)
+    frac_cols = [str(i) for i in range(1, n_frac + 1)]
+    by_sector: Dict[str, Dict[int, np.ndarray]] = {}
+    for r in rows:
+        sec = r.get("sector_abbr", "com")
+        vals = np.asarray([float(r.get(c, 0.0) or 0.0) for c in frac_cols],
+                          dtype=np.float32)
+        by_sector.setdefault(sec, {})[int(float(r["year"]))] = vals
+    fallback = by_sector.get("com") or next(iter(by_sector.values()))
+    out = np.zeros((len(model_years), len(SECTORS), n_frac), np.float32)
+    for si, sec in enumerate(SECTORS):
+        sched = by_sector.get(sec, fallback)
+        years_avail = np.asarray(sorted(sched))
+        vals = np.stack([sched[y] for y in sorted(sched)])
+        out[:, si, :] = _year_grid_interp(years_avail, vals, model_years)
+    # every schedule must distribute ~the full basis; files in other
+    # semantics (e.g. the reference's deprec_sch_FY24.csv rows are
+    # remaining-basis factors summing to ~4.9) would silently multiply
+    # depreciation several-fold
+    sums = out.sum(axis=-1)
+    if np.any(np.abs(sums - 1.0) > 0.05):
+        raise ValueError(
+            f"depreciation schedule rows in {path} sum to "
+            f"{float(sums.min()):.3f}..{float(sums.max()):.3f}, expected "
+            "~1.0 (year-fraction schedule); refusing to ingest"
+        )
+    return out
+
+
 def load_financing_terms(path: str, model_years: Sequence[int]) -> Dict[str, np.ndarray]:
     """financing_terms CSV -> dict of [Y, 3] arrays (+ economic lifetime)."""
     out = {}
@@ -231,6 +283,8 @@ def discover_reference_inputs(root: str) -> Dict[str, str]:
         ("financing", "financing_terms", "FY19"),
         ("load_growth", "load_growth", None),
         ("elec_prices", "elec_prices", "Mid_Case"),
+        ("batt_tech", "batt_tech_performance", "FY19"),
+        ("deprec", "depreciation_schedules", "FY19"),
     ):
         p = first(sub, prefer)
         if p:

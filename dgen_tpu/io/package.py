@@ -33,6 +33,7 @@ import pandas as pd
 from dgen_tpu.io import store
 from dgen_tpu.models.agents import AgentTable, ProfileBank, build_agent_table
 from dgen_tpu.ops.tariff import TariffBank, compile_tariffs
+from dgen_tpu.utils.timing import fn_timer
 
 FORMAT_VERSION = 1
 
@@ -43,12 +44,21 @@ AGENT_COLUMNS = (
     "load_kwh_per_customer_in_bin", "developable_frac", "one_time_charge",
 )
 
+#: optional per-agent policy columns (absent in format-1 packages
+#: written before the NEM machine / size-conditioned switch; defaults
+#: from build_agent_table apply on load)
+POLICY_COLUMNS = (
+    "nem_kw_limit", "nem_first_year", "nem_sunset_year",
+    "switch_min_kw", "switch_max_kw",
+)
+
 
 #: IncentiveParams leaves serialized as agents.parquet columns
-#: (``<leaf>_<slot>`` for the two incentive slots)
+#: (``<leaf>_<slot>`` for the two incentive slots); pbi_decay is
+#: optional on load (absent in packages written before decay support)
 INCENTIVE_LEAVES = (
     "cbi_usd_p_w", "cbi_max_usd", "ibi_frac", "ibi_max_usd",
-    "pbi_usd_p_kwh", "pbi_years",
+    "pbi_usd_p_kwh", "pbi_years", "pbi_decay",
 )
 
 
@@ -61,6 +71,7 @@ class Population:
     tariff_specs: List[dict]
 
 
+@fn_timer()
 def save_population(
     pkg_dir: str,
     table: AgentTable,
@@ -72,7 +83,10 @@ def save_population(
     os.makedirs(pkg_dir, exist_ok=True)
     keep = np.asarray(table.mask) > 0
 
-    cols = {c: np.asarray(getattr(table, c))[keep] for c in AGENT_COLUMNS}
+    cols = {
+        c: np.asarray(getattr(table, c))[keep]
+        for c in AGENT_COLUMNS + POLICY_COLUMNS
+    }
     for leaf in INCENTIVE_LEAVES:
         vals = np.asarray(getattr(table.incentives, leaf))[keep]  # [n, 2]
         for slot in range(vals.shape[1]):
@@ -103,6 +117,7 @@ def save_population(
         }, f)
 
 
+@fn_timer()
 def load_population(pkg_dir: str, pad_multiple: int = 128) -> Population:
     """Load a package into the device pytrees the Simulation consumes."""
     with open(os.path.join(pkg_dir, "meta.json")) as f:
@@ -118,10 +133,13 @@ def load_population(pkg_dir: str, pad_multiple: int = 128) -> Population:
         raise ValueError(f"agents.parquet missing columns: {sorted(missing)}")
 
     incentives = None
-    if all(f"{leaf}_0" in df.columns for leaf in INCENTIVE_LEAVES):
+    core = [l for l in INCENTIVE_LEAVES if l != "pbi_decay"]
+    if all(f"{leaf}_0" in df.columns for leaf in core):
         from dgen_tpu.ops.cashflow import IncentiveParams
 
         def leaf(name, dtype):
+            if f"{name}_0" not in df.columns:
+                return None
             return np.stack(
                 [df[f"{name}_0"].to_numpy(), df[f"{name}_1"].to_numpy()],
                 axis=1,
@@ -134,10 +152,16 @@ def load_population(pkg_dir: str, pad_multiple: int = 128) -> Population:
             ibi_max_usd=leaf("ibi_max_usd", np.float32),
             pbi_usd_p_kwh=leaf("pbi_usd_p_kwh", np.float32),
             pbi_years=leaf("pbi_years", np.int32),
+            pbi_decay=leaf("pbi_decay", np.float32),
         )
 
+    policy = {
+        c: df[c].to_numpy(np.float32)
+        for c in POLICY_COLUMNS if c in df.columns
+    }
     table = build_agent_table(
         incentives=incentives,
+        **policy,
         state_idx=df["state_idx"].to_numpy(),
         sector_idx=df["sector_idx"].to_numpy(),
         region_idx=df["region_idx"].to_numpy(),

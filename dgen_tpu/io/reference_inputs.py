@@ -41,6 +41,7 @@ from dgen_tpu.io import ingest
 from dgen_tpu.io.ingest import _read_csv
 from dgen_tpu.models import scenario as scen
 from dgen_tpu.models.scenario import ScenarioInputs
+from dgen_tpu.utils.timing import fn_timer
 
 #: census divisions (the reference's load-growth region key)
 CENSUS_DIVISIONS = ("NE", "MA", "ENC", "WNC", "SA", "ESC", "WSC", "MTN", "PAC")
@@ -104,6 +105,49 @@ def load_wholesale_base(
     return bas, np.asarray(vals, dtype=np.float32)
 
 
+def wholesale_profile_bank(
+    meta: Dict[str, object],
+    input_root: Optional[str] = None,
+) -> np.ndarray:
+    """[R, 8760] $/kWh wholesale sell-rate bank from a scenario's meta.
+
+    The reference feeds a FLAT annual wholesale price as the
+    net-billing sell rate (one scalar per agent-year,
+    financial_functions.py:182,372) — so the flat default here is
+    reference-faithful, not a simplification. When the input root
+    carries a ``wholesale_hourly_shape.csv`` (column ``shape``, 8760
+    rows, or one column per region name), the flat base is modulated by
+    that normalized hourly shape instead, giving the TS-sell bill path
+    real intra-day/seasonal structure the reference cannot express.
+    """
+    base = np.asarray(meta["wholesale_base_usd_per_kwh"], dtype=np.float32)
+    r = len(base)
+    out = np.broadcast_to(base[:, None], (r, 8760)).copy()
+
+    if input_root:
+        path = os.path.join(input_root, "wholesale_hourly_shape.csv")
+        if os.path.exists(path):
+            rows = _read_csv(path)
+            if len(rows) != 8760:
+                raise ValueError(
+                    f"wholesale_hourly_shape.csv has {len(rows)} rows, "
+                    "expected 8760"
+                )
+            regions = list(meta.get("regions", []))
+            cols = rows[0].keys()
+            for ri, name in enumerate(regions):
+                col = name if name in cols else (
+                    "shape" if "shape" in cols else None)
+                if col is None:
+                    continue
+                shape = np.asarray([float(r_[col]) for r_ in rows],
+                                   dtype=np.float32)
+                shape /= max(float(shape.mean()), 1e-9)
+                out[ri] = base[ri] * shape
+    return out
+
+
+@fn_timer()
 def scenario_inputs_from_reference(
     input_root: str,
     config: ScenarioConfig,
@@ -159,6 +203,13 @@ def scenario_inputs_from_reference(
     if "pv_tech" in files:
         ov["pv_degradation"] = jnp.asarray(ingest.load_stacked_sectors(
             files["pv_tech"], "pv_degradation_factor", years))
+    if "batt_tech" in files:
+        bt = ingest.load_batt_tech(files["batt_tech"], years)
+        ov["batt_eff"] = jnp.asarray(bt["batt_eff"])
+        ov["batt_lifetime_yrs"] = jnp.asarray(bt["batt_lifetime_yrs"])
+    if "deprec" in files:
+        ov["deprec_sch"] = jnp.asarray(
+            ingest.load_depreciation_schedules(files["deprec"], years))
     if "batt_prices" in files:
         ov["batt_capex_per_kwh"] = jnp.asarray(ingest.load_stacked_sectors(
             files["batt_prices"], "batt_capex_per_kwh", years,
@@ -223,6 +274,43 @@ def scenario_inputs_from_reference(
     if os.path.exists(cap_path):
         ov["starting_kw"] = jnp.asarray(load_starting_capacities(
             cap_path, config.start_year, states))
+
+    # --- NEM capacity caps (agent_mutation/elec.py:459-478) ---
+    # peak_demand_mw.csv + cf_during_peak_demand.csv ship with the
+    # reference next to dgen_model.py (read there every model year,
+    # dgen_model.py:253-254); the state-limits table lives in its
+    # Postgres dump and is accepted here as an exported
+    # nem_state_limits.csv in the input root.
+    def _opt(name: str) -> Optional[str]:
+        for d in (input_root, os.path.join(input_root, os.pardir, "python")):
+            p = os.path.join(d, name)
+            if os.path.exists(p):
+                return p
+        return None
+
+    sl_path = _opt("nem_state_limits.csv")
+    pk_path = _opt("peak_demand_mw.csv")
+    cfp_path = _opt("cf_during_peak_demand.csv")
+    if sl_path and pk_path and cfp_path:
+        import pandas as pd
+
+        from dgen_tpu.io.nem import compile_state_nem_caps
+
+        # residential load multiplier proxy for peak-demand growth
+        # (reference elec.py:813-814 averages county res growth per
+        # state; regions here are census divisions, so use the regional
+        # mean as every state's multiplier)
+        res_mult = None
+        if "load_growth" in ov:
+            lg = np.asarray(ov["load_growth"])            # [Y, R, S]
+            res_mult = np.broadcast_to(
+                lg[:, :, 0].mean(axis=1, keepdims=True),
+                (len(years), n_states),
+            ).copy()
+        ov["nem_cap_kw"] = jnp.asarray(compile_state_nem_caps(
+            pd.read_csv(sl_path), pd.read_csv(pk_path),
+            pd.read_csv(cfp_path), years, states, res_mult,
+        ))
 
     if overrides:
         ov.update(overrides)

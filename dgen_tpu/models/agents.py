@@ -53,9 +53,21 @@ class AgentTable:
     customers_in_bin: jax.Array            # [N] f32
     load_kwh_per_customer_in_bin: jax.Array  # [N] f32 (base year)
     developable_frac: jax.Array            # [N] f32
-    #: one-time interconnection charge on adoption (reference
-    #: elec.py:850-860), added to the installed cost
+    #: one-time interconnection charge, applied only when the DG-rate
+    #: switch takes effect (reference elec.py:850-860)
     one_time_charge: jax.Array             # [N] f32
+    #: NEM availability (reference apply_export_tariff_params,
+    #: elec.py:92-119): system-kW limit (0 = no NEM; while NEM is
+    #: active it caps the sizing bracket) + the policy window years
+    #: (reference filter_nem_year, elec.py:449-454)
+    nem_kw_limit: jax.Array                # [N] f32
+    nem_first_year: jax.Array              # [N] f32
+    nem_sunset_year: jax.Array             # [N] f32
+    #: DG-rate switch window: the switch to ``tariff_switch_idx``
+    #: applies only when the SIZED kW lands in
+    #: [switch_min_kw, switch_max_kw) (reference elec.py:844-845)
+    switch_min_kw: jax.Array               # [N] f32
+    switch_max_kw: jax.Array               # [N] f32
     incentives: IncentiveParams            # leaves [N, 2]
 
     n_states: int = dataclasses.field(metadata=dict(static=True), default=51)
@@ -113,6 +125,11 @@ def build_agent_table(
     incentives: IncentiveParams | None = None,
     tariff_switch_idx: np.ndarray | None = None,
     one_time_charge: np.ndarray | None = None,
+    nem_kw_limit: np.ndarray | None = None,
+    nem_first_year: np.ndarray | None = None,
+    nem_sunset_year: np.ndarray | None = None,
+    switch_min_kw: np.ndarray | None = None,
+    switch_max_kw: np.ndarray | None = None,
     pad_multiple: int = 128,
 ) -> AgentTable:
     """Assemble + pad an :class:`AgentTable` from host arrays.
@@ -145,12 +162,13 @@ def build_agent_table(
         incentives = IncentiveParams(
             cbi_usd_p_w=z2, cbi_max_usd=z2, ibi_frac=z2, ibi_max_usd=z2,
             pbi_usd_p_kwh=z2, pbi_years=jnp.zeros((n_pad, 2), dtype=jnp.int32),
+            pbi_decay=z2,
         )
     else:
         def pad2(a, dtype):
-            a = np.asarray(a)
             out = np.zeros((n_pad, 2), dtype=dtype)
-            out[:n] = a
+            if a is not None:
+                out[:n] = np.asarray(a)
             return jnp.asarray(out)
 
         incentives = IncentiveParams(
@@ -160,12 +178,29 @@ def build_agent_table(
             ibi_max_usd=pad2(incentives.ibi_max_usd, np.float32),
             pbi_usd_p_kwh=pad2(incentives.pbi_usd_p_kwh, np.float32),
             pbi_years=pad2(incentives.pbi_years, np.int32),
+            pbi_decay=pad2(incentives.pbi_decay, np.float32),
         )
 
     if tariff_switch_idx is None:
         tariff_switch_idx = np.asarray(tariff_idx)
     if one_time_charge is None:
         one_time_charge = np.zeros(n, dtype=np.float32)
+    # NEM defaults: unlimited NEM, window always open — the behavior of
+    # populations with no compiled NEM policy data
+    if nem_kw_limit is None:
+        nem_kw_limit = np.full(n, 1e30, dtype=np.float32)
+    if nem_first_year is None:
+        nem_first_year = np.zeros(n, dtype=np.float32)
+    if nem_sunset_year is None:
+        nem_sunset_year = np.full(n, 9999.0, dtype=np.float32)
+    # switch-window defaults: agents WITH a distinct DG rate switch at
+    # any size (the pre-size-conditioning behavior); agents without one
+    # never enter the window
+    has_switch = np.asarray(tariff_switch_idx) != np.asarray(tariff_idx)
+    if switch_min_kw is None:
+        switch_min_kw = np.where(has_switch, 0.0, 1e30).astype(np.float32)
+    if switch_max_kw is None:
+        switch_max_kw = np.full(n, 1e30, dtype=np.float32)
 
     return AgentTable(
         agent_id=pad_i(np.arange(n)),
@@ -182,6 +217,11 @@ def build_agent_table(
         load_kwh_per_customer_in_bin=pad_f(load_kwh_per_customer_in_bin),
         developable_frac=pad_f(developable_frac),
         one_time_charge=pad_f(one_time_charge),
+        nem_kw_limit=pad_f(nem_kw_limit, fill=1e30),
+        nem_first_year=pad_f(nem_first_year),
+        nem_sunset_year=pad_f(nem_sunset_year, fill=9999.0),
+        switch_min_kw=pad_f(switch_min_kw, fill=1e30),
+        switch_max_kw=pad_f(switch_max_kw, fill=1e30),
         incentives=incentives,
         n_states=n_states,
     )

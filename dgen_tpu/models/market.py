@@ -181,14 +181,16 @@ def anchor_to_observed(
     :126).
     """
     group_kw = jax.ops.segment_sum(system_kw_cum, group_idx, n_groups)
-    group_count = jax.ops.segment_sum(
-        jnp.ones_like(system_kw_cum), group_idx, n_groups
-    )
+    # only developable agents can carry anchored capacity — this also
+    # keeps padding rows (weight 0) out of the zero-modeled fallback
+    # split, so results are invariant under padded reorderings
+    countable = (developable_agent_weight > 0.0).astype(system_kw_cum.dtype)
+    group_count = jax.ops.segment_sum(countable, group_idx, n_groups)
     per_agent_group_kw = group_kw[group_idx]
     per_agent_count = jnp.maximum(group_count[group_idx], 1.0)
     scale = jnp.where(
         per_agent_group_kw == 0.0,
-        1.0 / per_agent_count,
+        countable / per_agent_count,
         system_kw_cum / jnp.maximum(per_agent_group_kw, 1e-30),
     )
     anchored_kw = scale * observed_group_kw[group_idx]

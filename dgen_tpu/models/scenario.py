@@ -20,7 +20,7 @@ import numpy as np
 
 from dgen_tpu.config import PAYBACK_GRID_N, SECTORS, ScenarioConfig
 from dgen_tpu.models.agents import AgentTable
-from dgen_tpu.ops.cashflow import FinanceParams
+from dgen_tpu.ops.cashflow import FinanceParams, MACRS_5
 
 
 @jax.tree_util.register_dataclass
@@ -37,6 +37,13 @@ class ScenarioInputs:
     pv_degradation: jax.Array             # [Y, S] (pv_tech_performance)
     batt_capex_per_kwh: jax.Array         # [Y, S] (batt_prices)
     batt_capex_per_kw: jax.Array          # [Y, S]
+    #: [Y, S] battery round-trip efficiency + lifetime trajectories
+    #: (batt_tech_performance; reference apply_batt_tech_performance,
+    #: elec.py:319). Lifetime is carried for parity but feeds no cost:
+    #: the reference zeroes battery replacement in the hot loop
+    #: (financial_functions.py:126,207 om_batt_replacement_cost=[0]).
+    batt_eff: jax.Array
+    batt_lifetime_yrs: jax.Array
     pv_capex_per_kw_combined: jax.Array   # [Y, S] (pv_plus_batt_prices)
     batt_capex_per_kwh_combined: jax.Array  # [Y, S]
     load_growth: jax.Array                # [Y, R, S] multiplier vs base year
@@ -49,6 +56,9 @@ class ScenarioInputs:
     real_discount_rate: jax.Array         # [Y, S]
     tax_rate: jax.Array                   # [Y, S]
     itc_fraction: jax.Array               # [Y, S]
+    #: [Y, S, D] depreciation schedule fractions (depreciation_schedules
+    #: CSVs; reference apply_depreciation_schedule, elec.py:157)
+    deprec_sch: jax.Array
     # --- market ---
     bass_p: jax.Array                     # [G]
     bass_q: jax.Array                     # [G]
@@ -67,6 +77,9 @@ class ScenarioInputs:
     #: gate compares against the *previous* year's state cumulative
     #: capacity (reference calc_state_capacity_by_year, elec.py:788).
     nem_cap_kw: jax.Array
+    #: [Y] calendar model years (f32), for the per-agent NEM
+    #: availability-window gate (reference filter_nem_year, elec.py:449)
+    years: jax.Array
     # --- misc ---
     value_of_resiliency: jax.Array        # [Y, S] $ per agent
     cap_cost_multiplier: jax.Array        # [Y, S]
@@ -90,6 +103,7 @@ class YearAgentInputs:
     elec_price_multiplier: jax.Array
     elec_price_escalator: jax.Array
     pv_degradation: jax.Array
+    batt_rt_eff: jax.Array
     system_capex_per_kw: jax.Array
     system_capex_per_kw_combined: jax.Array
     batt_capex_per_kwh_combined: jax.Array
@@ -125,6 +139,7 @@ def apply_year(
         itc_fraction=inputs.itc_fraction[year_idx, s],
         is_commercial=(s != 0).astype(jnp.float32),
         om_per_year=jnp.zeros_like(load_kwh),  # reference zeroes O&M in the hot loop
+        deprec_sch=inputs.deprec_sch[year_idx, s],
     )
 
     return YearAgentInputs(
@@ -134,6 +149,7 @@ def apply_year(
         elec_price_multiplier=inputs.elec_price_multiplier[year_idx, r, s],
         elec_price_escalator=inputs.elec_price_escalator[year_idx, r, s],
         pv_degradation=inputs.pv_degradation[year_idx, s],
+        batt_rt_eff=inputs.batt_eff[year_idx, s],
         system_capex_per_kw=inputs.pv_capex_per_kw[year_idx, s],
         system_capex_per_kw_combined=inputs.pv_capex_per_kw_combined[year_idx, s],
         batt_capex_per_kwh_combined=inputs.batt_capex_per_kwh_combined[year_idx, s],
@@ -144,20 +160,28 @@ def apply_year(
 
 
 def escalator_from_multipliers(mult: np.ndarray, years: np.ndarray,
-                               horizon: int = 30, clip: float = 0.01) -> np.ndarray:
-    """Forward CAGR of the retail price multiplier over the analysis
-    horizon, clipped to ±1%/yr (reference agent_mutation/elec.py:29-89
-    ``apply_elec_price_multiplier_and_escalator``).
+                               year_cap: int = 2040,
+                               clip: float = 0.01) -> np.ndarray:
+    """Price escalator per model year, reference semantics
+    (agent_mutation/elec.py:63-79): the escalator for model year ``y``
+    is the CAGR of the multiplier from ``min(y, 2040)`` to the
+    trajectory's FINAL year, clipped to ±1%/yr.
 
-    ``mult``: [Y, ...] multiplier trajectory on the model-year grid.
+    ``mult``: [Y, ...] multiplier trajectory on the model-year grid
+    (the reference evaluates against its full 2050 trajectory; here the
+    grid is whatever the scenario covers).
     """
-    y_count = mult.shape[0]
+    years = np.asarray(years)
     out = np.zeros_like(mult)
-    for i in range(y_count):
-        j = min(y_count - 1, i + max(1, horizon // max(1, int(years[1] - years[0]) if y_count > 1 else 1)))
-        span_years = max(float(years[j] - years[i]), 1.0)
+    final_idx = len(years) - 1
+    for i, y in enumerate(years):
+        yc = min(int(y), year_cap)
+        j = max(0, int(np.searchsorted(years, yc, side="right")) - 1)
+        span = max(float(years[final_idx] - years[j]), 1.0)
         with np.errstate(divide="ignore", invalid="ignore"):
-            cagr = (mult[j] / np.maximum(mult[i], 1e-9)) ** (1.0 / span_years) - 1.0
+            cagr = (
+                mult[final_idx] / np.maximum(mult[j], 1e-9)
+            ) ** (1.0 / span) - 1.0
         out[i] = np.clip(np.nan_to_num(cagr), -clip, clip)
     return out
 
@@ -203,6 +227,8 @@ def uniform_inputs(
         pv_degradation=yz(0.005),
         batt_capex_per_kwh=batt_capex_kwh,
         batt_capex_per_kw=yz(1000.0),
+        batt_eff=yz(0.9216),
+        batt_lifetime_yrs=yz(10.0),
         pv_capex_per_kw_combined=pv_capex * 1.05,
         batt_capex_per_kwh_combined=batt_capex_kwh * 0.95,
         load_growth=jnp.ones((Y, R, S), dtype=f),
@@ -214,6 +240,9 @@ def uniform_inputs(
         real_discount_rate=yz(0.027),
         tax_rate=yz(0.257),
         itc_fraction=yz(0.30),
+        deprec_sch=jnp.broadcast_to(
+            jnp.asarray(MACRS_5), (Y, S, MACRS_5.shape[0])
+        ),
         bass_p=jnp.full(G, 0.0015, dtype=f),
         bass_q=jnp.full(G, 0.35, dtype=f),
         teq_yr1=jnp.full(G, 2.0, dtype=f),
@@ -227,6 +256,7 @@ def uniform_inputs(
         # group layout is always state x len(SECTORS) (AgentTable.group_idx),
         # regardless of which sectors the scenario enables
         nem_cap_kw=jnp.full((Y, max(G // len(SECTORS), 1)), 1e30, dtype=f),
+        years=jnp.asarray(years.astype(f)),
         value_of_resiliency=yz(0.0),
         cap_cost_multiplier=yz(1.0),
         inflation=jnp.asarray(config.annual_inflation, dtype=f),

@@ -47,6 +47,7 @@ from dgen_tpu.ops import bill as bill_ops
 from dgen_tpu.ops import sizing as sizing_ops
 from dgen_tpu.ops.tariff import NET_BILLING, TariffBank
 from dgen_tpu.parallel.mesh import AGENT_AXIS
+from dgen_tpu.utils import timing
 from dgen_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -157,6 +158,15 @@ def build_econ_inputs(
     # (reference financial_functions.py:182).
     ts_sell = profiles.wholesale[table.region_idx] * mult[:, None]
 
+    # NEM system-size limit caps the sizing bracket while NEM is active;
+    # agents with a DG-rate switch are exempt — the switch forces NEM on
+    # regardless of size (reference elec.py:852 sets the limit to 1e6)
+    has_switch = table.switch_min_kw < 1e29
+    nem_kw_cap = jnp.where(
+        (nem_allowed > 0) & jnp.logical_not(has_switch),
+        table.nem_kw_limit, 1e30,
+    )
+
     return sizing_ops.AgentEconInputs(
         load=load,
         gen_per_kw=gen_per_kw,
@@ -174,7 +184,32 @@ def build_econ_inputs(
         cap_cost_multiplier=ya.cap_cost_multiplier,
         value_of_resiliency_usd=ya.value_of_resiliency,
         one_time_charge=table.one_time_charge,
+        nem_kw_cap=nem_kw_cap,
+        switch_min_kw=table.switch_min_kw,
+        switch_max_kw=table.switch_max_kw,
+        batt_rt_eff=ya.batt_rt_eff,
     )
+
+
+def compute_nem_allowed(
+    table: AgentTable,
+    inputs: ScenarioInputs,
+    year_idx: jax.Array,
+    state_kw_last: jax.Array,
+) -> jax.Array:
+    """[N] float32 mask: 1 where net metering remains available.
+
+    Three gates, all from the reference's NEM machine
+    (agent_mutation/elec.py:449-505): the state cumulative-capacity cap
+    (vs LAST step's installed kW), the per-agent availability window
+    (``filter_nem_year``, elec.py:449-454), and a positive per-agent
+    system-kW limit (the reference's fillna(0) = no NEM, elec.py:119).
+    """
+    cap = inputs.nem_cap_kw[year_idx]                       # [n_states]
+    cap_gate = (state_kw_last < cap)[table.state_idx]
+    yr = inputs.years[year_idx]
+    window = (table.nem_first_year <= yr) & (yr <= table.nem_sunset_year)
+    return (cap_gate & window & (table.nem_kw_limit > 0)).astype(jnp.float32)
 
 
 @partial(
@@ -229,8 +264,7 @@ def year_step(
         state_kw_last = jax.ops.segment_sum(
             carry.market.system_kw_cum, table.state_idx, n_states
         )
-    cap = inputs.nem_cap_kw[year_idx]                       # [n_states]
-    nem_allowed = (state_kw_last < cap).astype(jnp.float32)[table.state_idx]
+    nem_allowed = compute_nem_allowed(table, inputs, year_idx, state_kw_last)
 
     envs = build_econ_inputs(
         table, profiles, tariffs, ya, nem_allowed, table.incentives,
@@ -416,6 +450,26 @@ class Simulation:
                 f"{len(self.years)}"
             )
 
+        # state-local shard layout (the reference's per-state task
+        # binning, SURVEY.md §2.6); results are keyed by agent_id and
+        # invariant under the reordering
+        self.partition = None
+        if (
+            mesh is not None and mesh.devices.size > 1
+            and self.run_config.partition_by_state
+        ):
+            from dgen_tpu.parallel.partition import partition_table
+
+            table, self.partition = partition_table(
+                table, int(mesh.devices.size),
+                self.run_config.agent_pad_multiple,
+            )
+            logger.info(
+                "partitioned %d agents into %d state-local shards of %d",
+                int(np.sum(np.asarray(table.mask))), mesh.devices.size,
+                self.partition.shard_len,
+            )
+
         if mesh is not None:
             shard = NamedSharding(mesh, P(AGENT_AXIS))
             repl = NamedSharding(mesh, P())
@@ -541,12 +595,31 @@ class Simulation:
 
             ckpt_writer = ckpt.Writer(checkpoint_dir)
 
+        debug = self.run_config.debug_invariants
+        if debug:
+            from dgen_tpu.utils import invariants
+
         for yi, year in enumerate(self.years):
             if yi < start_idx:
                 continue
             t0 = time.time()
-            carry, outs = self.step(carry, yi, first_year=(yi == 0))
-            jax.block_until_ready(carry.market.market_share)
+            with timing.timer("year_step"):
+                prev_carry = carry
+                carry, outs = self.step(carry, yi, first_year=(yi == 0))
+                jax.block_until_ready(carry.market.market_share)
+            if debug:
+                # the reference runs its dataframe invariants after
+                # every on_frame transform (agents.py:149-262); here the
+                # carry pytree is checked after every year step
+                invariants.check_transform(
+                    prev_carry, carry, context=f"year {year} carry"
+                )
+                invariants.check_finite(
+                    carry, context=f"year {year} carry"
+                )
+                invariants.check_finite(
+                    outs, context=f"year {year} outputs"
+                )
             logger.info("year %d (%d/%d) %.2fs", year, yi + 1,
                         len(self.years), time.time() - t0)
             if callback is not None:

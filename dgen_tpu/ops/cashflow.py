@@ -46,11 +46,15 @@ class FinanceParams:
     inflation_rate: jax.Array
     tax_rate: jax.Array
     itc_fraction: jax.Array
-    #: 1.0 for non-res agents -> MACRS-5 depreciation + deductible
+    #: 1.0 for non-res agents -> depreciation + deductible
     #: interest (business expense); 0.0 for res.
     is_commercial: jax.Array
     #: annual O&M $ (year-1 dollars, inflates)
     om_per_year: jax.Array
+    #: [D] depreciation schedule fractions (the reference's data-driven
+    #: ``deprec_sch`` column, agent_mutation/elec.py:157
+    #: ``apply_depreciation_schedule``); None = the MACRS-5 default
+    deprec_sch: jax.Array = None
 
     @staticmethod
     def example() -> "FinanceParams":
@@ -86,6 +90,12 @@ class IncentiveParams:
     ibi_max_usd: jax.Array      # [2]
     pbi_usd_p_kwh: jax.Array    # [2] $/kWh production-based
     pbi_years: jax.Array        # [2] int32 duration
+    #: [2] 1.0 = the $/kWh rate decays linearly to zero over the
+    #: duration (reference eqn_builder 'linear_decay',
+    #: financial_functions.py:1379-1385); 0.0 = flat rate, the only
+    #: mode the reference's own hot path uses
+    #: (process_incentives :1072 repeats a flat amount)
+    pbi_decay: jax.Array = None
 
     @staticmethod
     def zeros() -> "IncentiveParams":
@@ -93,6 +103,7 @@ class IncentiveParams:
         return IncentiveParams(
             cbi_usd_p_w=z2, cbi_max_usd=z2, ibi_frac=z2, ibi_max_usd=z2,
             pbi_usd_p_kwh=z2, pbi_years=jnp.zeros(2, dtype=jnp.int32),
+            pbi_decay=z2,
         )
 
 
@@ -150,15 +161,24 @@ def incentive_cashflows(
     CBI: $/W x kW x 1000, clamped to its max (reference
     financial_functions.py:1317 ``check_incentive_constraints``).
     IBI: fraction x installed cost, clamped. PBI: $/kWh x degraded
-    production for the row's duration.
+    production for the row's duration — flat, or decaying linearly to
+    zero at the end of the duration when the row's ``pbi_decay`` is set
+    (reference eqn_builder 'linear_decay', financial_functions.py:1379:
+    ``value(ts) = rate * (1 - ts/expiration)`` for ts = 1..expiration).
     """
     cbi = jnp.sum(jnp.minimum(inc.cbi_usd_p_w * system_kw * 1000.0, inc.cbi_max_usd))
     ibi = jnp.sum(jnp.minimum(inc.ibi_frac * installed_cost, inc.ibi_max_usd))
 
     y = jnp.arange(n_years, dtype=jnp.float32)
     prod = annual_kwh * (1.0 - degradation) ** y                       # [Y]
-    active = (y[None, :] < inc.pbi_years[:, None].astype(jnp.float32))  # [2, Y]
-    pbi = jnp.sum(inc.pbi_usd_p_kwh[:, None] * prod[None, :] * active, axis=0)
+    dur = inc.pbi_years[:, None].astype(jnp.float32)                   # [2, 1]
+    active = (y[None, :] < dur).astype(jnp.float32)                    # [2, Y]
+    rate = inc.pbi_usd_p_kwh[:, None]
+    if inc.pbi_decay is not None:
+        ts = y[None, :] + 1.0
+        decay_f = jnp.clip(1.0 - ts / jnp.maximum(dur, 1.0), 0.0, 1.0)
+        rate = rate * jnp.where(inc.pbi_decay[:, None] > 0, decay_f, 1.0)
+    pbi = jnp.sum(rate * prod[None, :] * active, axis=0)
     return cbi + ibi, pbi
 
 
@@ -206,11 +226,14 @@ def cashflow(
     itc = fin.itc_fraction * installed_cost
     year1 = (jnp.arange(n_years) == 0).astype(f32)
 
-    # MACRS-5 depreciation for commercial, basis reduced by half the ITC
-    # (SAM convention for depr type 2).
+    # Depreciation for commercial, basis reduced by half the ITC
+    # (SAM convention for depr type 2); schedule is the data-driven
+    # deprec_sch when supplied (reference apply_depreciation_schedule,
+    # elec.py:157), MACRS-5 otherwise.
+    sch = MACRS_5 if fin.deprec_sch is None else fin.deprec_sch
     basis = installed_cost * (1.0 - 0.5 * fin.itc_fraction)
-    depr = jnp.zeros(n_years, dtype=f32).at[: MACRS_5.shape[0]].set(
-        MACRS_5[: min(MACRS_5.shape[0], n_years)] * basis
+    depr = jnp.zeros(n_years, dtype=f32).at[: sch.shape[-1]].set(
+        sch[: min(sch.shape[-1], n_years)] * basis
     )
     depr_savings = depr * tax_eff * fin.is_commercial
     interest_savings = interests * tax_eff * fin.is_commercial

@@ -33,9 +33,11 @@ BATT_CAPACITY_TO_POWER_RATIO = 2.0
 # Reference SOC settings (financial_functions.py:138,151).
 SOC_MIN_FRAC = 0.10
 SOC_INIT_FRAC = 0.30
-# One-way efficiencies (round trip ~0.92, typical Li-ion AC-coupled).
-ETA_CHARGE = 0.96
-ETA_DISCHARGE = 0.96
+# Default round-trip efficiency when no trajectory is supplied (~0.92,
+# typical Li-ion AC-coupled); the scenario's batt_tech trajectory
+# (reference batt_tech_performance CSVs, applied per year at
+# agent_mutation/elec.py:319) overrides this per agent-year.
+DEFAULT_RT_EFF = 0.9216
 
 
 def batt_size_from_pv(system_kw: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -60,6 +62,7 @@ def dispatch_battery(
     gen: jax.Array,
     batt_kw: jax.Array,
     batt_kwh: jax.Array,
+    rt_eff: jax.Array | float = DEFAULT_RT_EFF,
     unroll: int = 24,
 ) -> DispatchResult:
     """Greedy self-consumption dispatch over one year.
@@ -71,9 +74,14 @@ def dispatch_battery(
     engine sees as the system's net meter contribution, mirroring how the
     reference hands the battery-modified ``SystemOutput.gen`` to
     Utilityrate5 (financial_functions.py:195).
+
+    ``rt_eff``: round-trip efficiency, split evenly into one-way charge
+    and discharge efficiencies (sqrt); year-dependent via the scenario's
+    batt_tech trajectory.
     """
     soc_min = batt_kwh * SOC_MIN_FRAC
     soc0 = batt_kwh * SOC_INIT_FRAC
+    eta = jnp.sqrt(jnp.asarray(rt_eff, dtype=jnp.float32))
 
     def step(soc, inputs):
         ld, g = inputs
@@ -81,13 +89,13 @@ def dispatch_battery(
         deficit = jnp.maximum(ld - g, 0.0)
         charge = jnp.minimum(
             jnp.minimum(surplus, batt_kw),
-            jnp.maximum(batt_kwh - soc, 0.0) / ETA_CHARGE,
+            jnp.maximum(batt_kwh - soc, 0.0) / eta,
         )
         discharge = jnp.minimum(
             jnp.minimum(deficit, batt_kw),
-            jnp.maximum(soc - soc_min, 0.0) * ETA_DISCHARGE,
+            jnp.maximum(soc - soc_min, 0.0) * eta,
         )
-        new_soc = soc + charge * ETA_CHARGE - discharge / ETA_DISCHARGE
+        new_soc = soc + charge * eta - discharge / eta
         return new_soc, (new_soc, charge, discharge)
 
     _, (soc, charge, discharge) = jax.lax.scan(

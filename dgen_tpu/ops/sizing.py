@@ -68,7 +68,40 @@ class AgentEconInputs:
     batt_capex_per_kwh_combined: jax.Array
     cap_cost_multiplier: jax.Array
     value_of_resiliency_usd: jax.Array
+    #: one-time interconnection charge, applied only when the DG-rate
+    #: switch takes effect (reference elec.py:857-860)
     one_time_charge: jax.Array
+    #: upper bound on the sizing bracket while NEM is active (the
+    #: per-agent nem_system_kw_limit, reference elec.py:92-119); 1e30
+    #: where NEM is off or unlimited. None -> filled with 1e30 by
+    #: :func:`size_agents`.
+    nem_kw_cap: jax.Array = None
+    #: DG-rate switch window: with-system bills price on ``tariff_w``
+    #: only where kw in [switch_min_kw, switch_max_kw) (reference
+    #: apply_rate_switch, elec.py:844-845); switch_min_kw=1e30 disables.
+    #: None -> always-switch when tariff_w is given (filled by
+    #: :func:`size_agents`).
+    switch_min_kw: jax.Array = None
+    switch_max_kw: jax.Array = None
+    #: battery round-trip efficiency for the forward dispatch run
+    #: (year-dependent batt_tech trajectory, reference elec.py:319);
+    #: None -> the dispatch default
+    batt_rt_eff: jax.Array = None
+
+
+def _switch_active(env: AgentEconInputs, kw: jax.Array) -> jax.Array:
+    """Whether the DG-rate switch applies at system size ``kw``
+    (reference apply_rate_switch, elec.py:844-845). Broadcasts the
+    per-agent window over a trailing candidate axis if present.
+
+    A ``None`` window is the legacy always-on behavior (switch and
+    one-time charge apply at every size)."""
+    mn, mx = env.switch_min_kw, env.switch_max_kw
+    if mn is None:
+        return jnp.ones_like(kw, dtype=bool)
+    if kw.ndim == mn.ndim + 1:
+        mn, mx = mn[..., None], mx[..., None]
+    return (kw >= mn) & (kw < mx)
 
 
 def _npv_given_system_out(
@@ -80,8 +113,18 @@ def _npv_given_system_out(
     n_periods: int,
     n_years: int,
 ):
-    """Shared tail of the objective: bills -> energy value -> cashflow."""
-    tw = env.tariff if env.tariff_w is None else env.tariff_w
+    """Shared tail of the objective: bills -> energy value -> cashflow.
+
+    The with-system tariff is size-conditioned: ``tariff_w`` applies
+    only where the DG-rate switch window contains ``system_kw``.
+    """
+    if env.tariff_w is None:
+        tw = env.tariff
+    else:
+        sw = _switch_active(env, system_kw)
+        tw = jax.tree.map(
+            lambda a, b: jnp.where(sw, a, b), env.tariff_w, env.tariff
+        )
     bills_w, bills_wo = bill_ops.bill_series(
         env.load, system_out, tw, env.ts_sell,
         env.fin.inflation_rate, env.elec_price_escalator, env.pv_degradation,
@@ -108,7 +151,8 @@ def pv_only_npv(
 ) -> jax.Array:
     """Objective for the sizing search (PV only, no battery)."""
     gen = env.gen_per_kw * kw * INV_EFF
-    cost = env.system_capex_per_kw * kw * env.cap_cost_multiplier + env.one_time_charge
+    otc = jnp.where(_switch_active(env, kw), env.one_time_charge, 0.0)
+    cost = env.system_capex_per_kw * kw * env.cap_cost_multiplier + otc
     out = _npv_given_system_out(
         env, kw, gen, cost, jnp.zeros(()), n_periods, n_years
     )
@@ -201,15 +245,21 @@ def size_one_agent(
     max_system = env.load_kwh_per_customer / jnp.maximum(naep, 1e-9)
     lo = max_system * SIZE_LO_FRAC
     hi = max_system * SIZE_HI_FRAC
+    # NEM system-size limit caps the bracket while NEM is active
+    # (reference nem_system_kw_limit, elec.py:92-119)
+    if env.nem_kw_cap is not None:
+        hi = jnp.minimum(hi, env.nem_kw_cap)
+        lo = jnp.minimum(lo, hi)
 
     obj = lambda kw: pv_only_npv(kw, env, n_periods, n_years)
     kw_star = golden_section_max(obj, lo, hi, n_iters)
 
     # --- PV-only outputs at kW* ---
     gen_n = env.gen_per_kw * kw_star * INV_EFF
+    otc_star = jnp.where(_switch_active(env, kw_star), env.one_time_charge, 0.0)
     cost_n = (
         env.system_capex_per_kw * kw_star * env.cap_cost_multiplier
-        + env.one_time_charge
+        + otc_star
     )
     out_n = _npv_given_system_out(
         env, kw_star, gen_n, cost_n, jnp.zeros(()), n_periods, n_years
@@ -218,13 +268,19 @@ def size_one_agent(
 
     # --- Forward run with battery at fixed ratio ---
     batt_kw, batt_kwh = dispatch_ops.batt_size_from_pv(kw_star)
-    dr = dispatch_ops.dispatch_battery(env.load, gen_n, batt_kw, batt_kwh)
+    rt_eff = (
+        dispatch_ops.DEFAULT_RT_EFF if env.batt_rt_eff is None
+        else env.batt_rt_eff
+    )
+    dr = dispatch_ops.dispatch_battery(
+        env.load, gen_n, batt_kw, batt_kwh, rt_eff
+    )
     # Battery capex enters the cost basis at 0.7x for the ITC treatment
     # (reference financial_functions.py:219).
     batt_cost = env.batt_capex_per_kwh_combined * batt_kwh * 0.7
     cost_w = (
         env.system_capex_per_kw_combined * kw_star + batt_cost
-    ) * env.cap_cost_multiplier + env.one_time_charge
+    ) * env.cap_cost_multiplier + otc_star
     out_w = _npv_given_system_out(
         env, kw_star, dr.system_out, cost_w, env.value_of_resiliency_usd,
         n_periods, n_years,
@@ -296,12 +352,18 @@ def _size_agents_fast(
     max_system = envs.load_kwh_per_customer / jnp.maximum(naep, 1e-9)
     lo = max_system * SIZE_LO_FRAC
     hi = max_system * SIZE_HI_FRAC
+    # NEM system-size limit caps the bracket while NEM is active
+    # (reference nem_system_kw_limit, elec.py:92-119)
+    hi = jnp.minimum(hi, envs.nem_kw_cap)
+    lo = jnp.minimum(lo, hi)
 
     gen_shape = envs.gen_per_kw * INV_EFF                         # [N, H]
     n_buckets = 12 * n_periods
-    # with-system bills price on the (possibly DG-rate-switched)
-    # tariff_w; the counterfactual stays on the original tariff
-    # (reference apply_rate_switch, agent_mutation/elec.py:838)
+    # with-system bills price on the DG-rate-switched tariff_w only for
+    # candidates inside the per-agent switch window; the counterfactual
+    # stays on the original tariff (reference apply_rate_switch,
+    # agent_mutation/elec.py:838-845)
+    has_switch = envs.tariff_w is not None
     tw = envs.tariff if envs.tariff_w is None else envs.tariff_w
     bucket = billpallas.hourly_bucket_ids(tw.hour_period, n_periods)
     sell = billpallas.sell_rate_hourly(tw, envs.ts_sell)
@@ -352,19 +414,27 @@ def _size_agents_fast(
         return out
 
     def pv_cost(kw):
-        # kw: [N] or [N, K]; per-agent cost params broadcast over K
+        # kw: [N] or [N, K]; per-agent cost params broadcast over K.
+        # The one-time (interconnection) charge applies only where the
+        # DG-rate switch takes effect (reference elec.py:857-860).
         unsq = (lambda x: x[:, None]) if kw.ndim == 2 else (lambda x: x)
+        otc = jnp.where(
+            _switch_active(envs, kw), unsq(envs.one_time_charge), 0.0
+        )
         return (
             unsq(envs.system_capex_per_kw) * kw * unsq(envs.cap_cost_multiplier)
-            + unsq(envs.one_time_charge)
+            + otc
         )
 
-    def eval_grid(kw_grid):
-        """kw_grid [N, K] -> economics of every candidate.
+    bucket_wo = (
+        billpallas.hourly_bucket_ids(envs.tariff.hour_period, n_periods)
+        if has_switch else bucket
+    )
 
-        One kernel call with R = K * Y packed scale rows.
-        """
-        scales = (kw_grid[:, :, None] * df[:, None, :]).reshape(n, k * n_years)
+    def candidate_bills(scales):
+        """[N, R] packed (candidate, year) scales -> with-system annual
+        bills on a given tariff structure; evaluated on the switched
+        tariff and, when a switch window exists, also on the original."""
         # bf16=False: measured slower on v5e (the in-kernel casts cost
         # more than the narrower matmul saves); revisit with a fused
         # bf16 layout if the search matmul becomes the bottleneck again
@@ -372,9 +442,35 @@ def _size_agents_fast(
             envs.load, gen_shape, sell, bucket, scales, n_buckets, impl,
             bf16=False, mesh=mesh,
         )
-        bills = billpallas.bills_linear_nb(
+        bills_sw = billpallas.bills_linear_nb(
             lin, imports, imp_sell, scales, tw, n_periods
-        ).reshape(n, k, n_years) * pf[:, None, :]                 # [N, K, Y]
+        )
+        if not has_switch:
+            return bills_sw, None
+        imports_o, imp_sell_o = billpallas.import_sums(
+            envs.load, gen_shape, sell_wo, bucket_wo, scales, n_buckets,
+            impl, bf16=False, mesh=mesh,
+        )
+        bills_o = billpallas.bills_linear_nb(
+            lin_wo, imports_o, imp_sell_o, scales, envs.tariff, n_periods
+        )
+        return bills_sw, bills_o
+
+    def eval_grid(kw_grid):
+        """kw_grid [N, K] -> economics of every candidate.
+
+        One kernel call with R = K * Y packed scale rows (two calls for
+        switch populations: the candidate's tariff depends on its size).
+        """
+        scales = (kw_grid[:, :, None] * df[:, None, :]).reshape(n, k * n_years)
+        bills_sw, bills_o = candidate_bills(scales)
+        if has_switch:
+            in_w = _switch_active(envs, kw_grid)                  # [N, K]
+            sel = jnp.repeat(in_w, n_years, axis=1)               # [N, K*Y]
+            bills = jnp.where(sel, bills_sw, bills_o)
+        else:
+            bills = bills_sw
+        bills = bills.reshape(n, k, n_years) * pf[:, None, :]     # [N, K, Y]
 
         rep = lambda x: jnp.repeat(x, k, axis=0)
         ev = (bills_wo[:, None, :] - bills).reshape(n * k, n_years)
@@ -418,21 +514,39 @@ def _size_agents_fast(
 
     # --- Forward run with battery at fixed ratio ---
     batt_kw, batt_kwh = dispatch_ops.batt_size_from_pv(kw_star)
+    rt_eff = (
+        jnp.full(n, dispatch_ops.DEFAULT_RT_EFF, f32)
+        if envs.batt_rt_eff is None else envs.batt_rt_eff
+    )
     dr = jax.vmap(dispatch_ops.dispatch_battery)(
-        envs.load, gen_n, batt_kw, batt_kwh
+        envs.load, gen_n, batt_kw, batt_kwh, rt_eff
     )
     batt_cost = envs.batt_capex_per_kwh_combined * batt_kwh * 0.7
+    sw_star = _switch_active(envs, kw_star)                       # [N]
+    otc_star = jnp.where(sw_star, envs.one_time_charge, 0.0)
     cost_w = (
         envs.system_capex_per_kw_combined * kw_star + batt_cost
-    ) * envs.cap_cost_multiplier + envs.one_time_charge
+    ) * envs.cap_cost_multiplier + otc_star
+    # the with-battery tariff follows the switch decision at kW*
+    if has_switch:
+        tariff_star = jax.tree.map(
+            lambda a, b: jnp.where(
+                sw_star.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
+            ),
+            tw, envs.tariff,
+        )
+        bucket_star = jnp.where(sw_star[:, None], bucket, bucket_wo)
+        sell_star = jnp.where(sw_star[:, None], sell, sell_wo)
+    else:
+        tariff_star, bucket_star, sell_star = tw, bucket, sell
     # battery-modified output is not a scale of gen_shape; use the full
     # bucket-sums kernel with per-year degradation scales
     s_b, i_b, c_b = billpallas.bucket_sums(
-        envs.load, dr.system_out, sell, bucket, df, n_buckets, impl,
-        mesh=mesh,
+        envs.load, dr.system_out, sell_star, bucket_star, df, n_buckets,
+        impl, mesh=mesh,
     )
     bills_w_b = billpallas.bills_from_sums(
-        s_b, i_b, c_b, tw, n_periods
+        s_b, i_b, c_b, tariff_star, n_periods
     ) * pf
     out_w = econ(bills_w_b, kw_star, cost_w, envs.value_of_resiliency_usd,
                  jnp.sum(dr.system_out, axis=1))
@@ -490,6 +604,23 @@ def size_agents(
     (shard_map), keeping the Pallas kernel live under real multi-chip
     sharding.
     """
+    if (envs.nem_kw_cap is None or envs.switch_min_kw is None
+            or envs.switch_max_kw is None):
+        n = envs.load.shape[0]
+        big = jnp.full(n, 1e30, jnp.float32)
+        # legacy default: unlimited NEM bracket; switch (if any tariff_w
+        # was supplied) applies at every size
+        envs = dataclasses.replace(
+            envs,
+            nem_kw_cap=big if envs.nem_kw_cap is None else envs.nem_kw_cap,
+            switch_min_kw=(
+                (jnp.zeros(n, jnp.float32) if envs.tariff_w is not None else big)
+                if envs.switch_min_kw is None else envs.switch_min_kw
+            ),
+            switch_max_kw=(
+                big if envs.switch_max_kw is None else envs.switch_max_kw
+            ),
+        )
     if fast:
         return _size_agents_fast(
             envs, n_periods=n_periods, n_years=n_years, n_iters=n_iters,

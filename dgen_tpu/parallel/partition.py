@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Sequence, Tuple
 
+import jax
 import numpy as np
 
 
@@ -90,3 +91,34 @@ def apply_partition_indices(part: Partition, n_agents: int) -> Tuple[np.ndarray,
         gather[off: off + len(seg)] = seg
         mask[off: off + len(seg)] = 1.0
     return gather, mask
+
+
+def partition_table(table, n_devices: int, pad_multiple: int = 128):
+    """(reordered AgentTable, Partition): lay agents out so each device
+    shard holds whole states, the TPU analogue of the reference's
+    per-state task binning (state_input_csvs/ + submit_all.sh).
+
+    The partition is computed over REAL agents only (padding rows are
+    re-created per shard); every [N]-leading leaf is gathered into the
+    new order and the mask re-derived, so results keyed by ``agent_id``
+    are invariant under the permutation.
+    """
+    old_mask = np.asarray(table.mask) > 0
+    real_rows = np.nonzero(old_mask)[0]
+    state_real = np.asarray(table.state_idx)[real_rows]
+    part = partition_by_state(
+        state_real, table.n_states, n_devices, pad_multiple
+    )
+    gather_sub, valid = apply_partition_indices(part, len(real_rows))
+    gather = real_rows[gather_sub]
+    n_old = table.n_agents
+
+    def g(x):
+        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == n_old:
+            return x[gather]
+        return x
+
+    out = jax.tree.map(g, table)
+    import jax.numpy as jnp
+
+    return dataclasses.replace(out, mask=jnp.asarray(valid)), part

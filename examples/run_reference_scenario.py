@@ -14,7 +14,10 @@ import numpy as np
 from dgen_tpu.config import RunConfig, ScenarioConfig
 from dgen_tpu.io import export as exp
 from dgen_tpu.io import synth
-from dgen_tpu.io.reference_inputs import scenario_inputs_from_reference
+from dgen_tpu.io.reference_inputs import (
+    scenario_inputs_from_reference,
+    wholesale_profile_bank,
+)
 from dgen_tpu.models.agents import ProfileBank
 from dgen_tpu.models.simulation import Simulation
 
@@ -26,11 +29,13 @@ inputs, meta = scenario_inputs_from_reference(REF, cfg, states)
 print(f"ingested reference trajectories: {sorted(meta['files'])}")
 
 pop = synth.generate_population(4096, seed=3, n_regions=len(meta["regions"]))
-base = np.asarray(meta["wholesale_base_usd_per_kwh"])
+# flat annual sell rate = the reference's own semantics
+# (financial_functions.py:372); drop a wholesale_hourly_shape.csv into
+# the input root to give it hourly structure
 profiles = ProfileBank(
     load=pop.profiles.load,
     solar_cf=pop.profiles.solar_cf,
-    wholesale=jnp.asarray(np.broadcast_to(base[:, None], (len(base), 8760)).copy()),
+    wholesale=jnp.asarray(wholesale_profile_bank(meta, REF)),
 )
 
 run_dir = tempfile.mkdtemp(prefix="dgen_tpu_run_")

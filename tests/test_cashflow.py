@@ -156,6 +156,65 @@ def test_pbi_incentive_stream():
     assert float(pbi[0]) == pytest.approx(0.02 * 7000.0, rel=1e-5)
 
 
+def test_pbi_linear_decay_stream():
+    """Decay semantics of the reference's eqn_builder 'linear_decay'
+    (financial_functions.py:1379-1385): value(ts) = rate*(1 - ts/exp)
+    for ts = 1..exp, zero after."""
+    n_years = 10
+    dur = 5
+    rate = 0.05
+    kwh = 10000.0
+    inc = cf.IncentiveParams(
+        cbi_usd_p_w=jnp.zeros(2), cbi_max_usd=jnp.zeros(2),
+        ibi_frac=jnp.zeros(2), ibi_max_usd=jnp.zeros(2),
+        pbi_usd_p_kwh=jnp.asarray([rate, 0.0], jnp.float32),
+        pbi_years=jnp.asarray([dur, 0], jnp.int32),
+        pbi_decay=jnp.asarray([1.0, 0.0], jnp.float32),
+    )
+    upfront, pbi = cf.incentive_cashflows(
+        inc, jnp.float32(5.0), jnp.float32(15000.0), jnp.float32(kwh),
+        jnp.float32(0.0), n_years,
+    )
+    pbi = np.asarray(pbi)
+    want = [rate * max(0.0, 1.0 - ts / dur) * kwh for ts in range(1, n_years + 1)]
+    want = [w if ts <= dur else 0.0 for ts, w in zip(range(1, n_years + 1), want)]
+    np.testing.assert_allclose(pbi, want, rtol=1e-5)
+    # decaying stream is worth strictly less than the flat one
+    flat = dataclasses_replace_decay(inc, 0.0)
+    _, pbi_flat = cf.incentive_cashflows(
+        flat, jnp.float32(5.0), jnp.float32(15000.0), jnp.float32(kwh),
+        jnp.float32(0.0), n_years,
+    )
+    assert float(jnp.sum(pbi)) < float(jnp.sum(pbi_flat))
+
+
+def dataclasses_replace_decay(inc, v):
+    import dataclasses as dc
+    return dc.replace(inc, pbi_decay=jnp.full(2, v, jnp.float32))
+
+
+def test_data_driven_depreciation_schedule():
+    """A front-loaded deprec_sch produces earlier tax savings than
+    MACRS-5 for a commercial agent (same total)."""
+    n_years = 12
+    fin_base = _fin()
+    import dataclasses as dc
+    com = dc.replace(fin_base, is_commercial=jnp.float32(1.0))
+    bonus = dc.replace(
+        com, deprec_sch=jnp.asarray([1.0, 0, 0, 0, 0, 0], jnp.float32)
+    )
+    ev = jnp.full(n_years, 1000.0, jnp.float32)
+    cost = jnp.float32(20000.0)
+    out_macrs = cf.cashflow(ev, cost, com, n_years)
+    out_bonus = cf.cashflow(ev, cost, bonus, n_years)
+    d_m = np.asarray(out_macrs["depreciation"])
+    d_b = np.asarray(out_bonus["depreciation"])
+    np.testing.assert_allclose(d_m.sum(), d_b.sum(), rtol=1e-5)
+    assert d_b[0] > d_m[0]
+    # earlier savings discount less -> higher NPV
+    assert float(out_bonus["npv"]) > float(out_macrs["npv"])
+
+
 def test_vmap_over_agents():
     n_years = 20
     n = 16

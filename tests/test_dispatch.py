@@ -50,6 +50,36 @@ def test_soc_bounds_and_energy_balance():
     assert discharge.sum() > 0.5 * charge.sum()
 
 
+def test_degraded_efficiency_year():
+    """A worse round-trip efficiency (the batt_tech trajectory's com
+    value, reference batt_tech_performance_FY19.csv: 0.829 vs res 0.92)
+    delivers less load-serving discharge for the same charge budget."""
+    load, gen = _profiles(seed=3)
+    kw, kwh = 2.0, 4.0
+    hi = dp.dispatch_battery(jnp.asarray(load), jnp.asarray(gen),
+                             jnp.float32(kw), jnp.float32(kwh),
+                             jnp.float32(0.92))
+    lo = dp.dispatch_battery(jnp.asarray(load), jnp.asarray(gen),
+                             jnp.float32(kw), jnp.float32(kwh),
+                             jnp.float32(0.829))
+    d_hi = float(np.asarray(hi.discharge).sum())
+    d_lo = float(np.asarray(lo.discharge).sum())
+    assert d_lo < d_hi
+    # loss ratio tracks the square-root split: discharged/charged ~ rt_eff
+    c_lo = float(np.asarray(lo.charge).sum())
+    assert d_lo / c_lo == pytest.approx(0.829, abs=0.06)
+    # default matches the explicit default constant
+    res_default = dp.dispatch_battery(
+        jnp.asarray(load), jnp.asarray(gen), jnp.float32(kw), jnp.float32(kwh))
+    res_explicit = dp.dispatch_battery(
+        jnp.asarray(load), jnp.asarray(gen), jnp.float32(kw),
+        jnp.float32(kwh), jnp.float32(dp.DEFAULT_RT_EFF))
+    # atol covers 1-ulp eta differences propagating through the SOC scan
+    np.testing.assert_allclose(np.asarray(res_default.system_out),
+                               np.asarray(res_explicit.system_out),
+                               rtol=1e-5, atol=1e-4)
+
+
 def test_self_consumption_reduces_imports():
     load, gen = _profiles(seed=2)
     res = dp.dispatch_battery(jnp.asarray(load), jnp.asarray(gen),

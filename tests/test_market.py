@@ -149,3 +149,49 @@ def test_initial_market_shares_apportions_by_weight():
     for g in range(n_groups):
         sel = np.asarray(group_idx) == g
         assert kw[sel].sum() == pytest.approx(float(start_kw[g]), rel=1e-4)
+
+
+def test_anchor_zero_modeled_capacity_splits_evenly():
+    """Edge: a group with zero modeled kW splits the observed total
+    1/count per agent (market.py scale fallback); the 5 kW res /
+    100 kW non-res adopter heuristic applies (reference
+    diffusion_functions_elec.py:126)."""
+    from dgen_tpu.models.market import anchor_to_observed
+
+    # 4 agents, one group (0), all res, zero modeled capacity
+    kw_cum = jnp.zeros(4, jnp.float32)
+    g = jnp.zeros(4, jnp.int32)
+    observed = jnp.asarray([800.0], jnp.float32)
+    res_mask = jnp.ones(4, bool)
+    weight = jnp.full(4, 50.0, jnp.float32)
+    a_kw, a_ad, a_sh = anchor_to_observed(
+        kw_cum, g, observed, res_mask, weight, 1)
+    np.testing.assert_allclose(np.asarray(a_kw), 200.0)      # 800/4
+    np.testing.assert_allclose(np.asarray(a_ad), 40.0)       # 200/5 kW
+    np.testing.assert_allclose(np.asarray(a_sh), 0.8)        # 40/50
+
+
+def test_anchor_adopter_size_heuristic_by_sector():
+    from dgen_tpu.models.market import anchor_to_observed
+
+    # two groups: agent 0 res, agent 1 com; modeled 100 kW each
+    kw_cum = jnp.asarray([100.0, 100.0], jnp.float32)
+    g = jnp.asarray([0, 1], jnp.int32)
+    observed = jnp.asarray([500.0, 1000.0], jnp.float32)
+    res_mask = jnp.asarray([True, False])
+    weight = jnp.full(2, 1000.0, jnp.float32)
+    a_kw, a_ad, _ = anchor_to_observed(
+        kw_cum, g, observed, res_mask, weight, 2)
+    np.testing.assert_allclose(np.asarray(a_kw), [500.0, 1000.0])
+    # res: 500/5 = 100 adopters; non-res: 1000/100 = 10
+    np.testing.assert_allclose(np.asarray(a_ad), [100.0, 10.0])
+
+
+def test_anchor_zero_weight_gives_zero_share():
+    from dgen_tpu.models.market import anchor_to_observed
+
+    kw_cum = jnp.asarray([10.0], jnp.float32)
+    a_kw, a_ad, a_sh = anchor_to_observed(
+        kw_cum, jnp.zeros(1, jnp.int32), jnp.asarray([50.0], jnp.float32),
+        jnp.ones(1, bool), jnp.zeros(1, jnp.float32), 1)
+    assert float(a_sh[0]) == 0.0

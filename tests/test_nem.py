@@ -1,0 +1,245 @@
+"""NEM policy machine: data-driven caps, availability windows, sizing
+bracket limits, and the size-conditioned DG-rate switch (reference
+agent_mutation/elec.py:92-119, 449-505, 838-845)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig, SECTORS
+from dgen_tpu.io import synth
+from dgen_tpu.io.nem import (
+    NO_CAP,
+    compile_state_nem_caps,
+    resolve_agent_nem_policy,
+)
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation, compute_nem_allowed
+from dgen_tpu.ops import sizing
+
+
+def test_compile_state_nem_caps_windows_and_formula():
+    years = [2014, 2016, 2018, 2020, 2022]
+    states = ["AA", "BB", "CC"]
+    limits = pd.DataFrame([
+        # absolute MW cap, active 2016-2020
+        {"state_abbr": "AA", "first_year": 2016, "sunset_year": 2020,
+         "max_cum_capacity_mw": 5.0, "max_pct_cum_capacity": np.nan},
+        # pct-of-peak cap, always active
+        {"state_abbr": "BB", "first_year": 2000, "sunset_year": 2050,
+         "max_cum_capacity_mw": np.nan, "max_pct_cum_capacity": 5.0},
+    ])
+    peak = pd.DataFrame([
+        {"state_abbr": "AA", "peak_demand_mw_2014": 2000.0},
+        {"state_abbr": "BB", "peak_demand_mw_2014": 1000.0},
+    ])
+    cf = pd.DataFrame([
+        {"state_abbr": "AA", "solar_cf_during_peak_demand_period": 0.4},
+        {"state_abbr": "BB", "solar_cf_during_peak_demand_period": 0.5},
+    ])
+    mult = np.ones((len(years), len(states)), np.float32)
+    mult[4, 1] = 1.2  # BB peak demand grows 20% by 2022
+    caps = compile_state_nem_caps(limits, peak, cf, years, states, mult)
+
+    # AA: capped 5 MW only inside [2016, 2020]
+    assert caps[0, 0] == NO_CAP and caps[4, 0] == NO_CAP
+    np.testing.assert_allclose(caps[1:4, 0], 5000.0)
+    # BB: 5% x 1000 MW / 0.5 = 100 MW -> 1e5 kW; 2022 scales by 1.2
+    np.testing.assert_allclose(caps[0, 1], 1e5, rtol=1e-6)
+    np.testing.assert_allclose(caps[4, 1], 1.2e5, rtol=1e-6)
+    # CC: no limits row at all -> uncapped
+    assert np.all(caps[:, 2] == NO_CAP)
+
+
+def test_resolve_agent_nem_policy_utility_overrides_state():
+    state_rows = pd.DataFrame([
+        {"state_abbr": "AA", "sector_abbr": "res",
+         "nem_system_kw_limit": 25.0, "first_year": 2010,
+         "sunset_year": 2030},
+    ])
+    util_rows = pd.DataFrame([
+        {"eia_id": "123", "state_abbr": "AA", "sector_abbr": "res",
+         "nem_system_kw_limit": 10.0, "first_year": 2012,
+         "sunset_year": 2020},
+    ])
+    out = resolve_agent_nem_policy(
+        state_rows, util_rows,
+        agent_state=["AA", "AA", "BB"],
+        agent_sector=["res", "res", "res"],
+        agent_eia_id=["123", "999", "999"],
+    )
+    # agent 0: utility row wins (limit 10, window 2012-2020)
+    assert out["nem_kw_limit"][0] == 10.0
+    assert out["nem_first_year"][0] == 2012.0
+    assert out["nem_sunset_year"][0] == 2020.0
+    # agent 1: state row applies
+    assert out["nem_kw_limit"][1] == 25.0
+    # agent 2: no row anywhere -> limit 0 = no NEM (fillna(0) semantics)
+    assert out["nem_kw_limit"][2] == 0.0
+
+
+def _population_with_nem(n=32, **nem_fields):
+    pop = synth.generate_population(n, states=["DE"], seed=11, pad_multiple=8)
+    t = pop.table
+    import dataclasses as dc
+
+    def pad(v):
+        out = np.full(t.n_agents, v[-1], np.float32)
+        out[: len(v)] = v
+        return jnp.asarray(out)
+
+    repl = {k: pad(np.asarray(v, np.float32)) for k, v in nem_fields.items()}
+    return dc.replace(t, **repl), pop
+
+
+def test_gate_closes_midrun_by_sunset_window():
+    cfg = ScenarioConfig(name="nem", start_year=2014, end_year=2020,
+                         anchor_years=())
+    table, pop = _population_with_nem(
+        32, nem_sunset_year=[2016.0] * 32,
+    )
+    inputs = scen.uniform_inputs(cfg, n_groups=table.n_groups,
+                                 n_regions=pop.n_regions)
+    zeros = jnp.zeros(table.n_states, jnp.float32)
+    m0 = np.asarray(compute_nem_allowed(table, inputs, jnp.int32(0), zeros))
+    m2 = np.asarray(compute_nem_allowed(table, inputs, jnp.int32(2), zeros))
+    mask = np.asarray(table.mask) > 0
+    assert np.all(m0[mask] == 1.0), "window open at 2014/2016"
+    assert np.all(m2[mask] == 0.0), "window closed at 2018"
+
+
+def test_gate_closes_by_state_capacity_cap():
+    cfg = ScenarioConfig(name="nem", start_year=2014, end_year=2018,
+                         anchor_years=())
+    table, pop = _population_with_nem(32)
+    n_states = table.n_states
+    caps = np.full((3, n_states), NO_CAP, np.float32)
+    caps[1:, :] = 50.0  # tight cap from the 2nd year on
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=table.n_groups, n_regions=pop.n_regions,
+        overrides={"nem_cap_kw": jnp.asarray(caps)},
+    )
+    over = jnp.full(n_states, 100.0, jnp.float32)  # cumulative over cap
+    m = np.asarray(compute_nem_allowed(table, inputs, jnp.int32(1), over))
+    mask = np.asarray(table.mask) > 0
+    assert np.all(m[mask] == 0.0)
+    m0 = np.asarray(compute_nem_allowed(table, inputs, jnp.int32(0), over))
+    assert np.all(m0[mask] == 1.0), "no cap in year 0"
+
+
+def test_zero_limit_means_no_nem():
+    cfg = ScenarioConfig(name="nem", start_year=2014, end_year=2018,
+                         anchor_years=())
+    table, pop = _population_with_nem(32, nem_kw_limit=[0.0] * 32)
+    inputs = scen.uniform_inputs(cfg, n_groups=table.n_groups,
+                                 n_regions=pop.n_regions)
+    zeros = jnp.zeros(table.n_states, jnp.float32)
+    m = np.asarray(compute_nem_allowed(table, inputs, jnp.int32(0), zeros))
+    assert np.all(m[np.asarray(table.mask) > 0] == 0.0)
+
+
+def test_nem_kw_limit_caps_sizing_bracket():
+    """An agent with a small NEM system-kW limit sizes no larger than
+    the limit; an unlimited twin sizes bigger."""
+    cfg = ScenarioConfig(name="nem", start_year=2014, end_year=2016,
+                         anchor_years=())
+    limit = 3.0
+    t_lim, pop = _population_with_nem(32, nem_kw_limit=[limit] * 32)
+    t_free, _ = _population_with_nem(32)
+    inputs = scen.uniform_inputs(cfg, n_groups=t_lim.n_groups,
+                                 n_regions=pop.n_regions)
+    outs = {}
+    for name, tbl in (("lim", t_lim), ("free", t_free)):
+        sim = Simulation(tbl, pop.profiles, pop.tariffs, inputs, cfg,
+                         RunConfig(sizing_iters=6))
+        carry = sim.init_carry()
+        _, o = sim.step(carry, 0, first_year=True)
+        outs[name] = np.asarray(o.system_kw)
+    mask = np.asarray(t_lim.mask) > 0
+    assert np.all(outs["lim"][mask] <= limit + 1e-3)
+    assert outs["free"][mask].max() > limit * 1.5, \
+        "unlimited twin should size beyond the limit for some agents"
+
+
+def test_rate_switch_is_size_conditioned():
+    """The same population switches on the DG rate only when sized kW
+    lands inside [switch_min_kw, switch_max_kw); the one-time charge
+    applies only on switch (reference elec.py:844-860)."""
+    pop = synth.generate_population(16, states=["DE"], seed=5,
+                                    pad_multiple=8, rate_switch_frac=0.0)
+    t = pop.table
+    n = t.n_agents
+    f32 = jnp.float32
+    import dataclasses as dc
+    from dgen_tpu.ops import bill as bill_ops
+    from dgen_tpu.ops import cashflow as cf_ops
+
+    fin = jax.tree.map(lambda x: jnp.broadcast_to(x, (n,)),
+                       cf_ops.FinanceParams.example())
+    at = jax.vmap(lambda k: bill_ops.gather_tariff(pop.tariffs, k))(t.tariff_idx)
+    at_w = jax.vmap(lambda k: bill_ops.gather_tariff(pop.tariffs, k))(
+        jnp.full_like(t.tariff_idx, 6))
+
+    def envs_with(window):
+        mn, mx = window
+        return sizing.AgentEconInputs(
+            load=pop.profiles.load[t.load_idx]
+            * t.load_kwh_per_customer_in_bin[:, None],
+            gen_per_kw=pop.profiles.solar_cf[t.cf_idx],
+            ts_sell=pop.profiles.wholesale[t.region_idx],
+            tariff=at, tariff_w=at_w, fin=fin, inc=t.incentives,
+            load_kwh_per_customer=t.load_kwh_per_customer_in_bin,
+            elec_price_escalator=jnp.full(n, 0.005, f32),
+            pv_degradation=jnp.full(n, 0.005, f32),
+            system_capex_per_kw=jnp.full(n, 2500.0, f32),
+            system_capex_per_kw_combined=jnp.full(n, 2600.0, f32),
+            batt_capex_per_kwh_combined=jnp.full(n, 800.0, f32),
+            cap_cost_multiplier=jnp.ones(n, f32),
+            value_of_resiliency_usd=jnp.zeros(n, f32),
+            one_time_charge=jnp.full(n, 500.0, f32),
+            nem_kw_cap=jnp.full(n, 1e30, f32),
+            switch_min_kw=jnp.full(n, mn, f32),
+            switch_max_kw=jnp.full(n, mx, f32),
+        )
+
+    p = pop.tariffs.max_periods
+    # window covers every realistic size -> switch always on
+    r_on = sizing.size_agents(envs_with((0.0, 1e30)), n_periods=p,
+                              n_years=25, n_iters=8)
+    # window below any realistic size -> switch never applies
+    r_off = sizing.size_agents(envs_with((1e29, 1e30)), n_periods=p,
+                               n_years=25, n_iters=8)
+    mask = np.asarray(t.mask) > 0
+
+    # never-switch == plain no-switch economics (same tariff, no charge)
+    envs_plain = dc.replace(envs_with((0.0, 1e30)), tariff_w=None,
+                            one_time_charge=jnp.zeros(n, f32))
+    r_plain = sizing.size_agents(envs_plain, n_periods=p, n_years=25,
+                                 n_iters=8)
+    np.testing.assert_allclose(
+        np.asarray(r_off.npv)[mask], np.asarray(r_plain.npv)[mask],
+        rtol=1e-5, atol=1.0)
+    np.testing.assert_allclose(
+        np.asarray(r_off.first_year_bill_with_system)[mask],
+        np.asarray(r_plain.first_year_bill_with_system)[mask],
+        rtol=1e-5, atol=0.5)
+
+    # switching moves bills/npv for some agents (different rate + charge)
+    dnpv = np.abs(np.asarray(r_on.npv) - np.asarray(r_off.npv))[mask]
+    assert dnpv.max() > 100.0
+
+    # slow path agrees under a partial window (some agents in, some out)
+    med = float(np.median(np.asarray(r_plain.system_kw)[mask]))
+    envs_part = envs_with((med, 1e30))
+    rf = sizing.size_agents(envs_part, n_periods=p, n_years=25, n_iters=10,
+                            fast=True)
+    rs = sizing.size_agents(envs_part, n_periods=p, n_years=25, n_iters=10,
+                            fast=False)
+    np.testing.assert_allclose(
+        np.asarray(rf.system_kw)[mask], np.asarray(rs.system_kw)[mask],
+        rtol=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(rf.payback_period)[mask],
+        np.asarray(rs.payback_period)[mask], atol=0.35)

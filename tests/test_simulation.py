@@ -90,13 +90,81 @@ def test_sharded_matches_unsharded():
     assert mesh.devices.size == 8, "conftest should provide 8 CPU devices"
     sim_s, pop = make_sim(mesh=mesh)
     sim_u, _ = make_sim(mesh=None)
+    # the sharded sim reorders agents into state-local shards
+    assert sim_s.partition is not None
     res_s = sim_s.run()
     res_u = sim_u.run()
-    m = np.asarray(pop.table.mask)
-    s, u = res_s.summary(m), res_u.summary(m)
+    s = res_s.summary(np.asarray(sim_s.table.mask))
+    u = res_u.summary(np.asarray(sim_u.table.mask))
     np.testing.assert_allclose(s["adopters"], u["adopters"], rtol=2e-4)
     np.testing.assert_allclose(s["system_kw_cum"], u["system_kw_cum"], rtol=2e-4)
     np.testing.assert_allclose(s["batt_kwh_cum"], u["batt_kwh_cum"], rtol=2e-4)
+
+    # per-agent round trip: keyed by agent_id, the partitioned run's
+    # outputs match the unpartitioned run's
+    def by_id(sim, res):
+        keep = np.asarray(sim.table.mask) > 0
+        ids = np.asarray(sim.table.agent_id)[keep]
+        order = np.argsort(ids)
+        return ids[order], res.agent["system_kw_cum"][:, keep][:, order]
+
+    ids_s, kw_s = by_id(sim_s, res_s)
+    ids_u, kw_u = by_id(sim_u, res_u)
+    np.testing.assert_array_equal(ids_s, ids_u)
+    np.testing.assert_allclose(kw_s, kw_u, rtol=5e-4, atol=1e-3)
+
+
+def test_partition_states_are_shard_local():
+    from dgen_tpu.parallel.partition import partition_by_state
+
+    rng = np.random.default_rng(3)
+    state_idx = rng.integers(0, 7, 500)
+    part = partition_by_state(state_idx, 7, 4, pad_multiple=8)
+    # every state's agents land on exactly one device
+    dev = part.device_of_state[state_idx[part.order]]
+    starts = np.concatenate([[0], np.cumsum(part.shard_sizes)])
+    for d in range(4):
+        seg = dev[starts[d]:starts[d + 1]]
+        assert np.all(seg == d)
+
+
+def test_invariant_harness_catches_corruption():
+    from dgen_tpu.utils.invariants import InvariantViolation
+
+    sim, pop = make_sim(end_year=2016)
+    sim.run_config = RunConfig(sizing_iters=8, debug_invariants=True)
+    res = sim.run()  # clean run passes the harness
+    assert res.agent
+
+    # corrupt the carry mid-run: NaN batt cumulative must raise
+    carry = sim.init_carry()
+    carry, _ = sim.step(carry, 0, first_year=True)
+    import dataclasses as dc
+
+    bad = dc.replace(
+        carry, batt_adopters_cum=carry.batt_adopters_cum.at[0].set(jnp.nan)
+    )
+    from dgen_tpu.utils import invariants
+
+    with pytest.raises(InvariantViolation):
+        invariants.check_finite(bad, context="corrupted carry")
+    # and a schema change must be caught by check_transform
+    with pytest.raises(InvariantViolation):
+        invariants.check_transform(
+            carry, {"not": "a carry"}, context="schema"
+        )
+
+
+def test_timing_report_collects_year_steps():
+    from dgen_tpu.utils import timing
+
+    timing.reset_timings()
+    sim, _ = make_sim(end_year=2016)
+    sim.run()
+    rep = timing.timing_report()
+    assert "year_step" in rep
+    assert rep["year_step"]["count"] == len(sim.years)
+    assert rep["year_step"]["total"] > 0
 
 
 def test_anchoring_rescales_to_observed():
@@ -154,3 +222,33 @@ def test_carry_zeros_shape():
     c = SimCarry.zeros(64)
     assert c.market.market_share.shape == (64,)
     assert c.batt_adopters_cum.shape == (64,)
+
+
+def test_escalator_reference_semantics():
+    """Pinned values for the reference's escalator rule
+    (agent_mutation/elec.py:63-79): CAGR from min(year, 2040) to the
+    final trajectory year, clipped to +/-1%/yr."""
+    years = np.asarray([2014, 2016, 2018])
+    mult = np.asarray([1.0, 1.01, 1.02], np.float32)[:, None]
+    esc = scen.escalator_from_multipliers(mult, years)
+    # 2014: (1.02/1.00)^(1/4) - 1
+    assert esc[0, 0] == pytest.approx(1.02 ** 0.25 - 1.0, rel=1e-4)
+    # 2016: (1.02/1.01)^(1/2) - 1
+    assert esc[1, 0] == pytest.approx((1.02 / 1.01) ** 0.5 - 1.0, rel=1e-4)
+    # final year: zero-span guard -> 0
+    assert esc[2, 0] == pytest.approx(0.0, abs=1e-7)
+
+    # steep growth clips at +1%/yr; decline clips at -1%/yr
+    up = scen.escalator_from_multipliers(
+        np.asarray([1.0, 1.1, 1.21], np.float32)[:, None], years)
+    assert up[0, 0] == pytest.approx(0.01)
+    dn = scen.escalator_from_multipliers(
+        np.asarray([1.0, 0.9, 0.8], np.float32)[:, None], years)
+    assert dn[0, 0] == pytest.approx(-0.01)
+
+    # beyond the 2040 cap the escalator freezes at the 2040 value
+    years2 = np.asarray([2038, 2040, 2042, 2044])
+    mult2 = np.asarray([1.0, 1.004, 1.008, 1.012], np.float32)[:, None]
+    esc2 = scen.escalator_from_multipliers(mult2, years2)
+    assert esc2[2, 0] == pytest.approx(esc2[1, 0])
+    assert esc2[3, 0] == pytest.approx(esc2[1, 0])

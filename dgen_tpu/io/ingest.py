@@ -98,6 +98,8 @@ def load_depreciation_schedules(
         vals = np.asarray([float(r.get(c, 0.0) or 0.0) for c in frac_cols],
                           dtype=np.float32)
         by_sector.setdefault(sec, {})[int(float(r["year"]))] = vals
+    if not by_sector:
+        raise ValueError(f"no depreciation schedule rows in {path}")
     fallback = by_sector.get("com") or next(iter(by_sector.values()))
     out = np.zeros((len(model_years), len(SECTORS), n_frac), np.float32)
     for si, sec in enumerate(SECTORS):
@@ -105,16 +107,19 @@ def load_depreciation_schedules(
         years_avail = np.asarray(sorted(sched))
         vals = np.stack([sched[y] for y in sorted(sched)])
         out[:, si, :] = _year_grid_interp(years_avail, vals, model_years)
-    # every schedule must distribute ~the full basis; files in other
-    # semantics (e.g. the reference's deprec_sch_FY24.csv rows are
+    # every schedule must distribute ~the full basis or none of it (the
+    # reference ships all-zero res rows = no depreciation); files in
+    # other semantics (e.g. the reference's deprec_sch_FY24.csv rows are
     # remaining-basis factors summing to ~4.9) would silently multiply
     # depreciation several-fold
     sums = out.sum(axis=-1)
-    if np.any(np.abs(sums - 1.0) > 0.05):
+    bad = (np.abs(sums - 1.0) > 0.05) & (np.abs(sums) > 0.05)
+    if np.any(bad):
         raise ValueError(
             f"depreciation schedule rows in {path} sum to "
-            f"{float(sums.min()):.3f}..{float(sums.max()):.3f}, expected "
-            "~1.0 (year-fraction schedule); refusing to ingest"
+            f"{float(sums[bad].min()):.3f}..{float(sums[bad].max()):.3f}, "
+            "expected ~1.0 (year-fraction schedule) or 0 (no "
+            "depreciation); refusing to ingest"
         )
     return out
 

@@ -139,6 +139,14 @@ def resolve_agent_nem_policy(
     first = np.zeros(n, dtype=np.float32)
     sunset = np.full(n, 9999.0, dtype=np.float32)
 
+    def norm_id(v) -> str:
+        # eia ids arrive as int64 from CSVs but float64 ('1234.0') from
+        # NaN-bearing pickle columns; normalize so they match
+        try:
+            return str(int(float(v)))
+        except (TypeError, ValueError):
+            return str(v)
+
     def index_rows(df, keys):
         out = {}
         if df is None or len(df) == 0:
@@ -147,7 +155,10 @@ def resolve_agent_nem_policy(
         fy = _num(df, "first_year").fillna(-np.inf)
         sy = _num(df, "sunset_year").fillna(np.inf)
         for i, row in df.iterrows():
-            k = tuple(str(row[c]) for c in keys)
+            k = tuple(
+                norm_id(row[c]) if c == "eia_id" else str(row[c])
+                for c in keys
+            )
             # first row wins, matching the reference's drop_duplicates
             # (elec.py:101-102)
             out.setdefault(k, (float(lim[i]), float(fy[i]), float(sy[i])))
@@ -162,7 +173,8 @@ def resolve_agent_nem_policy(
         hit = None
         if agent_eia_id is not None and util_rows:
             hit = util_rows.get(
-                (str(agent_eia_id[i]), str(agent_sector[i]), str(agent_state[i]))
+                (norm_id(agent_eia_id[i]), str(agent_sector[i]),
+                 str(agent_state[i]))
             )
         if hit is None:
             hit = state_rows.get((str(agent_state[i]), str(agent_sector[i])))

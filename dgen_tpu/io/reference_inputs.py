@@ -229,6 +229,17 @@ def scenario_inputs_from_reference(
             ov["batt_capex_per_kwh_combined"] = jnp.asarray(
                 pb["batt_capex_per_kwh_combined"])
 
+    # --- ITC schedule: an itc_schedule.csv in the input root (columns
+    # itc_fraction_res/com/ind by year — the workbook's itc_options
+    # analogue, reference elec.py:348) wins; otherwise the statutory
+    # federal schedule ---
+    itc_path = os.path.join(input_root, "itc_schedule.csv")
+    if os.path.exists(itc_path):
+        ov["itc_fraction"] = jnp.asarray(ingest.load_stacked_sectors(
+            itc_path, "itc_fraction", years))
+    else:
+        ov["itc_fraction"] = jnp.asarray(scen.federal_itc_schedule(years))
+
     # --- financing ---
     if "financing" in files:
         fin = ingest.load_financing_terms(files["financing"], years)

@@ -159,6 +159,34 @@ def apply_year(
     )
 
 
+def federal_itc_schedule(years: Sequence[int]) -> np.ndarray:
+    """[Y, 3] statutory federal ITC fractions for host-owned systems.
+
+    The reference reads ITC options from its scenario workbook
+    (``itc_options`` merged at agent_mutation/elec.py:348
+    ``apply_financial_params``); absent a workbook this is the
+    residential/commercial statute the workbook encodes: 30% through
+    2019, 26% 2020-21, 30% 2022-2032 (IRA), 26% 2033, 22% 2034, then
+    0% res / 10% com+ind.
+    """
+    out = np.zeros((len(years), len(SECTORS)), dtype=np.float32)
+    for i, y in enumerate(years):
+        if y <= 2019:
+            frac = (0.30, 0.30, 0.30)
+        elif y <= 2021:
+            frac = (0.26, 0.26, 0.26)
+        elif y <= 2032:
+            frac = (0.30, 0.30, 0.30)
+        elif y == 2033:
+            frac = (0.26, 0.26, 0.26)
+        elif y == 2034:
+            frac = (0.22, 0.22, 0.22)
+        else:
+            frac = (0.0, 0.10, 0.10)
+        out[i] = frac
+    return out
+
+
 def escalator_from_multipliers(mult: np.ndarray, years: np.ndarray,
                                year_cap: int = 2040,
                                clip: float = 0.01) -> np.ndarray:

@@ -209,7 +209,11 @@ def compute_nem_allowed(
     cap_gate = (state_kw_last < cap)[table.state_idx]
     yr = inputs.years[year_idx]
     window = (table.nem_first_year <= yr) & (yr <= table.nem_sunset_year)
-    return (cap_gate & window & (table.nem_kw_limit > 0)).astype(jnp.float32)
+    # agents with a DG-rate switch keep NEM regardless of the gates —
+    # the reference overrides their limit to 1e6 on switch (elec.py:852)
+    has_switch = table.switch_min_kw < 1e29
+    gated = cap_gate & window & (table.nem_kw_limit > 0)
+    return (gated | has_switch).astype(jnp.float32)
 
 
 @partial(

@@ -332,12 +332,31 @@ def sell_rate_hourly(tariff, ts_sell: jax.Array) -> jax.Array:
 
 
 def _tier_charge_batched(sums_mp, tariff):
-    """[N, R, 12, P] monthly sums -> [N, R] annual tiered charges."""
-    return jax.vmap(  # over agents
-        lambda s_ry, p, c: jax.vmap(  # over scales
-            lambda s_m: jnp.sum(tiered_charge(s_m, p, c))
-        )(s_ry)
-    )(sums_mp, tariff.price, tariff.tier_cap)
+    """[N, R, 12, P] monthly sums -> [N, R] annual tiered charges.
+
+    Same semantics as ``bill.tiered_charge`` but written as a static
+    loop over the (small) tier axis so the largest intermediate stays
+    [N, R, 12, P] — the vmap-of-vmap formulation materializes an extra
+    T axis ([N, R, 12, P, T]), several GB at 16k+ agents x 250 scales,
+    and HBM pressure there is what capped population scaling.
+    """
+    price = tariff.price          # [N, P, T]
+    caps = tariff.tier_cap        # [N, T]
+    n_tiers = price.shape[-1]
+    lower = jnp.concatenate(
+        [jnp.zeros_like(caps[:, :1]), caps[:, :-1]], axis=1
+    )                             # [N, T]
+    width = caps - lower
+    total = jnp.zeros(sums_mp.shape[:2], dtype=sums_mp.dtype)   # [N, R]
+    for t in range(n_tiers):
+        lo = lower[:, t][:, None, None, None]
+        seg = jnp.clip(sums_mp - lo, 0.0, width[:, t][:, None, None, None])
+        total = total + jnp.einsum("nrmp,np->nr", seg, price[:, :, t])
+    # negative (net-metered export) months credit at tier-1 price
+    total = total + jnp.einsum(
+        "nrmp,np->nr", jnp.minimum(sums_mp, 0.0), price[:, :, 0]
+    )
+    return total
 
 
 def bills_from_sums(

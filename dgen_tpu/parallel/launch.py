@@ -1,0 +1,166 @@
+"""Scale-out launch harness: the TPU-native analogue of the reference's
+cluster orchestration layer (SURVEY.md §2.6 L7).
+
+The reference shards national runs by STATE across GCP Batch tasks:
+``submit_all.sh`` submits four size-binned jobs
+(state_input_csvs/{small,mid,mid_large,large}_states.csv on
+c2d-highcpu-8/16/32), each task picks its state via ``BATCH_TASK_INDEX``
+(batch_job_yamls/dgen-batch-job-small-states.yaml:47-56) and the tasks
+never talk to each other — Postgres is the only shared surface.
+
+The TPU equivalents here:
+
+  * ``bin_states`` — the same size-binned grouping, used either to
+    launch one process per bin (:func:`shard_commands`) or to feed the
+    in-process state-local partitioner (parallel.partition).
+  * ``initialize_multihost`` — jax.distributed bring-up for multi-host
+    / multi-slice meshes: every host calls it, gets the global device
+    set, and the SAME single-axis agent mesh (parallel.mesh) spans ICI
+    within a slice and DCN across slices; XLA routes the (tiny)
+    state-aggregation psums accordingly. This replaces the reference's
+    no-comms design with real collectives, and is exercised on
+    single-host by the 8-device virtual mesh tests.
+  * ``shard_commands`` — emits the per-task env/command lines (the
+    ``BATCH_TASK_INDEX`` analogue ``DGEN_SHARD_INDEX``) for operators
+    who prefer the reference's share-nothing process-per-bin model,
+    e.g. one v5e-8 slice per size bin.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class StateBins:
+    """Size-binned state groups (largest states in the last bin)."""
+
+    bins: List[List[str]]
+
+    def flat(self) -> List[str]:
+        return [s for b in self.bins for s in b]
+
+
+def bin_states(
+    state_sizes: Dict[str, float],
+    n_bins: int = 4,
+) -> StateBins:
+    """Greedy size-binning of states, the reference's
+    small/mid/mid_large/large split (state_input_csvs/) generalized:
+    states sorted by size are dealt into ``n_bins`` quantile groups so
+    each bin's tasks have comparable runtimes on one machine shape."""
+    if not state_sizes:
+        return StateBins(bins=[[] for _ in range(n_bins)])
+    names = sorted(state_sizes, key=lambda s: state_sizes[s])
+    splits = np.array_split(np.asarray(names, dtype=object), n_bins)
+    return StateBins(bins=[list(map(str, s)) for s in splits])
+
+
+def shard_commands(
+    bins: StateBins,
+    entry: str = "python -m dgen_tpu.parallel.launch",
+) -> List[str]:
+    """Per-bin launch lines (the ``submit_all.sh`` analogue): each
+    carries its shard index and comma-joined state list via env."""
+    out = []
+    for i, states in enumerate(bins.bins):
+        if not states:
+            continue
+        out.append(
+            f"DGEN_SHARD_INDEX={i} DGEN_SHARD_STATES={','.join(states)} "
+            f"{entry}"
+        )
+    return out
+
+
+def initialize_multihost(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bring up jax.distributed for a multi-host / multi-slice run.
+
+    Reads ``DGEN_COORDINATOR`` (host:port), ``DGEN_NUM_PROCESSES`` and
+    ``DGEN_PROCESS_ID`` when args are omitted — the operator-supplied
+    analogue of GCP Batch's injected task env
+    (batch_job_yamls/...:11-25). Returns True when distributed mode was
+    initialized; False (single-process) when no coordinator is
+    configured. After initialization ``jax.devices()`` is the GLOBAL
+    device set, so ``parallel.mesh.make_mesh()`` spans every slice —
+    collectives ride ICI within a slice and DCN across.
+    """
+    coordinator = coordinator or os.environ.get("DGEN_COORDINATOR")
+    if not coordinator:
+        return False
+    num_processes = int(
+        num_processes if num_processes is not None
+        else os.environ["DGEN_NUM_PROCESSES"]
+    )
+    process_id = int(
+        process_id if process_id is not None
+        else os.environ["DGEN_PROCESS_ID"]
+    )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def shard_states_from_env() -> Optional[List[str]]:
+    """The per-task state list, if launched via :func:`shard_commands`."""
+    raw = os.environ.get("DGEN_SHARD_STATES")
+    return [s for s in raw.split(",") if s] if raw else None
+
+
+def run_with_recovery(sim, checkpoint_dir: str, max_retries: int = 3,
+                      **run_kwargs):
+    """Run a Simulation with crash recovery: the analogue of the
+    reference's GCP Batch ``maxRetryCount: 3`` + SPOT re-runs
+    (batch_job_yamls/...:10, submit_all.sh:15) — except a re-run here
+    resumes from the last per-year orbax checkpoint instead of
+    restarting the whole state task from scratch (the reference re-runs
+    the entire task and relies on a fresh output schema for
+    idempotency, data_functions.py:158).
+    """
+    from dgen_tpu.io import checkpoint as ckpt
+
+    user_resume = run_kwargs.pop("resume", None)
+
+    def should_resume(attempt: int) -> bool:
+        if attempt > 0:
+            return True
+        if user_resume is not None:
+            return bool(user_resume)
+        # fresh process after a preemption: resume iff checkpoints exist
+        try:
+            return ckpt.latest_year(checkpoint_dir) is not None
+        except (FileNotFoundError, OSError):
+            return False
+
+    last_err = None
+    for attempt in range(max_retries + 1):
+        try:
+            return sim.run(
+                checkpoint_dir=checkpoint_dir,
+                resume=should_resume(attempt),
+                **run_kwargs,
+            )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 - recovery boundary
+            last_err = e
+            import logging
+
+            logging.getLogger("dgen_tpu").warning(
+                "run attempt %d/%d failed: %s", attempt + 1,
+                max_retries + 1, e,
+            )
+    raise last_err

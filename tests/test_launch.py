@@ -1,0 +1,104 @@
+"""Launch harness: state binning, shard command emission, env plumbing,
+and the federal ITC schedule (cluster-orchestration analogues,
+SURVEY.md §2.6 L7)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dgen_tpu.models.scenario import federal_itc_schedule
+from dgen_tpu.parallel.launch import (
+    bin_states,
+    initialize_multihost,
+    shard_commands,
+    shard_states_from_env,
+)
+
+
+def test_bin_states_size_ordering():
+    sizes = {"CA": 5000, "TX": 4000, "NY": 3000, "DE": 100, "VT": 50,
+             "RI": 60, "WY": 40, "FL": 2500}
+    bins = bin_states(sizes, n_bins=4)
+    assert len(bins.bins) == 4
+    assert sorted(bins.flat()) == sorted(sizes)
+    # biggest states land in the last bin (the reference's large_states
+    # bin gets the beefiest machine shape, submit_all.sh)
+    assert "CA" in bins.bins[-1]
+    assert "WY" in bins.bins[0]
+
+
+def test_shard_commands_env_round_trip(monkeypatch):
+    bins = bin_states({"CA": 10, "DE": 1, "TX": 8}, n_bins=2)
+    cmds = shard_commands(bins, entry="run")
+    assert len(cmds) == 2
+    assert all("DGEN_SHARD_INDEX=" in c and "DGEN_SHARD_STATES=" in c
+               for c in cmds)
+    # simulate the launched task's env and read the state list back
+    states_str = cmds[1].split("DGEN_SHARD_STATES=")[1].split(" ")[0]
+    monkeypatch.setenv("DGEN_SHARD_STATES", states_str)
+    got = shard_states_from_env()
+    assert got == bins.bins[1]
+
+
+def test_initialize_multihost_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("DGEN_COORDINATOR", raising=False)
+    assert initialize_multihost() is False
+
+
+def test_federal_itc_schedule_values():
+    years = [2014, 2020, 2024, 2033, 2034, 2036]
+    sch = federal_itc_schedule(years)
+    assert sch.shape == (6, 3)
+    np.testing.assert_allclose(sch[0], 0.30)
+    np.testing.assert_allclose(sch[1], 0.26)
+    np.testing.assert_allclose(sch[2], 0.30)
+    np.testing.assert_allclose(sch[3], 0.26)
+    np.testing.assert_allclose(sch[4], 0.22)
+    np.testing.assert_allclose(sch[5], [0.0, 0.10, 0.10])
+
+
+def test_run_with_recovery_resumes_after_crash(tmp_path):
+    """A mid-run crash resumes from the last checkpoint on retry
+    (the maxRetryCount analogue, but checkpoint-granular)."""
+    import jax.numpy as jnp
+
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.simulation import Simulation
+    from dgen_tpu.parallel.launch import run_with_recovery
+
+    cfg = ScenarioConfig(name="rec", start_year=2014, end_year=2020,
+                         anchor_years=())
+    pop = synth.generate_population(32, states=["DE"], seed=1, pad_multiple=8)
+    inputs = scen.uniform_inputs(cfg, n_groups=pop.table.n_groups,
+                                 n_regions=pop.n_regions)
+    sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                     RunConfig(sizing_iters=6))
+
+    calls = {"n": 0}
+    orig_step = sim.step
+
+    def flaky_step(carry, yi, first_year):
+        calls["n"] += 1
+        if calls["n"] == 3:  # die inside year 3 of attempt 1
+            raise RuntimeError("injected crash")
+        return orig_step(carry, yi, first_year)
+
+    sim.step = flaky_step
+    res = run_with_recovery(sim, str(tmp_path / "ckpt"), max_retries=2)
+    # attempt 1 ran years 1-2 then died; attempt 2 resumes after the
+    # last DURABLE checkpoint (orbax saves are async, so the year-2
+    # save may not have committed before the crash)
+    assert res.years[0] in (2016, 2018)
+    assert res.years[-1] == 2020
+
+    # clean reference run matches the recovered tail
+    sim2 = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                      RunConfig(sizing_iters=6))
+    res2 = sim2.run()
+    i = res2.years.index(res.years[0])
+    np.testing.assert_allclose(
+        res.agent["system_kw_cum"][0], res2.agent["system_kw_cum"][i],
+        rtol=1e-5)

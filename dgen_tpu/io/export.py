@@ -35,6 +35,7 @@ AGENT_OUTPUT_FIELDS = (
     "first_year_bill_with_system", "first_year_bill_without_system",
     "batt_kw", "batt_kwh", "new_batt_adopters", "batt_adopters_cum",
     "batt_kw_cum", "batt_kwh_cum",
+    "carbon_intensity_t_per_kwh", "avoided_co2_t",
 )
 
 

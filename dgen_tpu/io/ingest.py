@@ -124,6 +124,40 @@ def load_depreciation_schedules(
     return out
 
 
+def load_carbon_intensities(
+    path: str, model_years: Sequence[int], states: Sequence[str]
+) -> np.ndarray:
+    """carbon_intensities CSV (state_abbr + one column per year,
+    tCO2/kWh) -> [Y, n_states] (reference apply_carbon_intensities,
+    agent_mutation/elec.py:595, ingested via melt_year at
+    dgen_model.py:215-216)."""
+    rows = _read_csv(path)
+    st_idx = {s: i for i, s in enumerate(states)}
+    out = np.zeros((len(model_years), len(states)), np.float32)
+    seen = set()
+    for r in rows:
+        s = r.get("state_abbr", "")
+        if s not in st_idx:
+            continue
+        seen.add(s)
+        year_cols = sorted(int(c) for c in r.keys() if c.isdigit())
+        years_avail = np.asarray(year_cols)
+        vals = np.asarray([float(r[str(y)]) for y in year_cols], np.float32)
+        out[:, st_idx[s]] = _year_grid_interp(years_avail, vals, model_years)
+    missing = [s for s in states if s not in seen]
+    if missing:
+        # the reference's left-merge would surface these as NaN
+        # (elec.py:595); here they stay 0 — say so instead of silently
+        # zeroing the emissions output
+        import logging
+
+        logging.getLogger("dgen_tpu").warning(
+            "carbon_intensities: no rows for states %s (intensity 0)",
+            missing,
+        )
+    return out
+
+
 def load_financing_terms(path: str, model_years: Sequence[int]) -> Dict[str, np.ndarray]:
     """financing_terms CSV -> dict of [Y, 3] arrays (+ economic lifetime)."""
     out = {}

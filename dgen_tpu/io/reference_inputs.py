@@ -17,9 +17,15 @@ Sourced per reference table (reference file -> field):
   * load_growth/*              -> load_growth [Y, R, S]
   * elec_prices/*              -> elec_price_multiplier + escalator
   * wholesale_electricity_prices/* -> flat hourly sell-rate base [R]
+  * batt_tech_performance/*    -> batt_eff, batt_lifetime_yrs
+  * depreciation_schedules/*   -> deprec_sch [Y, S, D]
+  * carbon_intensities/*       -> carbon_intensity_t_per_kwh [Y, states]
   * installed_capacity_mw_by_state_sector.csv -> starting_kw [G]
   * observed_deployment_by_state_sector_*.csv -> observed_kw [Y, G]
   * ohm_attachment_rates.csv   -> attachment_rate [G]
+  * peak_demand_mw.csv + cf_during_peak_demand.csv (+ exported
+    nem_state_limits.csv)      -> nem_cap_kw [Y, states]
+  * itc_schedule.csv (optional) -> itc_fraction (else federal statute)
 
 Not in the reference's CSVs (they live only in its Postgres dump):
 Bass p/q/teq and the max-market-share curves — those keep the
@@ -228,6 +234,15 @@ def scenario_inputs_from_reference(
                 pb["pv_capex_per_kw_combined"])
             ov["batt_capex_per_kwh_combined"] = jnp.asarray(
                 pb["batt_capex_per_kwh_combined"])
+
+    # --- carbon intensities (elec.py:595 passthrough) ---
+    cdir = os.path.join(input_root, "carbon_intensities")
+    if os.path.isdir(cdir):
+        csvs = sorted(f for f in os.listdir(cdir) if f.endswith(".csv"))
+        if csvs:
+            ov["carbon_intensity_t_per_kwh"] = jnp.asarray(
+                ingest.load_carbon_intensities(
+                    os.path.join(cdir, csvs[-1]), years, states))
 
     # --- ITC schedule: an itc_schedule.csv in the input root (columns
     # itc_fraction_res/com/ind by year — the workbook's itc_options

@@ -83,6 +83,10 @@ class ScenarioInputs:
     # --- misc ---
     value_of_resiliency: jax.Array        # [Y, S] $ per agent
     cap_cost_multiplier: jax.Array        # [Y, S]
+    #: [Y, n_states] grid carbon intensity tCO2/kWh (reference
+    #: apply_carbon_intensities, elec.py:595) — an output passthrough
+    #: for avoided-emissions accounting
+    carbon_intensity_t_per_kwh: jax.Array
     inflation: jax.Array                  # scalar
 
     @property
@@ -287,6 +291,8 @@ def uniform_inputs(
         years=jnp.asarray(years.astype(f)),
         value_of_resiliency=yz(0.0),
         cap_cost_multiplier=yz(1.0),
+        carbon_intensity_t_per_kwh=jnp.zeros(
+            (Y, max(G // len(SECTORS), 1)), dtype=f),
         inflation=jnp.asarray(config.annual_inflation, dtype=f),
     )
     if overrides:

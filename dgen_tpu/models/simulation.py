@@ -105,6 +105,10 @@ class YearOutputs:
     batt_adopters_cum: jax.Array
     batt_kw_cum: jax.Array
     batt_kwh_cum: jax.Array
+    # avoided-emissions accounting (reference apply_carbon_intensities,
+    # elec.py:595: the intensity column rides along to agent_outputs)
+    carbon_intensity_t_per_kwh: jax.Array
+    avoided_co2_t: jax.Array              # cum fleet production x intensity
     # state-hourly aggregate (attachment_rate_functions.py:151-201);
     # shape [n_states, 8760] MW, or [0, 0] when hourly export is off
     state_hourly_net_mw: jax.Array
@@ -134,23 +138,34 @@ def build_econ_inputs(
     """
     mult = ya.elec_price_multiplier
 
-    def gather(idx):
+    def gather(idx, gate_metering=True):
         at = jax.vmap(lambda k: bill_ops.gather_tariff(tariffs, k))(idx)
+        metering = at.metering
+        if gate_metering:
+            metering = jnp.where(
+                nem_allowed > 0, at.metering,
+                jnp.full_like(at.metering, NET_BILLING),
+            )
         return at._replace(
             price=at.price * mult[:, None, None],
             sell_price=at.sell_price * mult[:, None],
-            metering=jnp.where(
-                nem_allowed > 0, at.metering,
-                jnp.full_like(at.metering, NET_BILLING),
-            ),
+            metering=metering,
         )
 
     at = gather(table.tariff_idx)
     # DG-rate switch on adoption (reference apply_rate_switch,
     # agent_mutation/elec.py:838): with-system bills price on the
-    # switched tariff. ``rate_switch`` is static (decided host-side)
-    # so no-switch populations skip the second gather entirely.
-    at_w = gather(table.tariff_switch_idx) if rate_switch else None
+    # switched tariff wherever the SIZED kW lands in the switch window
+    # (selected per candidate in ops.sizing). The switched rate keeps
+    # its own bank metering ungated — the reference forces NEM on for a
+    # taken switch (elec.py:852 sets the limit to 1e6) — while
+    # out-of-window candidates fall back to the gated original tariff.
+    # ``rate_switch`` is static (decided host-side) so no-switch
+    # populations skip the second gather entirely.
+    at_w = (
+        gather(table.tariff_switch_idx, gate_metering=False)
+        if rate_switch else None
+    )
 
     load = profiles.load[table.load_idx] * ya.load_kwh_per_customer[:, None]
     gen_per_kw = profiles.solar_cf[table.cf_idx]
@@ -209,11 +224,7 @@ def compute_nem_allowed(
     cap_gate = (state_kw_last < cap)[table.state_idx]
     yr = inputs.years[year_idx]
     window = (table.nem_first_year <= yr) & (yr <= table.nem_sunset_year)
-    # agents with a DG-rate switch keep NEM regardless of the gates —
-    # the reference overrides their limit to 1e6 on switch (elec.py:852)
-    has_switch = table.switch_min_kw < 1e29
-    gated = cap_gate & window & (table.nem_kw_limit > 0)
-    return (gated | has_switch).astype(jnp.float32)
+    return (cap_gate & window & (table.nem_kw_limit > 0)).astype(jnp.float32)
 
 
 @partial(
@@ -364,6 +375,8 @@ def year_step(
         initial_adopters=mstate.initial_adopters,
         initial_market_share=mstate.initial_market_share,
     )
+    carbon_t = inputs.carbon_intensity_t_per_kwh[year_idx][table.state_idx]
+
     new_carry = SimCarry(market=new_market, batt_adopters_cum=batt_adopters_cum)
 
     outputs = YearOutputs(
@@ -387,6 +400,8 @@ def year_step(
         batt_adopters_cum=batt_adopters_cum,
         batt_kw_cum=batt_kw_cum,
         batt_kwh_cum=batt_kwh_cum,
+        carbon_intensity_t_per_kwh=carbon_t,
+        avoided_co2_t=kw_cum * res.naep * carbon_t,
         state_hourly_net_mw=state_hourly,
     )
     return new_carry, outputs

@@ -95,6 +95,8 @@ def make_reference_frame(n=50, seed=0):
             "solar_re_9809_gid": int(100 + (i % 4)),
             "tilt": 25,
             "azimuth": "S",
+            # float-typed like real NaN-bearing pickle columns
+            "eia_id": float(500 + s),
         })
     return pd.DataFrame(rows).set_index("agent_id")
 
@@ -203,3 +205,54 @@ def test_roundtrip_runs_simulation(converted):
     kw = res.agent["system_kw_cum"]
     assert np.all(np.isfinite(kw))
     assert kw.sum() > 0.0
+
+
+def test_nem_policy_conversion(tmp_path):
+    """NEM tables resolve per agent at conversion: utility row (float
+    eia_id normalized) overrides state row; agents with no row get
+    limit 0 (elec.py:92-119 fillna semantics)."""
+    frame = make_reference_frame()
+    load_df, cf_df = make_profile_tables(frame)
+    state_nem = pd.DataFrame([
+        {"state_abbr": "DE", "sector_abbr": "res",
+         "nem_system_kw_limit": 20.0, "first_year": 2010,
+         "sunset_year": 2035},
+        {"state_abbr": "MD", "sector_abbr": "com",
+         "nem_system_kw_limit": 500.0, "first_year": 2010,
+         "sunset_year": 2030},
+    ])
+    util_nem = pd.DataFrame([
+        # int-typed id must match the pickle's float 500.0
+        {"eia_id": 500, "state_abbr": "DE", "sector_abbr": "res",
+         "nem_system_kw_limit": 5.0, "first_year": 2012,
+         "sunset_year": 2025},
+    ])
+    pop = convert.from_reference_pickle(
+        frame, str(tmp_path / "pkg"), load_df, cf_df,
+        nem_state_by_sector=state_nem, nem_utility_by_sector=util_nem,
+    )
+    t = pop.table
+    mask = np.asarray(t.mask) > 0
+    states = pop.states
+    st = np.asarray(t.state_idx)[mask]
+    sec = np.asarray(t.sector_idx)[mask]
+    lim = np.asarray(t.nem_kw_limit)[mask]
+    sun = np.asarray(t.nem_sunset_year)[mask]
+
+    de, md = states.index("DE"), states.index("MD")
+    de_res = (st == de) & (sec == 0)
+    md_com = (st == md) & (sec == 1)
+    other = ~(de_res | md_com)
+    assert de_res.any() and md_com.any() and other.any()
+    # DE res: the utility row wins (limit 5, sunset 2025)
+    np.testing.assert_allclose(lim[de_res], 5.0)
+    np.testing.assert_allclose(sun[de_res], 2025.0)
+    # MD com: state row
+    np.testing.assert_allclose(lim[md_com], 500.0)
+    # everyone else: no row -> no NEM
+    np.testing.assert_allclose(lim[other], 0.0)
+
+    # round-trips through the package format
+    pop2 = package.load_population(str(tmp_path / "pkg"), pad_multiple=8)
+    m2 = np.asarray(pop2.table.mask) > 0
+    np.testing.assert_allclose(np.asarray(pop2.table.nem_kw_limit)[m2], lim)

@@ -2,10 +2,7 @@
 and the federal ITC schedule (cluster-orchestration analogues,
 SURVEY.md §2.6 L7)."""
 
-import os
-
 import numpy as np
-import pytest
 
 from dgen_tpu.models.scenario import federal_itc_schedule
 from dgen_tpu.parallel.launch import (

@@ -185,3 +185,14 @@ def test_wholesale_hourly_shape(tmp_path):
     assert shaped[0].std() > 0.001
     np.testing.assert_allclose(shaped[0].mean(), 0.04, rtol=1e-3)
     np.testing.assert_allclose(shaped[1].mean(), 0.05, rtol=1e-3)
+
+
+def test_carbon_intensities_from_reference(ref_scenario):
+    """carbon_intensities_FY19.csv lands per state-year: AL 2014 is
+    0.0004 tCO2/kWh in the file."""
+    cfg, states, inputs, meta = ref_scenario
+    ci = np.asarray(inputs.carbon_intensity_t_per_kwh)
+    assert ci.shape == (len(cfg.model_years), len(states))
+    al = states.index("AL")
+    assert ci[0, al] == pytest.approx(0.0004, abs=1e-6)
+    assert ci.max() < 0.01 and ci.min() >= 0.0

@@ -252,3 +252,41 @@ def test_escalator_reference_semantics():
     esc2 = scen.escalator_from_multipliers(mult2, years2)
     assert esc2[2, 0] == pytest.approx(esc2[1, 0])
     assert esc2[3, 0] == pytest.approx(esc2[1, 0])
+
+
+def test_avoided_co2_outputs():
+    """Avoided CO2 = cumulative fleet production x state intensity."""
+    cfg = ScenarioConfig(name="t", start_year=2014, end_year=2018,
+                         anchor_years=())
+    pop = synth.generate_population(96, states=["DE", "CA", "TX"], seed=11,
+                                    pad_multiple=32)
+    y = len(cfg.model_years)
+    ci = np.full((y, pop.table.n_states), 4e-4, np.float32)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+        overrides={"carbon_intensity_t_per_kwh": jnp.asarray(ci)},
+    )
+    sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                     RunConfig(sizing_iters=6))
+    res = sim.run()
+    m = np.asarray(pop.table.mask) > 0
+    co2 = res.agent["avoided_co2_t"][:, m]
+    kw = res.agent["system_kw_cum"][:, m]
+    assert np.all(co2 >= 0)
+    has_cap = kw > 0
+    assert np.all((co2 > 0) == has_cap)
+    np.testing.assert_allclose(
+        np.asarray(res.agent["carbon_intensity_t_per_kwh"])[:, m], 4e-4,
+        rtol=1e-6)
+    # co2 = kw_cum * naep * intensity, with naep a per-agent constant
+    # (annual kWh per kW, set by the agent's CF profile): the implied
+    # naep must be constant across years per agent and physically sane
+    with np.errstate(divide="ignore", invalid="ignore"):
+        naep = co2 / (kw * 4e-4)
+    valid = has_cap.all(axis=0)  # agents with capacity every year
+    assert valid.any()
+    naep_v = naep[:, valid]
+    # rtol covers f32 round-trip noise in co2 = kw * naep * ci
+    np.testing.assert_allclose(
+        naep_v, np.broadcast_to(naep_v[0], naep_v.shape), rtol=5e-3)
+    assert np.all((naep_v[0] > 500.0) & (naep_v[0] < 3000.0))

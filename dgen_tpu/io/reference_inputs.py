@@ -95,20 +95,43 @@ def load_starting_capacities(
     return out
 
 
-def load_wholesale_base(
-    path: str, base_year: int
-) -> Tuple[List[str], np.ndarray]:
-    """wholesale CSV (ba, <year columns>) -> (ba names, $/kWh at the
-    base year). The reference feeds annual wholesale prices as the
-    net-billing sell rate (financial_functions.py:182,372)."""
+def load_wholesale(
+    path: str, model_years: Sequence[int], base_year: int
+) -> Tuple[List[str], np.ndarray, np.ndarray]:
+    """(ba names, base $/kWh [n_bas], multiplier [Y, n_bas]) from one
+    parse of the wholesale CSV (ba, <year columns>).
+
+    The reference feeds annual wholesale prices as the net-billing sell
+    rate, re-merged every model year (financial_functions.py:182,372;
+    apply_wholesale_elec_prices elec.py:608). Here the base-year level
+    seeds the profile bank and the multiplier (1.0 at base) rescales it
+    per model year.
+    """
     rows = _read_csv(path)
-    bas, vals = [], []
-    for r in rows:
+    bas: List[str] = []
+    base_vals = np.zeros(len(rows), np.float32)
+    mult = np.ones((len(model_years), len(rows)), np.float32)
+    for bi, r in enumerate(rows):
         bas.append(r["ba"])
         years = sorted(int(c) for c in r.keys() if c.isdigit())
         pick = max([y for y in years if y <= base_year] or years[:1])
-        vals.append(float(r[str(pick)]))
-    return bas, np.asarray(vals, dtype=np.float32)
+        base = float(r[str(pick)])
+        base_vals[bi] = base
+        if base <= 0:
+            continue
+        years_avail = np.asarray(years)
+        vals = np.asarray([float(r[str(y)]) for y in years], np.float32)
+        traj = ingest._year_grid_interp(years_avail, vals, model_years)
+        mult[:, bi] = traj / base
+    return bas, base_vals, mult
+
+
+def load_wholesale_base(
+    path: str, base_year: int
+) -> Tuple[List[str], np.ndarray]:
+    """(ba names, base-year $/kWh) — see :func:`load_wholesale`."""
+    bas, base_vals, _ = load_wholesale(path, [base_year], base_year)
+    return bas, base_vals
 
 
 def wholesale_profile_bank(
@@ -187,8 +210,10 @@ def scenario_inputs_from_reference(
 
     bas: List[str] = []
     wholesale_base = np.zeros(0, np.float32)
+    wholesale_traj = None
     if wholesale_path:
-        bas, wholesale_base = load_wholesale_base(wholesale_path, config.start_year)
+        bas, wholesale_base, wholesale_traj = load_wholesale(
+            wholesale_path, years, config.start_year)
 
     if region_kind == "census_division":
         regions = list(CENSUS_DIVISIONS)
@@ -234,6 +259,15 @@ def scenario_inputs_from_reference(
                 pb["pv_capex_per_kw_combined"])
             ov["batt_capex_per_kwh_combined"] = jnp.asarray(
                 pb["batt_capex_per_kwh_combined"])
+
+    # --- wholesale trajectory -> per-year sell-rate multiplier ---
+    if wholesale_traj is not None and len(bas):
+        if region_kind == "ba":
+            ov["wholesale_multiplier"] = jnp.asarray(wholesale_traj)
+        else:
+            ov["wholesale_multiplier"] = jnp.asarray(np.broadcast_to(
+                wholesale_traj.mean(axis=1, keepdims=True),
+                (len(years), n_regions)).copy())
 
     # --- carbon intensities (elec.py:595 passthrough) ---
     cdir = os.path.join(input_root, "carbon_intensities")

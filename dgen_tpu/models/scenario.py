@@ -49,6 +49,11 @@ class ScenarioInputs:
     load_growth: jax.Array                # [Y, R, S] multiplier vs base year
     elec_price_multiplier: jax.Array      # [Y, R, S] retail price vs base year
     elec_price_escalator: jax.Array       # [Y, R, S] forward CAGR (clipped ±1%/yr)
+    #: [Y, R] wholesale price trajectory relative to the base-year
+    #: profile bank (the reference merges wholesale $/kWh per YEAR,
+    #: apply_wholesale_elec_prices elec.py:608; the hourly shape lives
+    #: in ProfileBank.wholesale, this scales it per model year)
+    wholesale_multiplier: jax.Array
     # --- financing (financing_terms + itc schedule) ---
     loan_term_yrs: jax.Array              # [Y, S] int32
     loan_interest_rate: jax.Array         # [Y, S]
@@ -108,6 +113,7 @@ class YearAgentInputs:
     elec_price_escalator: jax.Array
     pv_degradation: jax.Array
     batt_rt_eff: jax.Array
+    wholesale_multiplier: jax.Array
     system_capex_per_kw: jax.Array
     system_capex_per_kw_combined: jax.Array
     batt_capex_per_kwh_combined: jax.Array
@@ -154,6 +160,7 @@ def apply_year(
         elec_price_escalator=inputs.elec_price_escalator[year_idx, r, s],
         pv_degradation=inputs.pv_degradation[year_idx, s],
         batt_rt_eff=inputs.batt_eff[year_idx, s],
+        wholesale_multiplier=inputs.wholesale_multiplier[year_idx, r],
         system_capex_per_kw=inputs.pv_capex_per_kw[year_idx, s],
         system_capex_per_kw_combined=inputs.pv_capex_per_kw_combined[year_idx, s],
         batt_capex_per_kwh_combined=inputs.batt_capex_per_kwh_combined[year_idx, s],
@@ -223,14 +230,18 @@ def uniform_inputs(
     n_groups: int,
     n_regions: int,
     overrides: Dict[str, object] | None = None,
+    n_states: int | None = None,
 ) -> ScenarioInputs:
     """Build flat/constant scenario inputs (testing + synthetic runs).
 
     Values default to the reference's shipped mid-case trajectories'
-    rough magnitudes; every field can be overridden.
+    rough magnitudes; every field can be overridden. ``n_states``
+    defaults to ``n_groups // len(SECTORS)`` (the AgentTable group
+    layout); pass it explicitly for populations that deviate.
     """
     years = np.asarray(config.model_years)
     Y, S, G, R = len(years), len(config.sectors), n_groups, n_regions
+    n_st = n_states if n_states is not None else max(G // len(SECTORS), 1)
     f = np.float32
 
     def yz(v):
@@ -266,6 +277,7 @@ def uniform_inputs(
         load_growth=jnp.ones((Y, R, S), dtype=f),
         elec_price_multiplier=jnp.ones((Y, R, S), dtype=f),
         elec_price_escalator=jnp.zeros((Y, R, S), dtype=f),
+        wholesale_multiplier=jnp.ones((Y, R), dtype=f),
         loan_term_yrs=jnp.full((Y, S), 20, dtype=jnp.int32),
         loan_interest_rate=yz(0.05),
         down_payment_fraction=yz(1.0),
@@ -285,14 +297,11 @@ def uniform_inputs(
         starting_batt_kwh=jnp.zeros(G, dtype=f),
         anchor_years_mask=jnp.asarray(anchor_mask),
         observed_kw=jnp.zeros((Y, G), dtype=f),
-        # group layout is always state x len(SECTORS) (AgentTable.group_idx),
-        # regardless of which sectors the scenario enables
-        nem_cap_kw=jnp.full((Y, max(G // len(SECTORS), 1)), 1e30, dtype=f),
+        nem_cap_kw=jnp.full((Y, n_st), 1e30, dtype=f),
         years=jnp.asarray(years.astype(f)),
         value_of_resiliency=yz(0.0),
         cap_cost_multiplier=yz(1.0),
-        carbon_intensity_t_per_kwh=jnp.zeros(
-            (Y, max(G // len(SECTORS), 1)), dtype=f),
+        carbon_intensity_t_per_kwh=jnp.zeros((Y, n_st), dtype=f),
         inflation=jnp.asarray(config.annual_inflation, dtype=f),
     )
     if overrides:

@@ -169,9 +169,13 @@ def build_econ_inputs(
 
     load = profiles.load[table.load_idx] * ya.load_kwh_per_customer[:, None]
     gen_per_kw = profiles.solar_cf[table.cf_idx]
-    # Net-billing sell rate = wholesale price x retail multiplier
-    # (reference financial_functions.py:182).
-    ts_sell = profiles.wholesale[table.region_idx] * mult[:, None]
+    # Net-billing sell rate = this year's wholesale price x retail
+    # multiplier (reference financial_functions.py:182; wholesale
+    # itself is merged per year, elec.py:608)
+    ts_sell = (
+        profiles.wholesale[table.region_idx]
+        * (mult * ya.wholesale_multiplier)[:, None]
+    )
 
     # NEM system-size limit caps the sizing bracket while NEM is active;
     # agents with a DG-rate switch are exempt — the switch forces NEM on

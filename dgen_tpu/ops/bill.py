@@ -18,8 +18,10 @@ Scope = exactly the subset the reference exercises (SURVEY.md §7):
     credited hourly at either a time-series sell rate (wholesale price x
     retail multiplier, reference financial_functions.py:182) or a TOU
     sell price (the CA NEM3 0.25 x buy rule, financial_functions.py:186).
-  * Demand charges are intentionally absent: the reference globally skips
-    them (``SKIP_DEMAND_CHARGES=True``, financial_functions.py:35).
+  * Demand charges are intentionally absent from the hot loop: the
+    reference globally skips them (``SKIP_DEMAND_CHARGES=True``,
+    financial_functions.py:35). An oracle-validated TOU/flat demand
+    engine for analysis runs lives in :mod:`dgen_tpu.ops.demand`.
 
 TPU notes: the hour->month reduction is expressed as a masked matmul
 against a static [8760, 12] month one-hot so it rides the MXU instead of

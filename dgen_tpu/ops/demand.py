@@ -1,0 +1,160 @@
+"""Demand charges: TOU-window and flat monthly peak-demand billing.
+
+The reference SKIPS demand charges globally in its hot loop
+(``SKIP_DEMAND_CHARGES=True``, financial_functions.py:35,601) — so
+nothing in the adoption pipeline depends on this module — but its
+in-repo oracle implements them (tariff_functions.py:762-799: TOU-period
+and flat monthly maxima priced through ``tiered_calc_vec``), and real
+C&I tariffs carry them. This module provides the TPU-native equivalent
+for analysis runs and forward compatibility, validated against that
+oracle in tests/test_demand.py.
+
+Semantics (oracle parity):
+  * flat: the charge for each month is the tiered price of that month's
+    peak net load (kW).
+  * TOU: within each month, the peak over each demand-TOU window is
+    priced through that window's tier structure and summed.
+  * Tier pricing follows the oracle's bracket formula
+    (tariff_functions.py:679 ``tiered_calc_vec``): the bracket
+    containing the max pays ``(v - L[t-1]) * p[t] + L[t-1] * p[t-1]``
+    — identical to cumulative accumulation for <= 2 tiers, which is
+    what the corpus uses.
+
+TPU notes: monthly/window maxima are masked max-reductions over the
+static hour->month map — elementwise VPU work, not MXU; demand tariffs
+are tiny [P_d, T_d] structures so the tier step is negligible.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.ops.tariff import BIG_CAP, HOURS, MONTHS, hour_month_map
+
+_HOUR_MONTH = jnp.asarray(hour_month_map())
+NEG = -1e30
+
+
+class DemandTariff(NamedTuple):
+    """Dense demand-charge structure for one agent (vmap for many)."""
+
+    flat_price: jax.Array    # [12, T] $/kW for the monthly peak (seasonal)
+    flat_cap: jax.Array      # [12, T] kW tier caps (BIG_CAP = unbounded)
+    tou_price: jax.Array     # [P, T] $/kW per demand-TOU window
+    tou_cap: jax.Array       # [P, T] kW tier caps
+    hour_window: jax.Array   # [8760] int32 demand-TOU window per hour
+
+    @staticmethod
+    def zeros(n_windows: int = 1, n_tiers: int = 1) -> "DemandTariff":
+        return DemandTariff(
+            flat_price=jnp.zeros((MONTHS, n_tiers), jnp.float32),
+            flat_cap=jnp.full((MONTHS, n_tiers), BIG_CAP, jnp.float32),
+            tou_price=jnp.zeros((n_windows, n_tiers), jnp.float32),
+            tou_cap=jnp.full((n_windows, n_tiers), BIG_CAP, jnp.float32),
+            hour_window=jnp.zeros(HOURS, jnp.int32),
+        )
+
+
+def _bracket_charge(v: jax.Array, caps: jax.Array, price: jax.Array) -> jax.Array:
+    """Oracle tier formula (tariff_functions.py:679) for a scalar-per-
+    month demand value ``v`` [...]: price of the bracket containing v.
+
+    ``caps``/``price`` [..., T] broadcast against v[..., None].
+    """
+    t_count = price.shape[-1]
+    lower = jnp.concatenate(
+        [jnp.zeros_like(caps[..., :1]), caps[..., :-1]], axis=-1
+    )
+    vx = v[..., None]
+    in_bracket = (vx >= lower) & (vx < caps)
+    # bracket t pays (v - L[t-1]) * p[t] + L[t-1] * p[t-1]
+    prev_price = jnp.concatenate(
+        [price[..., :1], price[..., :-1]], axis=-1
+    )
+    per_tier = (vx - lower) * price + lower * jnp.where(
+        jnp.arange(t_count) == 0, 0.0, prev_price
+    )
+    return jnp.sum(jnp.where(in_bracket, per_tier, 0.0), axis=-1)
+
+
+def monthly_peaks(net_load: jax.Array, window: jax.Array,
+                  n_windows: int) -> tuple[jax.Array, jax.Array]:
+    """(flat [12], tou [12, P]) monthly peak net load (kW).
+
+    Masked max over the static hour->month map; negative demand (net
+    export hours) floors at 0, matching the oracle's load-distributed
+    max over a boolean matrix of non-negative products."""
+    x = jnp.maximum(net_load, 0.0)
+    month = _HOUR_MONTH
+    m_onehot = (month[:, None] == jnp.arange(MONTHS)[None, :])   # [H, 12]
+    flat = jnp.max(jnp.where(m_onehot, x[:, None], NEG), axis=0)
+    w_onehot = (window[:, None] == jnp.arange(n_windows)[None, :])  # [H, P]
+    both = m_onehot[:, :, None] & w_onehot[:, None, :]           # [H, 12, P]
+    tou = jnp.max(jnp.where(both, x[:, None, None], NEG), axis=0)
+    return jnp.maximum(flat, 0.0), jnp.maximum(tou, 0.0)
+
+
+@jax.jit
+def annual_demand_charge(
+    net_load: jax.Array,
+    tariff: DemandTariff,
+) -> jax.Array:
+    """Annual $ of flat + TOU demand charges for one agent's [8760]
+    net load (vmap over agents). The window count comes from the
+    tariff's own [P, T] TOU shape (static under jit), so the map and
+    the price table cannot disagree."""
+    n_windows = tariff.tou_price.shape[0]
+    flat, tou = monthly_peaks(net_load, tariff.hour_window, n_windows)
+    flat_charge = _bracket_charge(flat, tariff.flat_cap, tariff.flat_price)
+    tou_charge = _bracket_charge(
+        tou, tariff.tou_cap[None, :, :], tariff.tou_price[None, :, :]
+    )
+    return jnp.sum(flat_charge) + jnp.sum(tou_charge)
+
+
+def compile_demand_tariff(
+    d_flat_prices=None,
+    d_flat_levels=None,
+    d_tou_prices=None,
+    d_tou_levels=None,
+    d_tou_8760=None,
+) -> DemandTariff:
+    """Host-side compiler from oracle-shaped inputs (tariff_functions
+    attribute conventions: ``d_flat_*`` are [T][12] tier x month,
+    ``d_tou_*`` are [T][P] tier x window, ``d_tou_8760`` the window
+    map)."""
+    def as_pt(prices, levels, p_fallback):
+        if prices is None:
+            return (np.zeros((p_fallback, 1), np.float32),
+                    np.full((p_fallback, 1), BIG_CAP, np.float32))
+        p = np.asarray(prices, np.float32).T        # [P, T]
+        if levels is None:
+            c = np.full(p.shape, BIG_CAP, np.float32)
+        else:
+            c = np.asarray(levels, np.float32).T.copy()
+            c[c <= 0] = BIG_CAP
+        return p, np.minimum(c, BIG_CAP)
+
+    tou_p, tou_c = as_pt(d_tou_prices, d_tou_levels, 1)
+    flat_p, flat_c = as_pt(d_flat_prices, d_flat_levels, MONTHS)
+    if flat_p.shape[0] == 1:  # single season -> every month
+        flat_p = np.broadcast_to(flat_p, (MONTHS, flat_p.shape[1])).copy()
+        flat_c = np.broadcast_to(flat_c, (MONTHS, flat_c.shape[1])).copy()
+    if flat_p.shape[0] != MONTHS:
+        raise ValueError(
+            f"d_flat prices cover {flat_p.shape[0]} months, expected 12"
+        )
+    hw = (np.zeros(HOURS, np.int32) if d_tou_8760 is None
+          else np.asarray(d_tou_8760, np.int32))
+    return DemandTariff(
+        flat_price=jnp.asarray(flat_p),
+        flat_cap=jnp.asarray(flat_c),
+        tou_price=jnp.asarray(tou_p),
+        tou_cap=jnp.asarray(tou_c),
+        hour_window=jnp.asarray(hw),
+    )

@@ -1,0 +1,123 @@
+"""Demand-charge engine vs the reference's in-repo oracle
+(tariff_functions.py:762-799: TOU + flat monthly-peak charges) — a
+capability the reference's hot loop skips (SKIP_DEMAND_CHARGES=True,
+financial_functions.py:35) but its bill_calculator implements."""
+
+import importlib.util
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.ops import demand as dm
+
+REF_TF = "/root/reference/dgen_os/python/tariff_functions.py"
+HOURS = 8760
+
+
+@pytest.fixture(scope="module")
+def ref_tf():
+    spec = importlib.util.spec_from_file_location("ref_tf_demand", REF_TF)
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except ImportError as e:  # pragma: no cover
+        pytest.skip(f"reference tariff_functions not importable: {e}")
+    return mod
+
+
+def _load(seed):
+    rng = np.random.default_rng(seed)
+    hod = np.arange(HOURS) % 24
+    base = 5.0 + 10.0 * np.exp(-0.5 * ((hod - 18) / 3.0) ** 2)
+    return (base * (0.7 + 0.6 * rng.random(HOURS))).astype(np.float64)
+
+
+def _oracle_bill(ref_tf, load, d_flat=None, d_tou=None):
+    """Run the oracle with ONLY demand charges active (flat 1-tier
+    energy at price 0 so e-charges vanish)."""
+    tariff = types.SimpleNamespace(
+        e_prices=np.array([[0.0]]),
+        e_levels=np.array([[1e9]]),
+        e_tou_8760=np.zeros(HOURS, int),
+        fixed_charge=0.0,
+    )
+    if d_flat is not None:
+        tariff.d_flat_prices = d_flat["prices"]
+        tariff.d_flat_levels = d_flat["levels"]
+    if d_tou is not None:
+        tariff.d_tou_prices = d_tou["prices"]
+        tariff.d_tou_levels = d_tou["levels"]
+        tariff.d_tou_8760 = d_tou["map"].copy()
+    export = ref_tf.Export_Tariff(full_retail_nem=True)
+    total, parts = ref_tf.bill_calculator(load.copy(), tariff, export)
+    return float(parts["d_charges"])
+
+
+def test_flat_demand_matches_oracle(ref_tf):
+    rng = np.random.default_rng(4)
+    for seed in range(4):
+        load = _load(seed)
+        # 2-tier seasonal flat demand (12 month columns)
+        p1 = rng.uniform(5, 15)
+        p2 = p1 * rng.uniform(1.2, 1.8)
+        cap = rng.uniform(10, 18)
+        prices = np.vstack([np.full(12, p1), np.full(12, p2)])
+        levels = np.vstack([np.full(12, cap), np.full(12, 1e9)])
+        want = _oracle_bill(ref_tf, load,
+                            d_flat={"prices": prices, "levels": levels})
+        dt = dm.compile_demand_tariff(
+            d_flat_prices=prices, d_flat_levels=levels)
+        got = float(dm.annual_demand_charge(
+            jnp.asarray(load, jnp.float32), dt))
+        assert got == pytest.approx(want, rel=2e-4, abs=0.5)
+
+
+def test_tou_demand_matches_oracle(ref_tf):
+    rng = np.random.default_rng(9)
+    hod = np.arange(HOURS) % 24
+    window_map = np.where((hod >= 16) & (hod < 21), 1, 0).astype(int)
+    for seed in range(4):
+        load = _load(seed + 10)
+        p_off = rng.uniform(1, 4)
+        p_on = rng.uniform(8, 20)
+        prices = np.array([[p_off, p_on]])          # [T=1][P=2]
+        levels = np.array([[1e9, 1e9]])
+        want = _oracle_bill(
+            ref_tf, load,
+            d_tou={"prices": prices, "levels": levels, "map": window_map})
+        dt = dm.compile_demand_tariff(
+            d_tou_prices=prices, d_tou_levels=levels,
+            d_tou_8760=window_map)
+        got = float(dm.annual_demand_charge(
+            jnp.asarray(load, jnp.float32), dt))
+        assert got == pytest.approx(want, rel=2e-4, abs=0.5)
+
+
+def test_combined_and_vmapped(ref_tf):
+    hod = np.arange(HOURS) % 24
+    window_map = np.where((hod >= 12) & (hod < 20), 1, 0).astype(int)
+    flat = {"prices": np.vstack([np.full(12, 8.0), np.full(12, 12.0)]),
+            "levels": np.vstack([np.full(12, 12.0), np.full(12, 1e9)])}
+    tou = {"prices": np.array([[2.0, 11.0]]),
+           "levels": np.array([[1e9, 1e9]]), "map": window_map}
+    loads = np.stack([_load(s + 20) for s in range(6)])
+    want = np.array([
+        _oracle_bill(ref_tf, l, d_flat=flat, d_tou=tou) for l in loads
+    ])
+    dt = dm.compile_demand_tariff(
+        d_flat_prices=flat["prices"], d_flat_levels=flat["levels"],
+        d_tou_prices=tou["prices"], d_tou_levels=tou["levels"],
+        d_tou_8760=window_map)
+    got = jax.vmap(
+        lambda l: dm.annual_demand_charge(l, dt)
+    )(jnp.asarray(loads, jnp.float32))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=1.0)
+
+
+def test_zero_tariff_is_free():
+    load = jnp.asarray(_load(1), jnp.float32)
+    assert float(dm.annual_demand_charge(
+        load, dm.DemandTariff.zeros())) == 0.0

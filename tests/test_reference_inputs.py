@@ -196,3 +196,15 @@ def test_carbon_intensities_from_reference(ref_scenario):
     al = states.index("AL")
     assert ci[0, al] == pytest.approx(0.0004, abs=1e-6)
     assert ci.max() < 0.01 and ci.min() >= 0.0
+
+
+def test_wholesale_trajectory_multiplier(ref_scenario):
+    """Wholesale sell rates vary per year (the reference merges them
+    per year, elec.py:608): multiplier is 1.0 at the base year and
+    moves with the file's trajectory."""
+    cfg, states, inputs, meta = ref_scenario
+    wm = np.asarray(inputs.wholesale_multiplier)
+    assert wm.shape == (len(cfg.model_years), len(meta["regions"]))
+    np.testing.assert_allclose(wm[0], 1.0, rtol=1e-5)
+    # the trajectory is not flat over the horizon
+    assert np.abs(wm - 1.0).max() > 0.01

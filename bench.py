@@ -28,7 +28,7 @@ Knobs (env):
   DGEN_TPU_BENCH_END      end model year             (default 2050)
   DGEN_TPU_BENCH_SKIP_CPU skip CPU baseline, use cached constant
   DGEN_TPU_BENCH_SCALE    comma list of scale points (default
-                          "8192,16384,32768"; "" disables the curve)
+                          "8192,32768"; "" disables the curve)
 """
 
 from __future__ import annotations
@@ -161,7 +161,7 @@ def _cpu_baseline(sim, pop) -> float:
 def main() -> None:
     n_agents = int(os.environ.get("DGEN_TPU_BENCH_AGENTS", "8192"))
     end_year = int(os.environ.get("DGEN_TPU_BENCH_END", "2050"))
-    scale_env = os.environ.get("DGEN_TPU_BENCH_SCALE", "8192,16384,32768")
+    scale_env = os.environ.get("DGEN_TPU_BENCH_SCALE", "8192,32768")
 
     sim, pop = _build(n_agents, end_year)
     n_real = int(np.asarray(pop.table.mask).sum())

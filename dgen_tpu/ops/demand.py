@@ -151,6 +151,11 @@ def compile_demand_tariff(
         )
     hw = (np.zeros(HOURS, np.int32) if d_tou_8760 is None
           else np.asarray(d_tou_8760, np.int32))
+    if hw.max(initial=0) >= tou_p.shape[0]:
+        raise ValueError(
+            f"d_tou_8760 references window {int(hw.max())} but the "
+            f"price table covers {tou_p.shape[0]} windows"
+        )
     return DemandTariff(
         flat_price=jnp.asarray(flat_p),
         flat_cap=jnp.asarray(flat_c),

@@ -120,6 +120,81 @@ def shard_states_from_env() -> Optional[List[str]]:
     return [s for s in raw.split(",") if s] if raw else None
 
 
+def main() -> None:
+    """Per-shard entrypoint (``python -m dgen_tpu.parallel.launch``):
+    runs a reference-input scenario for this shard's states.
+
+    Env contract (the batch_job_yamls analogue): ``DGEN_SHARD_STATES``
+    (comma list, from :func:`shard_commands`), optional
+    ``DGEN_INPUT_ROOT`` (default the reference mount),
+    ``DGEN_RUN_DIR`` (default ./runs/shard_<i>), ``DGEN_AGENTS``
+    (synthetic population size until a converted package is supplied
+    via ``DGEN_PACKAGE``), plus the multi-host vars read by
+    :func:`initialize_multihost`.
+    """
+    initialize_multihost()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.io import package as pkg
+    from dgen_tpu.io import synth
+    from dgen_tpu.io.export import RunExporter
+    from dgen_tpu.io.reference_inputs import (
+        scenario_inputs_from_reference,
+        wholesale_profile_bank,
+    )
+    from dgen_tpu.models.agents import ProfileBank
+    from dgen_tpu.models.simulation import Simulation
+    from dgen_tpu.parallel.mesh import make_mesh
+
+    shard = int(os.environ.get("DGEN_SHARD_INDEX", "0"))
+    states = shard_states_from_env() or ["DE"]
+    root = os.environ.get(
+        "DGEN_INPUT_ROOT", "/root/reference/dgen_os/input_data")
+    run_dir = os.environ.get("DGEN_RUN_DIR", f"./runs/shard_{shard}")
+
+    cfg = ScenarioConfig(name=f"shard{shard}", start_year=2014,
+                         end_year=int(os.environ.get("DGEN_END_YEAR", 2040)))
+
+    if os.environ.get("DGEN_PACKAGE"):
+        pop = pkg.load_population(os.environ["DGEN_PACKAGE"])
+        input_states = pop.states
+        inputs, meta = scenario_inputs_from_reference(
+            root, cfg, input_states)
+        profiles = pop.profiles
+    else:
+        # synthetic populations index the full state list even when only
+        # the shard's states are populated, so inputs must cover it too
+        input_states = list(synth.STATES)
+        inputs, meta = scenario_inputs_from_reference(
+            root, cfg, input_states)
+        pop = synth.generate_population(
+            int(os.environ.get("DGEN_AGENTS", "4096")), states=states,
+            seed=shard, n_regions=len(meta["regions"]),
+        )
+        profiles = ProfileBank(
+            load=pop.profiles.load, solar_cf=pop.profiles.solar_cf,
+            wholesale=jnp.asarray(wholesale_profile_bank(meta, root)),
+        )
+
+    mesh = make_mesh() if len(jax.devices()) > 1 else None
+    sim = Simulation(pop.table, profiles, pop.tariffs, inputs, cfg,
+                     RunConfig.from_env(), mesh=mesh)
+    exporter = RunExporter(
+        run_dir, agent_id=np.asarray(sim.table.agent_id),
+        mask=np.asarray(sim.table.mask), state_names=list(input_states),
+    )
+    res = run_with_recovery(
+        sim, os.path.join(run_dir, "ckpt"), callback=exporter,
+        collect=False,
+    )
+    ran = pop.states if os.environ.get("DGEN_PACKAGE") else states
+    print(f"shard {shard} ({','.join(ran)}): "
+          f"{len(res.years)} years -> {run_dir}")
+
+
 def run_with_recovery(sim, checkpoint_dir: str, max_retries: int = 3,
                       **run_kwargs):
     """Run a Simulation with crash recovery: the analogue of the
@@ -164,3 +239,7 @@ def run_with_recovery(sim, checkpoint_dir: str, max_retries: int = 3,
                 max_retries + 1, e,
             )
     raise last_err
+
+
+if __name__ == "__main__":
+    main()

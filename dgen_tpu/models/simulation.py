@@ -23,6 +23,7 @@ within one program.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from functools import partial
 from typing import Callable, Dict, List, Optional
@@ -622,14 +623,34 @@ class Simulation:
         if debug:
             from dgen_tpu.utils import invariants
 
+        # opt-in device trace (xprof/tensorboard-consumable), the
+        # device-level analogue of the reference's cProfile prof.dat
+        # (SURVEY.md §5): traces the first post-compile year step
+        profile_dir = os.environ.get("DGEN_TPU_PROFILE")
+        profiled = False
+
         for yi, year in enumerate(self.years):
             if yi < start_idx:
                 continue
             t0 = time.time()
-            with timing.timer("year_step"):
-                prev_carry = carry
-                carry, outs = self.step(carry, yi, first_year=(yi == 0))
-                jax.block_until_ready(carry.market.market_share)
+            # trace the second executed step (post-compile) — or the
+            # only step when the run has just one
+            trace_now = profile_dir and not profiled and (
+                yi == start_idx + 1
+                or (yi == start_idx and len(self.years) - start_idx == 1)
+            )
+            if trace_now:
+                jax.profiler.start_trace(profile_dir)
+            try:
+                with timing.timer("year_step"):
+                    prev_carry = carry
+                    carry, outs = self.step(carry, yi, first_year=(yi == 0))
+                    jax.block_until_ready(carry.market.market_share)
+            finally:
+                if trace_now:
+                    jax.profiler.stop_trace()
+                    profiled = True
+                    logger.info("device trace written to %s", profile_dir)
             if debug:
                 # the reference runs its dataframe invariants after
                 # every on_frame transform (agents.py:149-262); here the

@@ -151,10 +151,11 @@ def compile_demand_tariff(
         )
     hw = (np.zeros(HOURS, np.int32) if d_tou_8760 is None
           else np.asarray(d_tou_8760, np.int32))
-    if hw.max(initial=0) >= tou_p.shape[0]:
+    if hw.min(initial=0) < 0 or hw.max(initial=0) >= tou_p.shape[0]:
         raise ValueError(
-            f"d_tou_8760 references window {int(hw.max())} but the "
-            f"price table covers {tou_p.shape[0]} windows"
+            f"d_tou_8760 window ids span [{int(hw.min())}, "
+            f"{int(hw.max())}] but the price table covers "
+            f"[0, {tou_p.shape[0]}) windows"
         )
     return DemandTariff(
         flat_price=jnp.asarray(flat_p),

@@ -132,7 +132,7 @@ def main() -> None:
     via ``DGEN_PACKAGE``), plus the multi-host vars read by
     :func:`initialize_multihost`.
     """
-    initialize_multihost()
+    distributed = initialize_multihost()
 
     import jax
     import jax.numpy as jnp
@@ -182,10 +182,19 @@ def main() -> None:
     mesh = make_mesh() if len(jax.devices()) > 1 else None
     sim = Simulation(pop.table, profiles, pop.tariffs, inputs, cfg,
                      RunConfig.from_env(), mesh=mesh)
-    exporter = RunExporter(
-        run_dir, agent_id=np.asarray(sim.table.agent_id),
-        mask=np.asarray(sim.table.mask), state_names=list(input_states),
-    )
+    # per-year parquet exports fetch full arrays to host — only valid
+    # when every device is addressable from this process; multi-host
+    # runs keep the (per-process-addressable) checkpoint stream and
+    # export from a reload instead
+    exporter = None
+    if not distributed:
+        exporter = RunExporter(
+            run_dir, agent_id=np.asarray(sim.table.agent_id),
+            mask=np.asarray(sim.table.mask),
+            state_names=list(input_states),
+        )
+    else:
+        run_dir = f"{run_dir}_p{jax.process_index()}"
     res = run_with_recovery(
         sim, os.path.join(run_dir, "ckpt"), callback=exporter,
         collect=False,

@@ -182,23 +182,28 @@ def main() -> None:
     mesh = make_mesh() if len(jax.devices()) > 1 else None
     sim = Simulation(pop.table, profiles, pop.tariffs, inputs, cfg,
                      RunConfig.from_env(), mesh=mesh)
-    # per-year parquet exports fetch full arrays to host — only valid
-    # when every device is addressable from this process; multi-host
-    # runs keep the (per-process-addressable) checkpoint stream and
-    # export from a reload instead
-    exporter = None
-    if not distributed:
+    if distributed:
+        # per-year parquet exports AND orbax checkpoints both fetch
+        # full arrays to host (np.asarray on the carry), which raises
+        # for globally-sharded multi-host arrays — multi-host runs go
+        # straight through without host-side persistence for now
+        import logging
+
+        logging.getLogger("dgen_tpu").warning(
+            "multi-host run: per-year exports/checkpoints disabled "
+            "(host fetch of non-addressable shards)"
+        )
+        res = sim.run(collect=False)
+    else:
         exporter = RunExporter(
             run_dir, agent_id=np.asarray(sim.table.agent_id),
             mask=np.asarray(sim.table.mask),
             state_names=list(input_states),
         )
-    else:
-        run_dir = f"{run_dir}_p{jax.process_index()}"
-    res = run_with_recovery(
-        sim, os.path.join(run_dir, "ckpt"), callback=exporter,
-        collect=False,
-    )
+        res = run_with_recovery(
+            sim, os.path.join(run_dir, "ckpt"), callback=exporter,
+            collect=False,
+        )
     ran = pop.states if os.environ.get("DGEN_PACKAGE") else states
     print(f"shard {shard} ({','.join(ran)}): "
           f"{len(res.years)} years -> {run_dir}")

@@ -205,8 +205,9 @@ def main() -> None:
             collect=False,
         )
     ran = pop.states if os.environ.get("DGEN_PACKAGE") else states
+    dest = run_dir if not distributed else "(no host outputs: multi-host)"
     print(f"shard {shard} ({','.join(ran)}): "
-          f"{len(res.years)} years -> {run_dir}")
+          f"{len(res.years)} years -> {dest}")
 
 
 def run_with_recovery(sim, checkpoint_dir: str, max_retries: int = 3,

@@ -325,8 +325,12 @@ def hourly_bucket_ids(hour_period: jax.Array, n_periods: int) -> jax.Array:
 
 def sell_rate_hourly(tariff, ts_sell: jax.Array) -> jax.Array:
     """Hourly sell rate per agent, matching bill.annual_bill's choice:
-    the tariff's TOU sell price when defined, else the time-series rate."""
-    tou = jnp.take_along_axis(tariff.sell_price, tariff.hour_period, axis=1)
+    the tariff's TOU sell price when defined, else the time-series rate
+    (shared static period select — see ``bill.select_by_period`` for
+    why this must not be a gather)."""
+    from dgen_tpu.ops.bill import select_by_period
+
+    tou = select_by_period(tariff.hour_period, tariff.sell_price, ts_sell)
     has_tou = jnp.any(tariff.sell_price > 0.0, axis=1, keepdims=True)
     return jnp.where(has_tou, tou, ts_sell)
 

@@ -435,9 +435,9 @@ def _size_agents_fast(
         """[N, R] packed (candidate, year) scales -> with-system annual
         bills on a given tariff structure; evaluated on the switched
         tariff and, when a switch window exists, also on the original."""
-        # bf16=False: measured slower on v5e (the in-kernel casts cost
-        # more than the narrower matmul saves); revisit with a fused
-        # bf16 layout if the search matmul becomes the bottleneck again
+        # bf16=False: re-measured post-gather-fix with clean
+        # (cache-defeating) timing — step time is identical either way,
+        # so the kernel is not MXU-bound at these shapes; keep f32
         imports, imp_sell = billpallas.import_sums(
             envs.load, gen_shape, sell, bucket, scales, n_buckets, impl,
             bf16=False, mesh=mesh,

@@ -25,6 +25,13 @@ PAYBACK_GRID_STEP = 0.1
 PAYBACK_GRID_N = int(round(PAYBACK_GRID_MAX / PAYBACK_GRID_STEP)) + 1  # 302
 PAYBACK_NEVER = 30.1
 
+#: synthetic Bass-diffusion defaults (p, q, teq_yr1) used by
+#: scenario.uniform_inputs AND as the fill for state x sector groups a
+#: bass_params.csv drop-in does not cover — single source so the two
+#: cannot drift (the real curves live only in the reference's Postgres
+#: dump, data_functions.py:279)
+BASS_DEFAULTS = (0.0015, 0.35, 2.0)
+
 
 def _check(cond: bool, msg: str) -> None:
     if not cond:

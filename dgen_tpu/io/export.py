@@ -19,6 +19,7 @@ Layout:
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Dict, Optional, Sequence
 
@@ -58,6 +59,7 @@ class RunExporter:
         mask: np.ndarray,
         state_names: Optional[Sequence[str]] = None,
         finance_series: bool = True,
+        meta: Optional[Dict[str, object]] = None,
     ) -> None:
         self.run_dir = run_dir
         self.keep = np.asarray(mask) > 0
@@ -65,6 +67,12 @@ class RunExporter:
         self.state_names = list(state_names) if state_names else None
         self.finance_series = finance_series
         os.makedirs(run_dir, exist_ok=True)
+        # provenance stamp: ``meta`` (notably market_curves:
+        # synthetic_default vs ingested, from scenario ingest) is written
+        # up front so a run's outputs carry their own caveats
+        self.meta = {"n_agents": int(self.keep.sum()), **(meta or {})}
+        with open(os.path.join(run_dir, "meta.json"), "w") as f:
+            json.dump(self.meta, f, indent=2, default=str)
 
     def _check_state_names(self, n_states: int) -> None:
         if self.state_names is not None and len(self.state_names) != n_states:

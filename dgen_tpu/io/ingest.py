@@ -27,7 +27,12 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from dgen_tpu.config import SECTORS
+from dgen_tpu.config import (
+    BASS_DEFAULTS,
+    PAYBACK_GRID_N,
+    PAYBACK_GRID_STEP,
+    SECTORS,
+)
 
 
 def _read_csv(path: str) -> List[Dict[str, str]]:
@@ -293,6 +298,103 @@ def load_attachment_rates(path: str, states: Sequence[str]) -> np.ndarray:
             avg = np.nanmean(rv)
         out[i] = float(np.clip(np.nan_to_num(avg), 0.0, 1.0))
     return out
+
+
+def load_value_of_resiliency(path: str, states: Sequence[str]) -> np.ndarray:
+    """value_of_resiliency CSV -> [G] $ per agent, G = state x sector.
+
+    Schema per the reference's shipped ``vor_FY20_mid.csv``: one row per
+    (state_abbr, sector_abbr) with ``value_of_resiliency_usd`` (merged
+    onto agents by ``apply_value_of_resiliency``, agent_mutation/
+    elec.py:287 — state+sector keyed, year-independent). Missing
+    (state, sector) pairs stay 0 (the reference's left-merge NaN ->
+    the kernel's no-VOR case; residential typically has no row)."""
+    rows = _read_csv(path)
+    st_idx = {s: i for i, s in enumerate(states)}
+    sec_idx = {s: i for i, s in enumerate(SECTORS)}
+    out = np.zeros(len(states) * len(SECTORS), dtype=np.float32)
+    for r in rows:
+        st, sec = r.get("state_abbr", ""), r.get("sector_abbr", "")
+        if st in st_idx and sec in sec_idx:
+            gi = st_idx[st] * len(SECTORS) + sec_idx[sec]
+            out[gi] = float(r["value_of_resiliency_usd"])
+    return out
+
+
+def load_max_market_curves(path: str) -> np.ndarray:
+    """max_market_curves CSV -> [S, PAYBACK_GRID_N] on the 0.1-yr grid.
+
+    Schema mirrors the reference's ``max_market_curves_to_model`` view
+    (data_functions.py:392-410): ``metric_value`` (payback years),
+    ``sector_abbr``, ``max_market_share``, plus optional ``metric`` /
+    ``business_model`` filters (kept: payback_period / host_owned, the
+    rows the host-owned hot loop consumes). Curves are interpolated to
+    tenths of a year and the 30.1 never-payback sentinel is pinned to
+    exactly 0 (the reference's UNION ALL row, data_functions.py:399)."""
+    rows = _read_csv(path)
+    sec_idx = {s: i for i, s in enumerate(SECTORS)}
+    pts: Dict[int, List[tuple]] = {i: [] for i in range(len(SECTORS))}
+    for r in rows:
+        if r.get("metric", "payback_period") != "payback_period":
+            continue
+        if r.get("business_model", "host_owned") != "host_owned":
+            continue
+        sec = r.get("sector_abbr", "")
+        if sec not in sec_idx:
+            continue
+        pts[sec_idx[sec]].append(
+            (float(r["metric_value"]), float(r["max_market_share"]))
+        )
+    grid = np.arange(PAYBACK_GRID_N, dtype=np.float64) * PAYBACK_GRID_STEP
+    out = np.zeros((len(SECTORS), PAYBACK_GRID_N), dtype=np.float32)
+    for si, p in pts.items():
+        if not p:
+            raise ValueError(
+                f"{path}: no host_owned payback_period rows for sector "
+                f"{SECTORS[si]!r}"
+            )
+        p.sort()
+        xs = np.asarray([x for x, _ in p])
+        ys = np.asarray([y for _, y in p])
+        out[si] = np.interp(grid, xs, ys).astype(np.float32)
+    out[:, -1] = 0.0  # the 30.1 sentinel row (data_functions.py:399-410)
+    return out
+
+
+def load_bass_params(
+    path: str, states: Sequence[str],
+    defaults: tuple = BASS_DEFAULTS,
+) -> Dict[str, np.ndarray]:
+    """bass_params CSV -> {"bass_p", "bass_q", "teq_yr1"} each [G].
+
+    Schema mirrors the reference's ``input_solar_bass_params`` table
+    (data_functions.py:300-306): state_abbr, p, q, teq_yr1, sector_abbr
+    (+ optional ``tech``, filtered to solar when present). Groups with
+    no row keep the synthetic defaults (and are reported by the caller
+    via the returned ``missing`` count)."""
+    rows = _read_csv(path)
+    st_idx = {s: i for i, s in enumerate(states)}
+    sec_idx = {s: i for i, s in enumerate(SECTORS)}
+    g = len(states) * len(SECTORS)
+    p = np.full(g, defaults[0], dtype=np.float32)
+    q = np.full(g, defaults[1], dtype=np.float32)
+    teq = np.full(g, defaults[2], dtype=np.float32)
+    seen = np.zeros(g, dtype=bool)
+    for r in rows:
+        if r.get("tech", "solar") not in ("solar", ""):
+            continue
+        st, sec = r.get("state_abbr", ""), r.get("sector_abbr", "")
+        if st not in st_idx or sec not in sec_idx:
+            continue
+        gi = st_idx[st] * len(SECTORS) + sec_idx[sec]
+        p[gi] = float(r["p"])
+        q[gi] = float(r["q"])
+        teq[gi] = float(r["teq_yr1"])
+        seen[gi] = True
+    return {
+        "bass_p": p, "bass_q": q, "teq_yr1": teq,
+        "missing": int((~seen).sum()),
+    }
 
 
 def state_attachment_to_groups(per_state: np.ndarray, n_sectors: int = 3) -> np.ndarray:

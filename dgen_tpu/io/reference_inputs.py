@@ -26,12 +26,18 @@ Sourced per reference table (reference file -> field):
   * peak_demand_mw.csv + cf_during_peak_demand.csv (+ exported
     nem_state_limits.csv)      -> nem_cap_kw [Y, states]
   * itc_schedule.csv (optional) -> itc_fraction (else federal statute)
+  * value_of_resiliency/*      -> value_of_resiliency [Y, G]
+  * max_market_curves.csv (optional drop-in) -> mms_table
+  * bass_params.csv (optional drop-in)       -> bass_p/q, teq_yr1
 
-Not in the reference's CSVs (they live only in its Postgres dump):
-Bass p/q/teq and the max-market-share curves — those keep the
-:func:`dgen_tpu.models.scenario.uniform_inputs` defaults unless
-overridden. ITC fraction likewise comes from the scenario workbook;
-the default schedule here mirrors the federal ITC (30%).
+Bass p/q/teq and the max-market-share curves live only in the
+reference's Postgres dump, not its input_data CSVs; they are accepted
+here as exported drop-ins (``max_market_curves.csv`` /
+``bass_params.csv``, schemas mirroring data_functions.py:279,370).
+Absent those, the synthetic :func:`uniform_inputs` defaults remain and
+``meta["market_curves"]`` says so. ITC fraction likewise comes from the
+scenario workbook; the default schedule here mirrors the federal
+statute (see ``itc_schedule.csv``).
 """
 
 from __future__ import annotations
@@ -321,6 +327,49 @@ def scenario_inputs_from_reference(
         esc = scen.escalator_from_multipliers(mult, np.asarray(years))
         ov["elec_price_escalator"] = jnp.asarray(esc.astype(np.float32))
 
+    def _opt(name: str) -> Optional[str]:
+        for d in (input_root, os.path.join(input_root, os.pardir, "python")):
+            p = os.path.join(d, name)
+            if os.path.exists(p):
+                return p
+        return None
+
+    # --- value of resiliency (apply_value_of_resiliency, elec.py:287;
+    # shipped vor_FY20 CSV keys on state_abbr + sector_abbr) ---
+    vdir = os.path.join(input_root, "value_of_resiliency")
+    if os.path.isdir(vdir):
+        vcsvs = sorted(f for f in os.listdir(vdir) if f.endswith(".csv"))
+        if vcsvs:
+            vor_g = ingest.load_value_of_resiliency(
+                os.path.join(vdir, vcsvs[-1]), states)
+            ov["value_of_resiliency"] = jnp.asarray(np.broadcast_to(
+                vor_g[None, :], (len(years), g)).copy())
+
+    # --- market curves: CSV drop-ins for the reference's Postgres-only
+    # tables (max_market_curves_to_model, data_functions.py:370;
+    # input_solar_bass_params, data_functions.py:279). Absent these the
+    # synthetic uniform_inputs defaults remain — flagged in meta so run
+    # outputs cannot be mistaken for dGen adoption numbers. ---
+    market_curves = {"mms": "synthetic_default", "bass": "synthetic_default"}
+    mmc_path = _opt("max_market_curves.csv")
+    if mmc_path:
+        ov["mms_table"] = jnp.asarray(ingest.load_max_market_curves(mmc_path))
+        market_curves["mms"] = "ingested"
+    bp_path = _opt("bass_params.csv")
+    if bp_path:
+        bp = ingest.load_bass_params(bp_path, states)
+        ov["bass_p"] = jnp.asarray(bp["bass_p"])
+        ov["bass_q"] = jnp.asarray(bp["bass_q"])
+        ov["teq_yr1"] = jnp.asarray(bp["teq_yr1"])
+        market_curves["bass"] = "ingested"
+        if bp["missing"]:
+            import logging
+
+            logging.getLogger("dgen_tpu").warning(
+                "bass_params.csv: %d of %d state x sector groups have no "
+                "row (keeping synthetic defaults there)", bp["missing"], g,
+            )
+
     # --- market data ---
     if "observed" in files:
         ov["observed_kw"] = jnp.asarray(ingest.load_observed_deployment(
@@ -341,13 +390,6 @@ def scenario_inputs_from_reference(
     # dgen_model.py:253-254); the state-limits table lives in its
     # Postgres dump and is accepted here as an exported
     # nem_state_limits.csv in the input root.
-    def _opt(name: str) -> Optional[str]:
-        for d in (input_root, os.path.join(input_root, os.pardir, "python")):
-            p = os.path.join(d, name)
-            if os.path.exists(p):
-                return p
-        return None
-
     sl_path = _opt("nem_state_limits.csv")
     pk_path = _opt("peak_demand_mw.csv")
     cfp_path = _opt("cf_during_peak_demand.csv")
@@ -387,5 +429,6 @@ def scenario_inputs_from_reference(
                          else 0.04, np.float32)
         ),
         "files": files,
+        "market_curves": market_curves,
     }
     return inputs, meta

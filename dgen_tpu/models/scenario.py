@@ -12,13 +12,18 @@ on ``(year_idx, sector_idx)``.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict
+from typing import Dict, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dgen_tpu.config import PAYBACK_GRID_N, SECTORS, ScenarioConfig
+from dgen_tpu.config import (
+    BASS_DEFAULTS,
+    PAYBACK_GRID_N,
+    SECTORS,
+    ScenarioConfig,
+)
 from dgen_tpu.models.agents import AgentTable
 from dgen_tpu.ops.cashflow import FinanceParams, MACRS_5
 
@@ -86,7 +91,10 @@ class ScenarioInputs:
     #: availability-window gate (reference filter_nem_year, elec.py:449)
     years: jax.Array
     # --- misc ---
-    value_of_resiliency: jax.Array        # [Y, S] $ per agent
+    #: [Y, G] $ per agent (reference merges VOR per state x sector,
+    #: apply_value_of_resiliency elec.py:287; the shipped vor_FY20 CSV
+    #: keys on state_abbr + sector_abbr)
+    value_of_resiliency: jax.Array
     cap_cost_multiplier: jax.Array        # [Y, S]
     #: [Y, n_states] grid carbon intensity tCO2/kWh (reference
     #: apply_carbon_intensities, elec.py:595) — an output passthrough
@@ -133,6 +141,7 @@ def apply_year(
     """
     s = table.sector_idx
     r = table.region_idx
+    g = table.group_idx
 
     growth = inputs.load_growth[year_idx, r, s]
     is_res = (s == 0).astype(jnp.float32)
@@ -165,7 +174,7 @@ def apply_year(
         system_capex_per_kw_combined=inputs.pv_capex_per_kw_combined[year_idx, s],
         batt_capex_per_kwh_combined=inputs.batt_capex_per_kwh_combined[year_idx, s],
         cap_cost_multiplier=inputs.cap_cost_multiplier[year_idx, s],
-        value_of_resiliency=inputs.value_of_resiliency[year_idx, s],
+        value_of_resiliency=inputs.value_of_resiliency[year_idx, g],
         fin=fin,
     )
 
@@ -260,7 +269,12 @@ def uniform_inputs(
     for s_i in range(S):
         halflife = 4.0 if s_i == 0 else 6.0
         curves.append(np.exp(-pb / halflife))
-    mms = jnp.asarray(np.stack(curves))
+    mms_np = np.stack(curves)
+    # the 30.1 never-payback sentinel is exactly 0 — the reference UNION
+    # ALLs a 0-share row at metric_value=30.1 (data_functions.py:399-410)
+    # so agents whose cashflow never pays back cannot adopt
+    mms_np[:, -1] = 0.0
+    mms = jnp.asarray(mms_np)
 
     anchor_mask = np.isin(years, np.asarray(config.anchor_years)).astype(f)
 
@@ -287,9 +301,9 @@ def uniform_inputs(
         deprec_sch=jnp.broadcast_to(
             jnp.asarray(MACRS_5), (Y, S, MACRS_5.shape[0])
         ),
-        bass_p=jnp.full(G, 0.0015, dtype=f),
-        bass_q=jnp.full(G, 0.35, dtype=f),
-        teq_yr1=jnp.full(G, 2.0, dtype=f),
+        bass_p=jnp.full(G, BASS_DEFAULTS[0], dtype=f),
+        bass_q=jnp.full(G, BASS_DEFAULTS[1], dtype=f),
+        teq_yr1=jnp.full(G, BASS_DEFAULTS[2], dtype=f),
         mms_table=mms,
         attachment_rate=jnp.zeros(G, dtype=f),
         starting_kw=jnp.zeros(G, dtype=f),
@@ -299,7 +313,7 @@ def uniform_inputs(
         observed_kw=jnp.zeros((Y, G), dtype=f),
         nem_cap_kw=jnp.full((Y, n_st), 1e30, dtype=f),
         years=jnp.asarray(years.astype(f)),
-        value_of_resiliency=yz(0.0),
+        value_of_resiliency=jnp.zeros((Y, G), dtype=f),
         cap_cost_multiplier=yz(1.0),
         carbon_intensity_t_per_kwh=jnp.zeros((Y, n_st), dtype=f),
         inflation=jnp.asarray(config.annual_inflation, dtype=f),

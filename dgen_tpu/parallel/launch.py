@@ -199,6 +199,11 @@ def main() -> None:
             run_dir, agent_id=np.asarray(sim.table.agent_id),
             mask=np.asarray(sim.table.mask),
             state_names=list(input_states),
+            meta={
+                "scenario": cfg.name, "shard": shard,
+                "states": list(states),
+                "market_curves": meta["market_curves"],
+            },
         )
         res = run_with_recovery(
             sim, os.path.join(run_dir, "ckpt"), callback=exporter,

@@ -1,0 +1,135 @@
+"""Market-curve drop-ins + VOR ingest + the mms never-payback sentinel.
+
+Covers VERDICT r2 items 5 (zero the 30.1 sentinel; accept
+max_market_curves.csv / bass_params.csv exports of the reference's
+Postgres-only tables, data_functions.py:279,370) and 6 (VOR loader for
+the shipped value_of_resiliency CSVs, elec.py:287).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from dgen_tpu.config import PAYBACK_GRID_N, ScenarioConfig
+from dgen_tpu.io import ingest
+from dgen_tpu.io.reference_inputs import scenario_inputs_from_reference
+from dgen_tpu.models.scenario import uniform_inputs
+
+REF_INPUTS = "/root/reference/dgen_os/input_data"
+
+
+def test_uniform_mms_sentinel_is_zero():
+    """Never-payback agents (payback == 30.1 -> grid index 301) must see
+    max market share exactly 0 (reference data_functions.py:399-410)."""
+    cfg = ScenarioConfig(name="t", start_year=2020, end_year=2024)
+    inputs = uniform_inputs(cfg, n_groups=6, n_regions=2)
+    mms = np.asarray(inputs.mms_table)
+    assert mms.shape[1] == PAYBACK_GRID_N
+    np.testing.assert_array_equal(mms[:, -1], 0.0)
+    # the curve itself is not degenerate
+    assert mms[:, 0].min() > 0.5
+
+
+def _write_mmc(path):
+    # 1-year-resolution curves; loader interpolates to tenths
+    rows = ["metric_value,sector_abbr,max_market_share,metric,business_model"]
+    for sec, scale in (("res", 1.0), ("com", 0.8), ("ind", 0.6)):
+        for pb in range(0, 31):
+            share = scale * max(0.0, 1.0 - pb / 30.0)
+            rows.append(f"{pb},{sec},{share},payback_period,host_owned")
+        # decoy rows that must be filtered out
+        rows.append(f"5,{sec},0.99,percent_monthly_bill_savings,host_owned")
+        rows.append(f"5,{sec},0.99,payback_period,tpo")
+    path.write_text("\n".join(rows) + "\n")
+
+
+def test_load_max_market_curves(tmp_path):
+    p = tmp_path / "max_market_curves.csv"
+    _write_mmc(p)
+    mms = ingest.load_max_market_curves(str(p))
+    assert mms.shape == (3, PAYBACK_GRID_N)
+    # interpolation to tenths: payback 4.5 sits between the 4 and 5 rows
+    assert mms[0, 45] == pytest.approx(1.0 - 4.5 / 30.0, abs=1e-6)
+    # decoys (0.99 at payback 5) filtered
+    assert mms[0, 50] == pytest.approx(1.0 - 5.0 / 30.0, abs=1e-6)
+    # sector scaling preserved
+    assert mms[1, 0] == pytest.approx(0.8, abs=1e-6)
+    # sentinel pinned to exactly 0
+    np.testing.assert_array_equal(mms[:, -1], 0.0)
+
+
+def test_load_bass_params(tmp_path):
+    p = tmp_path / "bass_params.csv"
+    p.write_text(
+        "state_abbr,p,q,teq_yr1,sector_abbr,tech\n"
+        "CA,0.003,0.5,3.0,res,solar\n"
+        "CA,0.001,0.4,1.0,com,solar\n"
+        "CA,0.009,0.9,9.0,res,wind\n"   # non-solar: ignored
+    )
+    out = ingest.load_bass_params(str(p), ["CA", "TX"])
+    assert out["bass_p"].shape == (6,)
+    assert out["bass_p"][0] == pytest.approx(0.003)
+    assert out["bass_q"][0] == pytest.approx(0.5)
+    assert out["teq_yr1"][1] == pytest.approx(1.0)
+    # TX + CA/ind keep defaults
+    assert out["bass_p"][3] == pytest.approx(0.0015)
+    assert out["missing"] == 4
+
+
+def test_load_value_of_resiliency(tmp_path):
+    p = tmp_path / "vor.csv"
+    p.write_text(
+        "state_abbr,sector_abbr,value_of_resiliency_usd\n"
+        "AL,com,2763.27\nAL,ind,57996.42\n"
+    )
+    vor = ingest.load_value_of_resiliency(str(p), ["AL", "AK"])
+    assert vor.shape == (6,)
+    assert vor[1] == pytest.approx(2763.27)
+    assert vor[2] == pytest.approx(57996.42)
+    assert vor[0] == 0.0 and vor[3] == 0.0  # res + AK absent
+
+
+def test_scenario_wiring_dropins(tmp_path):
+    """A bare input root with only the drop-ins: curves land in
+    ScenarioInputs and meta flags them ingested (VERDICT r2 item 8)."""
+    root = tmp_path / "input_data"
+    root.mkdir()
+    _write_mmc(root / "max_market_curves.csv")
+    (root / "bass_params.csv").write_text(
+        "state_abbr,p,q,teq_yr1,sector_abbr\nCA,0.002,0.45,2.5,res\n"
+    )
+    vdir = root / "value_of_resiliency"
+    vdir.mkdir()
+    (vdir / "vor.csv").write_text(
+        "state_abbr,sector_abbr,value_of_resiliency_usd\nCA,com,1000.0\n"
+    )
+    cfg = ScenarioConfig(name="t", start_year=2020, end_year=2024)
+    states = ["CA", "TX"]
+    inputs, meta = scenario_inputs_from_reference(str(root), cfg, states)
+    assert meta["market_curves"] == {"mms": "ingested", "bass": "ingested"}
+    assert float(np.asarray(inputs.bass_p)[0]) == pytest.approx(0.002)
+    assert float(np.asarray(inputs.mms_table)[1, 0]) == pytest.approx(0.8)
+    y = len(cfg.model_years)
+    assert inputs.value_of_resiliency.shape == (y, 6)
+    assert float(np.asarray(inputs.value_of_resiliency)[0, 1]) == 1000.0
+    # without drop-ins the meta says synthetic
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    _, meta2 = scenario_inputs_from_reference(str(bare), cfg, states)
+    assert meta2["market_curves"] == {
+        "mms": "synthetic_default", "bass": "synthetic_default"}
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF_INPUTS, "value_of_resiliency")),
+    reason="reference inputs not mounted",
+)
+def test_vor_from_shipped_reference_csv():
+    """The reference's actual vor_FY20_mid.csv: AL com row carries
+    2763.274124 $ (file line 2)."""
+    d = os.path.join(REF_INPUTS, "value_of_resiliency")
+    path = os.path.join(d, sorted(os.listdir(d))[-1])
+    vor = ingest.load_value_of_resiliency(path, ["AL"])
+    assert vor[1] == pytest.approx(2763.274124, rel=1e-6)
+    assert vor[2] == pytest.approx(57996.42041, rel=1e-6)

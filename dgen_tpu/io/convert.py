@@ -103,8 +103,10 @@ def reference_tariff_to_spec(td: Dict[str, Any]) -> Dict[str, Any]:
     [period(1..P), tier(1..T), max_usage, unit, price, sell] with
     1-based 12x24 schedules) — the same two shapes
     financial_functions.py:962 ``normalize_tariff`` accepts. Demand
-    charges are dropped, matching the reference's global
-    SKIP_DEMAND_CHARGES=True (financial_functions.py:35).
+    charges are excluded from the ENERGY spec, matching the reference's
+    global SKIP_DEMAND_CHARGES=True (financial_functions.py:35); they
+    are preserved separately by
+    :func:`reference_tariff_to_demand_spec` for analysis runs.
     """
     spec: Dict[str, Any] = {
         "fixed_charge": float(
@@ -147,6 +149,82 @@ def reference_tariff_to_spec(td: Dict[str, Any]) -> Dict[str, Any]:
     return spec
 
 
+def reference_tariff_to_demand_spec(
+    td: Dict[str, Any],
+) -> Optional[Dict[str, Any]]:
+    """Demand-charge fields of one ``tariff_dict`` -> a JSON-able demand
+    spec, or None when the tariff has no demand charges.
+
+    The hot loop drops these on purpose (SKIP_DEMAND_CHARGES parity,
+    financial_functions.py:35); this hook preserves them for analysis
+    runs through :mod:`dgen_tpu.ops.demand`. Both shapes found in agent
+    pickles are accepted — legacy ``d_flat_*`` [T][12] / ``d_tou_*``
+    [T][P] arrays with 0-based ``d_wkday_12by24`` schedules (the URDB
+    repackaging of tariff_functions.py:213-268) and PySAM
+    ``ur_dc_flat_mat`` / ``ur_dc_tou_mat`` rows
+    [period(1..P), tier(1..T), max_kW, price] with 1-based schedules
+    (financial_functions.py:793 ``_build_ur_dc_from_d_parts``).
+
+    Spec keys mirror :func:`dgen_tpu.ops.demand.compile_demand_tariff`
+    kwargs plus the two 12x24 window schedules (expanded to the hourly
+    map at bank-compile time).
+    """
+    def dense_from_mat(mat):
+        rows = np.asarray(mat, dtype=np.float64)
+        if rows.ndim != 2 or rows.shape[1] < 4 or not rows.size:
+            return None, None
+        P = int(rows[:, 0].max())
+        T = int(rows[:, 1].max())
+        prices = np.zeros((T, P))
+        levels = np.full((T, P), BIG_CAP)
+        for r in rows:
+            p, t = int(r[0]) - 1, int(r[1]) - 1
+            prices[t, p] = r[3]
+            if r[2] > 0:
+                levels[t, p] = min(levels[t, p], r[2])
+        return prices, levels
+
+    def pick(prices_key, levels_key, mat_key):
+        pr, lv = td.get(prices_key), td.get(levels_key)
+        if pr is not None and np.asarray(pr, np.float64).size:
+            pr = np.asarray(pr, np.float64)
+            lv = (np.asarray(lv, np.float64) if lv is not None
+                  else np.full(pr.shape, BIG_CAP))
+        elif td.get(mat_key):
+            pr, lv = dense_from_mat(td[mat_key])
+        else:
+            return None, None
+        if pr is None or not np.any(pr > 0):
+            return None, None
+        return pr.tolist(), lv.tolist()
+
+    out: Dict[str, Any] = {}
+    fp, fl = pick("d_flat_prices", "d_flat_levels", "ur_dc_flat_mat")
+    if fp is not None:
+        out["d_flat_prices"], out["d_flat_levels"] = fp, fl
+    tp, tl = pick("d_tou_prices", "d_tou_levels", "ur_dc_tou_mat")
+    if tp is not None:
+        out["d_tou_prices"], out["d_tou_levels"] = tp, tl
+    if not out:
+        return None
+
+    if "d_tou_prices" in out:
+        wkday = td.get("d_wkday_12by24")
+        wkend = td.get("d_wkend_12by24")
+        if wkday is None and td.get("ur_dc_sched_weekday") is not None:
+            # ur schedules are 1-based (financial_functions.py:823)
+            wkday = (np.asarray(td["ur_dc_sched_weekday"], np.int64)
+                     - 1).clip(0).tolist()
+            raw_we = td.get("ur_dc_sched_weekend",
+                            td["ur_dc_sched_weekday"])
+            wkend = (np.asarray(raw_we, np.int64) - 1).clip(0).tolist()
+        if wkday is not None:
+            out["d_wkday_12by24"] = np.asarray(wkday, np.int64).tolist()
+            out["d_wkend_12by24"] = np.asarray(
+                wkend if wkend is not None else wkday, np.int64).tolist()
+    return out
+
+
 def _canonical_key(spec: Dict[str, Any]) -> str:
     return json.dumps(spec, sort_keys=True)
 
@@ -179,22 +257,27 @@ def reassign_bad_tariffs(
 
     df = df.copy()
 
-    def modal(frame: pd.DataFrame) -> Optional[pd.Series]:
-        if frame.empty:
-            return None
-        tid = frame["tariff_id"].mode().iloc[0]
-        return frame[frame["tariff_id"] == tid].iloc[0]
+    # vectorized modal lookup: one groupby per fallback level instead of
+    # a per-bad-row scan (national pickles carry ~1e6 rows)
+    first_mode = lambda s: s.mode().iloc[0]
+    modal_ss = good.groupby(["state_abbr", "sector_abbr"])["tariff_id"] \
+        .agg(first_mode)
+    modal_s = good.groupby("sector_abbr")["tariff_id"].agg(first_mode)
+    modal_any = first_mode(good["tariff_id"])
+    # representative dict per good tariff id (ids key a shared tariff
+    # table in the reference, so same-id rows carry the same dict)
+    rep = good.drop_duplicates("tariff_id").set_index("tariff_id")[
+        "tariff_dict"]
 
-    for idx in df.index[bad]:
-        row = df.loc[idx]
-        donor = modal(good[(good["state_abbr"] == row["state_abbr"])
-                           & (good["sector_abbr"] == row["sector_abbr"])])
-        if donor is None:
-            donor = modal(good[good["sector_abbr"] == row["sector_abbr"]])
-        if donor is None:
-            donor = good.iloc[0]
-        df.at[idx, "tariff_id"] = donor["tariff_id"]
-        df.at[idx, "tariff_dict"] = donor["tariff_dict"]
+    bad_rows = df.loc[bad]
+    key = pd.MultiIndex.from_frame(bad_rows[["state_abbr", "sector_abbr"]])
+    tid = modal_ss.reindex(key).to_numpy(object)
+    fb = modal_s.reindex(bad_rows["sector_abbr"]).to_numpy(object)
+    tid = np.where(pd.isna(tid), fb, tid)
+    tid = np.where(pd.isna(tid), modal_any, tid)
+    df.loc[bad, "tariff_id"] = pd.array(
+        tid, dtype=df["tariff_id"].dtype)
+    df.loc[bad, "tariff_dict"] = rep.reindex(tid).to_numpy(object)
     return df
 
 
@@ -218,12 +301,16 @@ def _profile_bank(
     scale: float = 1.0,
     normalize_sum: bool = False,
 ) -> Tuple[np.ndarray, Dict[Tuple, int]]:
-    """Dedup profiles by key into an [n, 8760] bank + key->row map."""
+    """Dedup profiles by key into an [n, 8760] bank + key->row map.
+
+    O(rows) dict build from plain tuples — no per-row pandas Series
+    (iterrows at national scale was the converter's wall-clock sink).
+    """
     lut: Dict[Tuple, int] = {}
-    by_key = {}
-    for _, row in df.iterrows():
-        k = tuple(row[c] for c in key_cols)
-        by_key[k] = row[value_col]
+    by_key = dict(zip(
+        df[list(key_cols)].itertuples(index=False, name=None),
+        df[value_col].tolist(),
+    ))
     rows = []
     for k in used_keys:
         if k in lut:
@@ -266,35 +353,62 @@ def compile_incentives(
     si = state_incentives.fillna(
         value={"incentive_duration_yrs": 5.0, "max_incentive_usd": 10000.0})
 
-    n = len(state_abbr)
-    out = {k: np.zeros((n, 2), np.float32)
-           for k in ("cbi_usd_p_w", "cbi_max_usd", "ibi_frac", "ibi_max_usd",
-                     "pbi_usd_p_kwh")}
-    pbi_years = np.zeros((n, 2), np.int32)
-
-    grouped = {k: g for k, g in si.groupby(["state_abbr", "sector_abbr"])}
-    for i, (st, sec) in enumerate(zip(state_abbr, sector_abbr)):
-        g = grouped.get((st, sec))
-        if g is None:
-            continue
+    # compile top-2 slots once per (state, sector) CELL — at most
+    # n_states x 3 of them — then gather per agent, instead of walking
+    # the agent axis in Python (the national pickle has ~1e6 rows)
+    cells: Dict[Tuple, Dict[str, np.ndarray]] = {}
+    for (st, sec), g in si.groupby(["state_abbr", "sector_abbr"]):
+        c = {k: np.zeros(2, np.float32)
+             for k in ("cbi_usd_p_w", "cbi_max_usd", "ibi_frac",
+                       "ibi_max_usd", "pbi_usd_p_kwh")}
+        c["pbi_years"] = np.zeros(2, np.int32)
         cbi = g[g.get("cbi_usd_p_w", pd.Series(dtype=float)).notna()] \
             .sort_values("cbi_usd_p_w", ascending=False)
         for s, (_, row) in enumerate(cbi.head(2).iterrows()):
-            out["cbi_usd_p_w"][i, s] = row["cbi_usd_p_w"]
-            out["cbi_max_usd"][i, s] = row["max_incentive_usd"]
+            c["cbi_usd_p_w"][s] = row["cbi_usd_p_w"]
+            c["cbi_max_usd"][s] = row["max_incentive_usd"]
         if "ibi_pct" in g:
             ibi = g[g["ibi_pct"].notna()].sort_values(
                 "ibi_pct", ascending=False)
             for s, (_, row) in enumerate(ibi.head(2).iterrows()):
-                out["ibi_frac"][i, s] = row["ibi_pct"]
-                out["ibi_max_usd"][i, s] = row["max_incentive_usd"]
+                c["ibi_frac"][s] = row["ibi_pct"]
+                c["ibi_max_usd"][s] = row["max_incentive_usd"]
         if "pbi_usd_p_kwh" in g:
             pbi = g[g["pbi_usd_p_kwh"].notna()].sort_values(
                 "pbi_usd_p_kwh", ascending=False)
             for s, (_, row) in enumerate(pbi.head(2).iterrows()):
-                out["pbi_usd_p_kwh"][i, s] = row["pbi_usd_p_kwh"]
-                pbi_years[i, s] = int(row["incentive_duration_yrs"])
-    return IncentiveParams(pbi_years=pbi_years, **out)
+                c["pbi_usd_p_kwh"][s] = row["pbi_usd_p_kwh"]
+                c["pbi_years"][s] = int(row["incentive_duration_yrs"])
+        cells[(st, sec)] = c
+
+    n = len(state_abbr)
+    if not cells:
+        # rows exist but none form a (state, sector) group (NaN keys are
+        # dropped by groupby) — same all-zero result as no matches
+        zero = {k: np.zeros((n, 2), np.float32)
+                for k in ("cbi_usd_p_w", "cbi_max_usd", "ibi_frac",
+                          "ibi_max_usd", "pbi_usd_p_kwh")}
+        return IncentiveParams(
+            pbi_years=np.zeros((n, 2), np.int32), **zero)
+
+    keys = list(cells)
+    cell_idx = {k: i for i, k in enumerate(keys)}
+    # stacked [n_cells + 1, 2] tables; the last row is the all-zero
+    # no-incentive cell agents without a matching row gather from
+    def stacked(name, dtype):
+        z = np.zeros((1, 2), dtype)
+        return np.concatenate(
+            [np.stack([cells[k][name] for k in keys]).astype(dtype), z])
+
+    agent_cell = np.asarray([
+        cell_idx.get((st, sec), len(keys))
+        for st, sec in zip(state_abbr, sector_abbr)
+    ])
+    out = {k: stacked(k, np.float32)[agent_cell]
+           for k in ("cbi_usd_p_w", "cbi_max_usd", "ibi_frac",
+                     "ibi_max_usd", "pbi_usd_p_kwh")}
+    return IncentiveParams(
+        pbi_years=stacked("pbi_years", np.int32)[agent_cell], **out)
 
 
 # ---------------------------------------------------------------------------
@@ -378,16 +492,33 @@ def from_reference_pickle(
     cd_idx = {c: i for i, c in enumerate(CENSUS_DIVISIONS)}
 
     # --- tariffs: parse, convert, dedup ---
+    # parse once per UNIQUE tariff_id, not per agent: ids key a shared
+    # tariff table in the reference (reassign_agent_tariffs swaps by id,
+    # elec.py:868), so same-id rows carry the same dict; a national
+    # pickle has ~1e6 agents over a few thousand tariffs
     specs: List[Dict[str, Any]] = []
     spec_lut: Dict[str, int] = {}
-    tariff_idx = np.zeros(len(df), np.int32)
-    for i, raw in enumerate(df["tariff_dict"]):
-        spec = reference_tariff_to_spec(parse_tariff_dict(raw))
+    tids = df["tariff_id"].to_numpy()
+    uniq_tids, first_pos, inv = np.unique(
+        tids, return_index=True, return_inverse=True)
+    spec_of_uid = np.zeros(len(uniq_tids), np.int32)
+    raw_dicts = df["tariff_dict"].to_numpy(object)
+    for u, pos in enumerate(first_pos):
+        td = parse_tariff_dict(raw_dicts[pos])
+        spec = reference_tariff_to_spec(td)
+        # demand charges ride along as a sub-spec: inert for the hot
+        # loop (normalize_tariff_spec ignores the key; SKIP_DEMAND_
+        # CHARGES parity) but compiled on demand for analysis runs via
+        # ops.demand.compile_demand_bank
+        dspec = reference_tariff_to_demand_spec(td)
+        if dspec is not None:
+            spec["demand"] = dspec
         key = _canonical_key(spec)
         if key not in spec_lut:
             spec_lut[key] = len(specs)
             specs.append(spec)
-        tariff_idx[i] = spec_lut[key]
+        spec_of_uid[u] = spec_lut[key]
+    tariff_idx = spec_of_uid[inv].astype(np.int32)
 
     # --- profiles: dedup into banks ---
     load_keys = [tuple(r) for r in

@@ -339,9 +339,10 @@ def scenario_inputs_from_reference(
     vdir = os.path.join(input_root, "value_of_resiliency")
     if os.path.isdir(vdir):
         vcsvs = sorted(f for f in os.listdir(vdir) if f.endswith(".csv"))
+        vprefer = [c for c in vcsvs if "mid" in c]
         if vcsvs:
             vor_g = ingest.load_value_of_resiliency(
-                os.path.join(vdir, vcsvs[-1]), states)
+                os.path.join(vdir, (vprefer or vcsvs)[-1]), states)
             ov["value_of_resiliency"] = jnp.asarray(np.broadcast_to(
                 vor_g[None, :], (len(years), g)).copy())
 

@@ -164,3 +164,67 @@ def compile_demand_tariff(
         tou_cap=jnp.asarray(tou_c),
         hour_window=jnp.asarray(hw),
     )
+
+
+def compile_demand_bank(demand_specs) -> "DemandTariff | None":
+    """Per-tariff demand specs -> one batched bank (leaves [K, ...]).
+
+    ``demand_specs``: one entry per tariff-bank row — the ``"demand"``
+    sub-spec the converter attaches (io.convert.reference_tariff_to_
+    demand_spec), or None for tariffs without demand charges. Returns
+    None when no tariff carries any (the corpus norm: the reference
+    skips them globally, financial_functions.py:35).
+
+    Tariffs are padded to the bank's max window/tier extents by EDGE
+    REPLICATION (the compile_tariffs convention): a pad tier repeats the
+    real top tier's cap, so its bracket [cap, cap) is empty and it can
+    never price — including when the real top cap is finite (padding
+    with an unbounded cap there would open a new bracket above it and
+    charge ``lower * prev_price``, diverging from
+    :func:`compile_demand_tariff` for the same tariff). A pad window's
+    masked peak is 0, which its tier 1 prices at 0 * price. Per-agent
+    tariffs come from ``jax.tree.map(lambda x: x[tariff_idx], bank)``;
+    price hourly nets with ``jax.vmap(annual_demand_charge)``.
+    """
+    if not demand_specs or not any(demand_specs):
+        return None
+    from dgen_tpu.ops.tariff import expand_schedule_8760
+
+    ts = []
+    for spec in demand_specs:
+        if not spec:
+            ts.append(DemandTariff.zeros())
+            continue
+        kwargs = {
+            k: spec[k]
+            for k in ("d_flat_prices", "d_flat_levels",
+                      "d_tou_prices", "d_tou_levels")
+            if k in spec
+        }
+        if "d_wkday_12by24" in spec:
+            kwargs["d_tou_8760"] = expand_schedule_8760(
+                spec["d_wkday_12by24"],
+                spec.get("d_wkend_12by24", spec["d_wkday_12by24"]),
+            )
+        ts.append(compile_demand_tariff(**kwargs))
+
+    P = max(t.tou_price.shape[0] for t in ts)
+    T = max(max(t.tou_price.shape[1], t.flat_price.shape[1]) for t in ts)
+
+    def pad2(a, r, c):
+        a = np.asarray(a, np.float32)
+        return np.pad(a, ((0, r - a.shape[0]), (0, c - a.shape[1])),
+                      mode="edge")
+
+    return DemandTariff(
+        flat_price=jnp.asarray(
+            np.stack([pad2(t.flat_price, MONTHS, T) for t in ts])),
+        flat_cap=jnp.asarray(
+            np.stack([pad2(t.flat_cap, MONTHS, T) for t in ts])),
+        tou_price=jnp.asarray(
+            np.stack([pad2(t.tou_price, P, T) for t in ts])),
+        tou_cap=jnp.asarray(
+            np.stack([pad2(t.tou_cap, P, T) for t in ts])),
+        hour_window=jnp.asarray(
+            np.stack([np.asarray(t.hour_window) for t in ts])),
+    )

@@ -121,3 +121,38 @@ def test_zero_tariff_is_free():
     load = jnp.asarray(_load(1), jnp.float32)
     assert float(dm.annual_demand_charge(
         load, dm.DemandTariff.zeros())) == 0.0
+
+
+def test_bank_padding_matches_single_tariff_compile():
+    """A tariff with a FINITE top tier cap must price identically alone
+    and inside a bank next to a deeper-tiered tariff (edge-replicated
+    pad tiers have empty brackets; BIG_CAP-filled padding would open a
+    new bracket above the finite cap and charge lower * prev_price)."""
+    import jax
+
+    from dgen_tpu.ops.demand import (
+        annual_demand_charge, compile_demand_bank, compile_demand_tariff,
+    )
+
+    spec_finite = {
+        "d_flat_prices": [[5.0] * 12],
+        "d_flat_levels": [[50.0] * 12],   # finite 50 kW top cap
+    }
+    spec_two_tier = {
+        "d_flat_prices": [[3.0] * 12, [4.0] * 12],
+        "d_flat_levels": [[20.0] * 12, [1e9] * 12],
+    }
+    load = np.full(8760, 80.0, np.float32)  # above the finite cap
+
+    alone = float(annual_demand_charge(
+        load, compile_demand_tariff(**spec_finite)))
+    bank = compile_demand_bank([spec_finite, spec_two_tier, None])
+    in_bank = np.asarray(jax.vmap(annual_demand_charge)(
+        np.broadcast_to(load, (3, 8760)), bank))
+    assert in_bank[0] == pytest.approx(alone, rel=1e-6)
+    # the no-demand row prices to exactly 0
+    assert in_bank[2] == 0.0
+    # the two-tier tariff prices per its own structure either way
+    alone2 = float(annual_demand_charge(
+        load, compile_demand_tariff(**spec_two_tier)))
+    assert in_bank[1] == pytest.approx(alone2, rel=1e-6)

@@ -27,8 +27,14 @@ Knobs (env):
   DGEN_TPU_BENCH_AGENTS   headline population size   (default 8192)
   DGEN_TPU_BENCH_END      end model year             (default 2050)
   DGEN_TPU_BENCH_SKIP_CPU skip CPU baseline, use cached constant
-  DGEN_TPU_BENCH_SCALE    comma list of scale points (default
-                          "8192,32768"; "" disables the curve)
+  DGEN_TPU_BENCH_SCALE    comma list of scale points, each "N" (whole
+                          table) or "N:chunk" (streaming year step with
+                          that per-device agent chunk); a point that
+                          exhausts HBM is recorded {"oom": true} so the
+                          curve documents the memory ceiling (default
+                          "8192,32768,65536,131072:16384"; "" disables)
+  DGEN_TPU_BENCH_BIG      the national-scale chunked point, "N:chunk"
+                          (default "1048576:8192"; "" disables)
 """
 
 from __future__ import annotations
@@ -49,7 +55,8 @@ FALLBACK_BASELINE_AGENT_YEARS_PER_SEC = 25.0
 V5E_PEAK_FLOPS = 197e12
 
 
-def _build(n_agents: int, end_year: int, sizing_iters: int = 10):
+def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
+           agent_chunk: int = 0):
     from dgen_tpu.config import RunConfig, ScenarioConfig
     from dgen_tpu.io import synth
     from dgen_tpu.models import scenario as scen
@@ -64,9 +71,23 @@ def _build(n_agents: int, end_year: int, sizing_iters: int = 10):
     )
     sim = Simulation(
         pop.table, pop.profiles, pop.tariffs, inputs, cfg,
-        RunConfig(sizing_iters=sizing_iters), with_hourly=False,
+        RunConfig(sizing_iters=sizing_iters, agent_chunk=agent_chunk),
+        with_hourly=False,
     )
     return sim, pop
+
+
+def _parse_point(tok: str) -> tuple[int, int]:
+    """"N" or "N:chunk" -> (n_agents, agent_chunk)."""
+    if ":" in tok:
+        n, c = tok.split(":", 1)
+        return int(n), int(c)
+    return int(tok), 0
+
+
+def _is_oom(err: Exception) -> bool:
+    s = str(err)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
 
 
 def _round8(r: int) -> int:
@@ -196,7 +217,9 @@ def _cpu_baseline(sim, pop) -> float:
 def main() -> None:
     n_agents = int(os.environ.get("DGEN_TPU_BENCH_AGENTS", "8192"))
     end_year = int(os.environ.get("DGEN_TPU_BENCH_END", "2050"))
-    scale_env = os.environ.get("DGEN_TPU_BENCH_SCALE", "8192,32768")
+    scale_env = os.environ.get(
+        "DGEN_TPU_BENCH_SCALE", "8192,32768,65536,131072:16384"
+    )
 
     sim, pop = _build(n_agents, end_year)
     n_real = int(np.asarray(pop.table.mask).sum())
@@ -233,21 +256,42 @@ def main() -> None:
         "sizing_standalone_s": round(sizing_s, 4),
     }
 
-    # --- population scale curve (agent-years/sec per cached step) ---
-    scale_curve = []
-    for tok in [s for s in scale_env.split(",") if s.strip()]:
-        n_s = int(tok)
-        if n_s == pop.table.n_agents:
-            n_real_s, dt = n_real, step_s   # already measured above
-        else:
-            sim_s, pop_s = _build(n_s, 2022)
-            n_real_s = int(np.asarray(pop_s.table.mask).sum())
-            dt = _time_steps(sim_s)
-        scale_curve.append({
-            "agents": n_real_s,
-            "sec_per_year_step": round(dt, 4),
-            "agent_years_per_sec": round(n_real_s / dt, 2),
-        })
+    def _run_point(tok: str, n_rep: int = 3) -> dict:
+        """Measure one scale point; a point that exhausts HBM is
+        recorded {"oom": true} so the curve documents the ceiling."""
+        n_s, chunk_s = _parse_point(tok)
+        entry = {"agents": n_s, "chunk": chunk_s or None}
+        try:
+            if n_s == pop.table.n_agents and not chunk_s:
+                n_real_s, dt = n_real, step_s   # already measured above
+            else:
+                sim_s, pop_s = _build(n_s, 2022, agent_chunk=chunk_s)
+                n_real_s = int(np.asarray(pop_s.table.mask).sum())
+                dt = _time_steps(sim_s, n_rep=n_rep)
+                del sim_s, pop_s   # release HBM before the next point
+            entry.update({
+                "agents": n_real_s,
+                "sec_per_year_step": round(dt, 4),
+                "agent_years_per_sec": round(n_real_s / dt, 2),
+            })
+        except Exception as e:  # noqa: BLE001 — record the OOM wall
+            if not _is_oom(e):
+                raise
+            entry["oom"] = True
+        return entry
+
+    # --- population scale curve (agent-years/sec per cached step);
+    # whole-table points past the HBM wall are recorded as OOM, chunked
+    # ("N:chunk") points stream past it ---
+    scale_curve = [
+        _run_point(tok) for tok in scale_env.split(",") if tok.strip()
+    ]
+
+    # --- national-scale chunked point (the reference's whole-US
+    # population is ~O(1M) agents across its state-sharded batch
+    # tasks, submit_all.sh:8-46) ---
+    big_env = os.environ.get("DGEN_TPU_BENCH_BIG", "1048576:8192")
+    big_run = _run_point(big_env, n_rep=1) if big_env.strip() else None
 
     if os.environ.get("DGEN_TPU_BENCH_SKIP_CPU"):
         baseline = FALLBACK_BASELINE_AGENT_YEARS_PER_SEC
@@ -269,6 +313,7 @@ def main() -> None:
                     "time / v5e bf16 peak (f32 kernel -> conservative)",
         "phases": phases,
         "scale_curve": scale_curve,
+        "big_run": big_run,
     }))
 
 

@@ -84,6 +84,13 @@ class RunConfig:
     agent_pad_multiple: int = 128
     #: golden-section iterations for the PV sizing search
     sizing_iters: int = 12
+    #: agent-axis chunk for the streaming year step (rows PER DEVICE per
+    #: chunk; 0 = whole-table). Chunking bounds peak HBM to one chunk's
+    #: [chunk, 8760] intermediates so populations far beyond the
+    #: whole-table ceiling (~50k agents on a 16 GB chip) fit — the TPU
+    #: answer to the reference's per-state task sharding
+    #: (submit_all.sh:8-46)
+    agent_chunk: int = 0
     #: number of devices to shard agents over (None = all available)
     n_devices: Optional[int] = None
     #: reorder agents so states are shard-local under a multi-device
@@ -97,11 +104,15 @@ class RunConfig:
     def __post_init__(self) -> None:
         _check(self.agent_pad_multiple >= 1, "bad pad multiple")
         _check(4 <= self.sizing_iters <= 64, "sizing_iters out of range")
+        _check(self.agent_chunk >= 0, "agent_chunk must be >= 0")
 
     @classmethod
     def from_env(cls, **overrides) -> "RunConfig":
         if "n_devices" not in overrides and os.environ.get("DGEN_TPU_DEVICES"):
             overrides["n_devices"] = int(os.environ["DGEN_TPU_DEVICES"])
+        if "agent_chunk" not in overrides and \
+                os.environ.get("DGEN_TPU_AGENT_CHUNK"):
+            overrides["agent_chunk"] = int(os.environ["DGEN_TPU_AGENT_CHUNK"])
         if "debug_invariants" not in overrides and \
                 os.environ.get("DGEN_TPU_DEBUG"):
             overrides["debug_invariants"] = True

@@ -215,8 +215,11 @@ def reference_tariff_to_demand_spec(
             # ur schedules are 1-based (financial_functions.py:823)
             wkday = (np.asarray(td["ur_dc_sched_weekday"], np.int64)
                      - 1).clip(0).tolist()
-            raw_we = td.get("ur_dc_sched_weekend",
-                            td["ur_dc_sched_weekday"])
+            # key may be present-but-None (parse_tariff_dict rewrites
+            # nan/none to JSON null), so .get's default is not enough
+            raw_we = td.get("ur_dc_sched_weekend")
+            if raw_we is None:
+                raw_we = td["ur_dc_sched_weekday"]
             wkend = (np.asarray(raw_we, np.int64) - 1).clip(0).tolist()
         if wkday is not None:
             out["d_wkday_12by24"] = np.asarray(wkday, np.int64).tolist()

@@ -190,7 +190,9 @@ def generate_population(
     rng = np.random.default_rng(seed)
 
     state_idx = rng.integers(0, len(states), n_agents)
-    global_state_idx = np.asarray([STATE_IDX[states[i]] for i in state_idx])
+    global_state_idx = np.asarray(
+        [STATE_IDX[s] for s in states], dtype=np.int64
+    )[state_idx]
     sector_idx = rng.choice(3, size=n_agents, p=np.asarray(sector_weights))
 
     load_profiles = make_load_profiles()

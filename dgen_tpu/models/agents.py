@@ -110,6 +110,45 @@ def pad_to_multiple(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+#: padding fills that keep masked rows inert in the sizing kernels
+#: (mirrors build_agent_table's pads): no NEM cap pressure, switch
+#: window never entered, sunset far in the future
+_PAD_FILLS = {
+    "nem_kw_limit": 1e30,
+    "nem_sunset_year": 9999.0,
+    "switch_min_kw": 1e30,
+    "switch_max_kw": 1e30,
+}
+
+
+def pad_table(table: AgentTable, multiple: int) -> AgentTable:
+    """Re-pad an existing table so N is a multiple of ``multiple``.
+
+    Used by the driver's chunked year step (the agent axis must divide
+    evenly into chunks) — new rows carry mask 0 and the same inert
+    fills as :func:`build_agent_table`'s padding.
+    """
+    n = table.n_agents
+    n_new = pad_to_multiple(n, multiple)
+    if n_new == n:
+        return table
+    pad = n_new - n
+
+    def extend(x, fill=0):
+        tail = jnp.full((pad,) + x.shape[1:], fill, dtype=x.dtype)
+        return jnp.concatenate([jnp.asarray(x), tail], axis=0)
+
+    repl = {}
+    for f in dataclasses.fields(AgentTable):
+        if f.name in ("incentives", "n_states"):
+            continue
+        repl[f.name] = extend(
+            getattr(table, f.name), _PAD_FILLS.get(f.name, 0)
+        )
+    inc = jax.tree.map(extend, table.incentives)
+    return dataclasses.replace(table, incentives=inc, **repl)
+
+
 def build_agent_table(
     *,
     state_idx: np.ndarray,

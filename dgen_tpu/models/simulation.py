@@ -34,7 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dgen_tpu.config import RunConfig, ScenarioConfig
-from dgen_tpu.models.agents import AgentTable, ProfileBank
+from dgen_tpu.models.agents import AgentTable, ProfileBank, pad_table
 from dgen_tpu.models.market import (
     MarketState,
     allocate_battery_adopters,
@@ -45,6 +45,7 @@ from dgen_tpu.models.market import (
 )
 from dgen_tpu.models.scenario import ScenarioInputs, apply_year
 from dgen_tpu.ops import bill as bill_ops
+from dgen_tpu.ops import dispatch as dispatch_ops
 from dgen_tpu.ops import sizing as sizing_ops
 from dgen_tpu.ops.tariff import NET_BILLING, TariffBank
 from dgen_tpu.parallel.mesh import AGENT_AXIS
@@ -232,12 +233,75 @@ def compute_nem_allowed(
     return (cap_gate & window & (table.nem_kw_limit > 0)).astype(jnp.float32)
 
 
+# ---------------------------------------------------------------------------
+# Agent-axis chunking (the streaming year step)
+# ---------------------------------------------------------------------------
+#
+# The whole-table year step materializes ~a dozen [N, 8760] f32
+# intermediates — ~0.3-0.5 MB per agent at peak, a ~50k-agent ceiling on
+# a 16 GB chip. National populations (the reference runs ~M agents by
+# sharding states across batch tasks, submit_all.sh:8-46) instead stream
+# the agent axis through the sizing engine in fixed chunks via lax.scan:
+# XLA reuses one chunk's buffers across iterations, so peak HBM is one
+# chunk's intermediates plus the small [N] per-agent outputs. The market
+# step (pure [N] vectors) still runs whole-table.
+#
+# Chunk layout is shard-aware: under a d-device mesh the agent axis is
+# laid out shard-major ([d, L] local blocks), so chunks are built as
+# [d, K, c] -> [K, d*c] — every chunk holds each device's NEXT c local
+# rows and no cross-device resharding is needed between chunks.
+
+def _n_chunks(n: int, d: int, chunk: int) -> int:
+    """Number of scan chunks (1 = whole-table path). Trace-time."""
+    if not chunk:
+        return 1
+    if n % d:
+        raise ValueError(f"{n} agents do not shard over {d} devices")
+    local = n // d
+    if local <= chunk:
+        return 1
+    if local % chunk:
+        raise ValueError(
+            f"per-device agent count {local} is not a multiple of "
+            f"agent_chunk {chunk}; pad the table (models.agents.pad_table)"
+        )
+    return local // chunk
+
+
+def _to_chunks(x: jax.Array, d: int, K: int) -> jax.Array:
+    """[N, ...] -> [K, N//K, ...] keeping each device's rows local."""
+    n = x.shape[0]
+    c = n // (d * K)
+    if d == 1:
+        return x.reshape((K, c) + x.shape[1:])
+    y = x.reshape((d, K, c) + x.shape[1:])
+    y = jnp.moveaxis(y, 0, 1)
+    return y.reshape((K, d * c) + x.shape[1:])
+
+
+def _from_chunks(y: jax.Array, d: int, K: int) -> jax.Array:
+    """Inverse of :func:`_to_chunks` on scan-stacked outputs."""
+    n = y.shape[0] * y.shape[1]
+    if d == 1:
+        return y.reshape((n,) + y.shape[2:])
+    c = y.shape[1] // d
+    z = y.reshape((K, d, c) + y.shape[2:])
+    z = jnp.moveaxis(z, 1, 0)
+    return z.reshape((n,) + y.shape[2:])
+
+
+def _constrain_chunked(mesh: Mesh, a: jax.Array) -> jax.Array:
+    """Pin a [K, C, ...] chunked leaf to P(None, AGENT_AXIS, ...)."""
+    spec = P(None, AGENT_AXIS, *([None] * (a.ndim - 2)))
+    return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "n_periods", "econ_years", "sizing_iters", "first_year",
         "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
-        "rate_switch", "mesh",
+        "rate_switch", "mesh", "agent_chunk",
     ),
 )
 def year_step(
@@ -258,6 +322,7 @@ def year_step(
     sizing_impl: str = "auto",
     rate_switch: bool = False,
     mesh: Optional[Mesh] = None,
+    agent_chunk: int = 0,
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
 
@@ -286,17 +351,49 @@ def year_step(
         )
     nem_allowed = compute_nem_allowed(table, inputs, year_idx, state_kw_last)
 
-    envs = build_econ_inputs(
-        table, profiles, tariffs, ya, nem_allowed, table.incentives,
-        rate_switch=rate_switch,
-    )
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    n_chunks = _n_chunks(table.n_agents, n_dev, agent_chunk)
 
-    # --- hot loop: size every agent (financial_functions.py:291) ---
-    res = sizing_ops.size_agents(
-        envs, n_periods=n_periods, n_years=econ_years,
-        n_iters=sizing_iters, keep_hourly=with_hourly, impl=sizing_impl,
-        mesh=mesh,
-    )
+    if n_chunks > 1:
+        # --- streaming hot loop: scan agent chunks through the sizing
+        # engine; XLA reuses one chunk's [C, 8760] buffers so peak HBM
+        # stays bounded regardless of N ---
+        xs = jax.tree.map(
+            lambda a: _to_chunks(a, n_dev, n_chunks),
+            (table, ya, nem_allowed),
+        )
+        if mesh is not None:
+            xs = jax.tree.map(partial(_constrain_chunked, mesh), xs)
+
+        def _size_chunk(_, xs_c):
+            tbl_c, ya_c, nem_c = xs_c
+            envs_c = build_econ_inputs(
+                tbl_c, profiles, tariffs, ya_c, nem_c, tbl_c.incentives,
+                rate_switch=rate_switch,
+            )
+            res_c = sizing_ops.size_agents(
+                envs_c, n_periods=n_periods, n_years=econ_years,
+                n_iters=sizing_iters, keep_hourly=False, impl=sizing_impl,
+                mesh=mesh,
+            )
+            return None, res_c
+
+        _, res_k = jax.lax.scan(_size_chunk, None, xs)
+        res = jax.tree.map(
+            lambda a: _from_chunks(a, n_dev, n_chunks), res_k
+        )
+    else:
+        envs = build_econ_inputs(
+            table, profiles, tariffs, ya, nem_allowed, table.incentives,
+            rate_switch=rate_switch,
+        )
+
+        # --- hot loop: size every agent (financial_functions.py:291) ---
+        res = sizing_ops.size_agents(
+            envs, n_periods=n_periods, n_years=econ_years,
+            n_iters=sizing_iters, keep_hourly=with_hourly, impl=sizing_impl,
+            mesh=mesh,
+        )
 
     # --- market step ---
     mms = max_market_share(
@@ -358,14 +455,57 @@ def year_step(
         batt_mix = jnp.minimum(batt_adopters_cum, adopters)
         pv_only = jnp.maximum(adopters - batt_mix, 0.0)
         base_cnt = jnp.maximum(ya.customers_in_bin - adopters, 0.0)
-        net = (
-            base_cnt[:, None] * res.baseline_net_hourly
-            + pv_only[:, None] * res.adopter_net_hourly_pvonly
-            + batt_mix[:, None] * res.adopter_net_hourly_with_batt
-        ) * table.mask[:, None]
-        state_hourly = jax.ops.segment_sum(
-            net, table.state_idx, n_states
-        ) / 1000.0  # kW -> MW
+        if n_chunks > 1:
+            # the sizing scan dropped the per-agent hourly profiles;
+            # rematerialize them chunk-by-chunk (one extra dispatch per
+            # chunk — FLOPs traded for HBM, the jax.checkpoint pattern)
+            # and accumulate the state segment sum in the scan carry
+            xs_h = jax.tree.map(
+                lambda a: _to_chunks(a, n_dev, n_chunks),
+                (
+                    table.load_idx, table.cf_idx, table.state_idx,
+                    table.mask, ya.load_kwh_per_customer, ya.batt_rt_eff,
+                    res.system_kw, res.batt_kw, res.batt_kwh,
+                    base_cnt, pv_only, batt_mix,
+                ),
+            )
+            if mesh is not None:
+                xs_h = jax.tree.map(partial(_constrain_chunked, mesh), xs_h)
+
+            def _hourly_chunk(acc, xs_c):
+                (li, ci, st, mk, lkpc, rt, kw, bkw, bkwh,
+                 b_cnt, p_only, b_mix) = xs_c
+                load = profiles.load[li] * lkpc[:, None]
+                gen = profiles.solar_cf[ci] * (
+                    kw * sizing_ops.INV_EFF
+                )[:, None]
+                dr = jax.vmap(dispatch_ops.dispatch_battery)(
+                    load, gen, bkw, bkwh, rt
+                )
+                base_p, pv_p, batt_p = sizing_ops.net_hourly_profiles(
+                    load, gen, dr.system_out
+                )
+                net_c = (
+                    b_cnt[:, None] * base_p
+                    + p_only[:, None] * pv_p
+                    + b_mix[:, None] * batt_p
+                ) * mk[:, None]
+                return acc + jax.ops.segment_sum(net_c, st, n_states), None
+
+            acc0 = jnp.zeros(
+                (n_states, profiles.hours), dtype=jnp.float32
+            )
+            state_hourly, _ = jax.lax.scan(_hourly_chunk, acc0, xs_h)
+            state_hourly = state_hourly / 1000.0  # kW -> MW
+        else:
+            net = (
+                base_cnt[:, None] * res.baseline_net_hourly
+                + pv_only[:, None] * res.adopter_net_hourly_pvonly
+                + batt_mix[:, None] * res.adopter_net_hourly_with_batt
+            ) * table.mask[:, None]
+            state_hourly = jax.ops.segment_sum(
+                net, table.state_idx, n_states
+            ) / 1000.0  # kW -> MW
     else:
         state_hourly = jnp.zeros((0, 0), dtype=jnp.float32)
 
@@ -477,6 +617,8 @@ class Simulation:
         # state-local shard layout (the reference's per-state task
         # binning, SURVEY.md §2.6); results are keyed by agent_id and
         # invariant under the reordering
+        chunk = self.run_config.agent_chunk
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
         self.partition = None
         if (
             mesh is not None and mesh.devices.size > 1
@@ -484,15 +626,32 @@ class Simulation:
         ):
             from dgen_tpu.parallel.partition import partition_table
 
+            pad_mult = self.run_config.agent_pad_multiple
+            if chunk:
+                # per-shard length must divide into agent chunks
+                pad_mult = int(np.lcm(pad_mult, chunk))
             table, self.partition = partition_table(
-                table, int(mesh.devices.size),
-                self.run_config.agent_pad_multiple,
+                table, int(mesh.devices.size), pad_mult,
             )
             logger.info(
                 "partitioned %d agents into %d state-local shards of %d",
                 int(np.sum(np.asarray(table.mask))), mesh.devices.size,
                 self.partition.shard_len,
             )
+        elif chunk:
+            # keep the lane-alignment invariant alongside chunk
+            # divisibility (the partition branch does the same via lcm)
+            table = pad_table(
+                table,
+                int(np.lcm(self.run_config.agent_pad_multiple,
+                           chunk * n_dev)),
+            )
+
+        # streaming year step: only engage when the table is actually
+        # larger than one chunk per device
+        self._agent_chunk = (
+            chunk if chunk and table.n_agents // n_dev > chunk else 0
+        )
 
         if mesh is not None:
             shard = NamedSharding(mesh, P(AGENT_AXIS))
@@ -541,6 +700,7 @@ class Simulation:
             sizing_impl="auto",
             rate_switch=self._rate_switch,
             mesh=self.mesh,
+            agent_chunk=self._agent_chunk,
         )
 
     def init_carry(self) -> SimCarry:

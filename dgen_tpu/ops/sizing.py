@@ -89,6 +89,20 @@ class AgentEconInputs:
     batt_rt_eff: jax.Array = None
 
 
+def net_hourly_profiles(
+    load: jax.Array, gen: jax.Array, system_out: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(baseline, pv_only, with_batt) net grid-consumption profiles —
+    the single definition shared by the sizing keep_hourly outputs and
+    the driver's chunked rematerialization pass (reference
+    attachment_rate_functions.py:177-201 mixes exactly these three)."""
+    return (
+        load,
+        jnp.maximum(load - gen, 0.0),
+        jnp.maximum(load - system_out, 0.0),
+    )
+
+
 def _switch_active(env: AgentEconInputs, kw: jax.Array) -> jax.Array:
     """Whether the DG-rate switch applies at system size ``kw``
     (reference apply_rate_switch, elec.py:844-845). Broadcasts the
@@ -290,9 +304,9 @@ def size_one_agent(
     naep_final = annual_kwh / jnp.maximum(kw_star, 1e-9)
 
     if keep_hourly:
-        baseline_net = env.load
-        net_pvonly = jnp.maximum(env.load - gen_n, 0.0)
-        net_with_batt = jnp.maximum(env.load - dr.system_out, 0.0)
+        baseline_net, net_pvonly, net_with_batt = net_hourly_profiles(
+            env.load, gen_n, dr.system_out
+        )
     else:
         empty = jnp.zeros((0,), dtype=env.load.dtype)
         baseline_net = net_pvonly = net_with_batt = empty
@@ -555,9 +569,9 @@ def _size_agents_fast(
     naep_final = annual_kwh / jnp.maximum(kw_star, 1e-9)
 
     if keep_hourly:
-        baseline_net = envs.load
-        net_pvonly = jnp.maximum(envs.load - gen_n, 0.0)
-        net_with_batt = jnp.maximum(envs.load - dr.system_out, 0.0)
+        baseline_net, net_pvonly, net_with_batt = net_hourly_profiles(
+            envs.load, gen_n, dr.system_out
+        )
     else:
         empty = jnp.zeros((n, 0), dtype=envs.load.dtype)
         baseline_net = net_pvonly = net_with_batt = empty

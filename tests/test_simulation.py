@@ -15,7 +15,8 @@ from dgen_tpu.parallel.mesh import make_mesh
 
 
 def make_sim(n_agents=190, states=("DE", "CA", "TX"), end_year=2022,
-             mesh=None, overrides=None, anchor_years=(), **kw):
+             mesh=None, overrides=None, anchor_years=(), run_config=None,
+             **kw):
     cfg = ScenarioConfig(name="t", start_year=2014, end_year=end_year,
                          anchor_years=anchor_years)
     pop = synth.generate_population(
@@ -29,7 +30,7 @@ def make_sim(n_agents=190, states=("DE", "CA", "TX"), end_year=2022,
     )
     sim = Simulation(
         pop.table, pop.profiles, pop.tariffs, inputs, cfg,
-        RunConfig(sizing_iters=8), mesh=mesh, **kw,
+        run_config or RunConfig(sizing_iters=8), mesh=mesh, **kw,
     )
     return sim, pop
 
@@ -112,6 +113,79 @@ def test_sharded_matches_unsharded():
     ids_u, kw_u = by_id(sim_u, res_u)
     np.testing.assert_array_equal(ids_s, ids_u)
     np.testing.assert_allclose(kw_s, kw_u, rtol=5e-4, atol=1e-3)
+
+
+def test_chunked_matches_whole_table():
+    """The streaming (agent-chunked) year step must reproduce the
+    whole-table path exactly: same sizing, same diffusion, and the same
+    state-hourly aggregate via the rematerialization pass."""
+    end = 2018
+    sim_u, pop = make_sim(end_year=end, with_hourly=True)
+    sim_c, _ = make_sim(
+        end_year=end, with_hourly=True,
+        run_config=RunConfig(sizing_iters=8, agent_chunk=64),
+    )
+    assert sim_c._agent_chunk == 64, "chunked path should engage"
+    res_u = sim_u.run()
+    res_c = sim_c.run()
+    m = np.asarray(sim_u.table.mask)
+    n = len(m)
+    for k in ("system_kw_cum", "number_of_adopters", "batt_kwh_cum",
+              "npv", "payback_period", "max_market_share"):
+        np.testing.assert_allclose(
+            res_u.agent[k] * m, res_c.agent[k][:, :n] * m,
+            rtol=2e-5, atol=1e-4, err_msg=k,
+        )
+    np.testing.assert_allclose(
+        res_u.state_hourly_net_mw, res_c.state_hourly_net_mw,
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_chunked_sharded_matches_whole_table():
+    """Chunking composes with the mesh: the shard-major chunk layout
+    ([d, K, c] -> [K, d*c]) must keep per-agent results keyed by
+    agent_id invariant."""
+    mesh = make_mesh()
+    sim_u, pop = make_sim(end_year=2018, with_hourly=True)
+    sim_m, _ = make_sim(
+        end_year=2018, with_hourly=True, mesh=mesh,
+        run_config=RunConfig(sizing_iters=8, agent_chunk=16),
+    )
+    assert sim_m._agent_chunk == 16
+    res_u = sim_u.run()
+    res_m = sim_m.run()
+
+    def by_id(sim, res):
+        keep = np.asarray(sim.table.mask) > 0
+        ids = np.asarray(sim.table.agent_id)[keep]
+        order = np.argsort(ids)
+        return res.agent["system_kw_cum"][:, keep][:, order]
+
+    np.testing.assert_allclose(
+        by_id(sim_m, res_m), by_id(sim_u, res_u), rtol=5e-4, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        res_m.state_hourly_net_mw, res_u.state_hourly_net_mw,
+        rtol=5e-4, atol=1e-4,
+    )
+
+
+def test_pad_table_round_trip():
+    from dgen_tpu.models.agents import pad_table
+
+    _, pop = make_sim(end_year=2016)
+    t = pop.table
+    t2 = pad_table(t, 1000)
+    assert t2.n_agents % 1000 == 0
+    n = t.n_agents
+    assert np.all(np.asarray(t2.mask)[n:] == 0.0)
+    np.testing.assert_array_equal(np.asarray(t2.agent_id)[:n],
+                                  np.asarray(t.agent_id))
+    # inert fills on padding rows
+    assert np.all(np.asarray(t2.switch_min_kw)[n:] >= 1e29)
+    assert np.all(np.asarray(t2.nem_kw_limit)[n:] >= 1e29)
+    assert pad_table(t2, 8).n_agents == t2.n_agents  # already aligned
 
 
 def test_partition_states_are_shard_local():

@@ -9,6 +9,13 @@ there). Here resume is real: the only cross-year state is the
 ``market_last_year_df`` analogue), so a checkpoint is one small orbax
 save per year and a restore is one restore + re-entering the year loop
 at the right index.
+
+Multi-host: carries are saved AS the (possibly globally-sharded)
+jax.Arrays — orbax writes each process's addressable shards
+collectively, so jax.distributed runs checkpoint without any host
+gather of non-addressable data. Restoring onto a mesh passes the
+target sharding (``restore_year(..., sharding=)``) so shards land
+directly on their devices.
 """
 
 from __future__ import annotations
@@ -47,11 +54,10 @@ class Writer:
             # overwriting, and skipping would resurrect a previous
             # run's carry on resume
             self._mgr.delete(year)
-        self._mgr.save(
-            year,
-            args=ocp.args.StandardSave(jax.tree.map(np.asarray, carry)),
-            force=True,
-        )
+        # leaves go in as live (possibly globally-sharded) jax.Arrays:
+        # orbax persists each process's addressable shards, which is
+        # what makes multi-host checkpointing work without a host fetch
+        self._mgr.save(year, args=ocp.args.StandardSave(carry), force=True)
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
@@ -79,14 +85,31 @@ def latest_year(directory: str) -> Optional[int]:
 
 
 def restore_year(
-    directory: str, n_agents: int, year: Optional[int] = None
+    directory: str,
+    n_agents: int,
+    year: Optional[int] = None,
+    sharding=None,
 ) -> Tuple[int, SimCarry]:
-    """(year, carry) for ``year`` (default: latest checkpointed year)."""
+    """(year, carry) for ``year`` (default: latest checkpointed year).
+
+    ``sharding``: a jax Sharding to restore each leaf onto (pass the
+    run's agent-axis NamedSharding for mesh/multi-host runs — shards
+    are read straight to their devices, no full-array host copy).
+    """
     with _mgr(directory) as mgr:
         step = year if year is not None else mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
-        template = jax.tree.map(np.asarray, SimCarry.zeros(n_agents))
+        zeros = SimCarry.zeros(n_agents)
+        if sharding is not None:
+            template = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=sharding
+                ),
+                zeros,
+            )
+        else:
+            template = jax.tree.map(np.asarray, zeros)
         restored = mgr.restore(
             step, args=ocp.args.StandardRestore(template)
         )

@@ -15,6 +15,14 @@ Layout:
     <run_dir>/agent_outputs/year=<Y>.parquet
     <run_dir>/state_hourly/year=<Y>.parquet     (hour-major long format)
     <run_dir>/finance_series/year=<Y>.parquet
+
+Multi-host: each process writes its OWN addressable shard rows as
+``year=<Y>-p<proc>.parquet`` partitions (replicated surfaces like the
+state-hourly aggregate are written by process 0 only), so a
+jax.distributed run persists every surface with zero cross-host
+gathers; :func:`load_surface` concatenates the parts. The reference
+gets the same property from per-task Postgres writes
+(dgen_model.py:459-462).
 """
 
 from __future__ import annotations
@@ -23,8 +31,47 @@ import json
 import os
 from typing import Dict, Optional, Sequence
 
+import jax
 import numpy as np
 import pandas as pd
+
+
+def _host_rows(
+    arr, with_idx: bool = True
+) -> tuple[np.ndarray, Optional[np.ndarray]]:
+    """(rows, global_row_idx) of the process-locally addressable part of
+    a per-agent array; idx None means all rows are local (the
+    single-controller case, or a fully replicated leaf).
+
+    ``with_idx=False`` skips building the index array — for follow-up
+    fields of the same pytree, whose sharding (hence index window) is
+    identical to the first field's.
+    """
+    # duck-typed (not isinstance) so the multi-host path is unit-testable
+    # from a single-controller test process
+    if (
+        getattr(arr, "is_fully_addressable", True) is False
+    ):
+        if arr.is_fully_replicated:
+            return np.asarray(arr), None
+        # distinct local shards, deduped (replication within a host
+        # yields repeated index windows)
+        seen: Dict[int, tuple[int, np.ndarray]] = {}
+        for s in arr.addressable_shards:
+            sl = s.index[0] if s.index else slice(None)
+            start = sl.start or 0
+            stop = sl.stop if sl.stop is not None else arr.shape[0]
+            if start not in seen:
+                seen[start] = (stop, np.asarray(s.data))
+        starts = sorted(seen)
+        rows = np.concatenate([seen[s][1] for s in starts], axis=0)
+        if not with_idx:
+            return rows, None
+        idx = np.concatenate(
+            [np.arange(s, seen[s][0]) for s in starts]
+        )
+        return rows, idx
+    return np.asarray(arr), None
 
 #: YearOutputs fields exported to agent_outputs (the reference drops
 #: its heavy intermediate columns before writing, dgen_model.py:441-456;
@@ -63,7 +110,8 @@ class RunExporter:
     ) -> None:
         self.run_dir = run_dir
         self.keep = np.asarray(mask) > 0
-        self.agent_id = np.asarray(agent_id)[self.keep]
+        self._ids_full = np.asarray(agent_id)
+        self.agent_id = self._ids_full[self.keep]
         self.state_names = list(state_names) if state_names else None
         self.finance_series = finance_series
         os.makedirs(run_dir, exist_ok=True)
@@ -71,8 +119,38 @@ class RunExporter:
         # synthetic_default vs ingested, from scenario ingest) is written
         # up front so a run's outputs carry their own caveats
         self.meta = {"n_agents": int(self.keep.sum()), **(meta or {})}
-        with open(os.path.join(run_dir, "meta.json"), "w") as f:
-            json.dump(self.meta, f, indent=2, default=str)
+        if jax.process_index() == 0:
+            with open(os.path.join(run_dir, "meta.json"), "w") as f:
+                json.dump(self.meta, f, indent=2, default=str)
+
+    def _part_name(self, year: int) -> str:
+        """Per-year parquet partition name; multi-host runs write one
+        part per process."""
+        if jax.process_count() > 1:
+            return f"year={year}-p{jax.process_index()}.parquet"
+        return f"year={year}.parquet"
+
+    def _local(self, arr) -> tuple[np.ndarray, np.ndarray]:
+        """(rows, ids): this process's real-agent rows of a per-agent
+        field, with their stable agent ids."""
+        (rows,), ids = self._local_fields([arr])
+        return rows, ids
+
+    def _local_fields(self, arrs) -> tuple[list, np.ndarray]:
+        """(rows per field, ids), with the shard index/keep bookkeeping
+        computed ONCE — every per-agent field of a YearOutputs shares
+        one sharding, so only the first field builds the index."""
+        first, idx = _host_rows(arrs[0])
+        if idx is None:
+            sel, ids = self.keep, self.agent_id
+        else:
+            sel = self.keep[idx]
+            ids = self._ids_full[idx][sel]
+        out = [first[sel]]
+        for a in arrs[1:]:
+            rows, _ = _host_rows(a, with_idx=False)
+            out.append(rows[sel])
+        return out, ids
 
     def _check_state_names(self, n_states: int) -> None:
         if self.state_names is not None and len(self.state_names) != n_states:
@@ -85,35 +163,41 @@ class RunExporter:
         self.write_agent_outputs(year, outs)
         if self.finance_series:
             self.write_finance_series(year, outs)
-        hourly = np.asarray(outs.state_hourly_net_mw)
-        if hourly.size:
-            self.write_state_hourly(year, hourly)
+        # the state aggregate is replicated across hosts; one writer
+        if (
+            getattr(outs.state_hourly_net_mw, "size", 0)
+            and jax.process_index() == 0
+        ):
+            self.write_state_hourly(
+                year, np.asarray(outs.state_hourly_net_mw)
+            )
 
     # --- agent_outputs (reference dgen_model.py:460-462) ---
     def write_agent_outputs(self, year: int, outs) -> None:
-        cols: Dict[str, np.ndarray] = {"agent_id": self.agent_id}
-        for f in AGENT_OUTPUT_FIELDS:
-            cols[f] = np.asarray(getattr(outs, f))[self.keep]
-        df = pd.DataFrame(cols)
-        df.insert(1, "year", year)
+        rows, ids = self._local_fields(
+            [getattr(outs, f) for f in AGENT_OUTPUT_FIELDS]
+        )
+        cols = dict(zip(AGENT_OUTPUT_FIELDS, rows))
+        df = pd.DataFrame({"agent_id": ids, "year": year, **cols})
         df.to_parquet(
             os.path.join(_dir(self.run_dir, "agent_outputs"),
-                         f"year={year}.parquet")
+                         self._part_name(year))
         )
 
     # --- agent_finance_series (reference finance_series_export.py:22) ---
     def write_finance_series(self, year: int, outs) -> None:
-        cf = np.asarray(outs.cash_flow)[self.keep]          # [n, Y+1]
-        ev = np.asarray(outs.energy_value_pv_only)[self.keep]  # [n, Y]
+        (cf, ev), ids = self._local_fields(
+            [outs.cash_flow, outs.energy_value_pv_only]  # [n,Y+1],[n,Y]
+        )
         df = pd.DataFrame({
-            "agent_id": self.agent_id,
+            "agent_id": ids,
             "year": year,
             "cash_flow": list(cf),
             "energy_value": list(ev),
         })
         df.to_parquet(
             os.path.join(_dir(self.run_dir, "finance_series"),
-                         f"year={year}.parquet")
+                         self._part_name(year))
         )
 
     # --- state_hourly_agg (reference attachment_rate_functions.py:151) ---

@@ -653,6 +653,12 @@ class Simulation:
             chunk if chunk and table.n_agents // n_dev > chunk else 0
         )
 
+        # host-side identity columns, captured BEFORE device placement:
+        # exporters key their rows on these, and fetching them back from
+        # a globally-sharded table would fail under true multi-host
+        self.host_agent_id = np.asarray(table.agent_id)
+        self.host_mask = np.asarray(table.mask)
+
         if mesh is not None:
             shard = NamedSharding(mesh, P(AGENT_AXIS))
             repl = NamedSharding(mesh, P())
@@ -755,13 +761,12 @@ class Simulation:
                     f"year grid {self.years}; refusing to resume"
                 )
             if last is not None:
+                # a mesh run restores straight onto its sharding (no
+                # full-array host copy — multi-host safe)
                 _, restored = ckpt.restore_year(
-                    checkpoint_dir, self.table.n_agents, last
+                    checkpoint_dir, self.table.n_agents, last,
+                    sharding=self._shard,
                 )
-                if self._shard is not None:
-                    restored = jax.tree.map(
-                        lambda x: jax.device_put(x, self._shard), restored
-                    )
                 carry = restored
                 start_idx = self.years.index(last) + 1
                 logger.info("resuming after year %d (index %d)", last, start_idx)

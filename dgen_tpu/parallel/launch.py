@@ -182,37 +182,29 @@ def main() -> None:
     mesh = make_mesh() if len(jax.devices()) > 1 else None
     sim = Simulation(pop.table, profiles, pop.tariffs, inputs, cfg,
                      RunConfig.from_env(), mesh=mesh)
-    if distributed:
-        # per-year parquet exports AND orbax checkpoints both fetch
-        # full arrays to host (np.asarray on the carry), which raises
-        # for globally-sharded multi-host arrays — multi-host runs go
-        # straight through without host-side persistence for now
-        import logging
-
-        logging.getLogger("dgen_tpu").warning(
-            "multi-host run: per-year exports/checkpoints disabled "
-            "(host fetch of non-addressable shards)"
-        )
-        res = sim.run(collect=False)
-    else:
-        exporter = RunExporter(
-            run_dir, agent_id=np.asarray(sim.table.agent_id),
-            mask=np.asarray(sim.table.mask),
-            state_names=list(input_states),
-            meta={
-                "scenario": cfg.name, "shard": shard,
-                "states": list(states),
-                "market_curves": meta["market_curves"],
-            },
-        )
-        res = run_with_recovery(
-            sim, os.path.join(run_dir, "ckpt"), callback=exporter,
-            collect=False,
-        )
+    # one persistence path for single- AND multi-host runs: orbax saves
+    # sharded carries collectively, and the exporter writes each
+    # process's local shard rows (io.export) — the distributed-run
+    # analogue of the reference's always-persisted per-task outputs
+    # (dgen_model.py:459-462)
+    exporter = RunExporter(
+        run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask,
+        state_names=list(input_states),
+        meta={
+            "scenario": cfg.name, "shard": shard,
+            "states": list(states),
+            "distributed": bool(distributed),
+            "n_processes": jax.process_count(),
+            "market_curves": meta["market_curves"],
+        },
+    )
+    res = run_with_recovery(
+        sim, os.path.join(run_dir, "ckpt"), callback=exporter,
+        collect=False,
+    )
     ran = pop.states if os.environ.get("DGEN_PACKAGE") else states
-    dest = run_dir if not distributed else "(no host outputs: multi-host)"
     print(f"shard {shard} ({','.join(ran)}): "
-          f"{len(res.years)} years -> {dest}")
+          f"{len(res.years)} years -> {run_dir}")
 
 
 def run_with_recovery(sim, checkpoint_dir: str, max_retries: int = 3,

@@ -90,6 +90,82 @@ def test_resume_matches_uninterrupted(tmp_path):
     np.testing.assert_allclose(r_last, f["system_kw_cum"][-1], rtol=1e-5)
 
 
+def test_host_rows_multihost_shard_path():
+    """_host_rows must return only the locally-addressable rows (with
+    their global indices, deduped across replicated local devices) for
+    a non-fully-addressable array — the true multi-host case, simulated
+    with a stub since a single-controller test owns every shard."""
+    import dataclasses
+
+    full = np.arange(12, dtype=np.float32).reshape(6, 2)
+
+    @dataclasses.dataclass
+    class Shard:
+        index: tuple
+        data: np.ndarray
+
+    class Stub:
+        is_fully_addressable = False
+        is_fully_replicated = False
+        shape = full.shape
+        # this process holds rows [2:4) twice (two local devices with a
+        # replicated copy) and rows [4:6) once; rows [0:2) are remote
+        addressable_shards = [
+            Shard((slice(2, 4), slice(None)), full[2:4]),
+            Shard((slice(2, 4), slice(None)), full[2:4]),
+            Shard((slice(4, 6), slice(None)), full[4:6]),
+        ]
+
+    rows, idx = exp._host_rows(Stub())
+    np.testing.assert_array_equal(idx, [2, 3, 4, 5])
+    np.testing.assert_array_equal(rows, full[2:6])
+
+    # replicated leaf: everything is local
+    class Repl(Stub):
+        is_fully_replicated = True
+
+        def __array__(self, dtype=None):
+            return full
+
+    rows, idx = exp._host_rows(Repl())
+    assert idx is None
+    np.testing.assert_array_equal(rows, full)
+
+    # plain arrays pass straight through
+    rows, idx = exp._host_rows(full)
+    assert idx is None and rows is not None
+
+
+def test_exporter_local_rows_multihost(tmp_path):
+    """RunExporter keyed writes stay correct when a process holds only a
+    slice of the agent axis: ids come from the global index window and
+    padding rows are dropped."""
+    import dataclasses
+
+    n = 8
+    ids = np.arange(100, 100 + n)
+    mask = np.ones(n, np.float32)
+    mask[5] = 0.0  # a padding row inside the local window
+    ex = exp.RunExporter(str(tmp_path / "run"), agent_id=ids, mask=mask)
+
+    vals = np.arange(n, dtype=np.float32) * 10
+
+    @dataclasses.dataclass
+    class Shard:
+        index: tuple
+        data: np.ndarray
+
+    class Stub:
+        is_fully_addressable = False
+        is_fully_replicated = False
+        shape = (n,)
+        addressable_shards = [Shard((slice(4, 8),), vals[4:8])]
+
+    rows, got_ids = ex._local(Stub())
+    np.testing.assert_array_equal(got_ids, [104, 106, 107])
+    np.testing.assert_array_equal(rows, [40.0, 60.0, 70.0])
+
+
 def test_exporter_surfaces(tmp_path):
     sim, pop = make_sim(with_hourly=True)
     exporter = exp.RunExporter(

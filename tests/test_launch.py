@@ -1,6 +1,12 @@
 """Launch harness: state binning, shard command emission, env plumbing,
-and the federal ITC schedule (cluster-orchestration analogues,
-SURVEY.md §2.6 L7)."""
+distributed persistence, and the federal ITC schedule
+(cluster-orchestration analogues, SURVEY.md §2.6 L7)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
 
 import numpy as np
 
@@ -53,6 +59,100 @@ def test_federal_itc_schedule_values():
     np.testing.assert_allclose(sch[3], 0.26)
     np.testing.assert_allclose(sch[4], 0.22)
     np.testing.assert_allclose(sch[5], [0.0, 0.10, 0.10])
+
+
+def test_distributed_run_persists_and_resumes(tmp_path):
+    """A jax.distributed-initialized mesh run must write checkpoints
+    plus all three parquet surfaces, and resume across a process
+    restart — the behavior the reference gets from always-persisted
+    per-task outputs (dgen_model.py:459-462). Runs in a subprocess
+    because jax.distributed is process-global state."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = textwrap.dedent(f"""
+        import os, sys
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+        jax.distributed.initialize(
+            coordinator_address="127.0.0.1:{port}",
+            num_processes=1, process_id=0,
+        )
+        assert jax.process_count() == 1 and len(jax.devices()) == 8
+        import numpy as np
+        import jax.numpy as jnp
+        from dgen_tpu.config import RunConfig, ScenarioConfig
+        from dgen_tpu.io import synth
+        from dgen_tpu.io.export import RunExporter
+        from dgen_tpu.models import scenario as scen
+        from dgen_tpu.models.simulation import Simulation
+        from dgen_tpu.parallel.launch import run_with_recovery
+        from dgen_tpu.parallel.mesh import make_mesh
+
+        run_dir = {str(tmp_path / "run")!r}
+        cfg = ScenarioConfig(name="dist", start_year=2014, end_year=2018,
+                             anchor_years=())
+        pop = synth.generate_population(96, states=["DE", "CA"], seed=3,
+                                        pad_multiple=64)
+        inputs = scen.uniform_inputs(cfg, n_groups=pop.table.n_groups,
+                                     n_regions=pop.n_regions)
+
+        def build():
+            return Simulation(pop.table, pop.profiles, pop.tariffs,
+                              inputs, cfg, RunConfig(sizing_iters=6),
+                              mesh=make_mesh(), with_hourly=True)
+
+        phase = sys.argv[1]
+        sim = build()
+        exporter = RunExporter(
+            run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask)
+        if phase == "first":
+            res = run_with_recovery(sim, run_dir + "/ckpt",
+                                    callback=exporter, collect=False)
+            assert len(res.years) == 3
+            print("FIRST_OK")
+        else:
+            # restart: drop the final year's checkpoint so the resumed
+            # run must actually re-execute 2018 from the 2016 carry
+            from dgen_tpu.io import checkpoint as ckpt
+            assert ckpt.latest_year(run_dir + "/ckpt") == 2018
+            import orbax.checkpoint as ocp
+            with ocp.CheckpointManager(run_dir + "/ckpt") as mgr:
+                mgr.delete(2018)
+            res = sim.run(checkpoint_dir=run_dir + "/ckpt", resume=True,
+                          callback=exporter)
+            assert res.years == [2018], res.years
+            # sharded restore really lands on the mesh
+            _, carry = ckpt.restore_year(
+                run_dir + "/ckpt", sim.table.n_agents, 2018,
+                sharding=sim._shard)
+            assert not carry.market.market_share.is_fully_replicated
+            print("RESUME_OK")
+    """)
+    env = {**os.environ, "PYTHONUNBUFFERED": "1"}
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for phase in ("first", "resume"):
+        proc = subprocess.run(
+            [sys.executable, "-c", script, phase],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=repo_root,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert f"{phase.upper()}_OK" in proc.stdout
+
+    # all three surfaces exist and reassemble
+    from dgen_tpu.io.export import load_surface
+
+    run_dir = str(tmp_path / "run")
+    agent = load_surface(run_dir, "agent_outputs")
+    assert set(agent["year"]) == {2014, 2016, 2018}
+    assert (agent.groupby("year").size() == 96).all()
+    fin = load_surface(run_dir, "finance_series")
+    assert len(fin) == 3 * 96
+    hourly = load_surface(run_dir, "state_hourly")
+    assert len(hourly["state"].unique()) > 0
 
 
 def test_run_with_recovery_resumes_after_crash(tmp_path):

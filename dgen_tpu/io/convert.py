@@ -173,8 +173,19 @@ def reference_tariff_to_demand_spec(
         rows = np.asarray(mat, dtype=np.float64)
         if rows.ndim != 2 or rows.shape[1] < 4 or not rows.size:
             return None, None
-        P = int(rows[:, 0].max())
-        T = int(rows[:, 1].max())
+        # junk guard: every row's period/tier index must be a sane
+        # 1-based URDB index — a malformed row (e.g. a max_kW landed in
+        # the tier column, or a 0/negative index that would wrap the
+        # dense fill below) makes the tariff's demand charges
+        # unpriceable rather than silently mis-binned
+        pcol, tcol = rows[:, 0], rows[:, 1]
+        if not (
+            np.all((1 <= pcol) & (pcol <= 64))
+            and np.all((1 <= tcol) & (tcol <= 64))
+        ):
+            return None, None
+        P = int(pcol.max())
+        T = int(tcol.max())
         prices = np.zeros((T, P))
         levels = np.full((T, P), BIG_CAP)
         for r in rows:

@@ -232,8 +232,19 @@ def run_with_recovery(sim, checkpoint_dir: str, max_retries: int = 3,
         except (FileNotFoundError, OSError):
             return False
 
+    import jax
+
+    # In-process retries are only sound single-controller: after a
+    # failed collective in a multi-process (jax.distributed) run the
+    # runtime is degraded and a lone process re-entering sim.run would
+    # hang at the next collective/orbax barrier. Re-raise instead so
+    # the cluster scheduler's task-level restart (the reference's
+    # maxRetryCount, batch_job_yamls/...:10) relaunches EVERY process;
+    # the fresh run resumes from the last checkpoint via should_resume.
+    retries = max_retries if jax.process_count() == 1 else 0
+
     last_err = None
-    for attempt in range(max_retries + 1):
+    for attempt in range(retries + 1):
         try:
             return sim.run(
                 checkpoint_dir=checkpoint_dir,
@@ -248,7 +259,7 @@ def run_with_recovery(sim, checkpoint_dir: str, max_retries: int = 3,
 
             logging.getLogger("dgen_tpu").warning(
                 "run attempt %d/%d failed: %s", attempt + 1,
-                max_retries + 1, e,
+                retries + 1, e,
             )
     raise last_err
 

@@ -1,0 +1,214 @@
+"""Generator for the committed golden end-to-end fixture.
+
+Produces a ~100-agent population in the reference's EXACT pickle schema
+(the column set import_agent_file consumes, reference
+input_data_functions.py:389-443: index agent_id, object ``tariff_dict``
+cells, bldg/solar profile keys, eia_id) plus the side tables the
+reference keeps in Postgres (hourly profile tables replacing
+elec.py:508-558, NEM limits elec.py:92-119, state incentives).
+
+Run ONCE to (re)generate the fixture files; the committed outputs are
+the contract — regenerating changes the golden adoption values and must
+be accompanied by a rebase of golden_adoption.json (see
+tests/test_golden_e2e.py).
+
+    python tests/fixtures/make_golden_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+
+HOURS = 8760
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _legacy_flat(price, fixed=8.0, stringify=False):
+    td = {
+        "e_prices": [[price]],
+        "e_levels": [[1e9]],
+        "e_wkday_12by24": [[0] * 24 for _ in range(12)],
+        "e_wkend_12by24": [[0] * 24 for _ in range(12)],
+        "fixed_charge": fixed,
+        "ur_metering_option": 0,
+    }
+    return json.dumps(td) if stringify else td
+
+
+def _legacy_tiered(price, fixed=9.0):
+    return {
+        "e_prices": [[price, price * 1.45], [price * 1.15, price * 1.7]],
+        "e_levels": [[650.0, 650.0], [1e9, 1e9]],
+        "e_wkday_12by24": [[0] * 12 + [1] * 12 for _ in range(12)],
+        "e_wkend_12by24": [[0] * 24 for _ in range(12)],
+        "fixed_charge": fixed,
+        "ur_metering_option": 0,
+    }
+
+
+def _ur_tou(price, fixed=6.0, metering=2):
+    return {
+        "ur_ec_tou_mat": [
+            [1, 1, 1e38, 0, price, 0.0],
+            [2, 1, 1e38, 0, price * 1.6, 0.0],
+        ],
+        "ur_ec_sched_weekday": [[1] * 16 + [2] * 5 + [1] * 3
+                                for _ in range(12)],
+        "ur_ec_sched_weekend": [[1] * 24 for _ in range(12)],
+        "ur_monthly_fixed_charge": fixed,
+        "ur_metering_option": metering,
+    }
+
+
+def _ur_tou_demand(price=0.105, fixed=22.0):
+    """Commercial TOU tariff carrying demand charges (priced by
+    ops.demand in analysis runs; inert for the sizing hot loop, the
+    reference's SKIP_DEMAND_CHARGES)."""
+    td = _ur_tou(price, fixed=fixed, metering=0)
+    # row format [period(1..P), tier(1..T), max_kW, price]
+    # (reference financial_functions.py:793)
+    td["ur_dc_flat_mat"] = [[1, 1, 1e38, 12.5]]
+    td["ur_dc_tou_mat"] = [[1, 1, 1e38, 4.0]]
+    td["ur_dc_sched_weekday"] = [[1] * 24 for _ in range(12)]
+    td["ur_dc_sched_weekend"] = None  # present-but-null, as in the wild
+    return td
+
+
+def build_agents(n=96, seed=20260730):
+    rng = np.random.default_rng(seed)
+    states = ["DE", "MD"]
+    sectors = ["res", "com", "ind"]
+
+    rows = []
+    for i in range(n):
+        s = i % 2
+        sector = sectors[i % 3]
+        if i % 13 == 5:
+            # known-bad tariff id, reassigned at conversion (elec.py:993)
+            tid, td = 4145, _legacy_flat(9.99)
+        elif sector == "res":
+            fam = i % 3
+            if fam == 0:
+                tid, td = 100 + s, _legacy_flat(
+                    0.115 + 0.02 * s, stringify=(i % 2 == 0))
+            elif fam == 1:
+                tid, td = 200 + s, _legacy_tiered(0.095 + 0.01 * s)
+            else:
+                tid, td = 300 + s, _ur_tou(0.12 + 0.015 * s)
+        elif sector == "com":
+            tid, td = (400 + s, _ur_tou_demand()) if i % 2 else \
+                (410 + s, _ur_tou(0.10, fixed=35.0, metering=0))
+        else:
+            tid, td = 500 + s, _legacy_flat(0.085, fixed=120.0)
+        rows.append({
+            "agent_id": i,
+            "state_abbr": states[s],
+            "census_division_abbr": "SA",
+            "county_id": 1000 + s,
+            "sector_abbr": sector,
+            "customers_in_bin": float(rng.integers(80, 5000)),
+            "load_kwh_per_customer_in_bin": float(
+                rng.uniform(*{
+                    "res": (4.5e3, 1.4e4),
+                    "com": (4.0e4, 3.5e5),
+                    "ind": (5.0e5, 3.0e6),
+                }[sector])
+            ),
+            "load_kwh_in_bin": 0.0,
+            "max_demand_kw": float(rng.uniform(2, 400)),
+            "developable_roof_sqft": float(rng.uniform(200, 5e4)),
+            "pct_of_bldgs_developable": float(rng.uniform(0.3, 0.9)),
+            "tariff_id": tid,
+            "tariff_dict": td,
+            "bldg_id": int(i % 6),
+            "solar_re_9809_gid": int(100 + (i % 4)),
+            "tilt": 25,
+            "azimuth": "S",
+            "eia_id": float(500 + s),
+        })
+    return pd.DataFrame(rows).set_index("agent_id")
+
+
+def build_profiles(frame, seed=20260730):
+    rng = np.random.default_rng(seed + 1)
+    hours = np.arange(HOURS)
+    day = np.sin(np.pi * ((hours % 24) - 6) / 12).clip(0)
+    season = 1.0 + 0.3 * np.cos(2 * np.pi * ((hours // 24) - 200) / 365.0)
+
+    load_rows = []
+    for key, _ in frame.groupby(["bldg_id", "sector_abbr", "state_abbr"]):
+        b, sec, st = key
+        shape = (0.45 + rng.random(HOURS) * 0.6 + 0.35 * day) * season
+        load_rows.append({
+            "bldg_id": b, "sector_abbr": sec, "state_abbr": st,
+            "consumption_hourly": shape.tolist(),
+        })
+    cf_rows = []
+    for key, _ in frame.groupby(["solar_re_9809_gid", "tilt", "azimuth"]):
+        g, t, a = key
+        cf = day * rng.uniform(0.65, 0.95) * 1e6  # reference 1e6 scale
+        cf_rows.append({
+            "solar_re_9809_gid": g, "tilt": t, "azimuth": a,
+            "cf": cf.tolist(),
+        })
+    return pd.DataFrame(load_rows), pd.DataFrame(cf_rows)
+
+
+def build_side_tables():
+    state_nem = pd.DataFrame([
+        {"state_abbr": "DE", "sector_abbr": "res",
+         "nem_system_kw_limit": 25.0, "first_year": 2010,
+         "sunset_year": 2038},
+        {"state_abbr": "DE", "sector_abbr": "com",
+         "nem_system_kw_limit": 2000.0, "first_year": 2010,
+         "sunset_year": 2038},
+        {"state_abbr": "MD", "sector_abbr": "res",
+         "nem_system_kw_limit": 20.0, "first_year": 2010,
+         "sunset_year": 2032},
+        {"state_abbr": "MD", "sector_abbr": "com",
+         "nem_system_kw_limit": 1500.0, "first_year": 2010,
+         "sunset_year": 2032},
+    ])
+    util_nem = pd.DataFrame([
+        {"eia_id": 500, "state_abbr": "DE", "sector_abbr": "res",
+         "nem_system_kw_limit": 10.0, "first_year": 2012,
+         "sunset_year": 2030},
+    ])
+    incentives = pd.DataFrame([
+        {"state_abbr": "DE", "sector_abbr": "res", "cbi_usd_p_w": 0.35,
+         "ibi_pct": np.nan, "pbi_usd_p_kwh": np.nan,
+         "max_incentive_usd": 4000.0, "incentive_duration_yrs": np.nan},
+        {"state_abbr": "MD", "sector_abbr": "res", "cbi_usd_p_w": np.nan,
+         "ibi_pct": 0.12, "pbi_usd_p_kwh": np.nan,
+         "max_incentive_usd": 3000.0, "incentive_duration_yrs": np.nan},
+        {"state_abbr": "MD", "sector_abbr": "com", "cbi_usd_p_w": np.nan,
+         "ibi_pct": np.nan, "pbi_usd_p_kwh": 0.015,
+         "max_incentive_usd": np.nan, "incentive_duration_yrs": 10.0},
+    ])
+    return state_nem, util_nem, incentives
+
+
+def main() -> None:
+    frame = build_agents()
+    load_df, cf_df = build_profiles(frame)
+    state_nem, util_nem, incentives = build_side_tables()
+
+    # protocol 4: stable across the pinned pandas/python environment
+    frame.to_pickle(os.path.join(HERE, "golden_agents.pkl"), protocol=4)
+    load_df.to_pickle(
+        os.path.join(HERE, "golden_load_profiles.pkl"), protocol=4)
+    cf_df.to_pickle(
+        os.path.join(HERE, "golden_solar_profiles.pkl"), protocol=4)
+    state_nem.to_csv(os.path.join(HERE, "golden_state_nem.csv"), index=False)
+    util_nem.to_csv(os.path.join(HERE, "golden_util_nem.csv"), index=False)
+    incentives.to_csv(
+        os.path.join(HERE, "golden_incentives.csv"), index=False)
+    print("fixture written under", HERE)
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,111 @@ def test_commercial_depreciation_adds_value():
     assert npv_com - npv_res > 0.75 * want_gain_undisc
 
 
+def test_cashloan_hand_computed_residential_loan_itc():
+    """Year-by-year hand computation of a residential levered case
+    against the kernel (the SAM Cashloan subset dGen drives, reference
+    financial_functions.py:385-394 parameter mapping: debt_fraction
+    from down_payment, loan_term, federal ITC in year 1, NO
+    depreciation or interest deduction for res).
+
+    Every expected number below derives from first principles (annuity
+    payment, declining balance), not from the kernel's own closed form.
+    """
+    n_years = 25
+    cost = 20000.0
+    down_frac, rate, term, itc_frac = 0.2, 0.05, 10, 0.30
+    ev = np.full(n_years, 1200.0, dtype=np.float32)
+    out = cf.cashflow(
+        jnp.asarray(ev), jnp.float32(cost),
+        _fin(down_payment_fraction=down_frac, loan_interest_rate=rate,
+             loan_term_yrs=term),
+        n_years,
+    )
+
+    # annuity payment on the financed 80%: P * r / (1 - (1+r)^-T)
+    principal = cost * (1.0 - down_frac)                    # 16000
+    pmt = principal * rate / (1.0 - (1.0 + rate) ** -term)  # 2072.07...
+    assert pmt == pytest.approx(2072.0727, rel=1e-5)
+    pay = np.asarray(out["payments"])
+    np.testing.assert_allclose(pay[:term], pmt, rtol=1e-5)
+    assert np.all(pay[term:] == 0.0)
+
+    # declining-balance interest, iterated by hand
+    bal, want_interest = principal, []
+    for _ in range(term):
+        i = bal * rate
+        want_interest.append(i)
+        bal -= pmt - i
+    assert bal == pytest.approx(0.0, abs=1e-2)  # fully amortized
+    np.testing.assert_allclose(
+        np.asarray(out["interest"])[:term], want_interest, rtol=1e-4)
+
+    # cashflow rows: year 0 = -down payment; year 1 adds the full ITC;
+    # residential => no tax shields on interest or depreciation
+    want_cf = np.zeros(n_years + 1)
+    want_cf[0] = -cost * down_frac                          # -4000
+    itc = itc_frac * cost                                   # 6000
+    for y in range(n_years):
+        want_cf[1 + y] = ev[y] - (pmt if y < term else 0.0) + \
+            (itc if y == 0 else 0.0)
+    np.testing.assert_allclose(np.asarray(out["cf"]), want_cf, rtol=1e-5)
+
+    # NPV at the nominal rate (1+real)(1+infl)-1
+    dnom = (1.027) * (1.025) - 1.0
+    want_npv = (want_cf / (1.0 + dnom) ** np.arange(n_years + 1)).sum()
+    assert float(out["npv"]) == pytest.approx(want_npv, rel=1e-4)
+
+
+def test_cashloan_hand_computed_commercial_macrs_tax_shields():
+    """Commercial case: MACRS-5 on an ITC-halved basis plus deductible
+    loan interest, at the combined fed/state rate with state tax
+    deductible from federal — the depr_fed_type=2 + 70/30 split path
+    (reference financial_functions.py:387-421)."""
+    n_years = 25
+    cost = 100000.0
+    rate, term = 0.06, 15
+    ev = np.full(n_years, 9000.0, dtype=np.float32)
+    out = cf.cashflow(
+        jnp.asarray(ev), jnp.float32(cost),
+        _fin(down_payment_fraction=0.0, loan_interest_rate=rate,
+             loan_term_yrs=term, is_commercial=1.0),
+        n_years,
+    )
+
+    # effective marginal rate: fed 70% + state 30% of the 25.7% rate,
+    # state deductible from federal income
+    fed, sta = 0.257 * 0.7, 0.257 * 0.3
+    tau = fed + sta - fed * sta
+    assert tau == pytest.approx(0.2431297, rel=1e-4)
+
+    # MACRS-5 half-year schedule on basis = cost * (1 - ITC/2)
+    macrs = [0.20, 0.32, 0.192, 0.1152, 0.1152, 0.0576]
+    basis = cost * (1.0 - 0.5 * 0.30)                       # 85000
+    want_depr = np.zeros(n_years)
+    want_depr[:6] = np.asarray(macrs) * basis
+    np.testing.assert_allclose(
+        np.asarray(out["depreciation"]), want_depr, rtol=1e-5)
+
+    # fully-financed: year 0 equity is zero, year-by-year flows carry
+    # payment, ITC, and both tax shields
+    pmt = cost * rate / (1.0 - (1.0 + rate) ** -term)
+    bal, interest = cost, []
+    for _ in range(term):
+        interest.append(bal * rate)
+        bal -= pmt - bal * rate
+    want_cf = np.zeros(n_years + 1)
+    for y in range(n_years):
+        want_cf[1 + y] = (
+            ev[y]
+            - (pmt if y < term else 0.0)
+            + (interest[y] * tau if y < term else 0.0)
+            + want_depr[y] * tau
+            + (0.30 * cost if y == 0 else 0.0)
+        )
+    np.testing.assert_allclose(
+        np.asarray(out["cf"]), want_cf, rtol=1e-4)
+
+
 def test_payback_semantics():
     # instant: positive from year 0
     cf0 = jnp.asarray(np.array([1.0, 1.0, 1.0], dtype=np.float32))

@@ -401,6 +401,26 @@ def test_converter_throughput_200k(tmp_path):
     assert np.all(cbi[sec == 2, 0] == 0.0)
 
 
+def test_demand_mat_junk_rows_rejected():
+    """Malformed ur_dc_* rows (garbage period/tier indices, e.g. a
+    max_kW value landing in the tier column) must make the demand spec
+    None instead of wrapping into wrong dense-table cells or allocating
+    absurd [T, P] tables."""
+    # tier column carries 1e38 (the malformed shape that motivated the
+    # guard): unpriceable, not a MemoryError
+    td = {"ur_dc_flat_mat": [[1, 1e38, 12.5, 0.0]]}
+    assert convert.reference_tariff_to_demand_spec(td) is None
+    # a zero period index alongside a valid row would wrap prices[0,-1]
+    td = {"ur_dc_tou_mat": [[1, 1, 1e38, 10.0], [0, 1, 1e38, 5.0]],
+          "ur_dc_sched_weekday": [[1] * 24 for _ in range(12)]}
+    assert convert.reference_tariff_to_demand_spec(td) is None
+    # well-formed rows still compile
+    td = {"ur_dc_flat_mat": [[1, 1, 1e38, 12.5]]}
+    spec = convert.reference_tariff_to_demand_spec(td)
+    assert spec is not None
+    np.testing.assert_allclose(spec["d_flat_prices"], [[12.5]])
+
+
 def test_incentives_all_nan_keys_yield_zeros():
     """Non-empty incentive frames whose keys never form a group (NaN
     state/sector) must compile to all-zero slots, not crash."""

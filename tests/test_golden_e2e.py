@@ -1,0 +1,138 @@
+"""Golden end-to-end fixture: committed reference-schema pickle ->
+converter -> package -> 19-year simulation -> pinned adoption curves.
+
+The fixture (tests/fixtures/, generated once by make_golden_fixture.py)
+is a ~100-agent population in the reference's exact pickle schema —
+object tariff_dict cells across every family the converter handles
+(legacy flat/tiered, normalized ur_* TOU, a demand-charge carrier, a
+known-bad id), NEM state+utility tables, and state incentives. The
+pinned curves in golden_adoption.json are the regression contract: any
+kernel change that shifts national adoption by more than 0.1% on this
+fixture fails here (VERDICT r2 item 2; the reference-side analogue is
+BASELINE.md's adoption-curve parity north star).
+
+Rebase intentionally with:
+    DGEN_TPU_WRITE_GOLDEN=1 python -m pytest tests/test_golden_e2e.py
+"""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import convert, package
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+GOLDEN_PATH = os.path.join(FIXTURES, "golden_adoption.json")
+HOURS = 8760
+
+#: the regression contract: adoption within 0.1% of the pinned curves
+RTOL = 1e-3
+
+
+@pytest.fixture(scope="module")
+def golden_run(tmp_path_factory):
+    frame = pd.read_pickle(os.path.join(FIXTURES, "golden_agents.pkl"))
+    load_df = pd.read_pickle(
+        os.path.join(FIXTURES, "golden_load_profiles.pkl"))
+    cf_df = pd.read_pickle(
+        os.path.join(FIXTURES, "golden_solar_profiles.pkl"))
+    state_nem = pd.read_csv(os.path.join(FIXTURES, "golden_state_nem.csv"))
+    util_nem = pd.read_csv(os.path.join(FIXTURES, "golden_util_nem.csv"))
+    incentives = pd.read_csv(
+        os.path.join(FIXTURES, "golden_incentives.csv"))
+
+    out = str(tmp_path_factory.mktemp("golden") / "pkg")
+    convert.from_reference_pickle(
+        frame, out, load_df, cf_df,
+        wholesale_by_region={"SA": np.full(HOURS, 0.03)},
+        state_incentives=incentives,
+        nem_state_by_sector=state_nem,
+        nem_utility_by_sector=util_nem,
+    )
+    pop = package.load_population(out, pad_multiple=32)
+
+    cfg = ScenarioConfig(name="golden", start_year=2014, end_year=2050,
+                         anchor_years=())
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups,
+        n_regions=np.asarray(pop.profiles.wholesale).shape[0],
+        overrides={
+            "attachment_rate": np.full((pop.table.n_groups,), 0.35,
+                                       np.float32),
+        },
+        n_states=pop.table.n_states,
+    )
+    sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                     RunConfig(sizing_iters=8))
+    res = sim.run()
+    assert len(res.years) == 19
+    mask = np.asarray(pop.table.mask)
+    s = res.summary(mask)
+    curves = {
+        "years": list(map(int, res.years)),
+        "adopters": [round(float(v), 4) for v in s["adopters"]],
+        "system_kw_cum": [round(float(v), 3) for v in s["system_kw_cum"]],
+        "batt_kwh_cum": [round(float(v), 3) for v in s["batt_kwh_cum"]],
+    }
+    return pop, res, curves
+
+
+def test_golden_adoption_curves(golden_run):
+    _, _, curves = golden_run
+    if os.environ.get("DGEN_TPU_WRITE_GOLDEN"):
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(curves, f, indent=1)
+        pytest.skip("golden curves rebased")
+    if not os.path.exists(GOLDEN_PATH):
+        pytest.fail(
+            "golden_adoption.json missing — generate with "
+            "DGEN_TPU_WRITE_GOLDEN=1 python -m pytest "
+            "tests/test_golden_e2e.py"
+        )
+    with open(GOLDEN_PATH) as f:
+        golden = json.load(f)
+    assert curves["years"] == golden["years"]
+    for key in ("adopters", "system_kw_cum", "batt_kwh_cum"):
+        np.testing.assert_allclose(
+            curves[key], golden[key], rtol=RTOL,
+            err_msg=f"{key} drifted >0.1% from the golden fixture curve",
+        )
+
+
+def test_golden_fixture_exercises_converter_surface(golden_run):
+    """The fixture must keep covering the converter paths it was built
+    to pin: tariff families incl. a demand carrier, NEM windows with a
+    utility override, incentives."""
+    pop, res, _ = golden_run
+    keep = np.asarray(pop.table.mask) > 0
+    # NEM: the DE-res utility override (10 kW, sunset 2030) beats the
+    # state row (25 kW, sunset 2038)
+    st = np.asarray(pop.table.state_idx)[keep]
+    sec = np.asarray(pop.table.sector_idx)[keep]
+    eia = np.asarray(pop.table.nem_kw_limit)[keep]
+    de_res = (st == pop.states.index("DE")) & (sec == 0)
+    assert np.all(eia[de_res] == np.float32(10.0))
+    sunset = np.asarray(pop.table.nem_sunset_year)[keep]
+    assert np.all(sunset[de_res] == np.float32(2030.0))
+    # incentives compiled for DE-res (CBI 0.35 $/W)
+    cbi = np.asarray(pop.table.incentives.cbi_usd_p_w)[keep]
+    assert np.all(cbi[de_res, 0] == np.float32(0.35))
+    # demand-charge tariffs survived conversion into a compilable bank
+    from dgen_tpu.ops.demand import compile_demand_bank
+
+    demand_specs = [s.get("demand") for s in pop.tariff_specs]
+    assert any(d for d in demand_specs), \
+        "fixture should carry demand-charge tariffs"
+    assert compile_demand_bank(demand_specs) is not None
+    # adoption actually happened and is monotone
+    m = np.asarray(pop.table.mask)
+    kw = (res.agent["system_kw_cum"] * m[None, :]).sum(axis=1)
+    assert kw[-1] > 0
+    assert np.all(np.diff(kw) >= -1e-3)

@@ -87,7 +87,14 @@ def _parse_point(tok: str) -> tuple[int, int]:
 
 def _is_oom(err: Exception) -> bool:
     s = str(err)
-    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
+    # direct PJRT signatures, plus the tunneled-compile flavor: a
+    # remote compile helper reports HBM exhaustion as an INTERNAL
+    # HTTP 500 with the "Ran out of memory ... hbm" detail on stderr
+    return any(tok in s for tok in (
+        "RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+        "Ran out of memory", "hbm capacity",
+        "tpu_compile_helper subprocess exit code",
+    ))
 
 
 def _round8(r: int) -> int:
@@ -274,10 +281,12 @@ def main() -> None:
                 "sec_per_year_step": round(dt, 4),
                 "agent_years_per_sec": round(n_real_s / dt, 2),
             })
-        except Exception as e:  # noqa: BLE001 — record the OOM wall
-            if not _is_oom(e):
-                raise
-            entry["oom"] = True
+        except Exception as e:  # noqa: BLE001 — a probe point must not
+            # kill the bench: record the wall (or the failure) instead
+            if _is_oom(e):
+                entry["oom"] = True
+            else:
+                entry["failed"] = str(e)[:300]
         return entry
 
     # --- population scale curve (agent-years/sec per cached step);

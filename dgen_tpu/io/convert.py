@@ -180,8 +180,8 @@ def reference_tariff_to_demand_spec(
         # unpriceable rather than silently mis-binned
         pcol, tcol = rows[:, 0], rows[:, 1]
         if not (
-            np.all((1 <= pcol) & (pcol <= 64))
-            and np.all((1 <= tcol) & (tcol <= 64))
+            np.all((1 <= pcol) & (pcol <= 64) & (pcol == np.floor(pcol)))
+            and np.all((1 <= tcol) & (tcol <= 64) & (tcol == np.floor(tcol)))
         ):
             return None, None
         P = int(pcol.max())

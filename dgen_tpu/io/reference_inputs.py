@@ -58,6 +58,26 @@ from dgen_tpu.utils.timing import fn_timer
 #: census divisions (the reference's load-growth region key)
 CENSUS_DIVISIONS = ("NE", "MA", "ENC", "WNC", "SA", "ESC", "WSC", "MTN", "PAC")
 
+#: standard US Census Bureau state -> division assignment (the
+#: reference resolves this via its county table's
+#: census_division_abbr column, absent from the OS release; a state's
+#: counties all share its division, so the division IS the per-state
+#: key). Division abbrs match CENSUS_DIVISIONS; note division "NE"
+#: (New England) vs state "NE" (Nebraska) are distinct namespaces.
+STATE_CENSUS_DIVISION = {
+    **{s: "NE" for s in ("CT", "MA", "ME", "NH", "RI", "VT")},
+    **{s: "MA" for s in ("NJ", "NY", "PA")},
+    **{s: "ENC" for s in ("IL", "IN", "MI", "OH", "WI")},
+    **{s: "WNC" for s in ("IA", "KS", "MN", "MO", "ND", "NE", "SD")},
+    **{s: "SA" for s in ("DC", "DE", "FL", "GA", "MD", "NC", "SC",
+                         "VA", "WV")},
+    **{s: "ESC" for s in ("AL", "KY", "MS", "TN")},
+    **{s: "WSC" for s in ("AR", "LA", "OK", "TX")},
+    **{s: "MTN" for s in ("AZ", "CO", "ID", "MT", "NM", "NV", "UT",
+                          "WY")},
+    **{s: "PAC" for s in ("AK", "CA", "HI", "OR", "WA")},
+}
+
 
 def load_pv_plus_batt_prices(
     path: str, model_years: Sequence[int]
@@ -292,8 +312,10 @@ def scenario_inputs_from_reference(
     if os.path.exists(itc_path):
         ov["itc_fraction"] = jnp.asarray(ingest.load_stacked_sectors(
             itc_path, "itc_fraction", years))
+        itc_source = "ingested"
     else:
         ov["itc_fraction"] = jnp.asarray(scen.federal_itc_schedule(years))
+        itc_source = "federal_statute_default"
 
     # --- financing ---
     if "financing" in files:
@@ -394,18 +416,32 @@ def scenario_inputs_from_reference(
     sl_path = _opt("nem_state_limits.csv")
     pk_path = _opt("peak_demand_mw.csv")
     cfp_path = _opt("cf_during_peak_demand.csv")
+    nem_caps_source = "uncapped_default"
     if sl_path and pk_path and cfp_path:
+        nem_caps_source = "ingested"
         import pandas as pd
 
         from dgen_tpu.io.nem import compile_state_nem_caps
 
-        # residential load multiplier proxy for peak-demand growth
-        # (reference elec.py:813-814 averages county res growth per
-        # state; regions here are census divisions, so use the regional
-        # mean as every state's multiplier)
+        # residential load multiplier for peak-demand growth (reference
+        # elec.py:813-814 averages county res growth per state; a
+        # state's counties share its census division, so each state
+        # takes its OWN division's growth — the division-mean fallback
+        # covers only states outside the standard assignment)
         res_mult = None
-        if "load_growth" in ov:
+        if "load_growth" in ov and region_kind == "census_division":
             lg = np.asarray(ov["load_growth"])            # [Y, R, S]
+            cd_of = {c: i for i, c in enumerate(regions)}
+            fallback = lg[:, :, 0].mean(axis=1)           # [Y]
+            res_mult = np.empty((len(years), n_states), np.float32)
+            for si, s in enumerate(states):
+                cd = STATE_CENSUS_DIVISION.get(s)
+                res_mult[:, si] = (
+                    lg[:, cd_of[cd], 0] if cd in cd_of else fallback
+                )
+        elif "load_growth" in ov:
+            # BA-keyed regions don't map to states; keep the mean proxy
+            lg = np.asarray(ov["load_growth"])
             res_mult = np.broadcast_to(
                 lg[:, :, 0].mean(axis=1, keepdims=True),
                 (len(years), n_states),
@@ -431,5 +467,12 @@ def scenario_inputs_from_reference(
         ),
         "files": files,
         "market_curves": market_curves,
+        # provenance for the other two drop-ins (market_curves carries
+        # mms/bass): stamped into every run's meta.json so synthetic
+        # defaults are never mistaken for ingested policy data
+        "data_sources": {
+            "itc": itc_source,
+            "nem_caps": nem_caps_source,
+        },
     }
     return inputs, meta

@@ -196,6 +196,7 @@ def main() -> None:
             "distributed": bool(distributed),
             "n_processes": jax.process_count(),
             "market_curves": meta["market_curves"],
+            "data_sources": meta.get("data_sources", {}),
         },
     )
     res = run_with_recovery(

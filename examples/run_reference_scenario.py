@@ -27,6 +27,7 @@ cfg = ScenarioConfig(name="national-ref", start_year=2014, end_year=2040)
 states = list(synth.STATES)
 inputs, meta = scenario_inputs_from_reference(REF, cfg, states)
 print(f"ingested reference trajectories: {sorted(meta['files'])}")
+print(f"data sources: {meta['data_sources']}")
 print(f"market curves: {meta['market_curves']} "
       "(synthetic_default = NOT dGen's Postgres-only Bass/mms curves; "
       "drop in max_market_curves.csv / bass_params.csv for real ones)")
@@ -45,7 +46,8 @@ run_dir = tempfile.mkdtemp(prefix="dgen_tpu_run_")
 exporter = exp.RunExporter(
     run_dir, agent_id=np.asarray(pop.table.agent_id),
     mask=np.asarray(pop.table.mask), state_names=states,
-    meta={"scenario": cfg.name, "market_curves": meta["market_curves"]},
+    meta={"scenario": cfg.name, "market_curves": meta["market_curves"],
+          "data_sources": meta["data_sources"]},
 )
 sim = Simulation(pop.table, profiles, pop.tariffs, inputs, cfg,
                  RunConfig(sizing_iters=10))

@@ -414,6 +414,10 @@ def test_demand_mat_junk_rows_rejected():
     td = {"ur_dc_tou_mat": [[1, 1, 1e38, 10.0], [0, 1, 1e38, 5.0]],
           "ur_dc_sched_weekday": [[1] * 24 for _ in range(12)]}
     assert convert.reference_tariff_to_demand_spec(td) is None
+    # a non-integer tier index (a max_kW landed in the tier column but
+    # within [1, 64]) is junk too, not a truncate-and-mis-bin
+    td = {"ur_dc_flat_mat": [[1, 12.5, 1e38, 4.0]]}
+    assert convert.reference_tariff_to_demand_spec(td) is None
     # well-formed rows still compile
     td = {"ur_dc_flat_mat": [[1, 1, 1e38, 12.5]]}
     spec = convert.reference_tariff_to_demand_spec(td)

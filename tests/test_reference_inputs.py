@@ -148,21 +148,37 @@ def test_nem_caps_compile_when_state_limits_present(tmp_path):
     pd.DataFrame([
         {"state_abbr": "CA", "first_year": 2014, "sunset_year": 2050,
          "max_cum_capacity_mw": "", "max_pct_cum_capacity": 5.0},
+        {"state_abbr": "OH", "first_year": 2014, "sunset_year": 2050,
+         "max_cum_capacity_mw": "", "max_pct_cum_capacity": 5.0},
     ]).to_csv(root / "nem_state_limits.csv", index=False)
 
     cfg = ScenarioConfig(name="ref", start_year=2014, end_year=2020,
                          anchor_years=())
-    states = ["CA", "TX"]
+    states = ["CA", "OH", "TX"]
     inputs, _ = scenario_inputs_from_reference(str(root), cfg, states)
     caps = np.asarray(inputs.nem_cap_kw)
+    from dgen_tpu.io.reference_inputs import CENSUS_DIVISIONS
+
+    lg = np.asarray(inputs.load_growth)                    # [Y, R, S]
     # CA: 5% x 51697.29 MW / 0.492661101 (peak_demand_mw.csv,
-    # cf_during_peak_demand.csv), scaled by the regional-mean res load
-    # multiplier the compiler applies as its peak-demand proxy
-    res_mult = float(np.asarray(inputs.load_growth)[0, :, 0].mean())
-    base = 0.05 * 51697.29 / 0.492661101 * 1000.0 * res_mult
-    assert caps[0, 0] == pytest.approx(base, rel=0.01)
+    # cf_during_peak_demand.csv), scaled by CA's OWN census division's
+    # (PAC) res growth — the per-state analogue of the reference's
+    # county-average peak-demand tracking (elec.py:813-814)
+    pac = CENSUS_DIVISIONS.index("PAC")
+    base_ca = 0.05 * 51697.29 / 0.492661101 * 1000.0 * lg[0, pac, 0]
+    assert caps[0, 0] == pytest.approx(base_ca, rel=0.01)
+    # OH rides ENC growth; with real trajectories the two divisions
+    # differ, so the caps' growth paths must differ too (the old
+    # global-mean proxy made every state's cap grow identically)
+    enc = CENSUS_DIVISIONS.index("ENC")
+    ratio_ca = caps[-1, 0] / caps[0, 0]
+    ratio_oh = caps[-1, 1] / caps[0, 1]
+    np.testing.assert_allclose(
+        ratio_ca, lg[-1, pac, 0] / lg[0, pac, 0], rtol=1e-5)
+    np.testing.assert_allclose(
+        ratio_oh, lg[-1, enc, 0] / lg[0, enc, 0], rtol=1e-5)
     # TX has no limits row -> uncapped
-    assert caps[0, 1] > 1e29
+    assert caps[0, 2] > 1e29
 
 
 def test_wholesale_hourly_shape(tmp_path):

@@ -62,9 +62,16 @@ def _kernel(scales_ref, load_ref, gen_ref, sell_ref, bucket_ref,
     """One agent per program: [r_pad, B_PAD] bucket sums.
 
     Outputs: (imports,) or (imports, signed) when ``with_signed``.
-    ``bf16`` runs the MXU contraction in bfloat16 with f32 accumulation
-    (~4x the f32 MXU rate on v5e) — used for search rounds, where only
-    the candidate RANKING matters; final/battery evaluations stay f32.
+
+    ``bf16`` is inert on this stack, kept for API stability: the
+    runtime compiles with ``--xla_allow_excess_precision=true``, which
+    (a) lets Mosaic elide the f32->bf16->f32 casts and (b) already runs
+    the f32 dot at the MXU's native bf16 input precision — measured
+    round 3: the bf16 variant is bit-identical to f32 and the same
+    speed, and a genuinely-bf16-operand variant (bf16 HBM inputs, no
+    elidable casts) was also no faster, confirming the contraction is
+    not the bottleneck (the per-program cost is VPU one-hot/net work
+    serialized with the dot).
     """
     scales = scales_ref[0, 0, :]                           # [r_pad]
     acc_i = jnp.zeros((r_pad, B_PAD), jnp.float32)
@@ -107,6 +114,23 @@ def _round8(r: int) -> int:
     return ((r + 7) // 8) * 8
 
 
+def _pick_h_chunk(r_pad: int, with_signed: bool) -> int:
+    """Largest hour chunk whose working set fits VMEM (~16 MB/core).
+
+    Per chunk the kernel holds net [r_pad, hc] f32, M [hc, B_PAD] f32,
+    the accumulators and the resident input rows; the signed path keeps
+    BOTH net and relu(net) live (each feeds its own dot), doubling the
+    r_pad term. Fewer, larger chunks measured ~5-10%% faster at
+    r_pad=256 (fewer VPU<->MXU pipeline boundaries); candidates are the
+    divisors of H_PAD."""
+    budget = 14_000_000  # leave headroom under the 16 MB VMEM
+    r_live = (2 if with_signed else 1) * r_pad
+    for hc in (8832, 4416, 2208, 1104, 552):
+        if 4 * (r_live + B_PAD) * hc <= budget:
+            return hc
+    return 552
+
+
 def _sums_pallas(load, gen, sell, bucket_id, scales, with_signed, bf16=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -114,8 +138,7 @@ def _sums_pallas(load, gen, sell, bucket_id, scales, with_signed, bf16=False):
     n = load.shape[0]
     r = scales.shape[1]
     r_pad = _round8(r)
-    # keep VMEM bounded: net is [r_pad, h_chunk] f32 (+ its relu copy)
-    h_chunk = 2208 if r_pad <= 64 else 1104
+    h_chunk = _pick_h_chunk(r_pad, with_signed)
 
     load_p = _pad_hours(load)[:, None, :]
     gen_p = _pad_hours(gen)[:, None, :]

@@ -140,6 +140,13 @@ class RunExporter:
         """(rows per field, ids), with the shard index/keep bookkeeping
         computed ONCE — every per-agent field of a YearOutputs shares
         one sharding, so only the first field builds the index."""
+        if not any(
+            getattr(a, "is_fully_addressable", True) is False for a in arrs
+        ):
+            # single-controller: ONE batched transfer for all fields
+            # (per-leaf np.asarray costs a host round trip each)
+            host = jax.device_get(list(arrs))
+            return [h[self.keep] for h in host], self.agent_id
         first, idx = _host_rows(arrs[0])
         if idx is None:
             sel, ids = self.keep, self.agent_id

@@ -804,6 +804,12 @@ class Simulation:
             callback is not None or ckpt_writer is not None or collect
             or debug or profile_dir
         )
+        # pipelined mode still bounds in-flight years: every queued
+        # step's YearOutputs buffers stay live until it executes, so an
+        # unthrottled queue holds queue-depth x per-year-outputs of
+        # extra HBM (~380 MB/year at 1M agents). Drain often enough to
+        # cap that at ~2 GB; at small populations this never triggers.
+        sync_every: Optional[int] = None
 
         for yi, year in enumerate(self.years):
             if yi < start_idx:
@@ -823,6 +829,17 @@ class Simulation:
                     carry, outs = self.step(carry, yi, first_year=(yi == 0))
                     if sync_per_year:
                         jax.block_until_ready(carry.market.market_share)
+                    else:
+                        if sync_every is None:
+                            per_year = sum(
+                                l.size * l.dtype.itemsize
+                                for l in jax.tree.leaves(outs)
+                            )
+                            sync_every = max(
+                                1, int(2e9 // max(per_year, 1))
+                            )
+                        if (yi - start_idx) % sync_every == sync_every - 1:
+                            jax.block_until_ready(carry.market.market_share)
             finally:
                 if trace_now:
                     jax.profiler.stop_trace()
@@ -849,15 +866,27 @@ class Simulation:
             if ckpt_writer is not None:
                 ckpt_writer.save(year, carry)
             if collect:
-                for k in agent_fields:
-                    collected[k].append(np.asarray(getattr(outs, k)))
+                # ONE batched device_get per year: per-leaf np.asarray
+                # costs a full host round trip each (~130 ms through a
+                # remote tunnel), turning collection into the dominant
+                # cost of small runs
+                to_fetch = {k: getattr(outs, k) for k in agent_fields}
                 if self.with_hourly:
-                    hourly.append(np.asarray(outs.state_hourly_net_mw))
+                    to_fetch["_hourly"] = outs.state_hourly_net_mw
+                host = jax.device_get(to_fetch)
+                for k in agent_fields:
+                    collected[k].append(host[k])
+                if self.with_hourly:
+                    hourly.append(host["_hourly"])
 
         if not sync_per_year:
-            # drain the queued year pipeline before returning
+            # drain the queued year pipeline before returning; the
+            # scalar fetch (not just block_until_ready) guarantees the
+            # chain really executed even on remote-tunnel platforms
+            # with lazy readiness semantics
             with timing.timer("device_drain"):
                 jax.block_until_ready(carry.market.market_share)
+                float(jnp.sum(carry.batt_adopters_cum))
         if ckpt_writer is not None:
             ckpt_writer.close()
         agent = (

@@ -102,6 +102,16 @@ def _round8(r: int) -> int:
     return ((r + 7) // 8) * 8
 
 
+def _pert_eps() -> float:
+    """Process-unique perturbation for cache-defeating timed reps.
+
+    Floored at 1e-4 so it survives float32 rounding on O(1..100)
+    carry values (a sub-ulp perturbation leaves the array bitwise
+    identical, and the runtime's cross-process (executable, inputs)
+    execution cache then serves a ~1 ms hit as the step time)."""
+    return 1e-4 * (1.0 + (time.time_ns() % 997) / 997.0)
+
+
 def _sizing_flops_per_step(n: int, k: int, n_years: int, n_periods: int) -> float:
     """Modeled matmul FLOPs of one year step's sizing engine.
 
@@ -135,17 +145,20 @@ def _time_steps(sim, n_rep: int = 3) -> float:
     carry, _ = sim.step(carry, 0, first_year=True)
     carry, out = sim.step(carry, 1, first_year=False)
     jax.block_until_ready(out.system_kw_cum)
-    total = 0.0
+    best = float("inf")
+    eps = _pert_eps()
     for i in range(n_rep):
         pert = dc.replace(
             carry,
-            batt_adopters_cum=carry.batt_adopters_cum + (i + 1) * 1e-4,
+            batt_adopters_cum=carry.batt_adopters_cum + (i + 1) * eps,
         )
         t0 = time.time()
         _, out = sim.step(pert, 1, first_year=False)
         jax.block_until_ready(out.system_kw_cum)
-        total += time.time() - t0
-    return total / n_rep
+        # min over reps: the tunnel to the device adds high-variance
+        # host latency that the mean would fold into the step time
+        best = min(best, time.time() - t0)
+    return best
 
 
 def _time_sizing(sim, n_rep: int = 3) -> float:
@@ -196,7 +209,9 @@ def _trace_step(sim) -> dict | None:
         carry, out = sim.step(carry, 1, first_year=False)
         jax.block_until_ready(out.system_kw_cum)
         pert = dc.replace(
-            carry, batt_adopters_cum=carry.batt_adopters_cum + 1e-4)
+            carry,
+            batt_adopters_cum=carry.batt_adopters_cum + _pert_eps(),
+        )
         tdir = tempfile.mkdtemp(prefix="dgen_bench_trace_")
         jax.profiler.start_trace(tdir)
         try:
@@ -262,10 +277,11 @@ def _cpu_baseline(sim, pop) -> float:
         # and the perturbation itself must not be billed to the step)
         import dataclasses as dc
         perturbed = []
+        eps = _pert_eps()
         for i in range(n_rep):
             c_i = dc.replace(
                 carry1,
-                batt_adopters_cum=carry1.batt_adopters_cum + (i + 1) * 1e-4,
+                batt_adopters_cum=carry1.batt_adopters_cum + (i + 1) * eps,
             )
             a = list(args)
             a[4] = c_i

@@ -1,0 +1,80 @@
+"""Post-run analysis passes the sizing hot loop deliberately skips.
+
+The reference disables demand charges globally in its adoption loop
+(``SKIP_DEMAND_CHARGES``, financial_functions.py:35) but its tariff
+layer can price them (tariff_functions.py:762-799). Here the same
+split: the sizing kernels never price demand, and this module offers
+the analysis-run path — annual per-agent demand charges over the
+baseline / PV-only / PV+battery net loads of a converted population
+whose tariffs carry ``d_flat_*`` / ``d_tou_*`` structures
+(io.convert preserves them as each tariff spec's ``"demand"``
+sub-spec).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.models.agents import AgentTable, ProfileBank
+from dgen_tpu.ops import demand as demand_ops
+from dgen_tpu.ops import dispatch as dispatch_ops
+from dgen_tpu.ops.sizing import INV_EFF, net_hourly_profiles
+
+
+def demand_charge_audit(
+    table: AgentTable,
+    profiles: ProfileBank,
+    tariff_specs: Sequence[dict],
+    load_kwh_per_customer: jax.Array,
+    system_kw: Optional[jax.Array] = None,
+    batt_kw: Optional[jax.Array] = None,
+    batt_kwh: Optional[jax.Array] = None,
+    batt_rt_eff: Optional[jax.Array] = None,
+) -> Optional[Dict[str, jax.Array]]:
+    """Annual demand charges ($/customer-year) per agent and scenario.
+
+    Returns ``{"baseline": [N], "pv_only": [N], "with_batt": [N]}``
+    (the latter two only when sizes are given; agents whose tariff has
+    no demand charges price 0), or None when NO tariff in the corpus
+    carries demand structures — the adoption-loop norm (reference
+    SKIP_DEMAND_CHARGES, financial_functions.py:35).
+
+    ``system_kw`` etc. are typically a run's sized outputs
+    (``YearOutputs.system_kw`` / ``batt_kw`` / ``batt_kwh``); net loads
+    are rebuilt exactly as the sizing kernel's hourly outputs
+    (ops.sizing.net_hourly_profiles), so the audit prices the same
+    profiles the adoption model aggregated.
+    """
+    bank = demand_ops.compile_demand_bank(
+        [s.get("demand") for s in tariff_specs]
+    )
+    if bank is None:
+        return None
+    at = jax.tree.map(lambda x: x[table.tariff_idx], bank)
+
+    load = profiles.load[table.load_idx] * load_kwh_per_customer[:, None]
+    charge = jax.vmap(demand_ops.annual_demand_charge)
+
+    out: Dict[str, jax.Array] = {
+        "baseline": charge(load, at) * table.mask,
+    }
+    if system_kw is None:
+        return out
+    gen = profiles.solar_cf[table.cf_idx] * (system_kw * INV_EFF)[:, None]
+    _, pv_net, _ = net_hourly_profiles(load, gen, gen)
+    out["pv_only"] = charge(pv_net, at) * table.mask
+    if batt_kw is not None and batt_kwh is not None:
+        rt = (
+            jnp.full(table.n_agents, dispatch_ops.DEFAULT_RT_EFF,
+                     jnp.float32)
+            if batt_rt_eff is None else batt_rt_eff
+        )
+        dr = jax.vmap(dispatch_ops.dispatch_battery)(
+            load, gen, batt_kw, batt_kwh, rt
+        )
+        _, _, batt_net = net_hourly_profiles(load, gen, dr.system_out)
+        out["with_batt"] = charge(batt_net, at) * table.mask
+    return out

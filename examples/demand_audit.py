@@ -1,0 +1,69 @@
+"""Demand-charge analysis over a converted reference-schema population.
+
+The adoption hot loop skips demand charges on purpose (the reference's
+SKIP_DEMAND_CHARGES parity, financial_functions.py:35); this is the
+ANALYSIS path: convert a reference-format pickle whose tariff dicts
+carry ``ur_dc_*`` / ``d_flat_*`` structures, size a year, then price
+each agent's baseline / PV-only / PV+battery net load through
+``dgen_tpu.analysis.demand_charge_audit``.
+
+Runs off the committed golden fixture (tests/fixtures/).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import pandas as pd
+
+from dgen_tpu.analysis import demand_charge_audit
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import convert, package
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+
+FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   os.pardir, "tests", "fixtures")
+
+frame = pd.read_pickle(os.path.join(FIX, "golden_agents.pkl"))
+pkg = tempfile.mkdtemp(prefix="dgen_demand_audit_")
+convert.from_reference_pickle(
+    frame, pkg,
+    pd.read_pickle(os.path.join(FIX, "golden_load_profiles.pkl")),
+    pd.read_pickle(os.path.join(FIX, "golden_solar_profiles.pkl")),
+    wholesale_by_region={"SA": np.full(8760, 0.03)},
+)
+pop = package.load_population(pkg, pad_multiple=32)
+
+cfg = ScenarioConfig(name="audit", start_year=2014, end_year=2016,
+                     anchor_years=())
+inputs = scen.uniform_inputs(
+    cfg, n_groups=pop.table.n_groups,
+    n_regions=np.asarray(pop.profiles.wholesale).shape[0],
+    n_states=pop.table.n_states,
+)
+sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                 RunConfig(sizing_iters=8))
+carry = sim.init_carry()
+_, outs = sim.step(carry, 0, first_year=True)
+
+ya = scen.apply_year(sim.table, sim.inputs, jnp.asarray(0, jnp.int32))
+audit = demand_charge_audit(
+    sim.table, sim.profiles, pop.tariff_specs,
+    ya.load_kwh_per_customer,
+    system_kw=outs.system_kw, batt_kw=outs.batt_kw,
+    batt_kwh=outs.batt_kwh, batt_rt_eff=ya.batt_rt_eff,
+)
+assert audit is not None, "golden fixture carries demand tariffs"
+
+m = np.asarray(sim.table.mask) > 0
+priced = np.asarray(audit["baseline"])[m] > 0
+print(f"{priced.sum()} of {m.sum()} agents carry demand charges")
+for k in ("baseline", "pv_only", "with_batt"):
+    v = np.asarray(audit[k])[m][priced]
+    print(f"  {k:10s}: mean ${v.mean():,.0f}/yr  "
+          f"median ${np.median(v):,.0f}/yr")
+sav = np.asarray(audit["baseline"] - audit["with_batt"])[m][priced]
+print(f"PV+battery demand-charge savings: mean ${sav.mean():,.0f}/yr")
+print("DEMAND AUDIT OK")

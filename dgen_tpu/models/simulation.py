@@ -653,43 +653,63 @@ class Simulation:
             chunk if chunk and table.n_agents // n_dev > chunk else 0
         )
 
-        # host-side identity columns, captured BEFORE device placement:
+        # host-side attributes, captured BEFORE device placement:
         # exporters key their rows on these, and fetching them back from
         # a globally-sharded table would fail under true multi-host
         self.host_agent_id = np.asarray(table.agent_id)
         self.host_mask = np.asarray(table.mask)
+        # static: whether any agent's post-adoption DG rate differs
+        # (skips the second tariff gather + bill structure when not)
+        self._rate_switch = bool(np.any(
+            np.asarray(table.tariff_switch_idx)
+            != np.asarray(table.tariff_idx)
+        ))
 
         if mesh is not None:
             shard = NamedSharding(mesh, P(AGENT_AXIS))
             repl = NamedSharding(mesh, P())
+
+            def put(x, sharding):
+                # multi-process (jax.distributed over a global mesh):
+                # device_put of host data to a sharding spanning remote
+                # devices raises, so build the global array from each
+                # process's addressable shards instead — every process
+                # holds the identical host copy (deterministic build),
+                # so the callback just slices it
+                if jax.process_count() > 1:
+                    h = np.asarray(x)
+                    return jax.make_array_from_callback(
+                        h.shape, sharding, lambda idx: h[idx]
+                    )
+                return jax.device_put(x, sharding)
 
             def place_agent_axis(x):
                 # shard leading (agent) axis; leave small leaves replicated
                 if hasattr(x, "ndim") and x.ndim >= 1 and (
                     x.shape[0] == table.n_agents
                 ):
-                    return jax.device_put(
-                        x, NamedSharding(mesh, P(AGENT_AXIS, *([None] * (x.ndim - 1))))
+                    return put(
+                        x,
+                        NamedSharding(
+                            mesh, P(AGENT_AXIS, *([None] * (x.ndim - 1)))
+                        ),
                     )
-                return jax.device_put(x, repl)
+                return put(x, repl)
 
             table = jax.tree.map(place_agent_axis, table)
-            profiles = jax.tree.map(lambda x: jax.device_put(x, repl), profiles)
-            tariffs = jax.tree.map(lambda x: jax.device_put(x, repl), tariffs)
-            inputs = jax.tree.map(lambda x: jax.device_put(x, repl), inputs)
+            profiles = jax.tree.map(lambda x: put(x, repl), profiles)
+            tariffs = jax.tree.map(lambda x: put(x, repl), tariffs)
+            inputs = jax.tree.map(lambda x: put(x, repl), inputs)
             self._shard = shard
+            self._put = put
         else:
             self._shard = None
+            self._put = None
 
         self.table = table
         self.profiles = profiles
         self.tariffs = tariffs
         self.inputs = inputs
-        # static: whether any agent's post-adoption DG rate differs
-        # (skips the second tariff gather + bill structure when not)
-        self._rate_switch = bool(np.any(
-            np.asarray(table.tariff_switch_idx) != np.asarray(table.tariff_idx)
-        ))
 
     def _step_kwargs(self, first_year: bool) -> dict:
         # Under a >1-device mesh the bucket-sums engine runs per-shard
@@ -713,7 +733,7 @@ class Simulation:
         carry = SimCarry.zeros(self.table.n_agents)
         if self._shard is not None:
             carry = jax.tree.map(
-                lambda x: jax.device_put(x, self._shard), carry
+                lambda x: self._put(x, self._shard), carry
             )
         return carry
 

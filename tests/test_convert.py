@@ -438,3 +438,60 @@ def test_incentives_all_nan_keys_yield_zeros():
     assert inc is not None
     np.testing.assert_allclose(np.asarray(inc.cbi_usd_p_w), 0.0)
     np.testing.assert_allclose(np.asarray(inc.pbi_years), 0)
+
+
+def test_converter_tolerates_ragged_real_world_frames(tmp_path):
+    """Real agent pickles are ragged: optional columns missing, junk
+    keys inside tariff dicts, NaN-bearing stringified dicts, float ids.
+    Conversion must either succeed with sane output or raise a clear
+    ValueError — never crash with TypeError/KeyError."""
+    rng = np.random.default_rng(9)
+    rows = []
+    for i in range(24):
+        td = _legacy_tariff(0.11 + 0.01 * (i % 3),
+                            stringify=(i % 4 == 0))
+        if isinstance(td, dict):
+            td["some_vendor_extension"] = {"nested": [1, 2, 3]}
+            td["energyratestructure"] = None  # junk key, present-null
+        # stringified tariffs pass through unmodified
+        rows.append({
+            "agent_id": i,
+            "state_abbr": "DE",
+            # census_division_abbr intentionally MISSING from half
+            **({"census_division_abbr": "SA"} if i % 2 else {}),
+            "sector_abbr": ["res", "com", "ind"][i % 3],
+            "customers_in_bin": float(rng.integers(50, 500)),
+            "load_kwh_per_customer_in_bin": float(rng.uniform(5e3, 5e4)),
+            "tariff_id": float(700 + (i % 5)),   # float-typed ids
+            "tariff_dict": td,
+            "bldg_id": i % 2,
+            "solar_re_9809_gid": 100,
+            "tilt": 25,
+            "azimuth": "S",
+            # eia_id / max_demand_kw / developable_* all absent
+        })
+    frame = pd.DataFrame(rows).set_index("agent_id")
+    load_df, cf_df = make_profile_tables(frame)
+    pop = convert.from_reference_pickle(
+        frame, str(tmp_path / "pkg"), load_df, cf_df)
+    keep = np.asarray(pop.table.mask) > 0
+    assert keep.sum() == 24
+    assert float(np.asarray(pop.tariffs.price).max()) < 1.0
+
+    # missing REQUIRED columns raise a clear ValueError naming them
+    bad = frame.drop(columns=["tariff_dict"])
+    with pytest.raises(ValueError, match="tariff_dict"):
+        convert.from_reference_pickle(
+            bad, str(tmp_path / "pkg2"), load_df, cf_df)
+
+    # a converted ragged population still runs
+    pop2 = package.load_population(str(tmp_path / "pkg"), pad_multiple=8)
+    cfg = ScenarioConfig(name="rag", start_year=2014, end_year=2016,
+                         anchor_years=())
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop2.table.n_groups,
+        n_regions=np.asarray(pop2.profiles.wholesale).shape[0],
+        n_states=pop2.table.n_states)
+    res = Simulation(pop2.table, pop2.profiles, pop2.tariffs, inputs,
+                     cfg, RunConfig(sizing_iters=6)).run()
+    assert np.isfinite(res.agent["system_kw_cum"]).all()

@@ -224,3 +224,28 @@ def test_wholesale_trajectory_multiplier(ref_scenario):
     np.testing.assert_allclose(wm[0], 1.0, rtol=1e-5)
     # the trajectory is not flat over the horizon
     assert np.abs(wm - 1.0).max() > 0.01
+
+
+def test_ba_region_mode():
+    """region_kind="ba": retail prices resolve per ReEDS balancing
+    area (the reference's native resolution); trajectories stay finite
+    and the BA list drives the region axis."""
+    cfg = ScenarioConfig(name="ba", start_year=2014, end_year=2020,
+                         anchor_years=())
+    inputs, meta = scenario_inputs_from_reference(
+        REF_INPUTS, cfg, ["CA", "TX"], region_kind="ba")
+    regions = meta["regions"]
+    assert len(regions) > 9, "BA mode should expose more than the 9 CDs"
+    mult = np.asarray(inputs.elec_price_multiplier)   # [Y, R, S]
+    assert mult.shape[1] == len(regions)
+    assert np.isfinite(mult).all() and (mult > 0).all()
+    # per-BA variation exists (census-division mode averages it away)
+    assert mult[-1, :, 0].std() > 1e-4
+    # wholesale base rates align with the BA axis
+    wb = np.asarray(meta["wholesale_base_usd_per_kwh"])
+    assert wb.shape[0] == len(regions)
+    assert np.isfinite(wb).all() and (wb >= 0).all()
+    # load growth in BA mode is the national-mean proxy: every region
+    # shares one trajectory (documented fallback, reference_inputs)
+    lg = np.asarray(inputs.load_growth)
+    assert np.allclose(lg, lg[:, :1, :], rtol=1e-5)

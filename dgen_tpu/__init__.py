@@ -21,8 +21,20 @@ Architecture (TPU-first, not a port):
   - Host I/O (ingest, profile store, checkpoints) stays off the device
     path in ``dgen_tpu.io``, replacing the reference's per-agent Postgres
     round trips (agent_mutation/elec.py:508-558).
+  - National-scale populations stream through the year step in fixed
+    agent chunks (``RunConfig.agent_chunk`` — a ``lax.scan`` that bounds
+    peak HBM to one chunk), and post-run analyses the adoption loop
+    skips (demand charges) live in ``dgen_tpu.analysis``.
 """
 
 __version__ = "0.1.0"
 
-from dgen_tpu import config, io, models, ops, parallel, utils  # noqa: F401
+from dgen_tpu import (  # noqa: F401
+    analysis,
+    config,
+    io,
+    models,
+    ops,
+    parallel,
+    utils,
+)

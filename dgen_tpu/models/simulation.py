@@ -17,7 +17,16 @@ Sharding: pass a :class:`jax.sharding.Mesh` and the driver lays the
 agent axis over it (NamedSharding); the only cross-device traffic is
 the state x sector segment reductions (tiny psums over ICI), matching
 the reference's per-state GCP-Batch sharding (SURVEY.md §2.6) but
-within one program.
+within one program. True multi-process (jax.distributed) runs place
+global arrays from each process's addressable shards and persist via
+collective orbax saves + per-process export shards.
+
+Scale: ``RunConfig.agent_chunk`` streams the agent axis through the
+sizing engine in fixed chunks (lax.scan), bounding peak HBM to one
+chunk — the measured single-chip path for ~1M-agent national
+populations past the ~50k whole-table ceiling. Runs with no per-year
+host consumer additionally pipeline year steps on device and drain
+once at the end.
 """
 
 from __future__ import annotations

@@ -242,6 +242,29 @@ def compute_nem_allowed(
     return (cap_gate & window & (table.nem_kw_limit > 0)).astype(jnp.float32)
 
 
+def nem_gate_never_closes(
+    nem_cap_kw: np.ndarray,
+    nem_first_year: np.ndarray,
+    nem_sunset_year: np.ndarray,
+    nem_kw_limit: np.ndarray,
+    years: List[int],
+) -> bool:
+    """Host-side static proof that :func:`compute_nem_allowed` returns
+    1 for every (real) agent in every model year — the two functions
+    mirror the SAME three gates (cap / window / positive limit) and
+    MUST change together: this one conservatively requires unbounded
+    caps (so no state can ever bind), windows covering the full year
+    grid, and positive limits. Used to statically drop net-billing
+    bill paths (``Simulation._net_billing``)."""
+    y_lo, y_hi = min(years), max(years)
+    return bool(
+        np.all(np.asarray(nem_cap_kw) >= 1e29)
+        and np.all(np.asarray(nem_first_year) <= y_lo)
+        and np.all(np.asarray(nem_sunset_year) >= y_hi)
+        and np.all(np.asarray(nem_kw_limit) > 0)
+    )
+
+
 # ---------------------------------------------------------------------------
 # Agent-axis chunking (the streaming year step)
 # ---------------------------------------------------------------------------
@@ -310,7 +333,7 @@ def _constrain_chunked(mesh: Mesh, a: jax.Array) -> jax.Array:
     static_argnames=(
         "n_periods", "econ_years", "sizing_iters", "first_year",
         "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
-        "rate_switch", "mesh", "agent_chunk",
+        "rate_switch", "mesh", "agent_chunk", "net_billing",
     ),
 )
 def year_step(
@@ -332,6 +355,7 @@ def year_step(
     rate_switch: bool = False,
     mesh: Optional[Mesh] = None,
     agent_chunk: int = 0,
+    net_billing: bool = True,
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
 
@@ -383,7 +407,7 @@ def year_step(
             res_c = sizing_ops.size_agents(
                 envs_c, n_periods=n_periods, n_years=econ_years,
                 n_iters=sizing_iters, keep_hourly=False, impl=sizing_impl,
-                mesh=mesh,
+                mesh=mesh, net_billing=net_billing,
             )
             return None, res_c
 
@@ -401,7 +425,7 @@ def year_step(
         res = sizing_ops.size_agents(
             envs, n_periods=n_periods, n_years=econ_years,
             n_iters=sizing_iters, keep_hourly=with_hourly, impl=sizing_impl,
-            mesh=mesh,
+            mesh=mesh, net_billing=net_billing,
         )
 
     # --- market step ---
@@ -673,6 +697,29 @@ class Simulation:
             np.asarray(table.tariff_switch_idx)
             != np.asarray(table.tariff_idx)
         ))
+        # static: whether net-billing bills can EVER price in this run.
+        # False only when (a) every tariff a real agent references —
+        # including DG-switch targets — is net-metering AND (b) the NEM
+        # policy gate provably never closes (unbounded caps, windows
+        # covering every model year, positive limits): the gate forces
+        # NET_BILLING at runtime when it closes (build_econ_inputs), so
+        # a binding cap or sunset makes the static skip unsound. When
+        # False, the sizing search prices bills by the linear NEM
+        # identity and skips its hourly bucket-sums kernel entirely.
+        keep = self.host_mask > 0
+        metering = np.asarray(tariffs.metering)
+        used = np.unique(np.concatenate([
+            np.asarray(table.tariff_idx)[keep],
+            np.asarray(table.tariff_switch_idx)[keep],
+        ]))
+        any_nb_tariff = bool(np.any(metering[used] == NET_BILLING))
+        self._net_billing = any_nb_tariff or not nem_gate_never_closes(
+            np.asarray(inputs.nem_cap_kw),
+            np.asarray(table.nem_first_year)[keep],
+            np.asarray(table.nem_sunset_year)[keep],
+            np.asarray(table.nem_kw_limit)[keep],
+            self.years,
+        )
 
         if mesh is not None:
             shard = NamedSharding(mesh, P(AGENT_AXIS))
@@ -736,6 +783,7 @@ class Simulation:
             rate_switch=self._rate_switch,
             mesh=self.mesh,
             agent_chunk=self._agent_chunk,
+            net_billing=self._net_billing,
         )
 
     def init_carry(self) -> SimCarry:

@@ -406,6 +406,33 @@ def bills_from_sums(
     return energy_bill + MONTHS * tariff.fixed_monthly[:, None]
 
 
+def _nem_energy_bill(lin, scales, tariff, n_periods):
+    """[N, R] annual NEM energy bills via the linear identity
+    ``signed(s) = S_load - s * S_gen`` (no fixed charges) — the single
+    definition shared by the all-NEM fast path and the mixed-metering
+    path, so their NEM bills cannot drift apart."""
+    s_load, s_gen = lin[0], lin[1]
+    n, r = scales.shape
+    signed = s_load[:, None, :] - scales[:, :, None] * s_gen[:, None, :]
+    return _tier_charge_batched(
+        signed.reshape(n, r, MONTHS, n_periods), tariff)
+
+
+def bills_linear_nem(
+    lin: tuple[jax.Array, jax.Array, jax.Array, jax.Array],
+    scales: jax.Array,    # [N, R]
+    tariff,
+    n_periods: int,
+) -> jax.Array:
+    """Annual bills [N, R] for an all-NET-METERING population: the
+    pure linear identity — NO hourly kernel work at all. Callers must
+    guarantee no agent prices on a net-billing tariff (the driver
+    derives that statically from the tariffs the population actually
+    references plus the NEM gate's never-closes proof)."""
+    bill = _nem_energy_bill(lin, scales, tariff, n_periods)
+    return bill + MONTHS * tariff.fixed_monthly[:, None]
+
+
 def bills_linear_nb(
     lin: tuple[jax.Array, jax.Array, jax.Array, jax.Array],
     imports: jax.Array,   # [N, R, B]
@@ -417,12 +444,10 @@ def bills_linear_nb(
     """Annual bills [N, R] from the search path's reduced outputs:
     NEM via the linear identity, net billing via import sums + the
     linear export-credit identity."""
-    s_load, s_gen, s_l_sell, s_g_sell = lin
+    s_l_sell, s_g_sell = lin[2], lin[3]
     n, r, _ = imports.shape
 
-    signed = s_load[:, None, :] - scales[:, :, None] * s_gen[:, None, :]
-    bill_nem = _tier_charge_batched(
-        signed.reshape(n, r, MONTHS, n_periods), tariff)
+    bill_nem = _nem_energy_bill(lin, scales, tariff, n_periods)
 
     credit = imp_sell - (s_l_sell[:, None] - scales * s_g_sell[:, None])
     bill_nb = _tier_charge_batched(

@@ -335,7 +335,7 @@ def size_one_agent(
 @partial(
     jax.jit,
     static_argnames=("n_periods", "n_years", "n_iters", "keep_hourly", "impl",
-                     "mesh"),
+                     "mesh", "net_billing"),
 )
 def _size_agents_fast(
     envs: AgentEconInputs,
@@ -345,6 +345,7 @@ def _size_agents_fast(
     keep_hourly: bool,
     impl: str,
     mesh=None,
+    net_billing: bool = True,
 ) -> SizingResult:
     """Table-level sizing via two refining candidate-grid rounds.
 
@@ -448,7 +449,19 @@ def _size_agents_fast(
     def candidate_bills(scales):
         """[N, R] packed (candidate, year) scales -> with-system annual
         bills on a given tariff structure; evaluated on the switched
-        tariff and, when a switch window exists, also on the original."""
+        tariff and, when a switch window exists, also on the original.
+
+        ``net_billing=False`` (the driver's static all-NEM detection):
+        every bill is the pure linear identity — the two dominant
+        bucket-sums kernel calls per search round are skipped entirely.
+        """
+        if not net_billing:
+            bills_sw = billpallas.bills_linear_nem(
+                lin, scales, tw, n_periods)
+            if not has_switch:
+                return bills_sw, None
+            return bills_sw, billpallas.bills_linear_nem(
+                lin_wo, scales, envs.tariff, n_periods)
         # bf16=False: the flag is inert on this stack — the runtime's
         # --xla_allow_excess_precision already runs the f32 contraction
         # at the MXU's native bf16 input precision (bit-identical
@@ -608,6 +621,7 @@ def size_agents(
     fast: bool = True,
     impl: str = "auto",
     mesh=None,
+    net_billing: bool = True,
 ) -> SizingResult:
     """Sizing over the whole agent table (leading axis).
 
@@ -617,7 +631,10 @@ def size_agents(
     (the oracle; ~100x more HBM traffic). ``mesh``: a >1-device Mesh
     runs the bucket-sums engine per-shard over the agent axis
     (shard_map), keeping the Pallas kernel live under real multi-chip
-    sharding.
+    sharding. ``net_billing=False`` asserts (statically) that no agent
+    prices on a net-billing tariff, so search-round bills reduce to the
+    linear NEM identity and skip the hourly kernel — the driver derives
+    this from the tariffs the population actually references.
     """
     if (envs.nem_kw_cap is None or envs.switch_min_kw is None
             or envs.switch_max_kw is None):
@@ -640,6 +657,7 @@ def size_agents(
         return _size_agents_fast(
             envs, n_periods=n_periods, n_years=n_years, n_iters=n_iters,
             keep_hourly=keep_hourly, impl=impl, mesh=mesh,
+            net_billing=net_billing,
         )
     fn = partial(
         size_one_agent,

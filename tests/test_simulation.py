@@ -171,6 +171,63 @@ def test_chunked_sharded_matches_whole_table():
     )
 
 
+def test_all_nem_population_skips_kernel_with_exact_parity():
+    """When every referenced tariff is net-metering AND the NEM gate
+    provably never closes, the driver statically drops to the linear
+    bill identity (no bucket-sums kernel in the search rounds) — and
+    the results must match the kernel path exactly. Any net-billing
+    tariff, binding cap, or closable window must keep the flag True
+    (the gate forces NET_BILLING at runtime when it closes)."""
+    import dataclasses as dc
+
+    cfg = ScenarioConfig(name="nem", start_year=2014, end_year=2020,
+                         anchor_years=())
+    pop = synth.generate_population(190, states=["DE", "CA"], seed=11,
+                                    pad_multiple=64)
+    rng = np.random.default_rng(0)
+    nem_ids = np.asarray([0, 2, 5], np.int32)  # synth NEM tariffs
+    tidx = jnp.asarray(nem_ids[rng.integers(0, 3, pop.table.n_agents)])
+    table = dc.replace(pop.table, tariff_idx=tidx, tariff_switch_idx=tidx)
+    inputs = scen.uniform_inputs(cfg, n_groups=table.n_groups,
+                                 n_regions=pop.n_regions)
+
+    sim = Simulation(table, pop.profiles, pop.tariffs, inputs, cfg,
+                     RunConfig(sizing_iters=8))
+    assert sim._net_billing is False
+    res_fast = sim.run()
+
+    sim_ref = Simulation(table, pop.profiles, pop.tariffs, inputs, cfg,
+                         RunConfig(sizing_iters=8))
+    sim_ref._net_billing = True  # force the kernel path
+    res_ref = sim_ref.run()
+    m = np.asarray(table.mask)
+    for k in ("system_kw_cum", "npv", "payback_period",
+              "number_of_adopters", "batt_kwh_cum"):
+        np.testing.assert_allclose(
+            res_fast.agent[k] * m, res_ref.agent[k] * m,
+            rtol=1e-5, atol=1e-4, err_msg=k)
+
+    # conservatism: a binding cap keeps net billing live
+    years = cfg.model_years
+    caps = np.full((len(years), table.n_states), 1e30, np.float32)
+    caps[2:] = 1e4
+    inputs_cap = scen.uniform_inputs(
+        cfg, n_groups=table.n_groups, n_regions=pop.n_regions,
+        overrides={"nem_cap_kw": jnp.asarray(caps)})
+    assert Simulation(table, pop.profiles, pop.tariffs, inputs_cap, cfg,
+                      RunConfig(sizing_iters=8))._net_billing is True
+    # ...as does any referenced net-billing tariff
+    t_nb = dc.replace(table, tariff_idx=table.tariff_idx.at[0].set(1))
+    assert Simulation(t_nb, pop.profiles, pop.tariffs, inputs, cfg,
+                      RunConfig(sizing_iters=8))._net_billing is True
+    # ...and a window that sunsets mid-run
+    t_sun = dc.replace(
+        table,
+        nem_sunset_year=table.nem_sunset_year.at[3].set(2016.0))
+    assert Simulation(t_sun, pop.profiles, pop.tariffs, inputs, cfg,
+                      RunConfig(sizing_iters=8))._net_billing is True
+
+
 def test_pad_table_round_trip():
     from dgen_tpu.models.agents import pad_table
 

@@ -85,12 +85,15 @@ class RunConfig:
     #: golden-section iterations for the PV sizing search
     sizing_iters: int = 12
     #: agent-axis chunk for the streaming year step (rows PER DEVICE per
-    #: chunk; 0 = whole-table). Chunking bounds peak HBM to one chunk's
-    #: [chunk, 8760] intermediates so populations far beyond the
-    #: whole-table ceiling (~50k agents on a 16 GB chip) fit — the TPU
-    #: answer to the reference's per-state task sharding
-    #: (submit_all.sh:8-46)
-    agent_chunk: int = 0
+    #: chunk). Chunking bounds peak HBM to one chunk's [chunk, 8760]
+    #: intermediates so populations far beyond the whole-table ceiling
+    #: (~50k agents on a 16 GB chip) fit — the TPU answer to the
+    #: reference's per-state task sharding (submit_all.sh:8-46).
+    #: ``None`` (default) derives the chunk from the device HBM budget
+    #: (models.simulation.auto_agent_chunk) — like the reference, the
+    #: operator never picks memory shapes; ``0`` forces the whole-table
+    #: path; ``>0`` fixes the chunk by hand.
+    agent_chunk: Optional[int] = None
     #: number of devices to shard agents over (None = all available)
     n_devices: Optional[int] = None
     #: reorder agents so states are shard-local under a multi-device
@@ -104,7 +107,8 @@ class RunConfig:
     def __post_init__(self) -> None:
         _check(self.agent_pad_multiple >= 1, "bad pad multiple")
         _check(4 <= self.sizing_iters <= 64, "sizing_iters out of range")
-        _check(self.agent_chunk >= 0, "agent_chunk must be >= 0")
+        _check(self.agent_chunk is None or self.agent_chunk >= 0,
+               "agent_chunk must be None (auto) or >= 0")
 
     @classmethod
     def from_env(cls, **overrides) -> "RunConfig":

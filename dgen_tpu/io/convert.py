@@ -321,18 +321,28 @@ def _profile_bank(
     (iterrows at national scale was the converter's wall-clock sink).
     """
     lut: Dict[Tuple, int] = {}
-    by_key = dict(zip(
-        df[list(key_cols)].itertuples(index=False, name=None),
-        df[value_col].tolist(),
-    ))
+    # restrict to the USED keys before touching the value column: each
+    # cell is an 8760-element object, and materializing the whole column
+    # (a national table carries ~1e5 distinct profiles) costs GBs of
+    # Python lists at peak — the key->position map is ints only, and
+    # only referenced rows are ever converted (last occurrence wins,
+    # matching the former dict(zip(...)) semantics)
+    need = set(used_keys)
+    row_pos: Dict[Tuple, int] = {}
+    for i, k in enumerate(
+        df[list(key_cols)].itertuples(index=False, name=None)
+    ):
+        if k in need:
+            row_pos[k] = i
+    values = df[value_col]
     rows = []
     for k in used_keys:
         if k in lut:
             continue
-        if k not in by_key:
+        if k not in row_pos:
             raise KeyError(f"profile key {k!r} not found in profile table "
                            f"(keys {list(key_cols)})")
-        arr = np.asarray(by_key[k], dtype=np.float64).ravel()
+        arr = np.asarray(values.iloc[row_pos[k]], dtype=np.float64).ravel()
         if arr.size != HOURS:
             raise ValueError(f"profile {k!r} has {arr.size} hours != {HOURS}")
         arr = arr * scale

@@ -36,17 +36,10 @@ import numpy as np
 import pandas as pd
 
 
-def _host_rows(
-    arr, with_idx: bool = True
-) -> tuple[np.ndarray, Optional[np.ndarray]]:
+def _host_rows(arr) -> tuple[np.ndarray, Optional[np.ndarray]]:
     """(rows, global_row_idx) of the process-locally addressable part of
     a per-agent array; idx None means all rows are local (the
-    single-controller case, or a fully replicated leaf).
-
-    ``with_idx=False`` skips building the index array — for follow-up
-    fields of the same pytree, whose sharding (hence index window) is
-    identical to the first field's.
-    """
+    single-controller case, or a fully replicated leaf)."""
     # duck-typed (not isinstance) so the multi-host path is unit-testable
     # from a single-controller test process
     if (
@@ -65,8 +58,6 @@ def _host_rows(
                 seen[start] = (stop, np.asarray(s.data))
         starts = sorted(seen)
         rows = np.concatenate([seen[s][1] for s in starts], axis=0)
-        if not with_idx:
-            return rows, None
         idx = np.concatenate(
             [np.arange(s, seen[s][0]) for s in starts]
         )
@@ -137,9 +128,11 @@ class RunExporter:
         return rows, ids
 
     def _local_fields(self, arrs) -> tuple[list, np.ndarray]:
-        """(rows per field, ids), with the shard index/keep bookkeeping
-        computed ONCE — every per-agent field of a YearOutputs shares
-        one sharding, so only the first field builds the index."""
+        """(rows per field, ids): the fast path reuses the first field's
+        shard index for follow-up fields; any field whose sharding
+        differs (GSPMD may replicate one YearOutputs leaf while sharding
+        its siblings) is realigned onto the first field's agent ids via
+        its own index instead of being mis-sliced."""
         if not any(
             getattr(a, "is_fully_addressable", True) is False for a in arrs
         ):
@@ -155,8 +148,38 @@ class RunExporter:
             ids = self._ids_full[idx][sel]
         out = [first[sel]]
         for a in arrs[1:]:
-            rows, _ = _host_rows(a, with_idx=False)
-            out.append(rows[sel])
+            rows, a_idx = _host_rows(a)
+            if (a_idx is None and idx is None) or (
+                a_idx is not None and idx is not None
+                and np.array_equal(a_idx, idx)
+            ):
+                out.append(rows[sel])
+                continue
+            # this leaf carries a DIFFERENT sharding than the first one
+            # (GSPMD propagation can replicate one output while sharding
+            # another): align on the leaf's OWN index, then reorder onto
+            # the first leaf's agent ids
+            a_sel = self.keep if a_idx is None else self.keep[a_idx]
+            a_ids = (
+                self.agent_id if a_idx is None
+                else self._ids_full[a_idx][a_sel]
+            )
+            rows = rows[a_sel]
+            if not np.array_equal(a_ids, ids):
+                pos = {int(g): i for i, g in enumerate(a_ids)}
+                try:
+                    rows = rows[np.asarray(
+                        [pos[int(g)] for g in ids], dtype=np.intp
+                    )]
+                except KeyError as e:
+                    raise ValueError(
+                        "per-agent output leaves carry incompatible "
+                        "shardings: a follow-up leaf's locally "
+                        f"addressable rows lack agent id {e} present in "
+                        "the first leaf's window; pin YearOutputs leaves "
+                        "to one sharding in year_step"
+                    ) from e
+            out.append(rows)
         return out, ids
 
     def _check_state_names(self, n_states: int) -> None:

@@ -221,28 +221,53 @@ def build_econ_inputs(
     )
 
 
+#: Conservative upper bound on any state's cumulative installed kW a run
+#: can reach (f32 segment sums of per-agent kW; a national all-sector
+#: total is ~1e9 kW, and the data plane's "no cap" sentinel is >= 1e29).
+#: The static all-NEM proof evaluates the gate AT this bound, which makes
+#: it sound for every reachable capacity; ``debug_invariants`` re-checks
+#: the bound against the live state totals each year.
+STATE_KW_BOUND = np.float32(1e28)
+
+
+def _nem_allowed_arrays(
+    state_idx, nem_first_year, nem_sunset_year, nem_kw_limit,
+    cap_row, year, state_kw_last,
+):
+    """The single NEM availability predicate — three gates, all from the
+    reference's NEM machine (agent_mutation/elec.py:449-505): the state
+    cumulative-capacity cap (vs LAST step's installed kW), the per-agent
+    availability window (``filter_nem_year``, elec.py:449-454), and a
+    positive per-agent system-kW limit (the reference's fillna(0) = no
+    NEM, elec.py:119).
+
+    Backend-polymorphic (operators + fancy indexing only): the traced
+    year step calls it with jax arrays and the host-side static proof
+    (:func:`nem_gate_never_closes`) calls it with numpy — both paths
+    evaluate the SAME gates, so they cannot drift apart.
+    """
+    cap_gate = (state_kw_last < cap_row)[state_idx]
+    window = (nem_first_year <= year) & (year <= nem_sunset_year)
+    return cap_gate & window & (nem_kw_limit > 0)
+
+
 def compute_nem_allowed(
     table: AgentTable,
     inputs: ScenarioInputs,
     year_idx: jax.Array,
     state_kw_last: jax.Array,
 ) -> jax.Array:
-    """[N] float32 mask: 1 where net metering remains available.
-
-    Three gates, all from the reference's NEM machine
-    (agent_mutation/elec.py:449-505): the state cumulative-capacity cap
-    (vs LAST step's installed kW), the per-agent availability window
-    (``filter_nem_year``, elec.py:449-454), and a positive per-agent
-    system-kW limit (the reference's fillna(0) = no NEM, elec.py:119).
-    """
-    cap = inputs.nem_cap_kw[year_idx]                       # [n_states]
-    cap_gate = (state_kw_last < cap)[table.state_idx]
-    yr = inputs.years[year_idx]
-    window = (table.nem_first_year <= yr) & (yr <= table.nem_sunset_year)
-    return (cap_gate & window & (table.nem_kw_limit > 0)).astype(jnp.float32)
+    """[N] float32 mask: 1 where net metering remains available
+    (:func:`_nem_allowed_arrays` on the traced year-step inputs)."""
+    return _nem_allowed_arrays(
+        table.state_idx, table.nem_first_year, table.nem_sunset_year,
+        table.nem_kw_limit, inputs.nem_cap_kw[year_idx],
+        inputs.years[year_idx], state_kw_last,
+    ).astype(jnp.float32)
 
 
 def nem_gate_never_closes(
+    state_idx: np.ndarray,
     nem_cap_kw: np.ndarray,
     nem_first_year: np.ndarray,
     nem_sunset_year: np.ndarray,
@@ -250,18 +275,24 @@ def nem_gate_never_closes(
     years: List[int],
 ) -> bool:
     """Host-side static proof that :func:`compute_nem_allowed` returns
-    1 for every (real) agent in every model year — the two functions
-    mirror the SAME three gates (cap / window / positive limit) and
-    MUST change together: this one conservatively requires unbounded
-    caps (so no state can ever bind), windows covering the full year
-    grid, and positive limits. Used to statically drop net-billing
-    bill paths (``Simulation._net_billing``)."""
-    y_lo, y_hi = min(years), max(years)
-    return bool(
-        np.all(np.asarray(nem_cap_kw) >= 1e29)
-        and np.all(np.asarray(nem_first_year) <= y_lo)
-        and np.all(np.asarray(nem_sunset_year) >= y_hi)
-        and np.all(np.asarray(nem_kw_limit) > 0)
+    1 for every given agent in every model year, derived by evaluating
+    the SAME predicate (:func:`_nem_allowed_arrays`) with numpy inputs:
+    one pass per model year with every state pinned at
+    :data:`STATE_KW_BOUND` installed kW (the worst reachable capacity).
+    Used to statically drop net-billing bill paths
+    (``Simulation._net_billing``)."""
+    caps = np.asarray(nem_cap_kw)                  # [n_years, n_states]
+    state_idx = np.asarray(state_idx)
+    first = np.asarray(nem_first_year)
+    sunset = np.asarray(nem_sunset_year)
+    limit = np.asarray(nem_kw_limit)
+    worst = np.full(caps.shape[1], STATE_KW_BOUND, np.float32)
+    return all(
+        bool(np.all(_nem_allowed_arrays(
+            state_idx, first, sunset, limit,
+            caps[yi], np.float32(yr), worst,
+        )))
+        for yi, yr in enumerate(years)
     )
 
 
@@ -282,6 +313,67 @@ def nem_gate_never_closes(
 # laid out shard-major ([d, L] local blocks), so chunks are built as
 # [d, K, c] -> [K, d*c] — every chunk holds each device's NEXT c local
 # rows and no cross-device resharding is needed between chunks.
+
+#: Live f32 [8760]-hour intermediates per agent at the sizing engine's
+#: peak (load/gen/sell/bucket, net profiles, dispatch traces — XLA
+#: reuses buffers, so this is the measured envelope, not the op count):
+#: calibrated against the v5e whole-table wall (32k agents fit a 16 GB
+#: chip, 65k does not -> true footprint is 250-490 KB/agent; 10 hour
+#: arrays + the [r_pad, B_PAD] kernel outputs model that window).
+_LIVE_HOUR_ARRAYS = 10
+_LIVE_HOUR_ARRAYS_HOURLY = 3   # keep_hourly net profiles (with_hourly)
+_HBM_RESERVE_FRAC = 0.2        # compiler scratch / fragmentation
+
+
+def default_hbm_bytes() -> Optional[int]:
+    """Per-device accelerator memory in bytes, or None when unknown
+    (non-TPU backends — auto-chunking then stays off and tests on
+    virtual CPU meshes keep whole-table semantics)."""
+    if jax.default_backend() != "tpu":
+        return None
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:  # tunneled/virtual devices may not expose stats
+        pass
+    return 16 * 1024**3  # v5e/v6e-class default
+
+
+def auto_agent_chunk(
+    n_local: int,
+    *,
+    sizing_iters: int,
+    econ_years: int,
+    with_hourly: bool,
+    hbm_bytes: Optional[int],
+) -> int:
+    """Derive the per-device streaming chunk from the HBM budget.
+
+    Returns 0 (whole-table) when the population fits, else the largest
+    lane-aligned (multiple-of-128) chunk whose working set fits. The
+    reference's operator never chooses memory shapes — the batch yamls
+    fix the machine per state bin (batch_job_yamls/
+    dgen-batch-job-small-states.yaml:25,73-75); here the driver knows
+    the per-agent footprint and does the same job in-process.
+    """
+    if not hbm_bytes or n_local <= 0:
+        return 0
+    from dgen_tpu.ops.billpallas import B_PAD, H_PAD, _round8
+
+    r_pad = _round8(max(sizing_iters, 4) * econ_years)
+    hour_arrays = _LIVE_HOUR_ARRAYS + (
+        _LIVE_HOUR_ARRAYS_HOURLY if with_hourly else 0
+    )
+    per_agent = 4 * (hour_arrays * H_PAD + 2 * r_pad * B_PAD)
+    budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
+    # persistent whole-table state ([N] outputs/carry, ~50 f32 fields)
+    budget -= n_local * 50 * 4
+    fit = budget // per_agent
+    if n_local <= fit:
+        return 0
+    return max(128, int(fit // 128) * 128)
+
 
 def _n_chunks(n: int, d: int, chunk: int) -> int:
     """Number of scan chunks (1 = whole-table path). Trace-time."""
@@ -539,6 +631,13 @@ def year_step(
             state_hourly = jax.ops.segment_sum(
                 net, table.state_idx, n_states
             ) / 1000.0  # kW -> MW
+        if mesh is not None:
+            # the exporter reads this [S, H] aggregate from process 0
+            # only; pin it replicated so GSPMD cannot shard it and leave
+            # rows non-addressable mid-export
+            state_hourly = jax.lax.with_sharding_constraint(
+                state_hourly, NamedSharding(mesh, P())
+            )
     else:
         state_hourly = jnp.zeros((0, 0), dtype=jnp.float32)
 
@@ -652,6 +751,22 @@ class Simulation:
         # invariant under the reordering
         chunk = self.run_config.agent_chunk
         n_dev = int(mesh.devices.size) if mesh is not None else 1
+        if chunk is None:
+            # operator picked no memory shape: derive the streaming
+            # chunk from the device HBM budget (0 = whole table fits)
+            chunk = auto_agent_chunk(
+                table.n_agents // n_dev,
+                sizing_iters=self.run_config.sizing_iters,
+                econ_years=econ_years,
+                with_hourly=with_hourly,
+                hbm_bytes=default_hbm_bytes(),
+            )
+            if chunk:
+                logger.info(
+                    "auto agent_chunk: %d rows/device (population %d "
+                    "exceeds the whole-table HBM envelope)",
+                    chunk, table.n_agents,
+                )
         self.partition = None
         if (
             mesh is not None and mesh.devices.size > 1
@@ -714,6 +829,7 @@ class Simulation:
         ]))
         any_nb_tariff = bool(np.any(metering[used] == NET_BILLING))
         self._net_billing = any_nb_tariff or not nem_gate_never_closes(
+            np.asarray(table.state_idx)[keep],
             np.asarray(inputs.nem_cap_kw),
             np.asarray(table.nem_first_year)[keep],
             np.asarray(table.nem_sunset_year)[keep],
@@ -935,6 +1051,23 @@ class Simulation:
                 invariants.check_finite(
                     outs, context=f"year {year} outputs"
                 )
+                if not self._net_billing:
+                    # the static all-NEM proof evaluated the cap gate at
+                    # STATE_KW_BOUND; it stays sound only while the live
+                    # state totals remain under that bound
+                    kw = np.asarray(
+                        jax.device_get(carry.market.system_kw_cum)
+                    )
+                    state_kw = np.zeros(self.table.n_states, np.float64)
+                    np.add.at(
+                        state_kw, np.asarray(self.table.state_idx), kw
+                    )
+                    if not np.all(state_kw < STATE_KW_BOUND):
+                        raise AssertionError(
+                            f"year {year}: state capacity exceeds "
+                            "STATE_KW_BOUND; the static all-NEM kernel "
+                            "skip is unsound for this run"
+                        )
             logger.info("year %d (%d/%d) %.2fs%s", year, yi + 1,
                         len(self.years), time.time() - t0,
                         "" if sync_per_year else " (queued)")

@@ -47,9 +47,10 @@ from dgen_tpu.ops.tariff import (
 )
 
 # Static [8760, 12] month one-hot, shared by every bill evaluation.
-_MONTH_ONEHOT = jnp.asarray(
-    np.eye(MONTHS, dtype=np.float32)[hour_month_map()]
-)
+# Kept as NUMPY (folded to a device constant at trace time): a
+# module-level jnp constant would initialize the XLA backend at import,
+# breaking jax.distributed.initialize in launch.main().
+_MONTH_ONEHOT = np.eye(MONTHS, dtype=np.float32)[hour_month_map()]
 
 
 class AgentTariff(NamedTuple):

@@ -21,12 +21,15 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dgen_tpu.config import PAYBACK_NEVER
 
 # MACRS 5-year half-year-convention schedule (what SAM's depr type 2
-# applies for commercial systems).
-MACRS_5 = jnp.array([0.20, 0.32, 0.192, 0.1152, 0.1152, 0.0576], dtype=jnp.float32)
+# applies for commercial systems). numpy on purpose: a module-level jnp
+# constant initializes the XLA backend at import, which breaks
+# jax.distributed.initialize in launch.main().
+MACRS_5 = np.array([0.20, 0.32, 0.192, 0.1152, 0.1152, 0.0576], dtype=np.float32)
 
 
 @jax.tree_util.register_dataclass

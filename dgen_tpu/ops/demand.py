@@ -36,7 +36,9 @@ import numpy as np
 
 from dgen_tpu.ops.tariff import BIG_CAP, HOURS, MONTHS, hour_month_map
 
-_HOUR_MONTH = jnp.asarray(hour_month_map())
+# numpy on purpose: a module-level jnp constant initializes the XLA
+# backend at import, breaking jax.distributed.initialize downstream
+_HOUR_MONTH = np.asarray(hour_month_map())
 NEG = -1e30
 
 

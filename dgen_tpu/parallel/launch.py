@@ -131,7 +131,24 @@ def main() -> None:
     (synthetic population size until a converted package is supplied
     via ``DGEN_PACKAGE``), plus the multi-host vars read by
     :func:`initialize_multihost`.
+
+    ``DGEN_PLATFORM`` / ``DGEN_CPU_DEVICES`` force the jax platform
+    in-process BEFORE backend bring-up — needed on hosts whose site
+    hooks pin a platform at interpreter startup, where the plain
+    ``JAX_PLATFORMS`` env var is silently overridden (CI runs the
+    launch entrypoint on virtual CPU devices this way).
     """
+    plat = os.environ.get("DGEN_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    if os.environ.get("DGEN_CPU_DEVICES"):
+        import jax
+
+        jax.config.update(
+            "jax_num_cpu_devices", int(os.environ["DGEN_CPU_DEVICES"])
+        )
     distributed = initialize_multihost()
 
     import jax

@@ -381,14 +381,49 @@ def test_converter_throughput_200k(tmp_path):
         for st in states for sec in ("res", "com")
     ])
 
+    import threading
+
+    def vm_rss_kb() -> int:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+        return 0
+
+    # sample CURRENT RSS during the conversion (ru_maxrss is a
+    # process-lifetime high-water mark — vacuous if an earlier test in
+    # the same pytest process peaked higher)
+    rss0_kb = vm_rss_kb()
+    peak = {"kb": rss0_kb}
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            peak["kb"] = max(peak["kb"], vm_rss_kb())
+            stop.wait(0.05)
+
+    th = threading.Thread(target=sampler, daemon=True)
+    th.start()
     t0 = time.time()
-    pop = convert.from_reference_pickle(
-        frame, str(tmp_path / "pkg"), load_df, cf_df,
-        state_incentives=incentives)
+    try:
+        pop = convert.from_reference_pickle(
+            frame, str(tmp_path / "pkg"), load_df, cf_df,
+            state_incentives=incentives)
+    finally:
+        stop.set()
+        th.join(timeout=2)
     dt = time.time() - t0
+    grew_kb = peak["kb"] - rss0_kb
     print(f"\nconverter: {n} agents in {dt:.1f}s "
-          f"({n / dt:,.0f} agents/sec -> 1M in ~{1e6 / (n / dt):.0f}s)")
+          f"({n / dt:,.0f} agents/sec -> 1M in ~{1e6 / (n / dt):.0f}s); "
+          f"RSS peak +{grew_kb / 1e6:.2f} GB over {rss0_kb / 1e6:.2f} GB")
     assert dt < 60.0, f"converter took {dt:.1f}s for {n} agents"
+    # _profile_bank dedups BEFORE materializing profile cells; a
+    # regression that rebuilds the whole value column as Python lists
+    # would blow far past this envelope
+    assert grew_kb < 6 * 1024**2, (
+        f"converter grew RSS by {grew_kb / 1e6:.2f} GB during conversion"
+    )
 
     m = np.asarray(pop.table.mask) > 0
     assert int(m.sum()) == n

@@ -70,16 +70,45 @@ def golden_run(tmp_path_factory):
         n_states=pop.table.n_states,
     )
     sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
-                     RunConfig(sizing_iters=8))
+                     RunConfig(sizing_iters=8), with_hourly=True)
     res = sim.run()
     assert len(res.years) == 19
     mask = np.asarray(pop.table.mask)
+    ids = np.asarray(pop.table.agent_id)
     s = res.summary(mask)
+    kw_final = (res.agent["system_kw"][-1] * mask)
     curves = {
         "years": list(map(int, res.years)),
         "adopters": [round(float(v), 4) for v in s["adopters"]],
         "system_kw_cum": [round(float(v), 3) for v in s["system_kw_cum"]],
         "batt_kwh_cum": [round(float(v), 3) for v in s["batt_kwh_cum"]],
+        # state-hourly surface: per-(year, state) net and absolute MWh
+        # (a corruption of the hourly mix that conserves agent totals
+        # still shifts these)
+        "state_hourly_net_mwh": [
+            [round(float(v), 3) for v in row]
+            for row in res.state_hourly_net_mw.sum(axis=2)
+        ],
+        "state_hourly_abs_mwh": [
+            [round(float(v), 3) for v in row]
+            for row in np.abs(res.state_hourly_net_mw).sum(axis=2)
+        ],
+        # finance-series surface: national cash-flow total per year
+        "cash_flow_total": [
+            round(float((cf * mask[:, None]).sum()), 2)
+            for cf in res.agent["cash_flow"]
+        ],
+        # conserving-total reshuffle detectors: an id-weighted adoption
+        # checksum plus the final system-size histogram — a bug that
+        # moves adoption BETWEEN agents while conserving the national
+        # curve fails these
+        "adoption_checksum": round(float(
+            (res.agent["number_of_adopters"][-1] * mask
+             * (ids % 97 + 1)).sum()), 3),
+        "kw_histogram": np.histogram(
+            kw_final[mask > 0],
+            bins=[0.0, 1e-6, 2, 4, 6, 8, 12, 20, 50, 200, 1e9],
+        )[0].tolist(),
     }
     return pop, res, curves
 
@@ -99,11 +128,21 @@ def test_golden_adoption_curves(golden_run):
     with open(GOLDEN_PATH) as f:
         golden = json.load(f)
     assert curves["years"] == golden["years"]
-    for key in ("adopters", "system_kw_cum", "batt_kwh_cum"):
+    for key in ("adopters", "system_kw_cum", "batt_kwh_cum",
+                "cash_flow_total", "adoption_checksum"):
         np.testing.assert_allclose(
             curves[key], golden[key], rtol=RTOL,
             err_msg=f"{key} drifted >0.1% from the golden fixture curve",
         )
+    for key in ("state_hourly_net_mwh", "state_hourly_abs_mwh"):
+        np.testing.assert_allclose(
+            curves[key], golden[key], rtol=RTOL, atol=0.05,
+            err_msg=f"{key} drifted from the golden fixture surface",
+        )
+    assert curves["kw_histogram"] == golden["kw_histogram"], (
+        "final per-agent system-size histogram changed — adoption was "
+        "reshuffled between agents"
+    )
 
 
 def test_golden_fixture_exercises_converter_surface(golden_run):

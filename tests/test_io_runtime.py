@@ -166,6 +166,55 @@ def test_exporter_local_rows_multihost(tmp_path):
     np.testing.assert_array_equal(rows, [40.0, 60.0, 70.0])
 
 
+def test_exporter_mixed_leaf_shardings(tmp_path):
+    """A YearOutputs leaf whose sharding differs from the first leaf's
+    (GSPMD may replicate one output while sharding its siblings) must be
+    realigned onto the first leaf's rows, not sliced with its index."""
+    import dataclasses
+
+    n = 8
+    ids = np.arange(100, 100 + n)
+    mask = np.ones(n, np.float32)
+    mask[5] = 0.0
+    ex = exp.RunExporter(str(tmp_path / "run"), agent_id=ids, mask=mask)
+
+    vals = np.arange(n, dtype=np.float32) * 10
+    other = np.arange(n, dtype=np.float32) + 0.5
+
+    @dataclasses.dataclass
+    class Shard:
+        index: tuple
+        data: np.ndarray
+
+    class Sharded:
+        is_fully_addressable = False
+        is_fully_replicated = False
+        shape = (n,)
+        addressable_shards = [Shard((slice(4, 8),), vals[4:8])]
+
+    class Repl:
+        is_fully_addressable = False
+        is_fully_replicated = True
+        shape = (n,)
+
+        def __array__(self, dtype=None):
+            return other
+
+    (r1, r2), got_ids = ex._local_fields([Sharded(), Repl()])
+    np.testing.assert_array_equal(got_ids, [104, 106, 107])
+    np.testing.assert_array_equal(r1, [40.0, 60.0, 70.0])
+    # replicated leaf realigned onto the sharded leaf's surviving rows
+    np.testing.assert_array_equal(r2, [4.5, 6.5, 7.5])
+
+    # and the symmetric order: replicated first, sharded second — the
+    # second leaf's local window misses rows the first leaf exposes, so
+    # the exporter must fail loudly instead of writing misaligned rows
+    import pytest
+
+    with pytest.raises(ValueError, match="incompatible"):
+        ex._local_fields([Repl(), Sharded()])
+
+
 def test_exporter_surfaces(tmp_path):
     sim, pop = make_sim(with_hourly=True)
     exporter = exp.RunExporter(

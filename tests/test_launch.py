@@ -288,6 +288,127 @@ def test_two_process_distributed_run_persists_shards(tmp_path):
     )
 
 
+def test_launch_main_executes_shard_commands(tmp_path):
+    """The flagship L7 entrypoint (``python -m dgen_tpu.parallel.launch``)
+    must actually run: two single-process shards launched EXACTLY as
+    ``shard_commands`` emits them (env-prefixed shell lines, the
+    submit_all.sh analogue), each producing a run dir with provenance
+    meta and all three parquet surfaces."""
+    bins = bin_states({"DE": 1.0, "CA": 10.0}, n_bins=2)
+    cmds = shard_commands(bins)
+    assert len(cmds) == 2
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    for i, cmd in enumerate(cmds):
+        run_dir = str(tmp_path / f"shard_{i}")
+        env = {
+            **os.environ,
+            # in-process platform pin (site hooks override JAX_PLATFORMS)
+            "DGEN_PLATFORM": "cpu",
+            "DGEN_AGENTS": "48",
+            "DGEN_END_YEAR": "2016",
+            "DGEN_RUN_DIR": run_dir,
+            "PYTHONUNBUFFERED": "1",
+        }
+        env.pop("XLA_FLAGS", None)  # single device: fastest CI shape
+        proc = subprocess.run(
+            cmd, shell=True, capture_output=True, text=True,
+            timeout=900, env=env, cwd=repo_root,
+        )
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert f"shard {i}" in proc.stdout
+
+        # provenance meta stamped up front (VERDICT r3 item 4)
+        import json
+
+        with open(os.path.join(run_dir, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["shard"] == i
+        assert meta["states"] == bins.bins[i]
+        assert meta["n_processes"] == 1 and meta["distributed"] is False
+        assert "market_curves" in meta and "data_sources" in meta
+
+        from dgen_tpu.io.export import load_surface
+
+        agent = load_surface(run_dir, "agent_outputs")
+        assert set(agent["year"]) == {2014, 2016}
+        assert len(load_surface(run_dir, "finance_series")) == len(agent)
+        # recovery wiring left a resumable checkpoint behind
+        from dgen_tpu.io import checkpoint as ckpt
+
+        assert ckpt.latest_year(os.path.join(run_dir, "ckpt")) == 2016
+
+
+def test_launch_main_two_process_coordinator(tmp_path):
+    """``main()`` through the DGEN_COORDINATOR/DGEN_NUM_PROCESSES env
+    contract: two real processes bring up jax.distributed (gloo), run
+    the same launch entrypoint, and persist disjoint per-process
+    parquet shards plus coordinator-written meta."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    run_dir = str(tmp_path / "run")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {
+        **os.environ,
+        # in-process platform pin: the site hook pins its own platform
+        # at interpreter startup, so with plain JAX_PLATFORMS env the
+        # default backend stays non-cpu and process_count() reads 1
+        "DGEN_PLATFORM": "cpu",
+        "DGEN_CPU_DEVICES": "4",
+        "JAX_CPU_COLLECTIVES_IMPLEMENTATION": "gloo",
+        "DGEN_COORDINATOR": f"127.0.0.1:{port}",
+        "DGEN_NUM_PROCESSES": "2",
+        "DGEN_SHARD_STATES": "DE,CA,TX,NY,FL,WA,CO,IL",
+        "DGEN_AGENTS": "96",
+        "DGEN_END_YEAR": "2016",
+        "DGEN_RUN_DIR": run_dir,
+        "PYTHONUNBUFFERED": "1",
+    }
+    base_env.pop("XLA_FLAGS", None)  # the legacy count flag, if inherited
+    logs = [open(tmp_path / f"p{pid}.log", "w+") for pid in (0, 1)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "dgen_tpu.parallel.launch"],
+            stdout=logs[pid], stderr=subprocess.STDOUT, text=True,
+            env={**base_env, "DGEN_PROCESS_ID": str(pid)}, cwd=repo_root,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        for p in procs:
+            p.wait(timeout=900)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    for pid, p in enumerate(procs):
+        out = (tmp_path / f"p{pid}.log").read_text()
+        assert p.returncode == 0, f"p{pid}: {out[-3000:]}"
+        assert "shard 0" in out
+
+    import json
+
+    with open(os.path.join(run_dir, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["distributed"] is True and meta["n_processes"] == 2
+
+    import pandas as pd
+
+    part = {
+        pid: pd.read_parquet(
+            os.path.join(run_dir, "agent_outputs",
+                         f"year=2014-p{pid}.parquet"))
+        for pid in (0, 1)
+    }
+    ids0, ids1 = set(part[0]["agent_id"]), set(part[1]["agent_id"])
+    assert ids0 and ids1 and not (ids0 & ids1)
+    assert len(ids0 | ids1) == 96
+
+
 def test_run_with_recovery_resumes_after_crash(tmp_path):
     """A mid-run crash resumes from the last checkpoint on retry
     (the maxRetryCount analogue, but checkpoint-granular)."""

@@ -228,6 +228,89 @@ def test_all_nem_population_skips_kernel_with_exact_parity():
                       RunConfig(sizing_iters=8))._net_billing is True
 
 
+def test_auto_agent_chunk_budget():
+    """agent_chunk=None derives the streaming chunk from the HBM
+    budget: whole-table when it fits, else the largest lane-aligned
+    chunk under the documented per-agent footprint model."""
+    from dgen_tpu.models import simulation as sm
+
+    kw = dict(sizing_iters=10, econ_years=25, with_hourly=False,
+              hbm_bytes=16 * 1024**3)
+    assert sm.auto_agent_chunk(8192, **kw) == 0
+
+    c = sm.auto_agent_chunk(65536, **kw)
+    assert c % 128 == 0 and 0 < c < 65536
+    # pinned against the documented footprint model
+    per_agent = 4 * (sm._LIVE_HOUR_ARRAYS * 8832 + 2 * 256 * 128)
+    budget = int((16 * 1024**3) * (1 - sm._HBM_RESERVE_FRAC)) - 65536 * 200
+    assert c == max(128, budget // per_agent // 128 * 128)
+
+    # with_hourly shrinks the chunk (rematerialized net profiles)
+    c_h = sm.auto_agent_chunk(
+        65536, sizing_iters=10, econ_years=25, with_hourly=True,
+        hbm_bytes=16 * 1024**3)
+    assert 0 < c_h < c
+
+    # unknown budget (non-TPU backends): never auto-chunk
+    assert sm.auto_agent_chunk(
+        10**6, sizing_iters=10, econ_years=25, with_hourly=False,
+        hbm_bytes=None) == 0
+
+    # a Simulation built on the CPU backend keeps whole-table semantics
+    sim, _ = make_sim(end_year=2016)
+    assert sim._agent_chunk == 0
+
+
+def test_nem_proof_matches_gate_on_random_populations():
+    """Property: for randomized caps/windows/limits,
+    ``nem_gate_never_closes`` is True iff the traced gate
+    (``compute_nem_allowed``) returns all-ones for every model year at
+    any reachable state capacity. Both sides now evaluate the SAME
+    predicate (simulation._nem_allowed_arrays), so this pins the
+    contract that makes the static all-NEM kernel skip sound."""
+    from dgen_tpu.models import simulation as sm
+
+    rng = np.random.default_rng(7)
+    years = list(range(2014, 2026, 2))
+    n, n_states = 64, 3
+    for trial in range(60):
+        state_idx = rng.integers(0, n_states, n).astype(np.int32)
+        # mix of open and potentially-binding configurations
+        caps = np.where(
+            rng.random((len(years), n_states)) < 0.6, 1e30,
+            rng.uniform(1e3, 1e9, (len(years), n_states)),
+        ).astype(np.float32)
+        first = np.where(rng.random(n) < 0.7, 2000.0,
+                         rng.uniform(2010, 2030, n)).astype(np.float32)
+        sunset = np.where(rng.random(n) < 0.7, 3000.0,
+                          rng.uniform(2010, 2030, n)).astype(np.float32)
+        limit = np.where(rng.random(n) < 0.8,
+                         rng.uniform(1.0, 100.0, n), 0.0).astype(np.float32)
+
+        proof = sm.nem_gate_never_closes(
+            state_idx, caps, first, sunset, limit, years
+        )
+        # ground truth: the shared predicate per year at worst capacity
+        open_all = all(
+            bool(np.all(sm._nem_allowed_arrays(
+                state_idx, first, sunset, limit, caps[yi],
+                np.float32(yr),
+                np.full(n_states, sm.STATE_KW_BOUND, np.float32),
+            )))
+            for yi, yr in enumerate(years)
+        )
+        assert proof == open_all, f"trial {trial}"
+        if proof:
+            # soundness at ANY reachable capacity, not just the bound
+            kw = rng.uniform(0, 1e12, n_states).astype(np.float32)
+            for yi, yr in enumerate(years):
+                ok = sm._nem_allowed_arrays(
+                    state_idx, first, sunset, limit, caps[yi],
+                    np.float32(yr), kw,
+                )
+                assert bool(np.all(ok)), f"trial {trial} year {yr}"
+
+
 def test_pad_table_round_trip():
     from dgen_tpu.models.agents import pad_table
 

@@ -17,14 +17,16 @@ Three structural facts make this cheap on a TPU:
    ``exp = relu(net) - net`` (elementwise identity),
    ``credit(s) = imp_sell(s) - (S_load_sell - s * S_gen_sell)`` — so the
    nonlinear kernel only ever computes ONE matmul: ``relu(net) @ M``.
-3. **Candidates batch into MXU rows**: packing (candidate, year) pairs
-   into the matmul row axis (R = K x Y ~ 400) fills the MXU's 128-row
-   tiles, where a per-candidate loop would run 32-row matmuls at 25%
-   utilization and 14x the launch count.
+3. **The hour->bucket map is structural, not data**: bucket =
+   month * P + period, the calendar month map is shared by every agent,
+   and P (TOU periods) is tiny. With a month-padded hour layout the
+   month becomes POSITIONAL and bucket sums reduce to P-1 masked row
+   reductions per month block — no per-agent one-hot materialization
+   and no matmul at all (see ``_kernel_month``; the round-3 one-hot+MXU
+   engine is kept as ``impl="pallas_dot"`` — its iota/compare/select M
+   build measured 54% of device time, tools/kernel_microbench.py).
 
-``M`` is the per-agent [H, 128] bucket one-hot with the hourly sell
-rate folded into column 127, built in VMEM from the bucket-id row. HBM
-traffic per sizing-objective evaluation is O(N * 8760) — the
+HBM traffic per sizing-objective evaluation is O(N * 8760) — the
 straightforward XLA formulation (dgen_tpu.ops.bill.bill_series)
 materializes O(N * Y * 8760), the measured v5e bottleneck; the
 reference re-runs its C++ rate engine per (agent, candidate)
@@ -54,7 +56,30 @@ B_PAD = 128           # bucket axis = MXU-friendly output width
 SELL_COL = B_PAD - 1  # column of M carrying the hourly sell rate
 PAD_BUCKET = B_PAD - 2  # bucket id for padding hours (values are 0 anyway)
 
+#: month-padded hour layout: month m occupies lanes [m*768, m*768+len_m)
+#: (768 = 6 * 128 lanes >= 744, the longest month), zero-filled beyond —
+#: makes the hour->month map POSITIONAL so the kernel needs no month
+#: comparisons at all (see _kernel_month)
+MONTH_SLOT = 768
+H_MONTHS = 12 * MONTH_SLOT
+
 _HOUR_MONTH = hour_month_map()
+
+
+def _month_layout() -> tuple[np.ndarray, np.ndarray]:
+    """(gather idx [H_MONTHS] int32, valid [H_MONTHS] f32) for the
+    month-padded repack; cached numpy (no backend touch at import)."""
+    hm = np.asarray(_HOUR_MONTH)
+    idx = np.zeros(H_MONTHS, np.int32)
+    valid = np.zeros(H_MONTHS, np.float32)
+    for m in range(MONTHS):
+        hrs = np.nonzero(hm == m)[0]
+        idx[m * MONTH_SLOT:m * MONTH_SLOT + len(hrs)] = hrs
+        valid[m * MONTH_SLOT:m * MONTH_SLOT + len(hrs)] = 1.0
+    return idx, valid
+
+
+_MONTH_IDX, _MONTH_VALID = _month_layout()
 
 
 def _kernel(scales_ref, load_ref, gen_ref, sell_ref, bucket_ref,
@@ -103,6 +128,94 @@ def _kernel(scales_ref, load_ref, gen_ref, sell_ref, bucket_ref,
         out_refs[1][0] = acc_s
 
 
+def _kernel_month(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
+                  *out_refs, r_pad, r_chunk, n_periods, with_signed):
+    """One agent per program: month-blocked masked reductions.
+
+    The round-3 kernel built a per-agent [H, 128] bucket one-hot in VMEM
+    and contracted against it on the MXU; the round-4 trace
+    (tools/kernel_microbench.py) showed that iota-compare-select build
+    was 54% of device time (92 of 171 ms/call at 8k agents x 250
+    scales) while the MXU dot itself was ~6 ms — and that the build
+    stays ~80 ms no matter how it is sliced (positional per-month
+    builds, B_PAD=64: both no better; lane padding swallows narrow
+    widths). This formulation needs NO one-hot and NO matmul:
+
+      * inputs arrive month-padded ([12 * 768] lanes, zero-filled), so
+        the hour->month map is positional — 12 static 768-lane slices;
+      * within a month, TOU-period sums use n_periods-1 masked row
+        reductions, the last period arriving by subtraction from the
+        month total (documented f32 cancellation ~3e-4 relative, inside
+        the engine's pinned parity tolerance);
+      * the sell-weighted sum accumulates across months in the same
+        pass.
+
+    Measured 89.5 ms/call vs 171 ms for the dot kernel (same shapes) —
+    within ~20% of the irreducible net-build floor (net+relu alone:
+    73 ms). Outputs keep the dot kernel's layout ([r_pad, B_PAD],
+    bucket cols month-major, sell sums in SELL_COL).
+    """
+    scales_all = scales_ref[0, 0, :]                        # [r_pad]
+    nb = MONTHS * n_periods
+
+    for r0 in range(0, r_pad, r_chunk):
+        scales = scales_all[r0:r0 + r_chunk]
+        cols_i = []
+        cols_s = []
+        sell_i = jnp.zeros((r_chunk,), jnp.float32)
+        sell_s = jnp.zeros((r_chunk,), jnp.float32)
+        for m in range(MONTHS):
+            lo = m * MONTH_SLOT
+            load = load_ref[0, 0, lo:lo + MONTH_SLOT]
+            gen = gen_ref[0, 0, lo:lo + MONTH_SLOT]
+            sell = sell_ref[0, 0, lo:lo + MONTH_SLOT]
+            period = period_ref[0, 0, lo:lo + MONTH_SLOT]
+
+            net = load[None, :] - scales[:, None] * gen[None, :]
+            pos = jnp.maximum(net, 0.0)                 # [r_chunk, 768]
+            sell_i = sell_i + jnp.sum(pos * sell[None, :], axis=1)
+            rem_i = jnp.sum(pos, axis=1)
+            if with_signed:
+                sell_s = sell_s + jnp.sum(net * sell[None, :], axis=1)
+                rem_s = jnp.sum(net, axis=1)
+            for p in range(n_periods - 1):
+                mask = (period == p).astype(jnp.float32)[None, :]
+                s_pm = jnp.sum(pos * mask, axis=1)
+                cols_i.append(s_pm)
+                rem_i = rem_i - s_pm
+                if with_signed:
+                    sgn_pm = jnp.sum(net * mask, axis=1)
+                    cols_s.append(sgn_pm)
+                    rem_s = rem_s - sgn_pm
+            cols_i.append(rem_i)
+            if with_signed:
+                cols_s.append(rem_s)
+
+        fill = jnp.zeros((r_chunk, B_PAD - nb - 1), jnp.float32)
+        out_i = jnp.concatenate(
+            [jnp.stack(cols_i, axis=1), fill, sell_i[:, None]], axis=1)
+        out_refs[0][0, r0:r0 + r_chunk, :] = out_i
+        if with_signed:
+            out_s = jnp.concatenate(
+                [jnp.stack(cols_s, axis=1), fill, sell_s[:, None]], axis=1)
+            out_refs[1][0, r0:r0 + r_chunk, :] = out_s
+
+
+def _pick_r_chunk(r_pad: int, with_signed: bool) -> int:
+    """Largest multiple-of-8 scales chunk whose [r_chunk, 768] working
+    set (net + pos + masked temporaries; signed keeps both live) stays
+    well under the 16 MB VMEM."""
+    live = 4 if with_signed else 3
+    budget = 10_000_000
+    r_chunk = min(r_pad, 1024)
+    while r_chunk > 8 and live * 4 * r_chunk * MONTH_SLOT > budget:
+        r_chunk //= 2
+    r_chunk = _round8(r_chunk)
+    while r_pad % r_chunk:   # chunks must tile the padded scales axis
+        r_chunk -= 8
+    return r_chunk
+
+
 def _pad_hours(x: jax.Array, fill=0.0) -> jax.Array:
     n, h = x.shape
     if h == H_PAD:
@@ -131,7 +244,65 @@ def _pick_h_chunk(r_pad: int, with_signed: bool) -> int:
     return 552
 
 
-def _sums_pallas(load, gen, sell, bucket_id, scales, with_signed, bf16=False):
+def _sums_pallas(load, gen, sell, bucket_id, scales, with_signed,
+                 n_periods=None, bf16=False):
+    """Month-blocked masked-reduction engine (see _kernel_month).
+
+    ``bucket_id`` must be the canonical month-major layout
+    (hourly_bucket_ids: month * n_periods + period), from which the
+    per-hour TOU period is recovered as ``bucket_id % n_periods``.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = load.shape[0]
+    r = scales.shape[1]
+    r_pad = _round8(r)
+    r_chunk = _pick_r_chunk(r_pad, with_signed)
+
+    idx = jnp.asarray(_MONTH_IDX)
+    valid = jnp.asarray(_MONTH_VALID)
+    rep = lambda x: x[:, idx] * valid[None, :]
+    period = (bucket_id % n_periods).astype(jnp.int32)
+    load_p = rep(load)[:, None, :]
+    gen_p = rep(gen)[:, None, :]
+    sell_p = rep(sell)[:, None, :]
+    period_p = period[:, idx][:, None, :]   # pad lanes harmless: values 0
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
+
+    out3 = lambda i: (i, 0, 0)
+    n_out = 2 if with_signed else 1
+    outs = pl.pallas_call(
+        partial(_kernel_month, r_pad=r_pad, r_chunk=r_chunk,
+                n_periods=n_periods, with_signed=with_signed),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, r_pad, B_PAD), out3, memory_space=pltpu.VMEM)
+        ] * n_out,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, r_pad, B_PAD), jnp.float32)
+        ] * n_out,
+        cost_estimate=pl.CostEstimate(
+            flops=(4 + 2 * n_periods) * n_out * n * r_pad * H_MONTHS,
+            bytes_accessed=5 * n * H_MONTHS * 4,
+            transcendentals=0,
+        ),
+    )(scales_p, load_p, gen_p, sell_p, period_p)
+    # imports first to match the dot engine's historical output order
+    return tuple(o[:, :r] for o in outs)
+
+
+def _sums_pallas_dot(load, gen, sell, bucket_id, scales, with_signed,
+                     n_periods=None, bf16=False):
+    """Round-3 one-hot + MXU-dot engine, kept for A/B benchmarking
+    (impl=\"pallas_dot\"); 1.9x slower than the month kernel on v5e."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -269,8 +440,12 @@ def import_sums(
     """(imports [N,R,B], imp_sell [N,R]): positive-part bucket sums and
     the sell-weighted positive-part sum for R net-load scales."""
     _check_buckets(n_buckets)
-    if _resolve_impl(impl) == "pallas":
-        fn = partial(_sums_pallas, with_signed=False, bf16=bf16)
+    resolved = _resolve_impl(impl)
+    if resolved == "pallas":
+        fn = partial(_sums_pallas, with_signed=False,
+                     n_periods=n_buckets // MONTHS, bf16=bf16)
+    elif resolved == "pallas_dot":
+        fn = partial(_sums_pallas_dot, with_signed=False, bf16=bf16)
     else:
         fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=False)
     (imp,) = _maybe_shard_agents(fn, mesh, 1)(
@@ -293,8 +468,12 @@ def bucket_sums(
     """(signed [N,R,B], imports [N,R,B], export_credit [N,R]) — the full
     reduction set (battery forward runs, tests)."""
     _check_buckets(n_buckets)
-    if _resolve_impl(impl) == "pallas":
-        fn = partial(_sums_pallas, with_signed=True)
+    resolved = _resolve_impl(impl)
+    if resolved == "pallas":
+        fn = partial(_sums_pallas, with_signed=True,
+                     n_periods=n_buckets // MONTHS)
+    elif resolved == "pallas_dot":
+        fn = partial(_sums_pallas_dot, with_signed=True)
     else:
         fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=True)
     imp, signed = _maybe_shard_agents(fn, mesh, 2)(

@@ -1,0 +1,529 @@
+"""Micro-benchmark for billpallas bucket-sums kernel variants.
+
+Isolates where the kernel's device time goes (one-hot M build vs
+net/relu build vs the MXU dot) and A/B-times candidate optimizations.
+Timing method: each measurement jits INNER chained kernel calls (data
+dependency threaded through a scalar accumulator, per-iteration scale
+perturbation to defeat CSE and the terminal's cross-process execution
+cache) and reports the slope between two INNER counts, cancelling the
+~250 ms tunnel dispatch+fetch constant.
+
+Usage: python tools/kernel_microbench.py [n_agents] [variant ...]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgen_tpu.ops import billpallas as bp
+
+H = 8760
+H_PAD = bp.H_PAD
+
+
+# ---------------------------------------------------------------- variants
+
+def _kernel_v(scales_ref, load_ref, gen_ref, sell_ref, bucket_ref,
+              *refs, r_pad, h_chunk, b_pad, build, dot, net):
+    """Parametrized copy of bp._kernel (imports only).
+
+    build: 'onehot' | 'const' (skip M build) | 'hbm' (M from input ref)
+    dot:   'dot' | 'none' (skip the MXU contraction)
+    net:   'fma' | 'bcast' (skip the scales fma)
+    """
+    m_ref = refs[0] if build == "hbm" else None
+    out_ref = refs[-1]
+    sell_col = b_pad - 1
+    scales = scales_ref[0, 0, :]
+    acc = jnp.zeros((r_pad, b_pad), jnp.float32)
+
+    for h0 in range(0, H_PAD, h_chunk):
+        load = load_ref[0, 0, h0:h0 + h_chunk]
+        gen = gen_ref[0, 0, h0:h0 + h_chunk]
+        sell = sell_ref[0, 0, h0:h0 + h_chunk]
+        bucket = bucket_ref[0, 0, h0:h0 + h_chunk]
+
+        if build == "onehot":
+            col = jax.lax.broadcasted_iota(jnp.int32, (h_chunk, b_pad), 1)
+            onehot = (bucket[:, None] == col).astype(jnp.float32)
+            m = jnp.where(col == sell_col, sell[:, None], onehot)
+        elif build == "const":
+            m = jnp.full((h_chunk, b_pad), 0.01, jnp.float32)
+        else:  # hbm
+            m = m_ref[0, h0:h0 + h_chunk, :].astype(jnp.float32)
+
+        if net == "fma":
+            netv = load[None, :] - scales[:, None] * gen[None, :]
+        else:
+            netv = load[None, :] + jnp.zeros((r_pad, 1), jnp.float32)
+        pos = jnp.maximum(netv, 0.0)
+        if dot == "dot":
+            acc = acc + jnp.dot(pos, m, preferred_element_type=jnp.float32)
+        else:
+            acc = acc + (jnp.sum(pos, axis=1, keepdims=True)
+                         + jnp.sum(m[:, :1]))
+
+    out_ref[0] = acc
+
+
+# --------------------------------------------------- month-masked variant
+
+MONTH_LEN_H = None  # computed lazily from tariff hour_month_map
+
+
+def _month_layout():
+    """(idx [12*768], n_pad) month-padded hour layout: month m occupies
+    lanes [m*768, m*768+len_m), zero-fill beyond."""
+    from dgen_tpu.ops.tariff import hour_month_map
+
+    hm = np.asarray(hour_month_map())
+    idx = np.zeros(12 * 768, np.int32)
+    valid = np.zeros(12 * 768, np.float32)
+    for m in range(12):
+        hrs = np.nonzero(hm == m)[0]
+        idx[m * 768:m * 768 + len(hrs)] = hrs
+        valid[m * 768:m * 768 + len(hrs)] = 1.0
+    return idx, valid
+
+
+def _kernel_mm(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
+               out_ref, *, r_pad, n_periods, b_pad):
+    """Month-blocked masked reduction: NO one-hot, NO matmul.
+
+    Inputs are month-padded [12*768] rows; bucket (month, period) sums
+    come from static 768-lane month slices, P-1 period masks (last
+    period = month total - others), and row reductions."""
+    scales = scales_ref[0, 0, :]                            # [r_pad]
+    cols = []
+    sell_acc = jnp.zeros((r_pad,), jnp.float32)
+    for m in range(12):
+        lo = m * 768
+        load = load_ref[0, 0, lo:lo + 768]
+        gen = gen_ref[0, 0, lo:lo + 768]
+        sell = sell_ref[0, 0, lo:lo + 768]
+        period = period_ref[0, 0, lo:lo + 768]
+
+        netv = load[None, :] - scales[:, None] * gen[None, :]
+        pos = jnp.maximum(netv, 0.0)                        # [r_pad, 768]
+        sell_acc = sell_acc + jnp.sum(pos * sell[None, :], axis=1)
+        tot = jnp.sum(pos, axis=1)                          # [r_pad]
+        rem = tot
+        for p in range(n_periods - 1):
+            mask = (period == p).astype(jnp.float32)[None, :]
+            s_pm = jnp.sum(pos * mask, axis=1)
+            cols.append(s_pm)
+            rem = rem - s_pm
+        cols.append(rem)
+    out = jnp.stack(cols, axis=1)                  # [r_pad, 12*P]
+    nb = 12 * n_periods
+    fill = jnp.zeros((r_pad, b_pad - nb - 1), jnp.float32)
+    out_ref[0] = jnp.concatenate([out, fill, sell_acc[:, None]], axis=1)
+
+
+def _kernel_md(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
+               out_ref, *, r_pad, n_periods, b_pad):
+    """Month-blocked DOT: per month, one [r,768]x[768,128] contraction
+    against a positionally-built M (period one-hots + ones + sell), so
+    the VPU net build overlaps the MXU instead of mul+reduce passes.
+
+    Column layout per month block: cols m*P..m*P+P-1 = period sums;
+    col 125 accumulates nothing; col 126 = month total (unused); col
+    127 = sell-weighted sum accumulated across months."""
+    scales = scales_ref[0, 0, :]
+    acc = jnp.zeros((r_pad, b_pad), jnp.float32)
+    for m in range(12):
+        lo = m * 768
+        load = load_ref[0, 0, lo:lo + 768]
+        gen = gen_ref[0, 0, lo:lo + 768]
+        sell = sell_ref[0, 0, lo:lo + 768]
+        period = period_ref[0, 0, lo:lo + 768]
+
+        col = jax.lax.broadcasted_iota(jnp.int32, (768, b_pad), 1)
+        onehot = (col == (m * n_periods + period[:, None])).astype(
+            jnp.float32)
+        mm = jnp.where(col == b_pad - 1, sell[:, None], onehot)
+
+        netv = load[None, :] - scales[:, None] * gen[None, :]
+        pos = jnp.maximum(netv, 0.0)
+        acc = acc + jnp.dot(pos, mm, preferred_element_type=jnp.float32)
+    out_ref[0] = acc
+
+
+def sums_monthdot(load, gen, sell, bucket_id, scales, *, n_periods=2,
+                  b_pad=128):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = load.shape[0]
+    r = scales.shape[1]
+    r_pad = bp._round8(r)
+    idx, valid = _month_layout()
+    H12 = 12 * 768
+
+    period = (bucket_id % n_periods).astype(jnp.int32)
+    rep = lambda x: x[:, idx] * valid[None, :]
+    load_p = rep(load)[:, None, :]
+    gen_p = rep(gen)[:, None, :]
+    sell_p = rep(sell)[:, None, :]
+    # pad hours -> period id P (no bucket column collects them)
+    period_p = jnp.where(
+        valid[None, :] > 0, period[:, idx], n_periods
+    ).astype(jnp.int32)[:, None, :]
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
+
+    out3 = lambda i: (i, 0, 0)
+    out = pl.pallas_call(
+        partial(_kernel_md, r_pad=r_pad, n_periods=n_periods, b_pad=b_pad),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((1, r_pad, b_pad), out3,
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((n, r_pad, b_pad), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * r_pad * H12 * b_pad,
+            bytes_accessed=5 * n * H12 * 4,
+            transcendentals=0,
+        ),
+    )(scales_p, load_p, gen_p, sell_p, period_p)
+    return out[0]
+
+
+def _kernel_mg(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
+               out_ref, *, g_block, r_pad, n_periods, b_pad):
+    """monthmask with G agents per program (amortizes program overhead)."""
+    for g in range(g_block):
+        scales = scales_ref[g, 0, :]
+        cols = []
+        sell_acc = jnp.zeros((r_pad,), jnp.float32)
+        for m in range(12):
+            lo = m * 768
+            load = load_ref[g, 0, lo:lo + 768]
+            gen = gen_ref[g, 0, lo:lo + 768]
+            sell = sell_ref[g, 0, lo:lo + 768]
+            period = period_ref[g, 0, lo:lo + 768]
+
+            netv = load[None, :] - scales[:, None] * gen[None, :]
+            pos = jnp.maximum(netv, 0.0)
+            sell_acc = sell_acc + jnp.sum(pos * sell[None, :], axis=1)
+            rem = jnp.sum(pos, axis=1)
+            for p in range(n_periods - 1):
+                mask = (period == p).astype(jnp.float32)[None, :]
+                s_pm = jnp.sum(pos * mask, axis=1)
+                cols.append(s_pm)
+                rem = rem - s_pm
+            cols.append(rem)
+        out = jnp.stack(cols, axis=1)
+        nb = 12 * n_periods
+        fill = jnp.zeros((r_pad, b_pad - nb - 1), jnp.float32)
+        out_ref[g] = jnp.concatenate(
+            [out, fill, sell_acc[:, None]], axis=1)
+
+
+def sums_monthmask_g(load, gen, sell, bucket_id, scales, *, n_periods=2,
+                     b_pad=128, g_block=8):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = load.shape[0]
+    r = scales.shape[1]
+    r_pad = bp._round8(r)
+    idx, valid = _month_layout()
+    H12 = 12 * 768
+
+    period = (bucket_id % n_periods).astype(jnp.int32)
+    rep = lambda x: x[:, idx] * valid[None, :]
+    load_p = rep(load)[:, None, :]
+    gen_p = rep(gen)[:, None, :]
+    sell_p = rep(sell)[:, None, :]
+    period_p = period[:, idx][:, None, :]
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
+
+    out3 = lambda i: (i, 0, 0)
+    out = pl.pallas_call(
+        partial(_kernel_mg, g_block=g_block, r_pad=r_pad,
+                n_periods=n_periods, b_pad=b_pad),
+        grid=(n // g_block,),
+        in_specs=[
+            pl.BlockSpec((g_block, 1, r_pad), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((g_block, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((g_block, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((g_block, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((g_block, 1, H12), out3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((g_block, r_pad, b_pad), out3,
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((n, r_pad, b_pad), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n * r_pad * H12,
+            bytes_accessed=5 * n * H12 * 4,
+            transcendentals=0,
+        ),
+    )(scales_p, load_p, gen_p, sell_p, period_p)
+    return out[0]
+
+
+def sums_monthmask(load, gen, sell, bucket_id, scales, *, n_periods=2,
+                   b_pad=128):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = load.shape[0]
+    r = scales.shape[1]
+    r_pad = bp._round8(r)
+    idx, valid = _month_layout()
+    H12 = 12 * 768
+
+    period = (bucket_id % n_periods).astype(jnp.int32)
+    # month-padded repack (static gather; pad lanes zeroed)
+    rep = lambda x: x[:, idx] * valid[None, :]
+    load_p = rep(load)[:, None, :]
+    gen_p = rep(gen)[:, None, :]
+    sell_p = rep(sell)[:, None, :]
+    period_p = (period[:, idx] * valid[None, :].astype(jnp.int32)
+                )[:, None, :]
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
+
+    out3 = lambda i: (i, 0, 0)
+    out = pl.pallas_call(
+        partial(_kernel_mm, r_pad=r_pad, n_periods=n_periods, b_pad=b_pad),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((1, r_pad, b_pad), out3,
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((n, r_pad, b_pad), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n * r_pad * H12,
+            bytes_accessed=5 * n * H12 * 4,
+            transcendentals=0,
+        ),
+    )(scales_p, load_p, gen_p, sell_p, period_p)
+    return out[0]
+
+
+def sums_variant(load, gen, sell, bucket_id, scales, *, b_pad=128,
+                 build="onehot", dot="dot", net="fma", m_hbm=None,
+                 h_chunk=None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = load.shape[0]
+    r = scales.shape[1]
+    r_pad = bp._round8(r)
+    hc = h_chunk or bp._pick_h_chunk(r_pad, False)
+
+    load_p = bp._pad_hours(load)[:, None, :]
+    gen_p = bp._pad_hours(gen)[:, None, :]
+    sell_p = bp._pad_hours(sell)[:, None, :]
+    bucket_p = bp._pad_hours(bucket_id, fill=b_pad - 2).astype(jnp.int32)[:, None, :]
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
+
+    out3 = lambda i: (i, 0, 0)
+    in_specs = [
+        pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, H_PAD), out3, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, H_PAD), out3, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, H_PAD), out3, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, H_PAD), out3, memory_space=pltpu.VMEM),
+    ]
+    args = [scales_p, load_p, gen_p, sell_p, bucket_p]
+    if build == "hbm":
+        in_specs.append(
+            pl.BlockSpec((1, H_PAD, b_pad), out3, memory_space=pltpu.ANY)
+            if False else
+            pl.BlockSpec((1, H_PAD, b_pad), out3, memory_space=pltpu.VMEM)
+        )
+        args.append(m_hbm)
+
+    out = pl.pallas_call(
+        partial(_kernel_v, r_pad=r_pad, h_chunk=hc, b_pad=b_pad,
+                build=build, dot=dot, net=net),
+        grid=(n,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, r_pad, b_pad), out3,
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((n, r_pad, b_pad), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * r_pad * H_PAD * b_pad,
+            bytes_accessed=4 * n * H_PAD * 4,
+            transcendentals=0,
+        ),
+    )(*args)
+    return out[0]
+
+
+# ------------------------------------------------------------------ timing
+#
+# Fresh executables compile in 1-3 min through the tunnel, so each
+# variant compiles exactly ONE program; per-call DEVICE time then comes
+# from the profiler trace (sum of device X events over perturbed reps —
+# wall clock through the tunnel carries ~250 ms dispatch+fetch noise).
+
+def _device_ms_per_rep(run_reps, reps: int) -> float:
+    import glob
+    import gzip
+    import json
+    import tempfile
+    from collections import defaultdict
+
+    tdir = tempfile.mkdtemp(prefix="kmb_trace_")
+    jax.profiler.start_trace(tdir)
+    try:
+        run_reps()
+    finally:
+        jax.profiler.stop_trace()
+    files = glob.glob(f"{tdir}/**/*.trace.json.gz", recursive=True)
+    with gzip.open(sorted(files)[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    pid_names = {
+        e["pid"]: e["args"].get("name", "") for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    dev = {p for p, nm in pid_names.items() if "TPU" in nm}
+    total_us = sum(
+        float(e.get("dur", 0.0)) for e in events
+        if e.get("ph") == "X" and e.get("pid") in dev
+    )
+    return total_us / 1e3 / reps
+
+
+def time_variant(name, variant_fn, data, reps=3):
+    # data arrays MUST be jit arguments, not closure captures: captured
+    # device arrays are baked into the HLO as literal constants, and the
+    # tunnel's remote_compile rejects (HTTP 413) / crawls on the
+    # hundreds-of-MB request body that produces.
+    load, gen, sell, bucket, scales = data
+    f = jax.jit(lambda l, g, s, b, sc: jnp.sum(variant_fn(l, g, s, b, sc)))
+    base = float(time.time() % 997.0)
+    t0 = time.perf_counter()
+    float(f(load, gen, sell, bucket, scales * (1.0 + base * 1e-6)))
+    t_compile = time.perf_counter() - t0
+
+    def run_reps():
+        for i in range(reps):
+            float(f(load, gen, sell, bucket,
+                    scales * (1.0 + (base + 1 + 0.37 * i) * 1e-6)))
+
+    ms = _device_ms_per_rep(run_reps, reps)
+    print(f"{name:34s} {ms:8.2f} ms/call device "
+          f"(compile {t_compile:.0f}s)", flush=True)
+    return ms
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    which = set(sys.argv[2:])
+    n_periods = 2
+    r = 250
+
+    # generate ON DEVICE: host->device through the tunnel is ~6 MB/s,
+    # so materializing [N, 8760] arrays on host would never finish
+    @jax.jit
+    def gen_data(key):
+        ks = jax.random.split(key, 5)
+        load = jax.random.uniform(ks[0], (n, H), jnp.float32, 0.2, 3.0)
+        g = jax.random.uniform(ks[1], (n, H), jnp.float32, 0.0, 1.0)
+        sell = jax.random.uniform(ks[2], (n, H), jnp.float32, 0.02, 0.08)
+        period = jax.random.randint(ks[3], (n, H), 0, n_periods, jnp.int32)
+        bucket = bp.hourly_bucket_ids(period, n_periods)
+        scales = jax.random.uniform(ks[4], (n, r), jnp.float32, 0.1, 6.0)
+        return load, g, sell, bucket, scales
+
+    data = jax.block_until_ready(gen_data(jax.random.key(0)))
+
+    variants = {
+        "base(onehot,dot,fma,128)": dict(),
+        "const_m(no onehot build)": dict(build="const"),
+        "no_dot(onehot, no MXU)": dict(dot="none"),
+        "no_dot_const(no build,no MXU)": dict(build="const", dot="none"),
+        "no_net(onehot,dot,bcast)": dict(net="bcast"),
+        "b64(onehot,dot,fma,64)": dict(b_pad=64),
+        "b64_const": dict(b_pad=64, build="const"),
+    }
+    results = {}
+    for name, kw in variants.items():
+        if which and not any(w in name for w in which):
+            continue
+        fn = lambda l, g, s, b, sc, kw=kw: sums_variant(l, g, s, b, sc, **kw)
+        results[name] = time_variant(name, fn, data)
+
+    if not which or "monthmask" in which:
+        fn = lambda l, g, s, b, sc: sums_monthmask(
+            l, g, s, b, sc, n_periods=n_periods)
+        results["monthmask(no onehot,no MXU)"] = time_variant(
+            "monthmask(no onehot,no MXU)", fn, data)
+
+    for g in (4, 8):
+        if which and f"mg{g}" not in which:
+            continue
+        fn = lambda l, gg, s, b, sc, g=g: sums_monthmask_g(
+            l, gg, s, b, sc, n_periods=n_periods, g_block=g)
+        results[f"monthmask_g{g}"] = time_variant(
+            f"monthmask_g{g}", fn, data)
+
+    if not which or "monthdot" in which:
+        fn = lambda l, g, s, b, sc: sums_monthdot(
+            l, g, s, b, sc, n_periods=n_periods)
+        results["monthdot(positional M,dot)"] = time_variant(
+            "monthdot(positional M,dot)", fn, data)
+        k = 32
+        sl = jax.jit(
+            lambda l, g, s, b, sc: (
+                bp._sums_pallas(l, g, s, b, sc, with_signed=False, n_periods=n_periods)[0],
+                sums_monthdot(l, g, s, b, sc, n_periods=n_periods),
+            )
+        )
+        a, b_ = jax.device_get(sl(*(d[:k] for d in data)))
+        nb = 12 * n_periods
+        err_b = np.max(np.abs(a[:, :250, :nb] - b_[:, :250, :nb]))
+        err_s = np.max(np.abs(a[:, :250, 127] - b_[:, :250, 127]))
+        print(f"parity monthdot vs base: max|d| buckets {err_b:.3e} "
+              f"sell {err_s:.3e}", flush=True)
+
+    # library baseline for cross-check
+    def lib(l, g, s, b, sc):
+        out = bp._sums_pallas(l, g, s, b, sc, with_signed=False, n_periods=n_periods)
+        return out[0]
+    if not which or "lib" in which:
+        results["library _sums_pallas"] = time_variant(
+            "library _sums_pallas", lib, data)
+
+    if not which or "parity" in which or "monthmask" in which:
+        k = 32
+        sl = jax.jit(
+            lambda l, g, s, b, sc: (
+                bp._sums_pallas(l, g, s, b, sc, with_signed=False, n_periods=n_periods)[0],
+                sums_monthmask(l, g, s, b, sc, n_periods=n_periods),
+            )
+        )
+        a, b_ = jax.device_get(sl(*(d[:k] for d in data)))
+        nb = 12 * n_periods
+        err_b = np.max(np.abs(a[:, :250, :nb] - b_[:, :250, :nb]))
+        err_s = np.max(np.abs(a[:, :250, 127] - b_[:, :250, 127]))
+        ref = np.max(np.abs(a[:, :250, :nb]))
+        print(f"parity monthmask vs base: max|d| buckets {err_b:.3e} "
+              f"sell {err_s:.3e} (scale {ref:.1f})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

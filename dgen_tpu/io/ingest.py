@@ -403,21 +403,36 @@ def state_attachment_to_groups(per_state: np.ndarray, n_sectors: int = 3) -> np.
     return np.repeat(per_state, n_sectors).astype(np.float32)
 
 
-def discover_reference_inputs(root: str) -> Dict[str, str]:
-    """Locate reference-format input files under an input_data directory."""
-    def first(sub: str, prefer: Optional[str] = None) -> Optional[str]:
+def discover_reference_inputs(
+    root: str, prefer: Optional[Dict[str, str]] = None
+) -> Dict[str, str]:
+    """Locate reference-format input files under an input_data directory.
+
+    ``prefer`` maps family keys (pv_prices, elec_prices, financing, ...)
+    to a filename substring — the scenario workbook's per-family
+    trajectory selection (io.workbook) — which beats the built-in
+    default substring; an unmatched preference falls back to the
+    default rather than failing the whole ingest."""
+    prefer = prefer or {}
+
+    def first(sub: str, want: Optional[str]) -> Optional[str]:
+        """Match ``want`` as a substring; None when unmatched (so the
+        caller can chain fallbacks); ``want=None`` = alphabetical first."""
         d = os.path.join(root, sub)
         if not os.path.isdir(d):
             return None
         names = sorted(n for n in os.listdir(d) if n.endswith(".csv"))
-        if prefer:
+        if not names:
+            return None
+        if want:
             for n in names:
-                if prefer in n:
+                if want.lower() in n.lower():
                     return os.path.join(d, n)
-        return os.path.join(d, names[0]) if names else None
+            return None
+        return os.path.join(d, names[0])
 
     out = {}
-    for key, sub, prefer in (
+    for key, sub, default in (
         ("pv_prices", "pv_prices", "mid"),
         ("pv_tech", "pv_tech_performance", "FY19"),
         ("batt_prices", "batt_prices", "mid"),
@@ -427,7 +442,8 @@ def discover_reference_inputs(root: str) -> Dict[str, str]:
         ("batt_tech", "batt_tech_performance", "FY19"),
         ("deprec", "depreciation_schedules", "FY19"),
     ):
-        p = first(sub, prefer)
+        p = (first(sub, prefer.get(key)) or first(sub, default)
+             or first(sub, None))
         if p:
             out[key] = p
     for key, name in (

@@ -209,6 +209,7 @@ def scenario_inputs_from_reference(
     states: Sequence[str],
     region_kind: str = "census_division",
     overrides: Optional[Dict[str, object]] = None,
+    prefer: Optional[Dict[str, str]] = None,
 ) -> Tuple[ScenarioInputs, Dict[str, object]]:
     """(ScenarioInputs, meta) from a reference input_data directory.
 
@@ -221,18 +222,34 @@ def scenario_inputs_from_reference(
 
     ``meta`` carries the region list and the per-region flat wholesale
     sell rate base [R] ($/kWh) for ProfileBank construction.
+
+    ``prefer`` maps family keys to filename substrings (the scenario
+    workbook's per-family trajectory selections, io.workbook /
+    ingest.discover_reference_inputs); unmatched preferences fall back
+    to the built-in defaults.
     """
-    files = ingest.discover_reference_inputs(input_root)
+    prefer = prefer or {}
+    files = ingest.discover_reference_inputs(input_root, prefer=prefer)
     years = list(config.model_years)
     n_states = len(states)
     g = n_states * len(SECTORS)
 
-    wholesale_path = None
-    wdir = os.path.join(input_root, "wholesale_electricity_prices")
-    if os.path.isdir(wdir):
-        cands = sorted(f for f in os.listdir(wdir) if f.endswith(".csv"))
-        prefer = [c for c in cands if "Mid_Case" in c]
-        wholesale_path = os.path.join(wdir, (prefer or cands)[-1]) if cands else None
+    def _pick_csv(dirname: str, key: str, default_substr: str) -> Optional[str]:
+        d = os.path.join(input_root, dirname)
+        if not os.path.isdir(d):
+            return None
+        cands = sorted(f for f in os.listdir(d) if f.endswith(".csv"))
+        if not cands:
+            return None
+        for substr in (prefer.get(key), default_substr):
+            if substr:
+                hit = [c for c in cands if substr.lower() in c.lower()]
+                if hit:
+                    return os.path.join(d, hit[-1])
+        return os.path.join(d, cands[-1])
+
+    wholesale_path = _pick_csv(
+        "wholesale_electricity_prices", "wholesale", "Mid_Case")
 
     bas: List[str] = []
     wholesale_base = np.zeros(0, np.float32)
@@ -274,17 +291,13 @@ def scenario_inputs_from_reference(
         ov["batt_capex_per_kw"] = jnp.asarray(ingest.load_stacked_sectors(
             files["batt_prices"], "batt_capex_per_kw", years,
             nonres_suffix=True))
-    pb_dir = os.path.join(input_root, "pv_plus_batt_prices")
-    if os.path.isdir(pb_dir):
-        cands = sorted(f for f in os.listdir(pb_dir) if f.endswith(".csv"))
-        prefer = [c for c in cands if "mid" in c]
-        if cands:
-            pb = load_pv_plus_batt_prices(
-                os.path.join(pb_dir, (prefer or cands)[-1]), years)
-            ov["pv_capex_per_kw_combined"] = jnp.asarray(
-                pb["pv_capex_per_kw_combined"])
-            ov["batt_capex_per_kwh_combined"] = jnp.asarray(
-                pb["batt_capex_per_kwh_combined"])
+    pb_path = _pick_csv("pv_plus_batt_prices", "pv_plus_batt", "mid")
+    if pb_path:
+        pb = load_pv_plus_batt_prices(pb_path, years)
+        ov["pv_capex_per_kw_combined"] = jnp.asarray(
+            pb["pv_capex_per_kw_combined"])
+        ov["batt_capex_per_kwh_combined"] = jnp.asarray(
+            pb["batt_capex_per_kwh_combined"])
 
     # --- wholesale trajectory -> per-year sell-rate multiplier ---
     if wholesale_traj is not None and len(bas):
@@ -296,13 +309,10 @@ def scenario_inputs_from_reference(
                 (len(years), n_regions)).copy())
 
     # --- carbon intensities (elec.py:595 passthrough) ---
-    cdir = os.path.join(input_root, "carbon_intensities")
-    if os.path.isdir(cdir):
-        csvs = sorted(f for f in os.listdir(cdir) if f.endswith(".csv"))
-        if csvs:
-            ov["carbon_intensity_t_per_kwh"] = jnp.asarray(
-                ingest.load_carbon_intensities(
-                    os.path.join(cdir, csvs[-1]), years, states))
+    c_path = _pick_csv("carbon_intensities", "carbon", "")
+    if c_path:
+        ov["carbon_intensity_t_per_kwh"] = jnp.asarray(
+            ingest.load_carbon_intensities(c_path, years, states))
 
     # --- ITC schedule: an itc_schedule.csv in the input root (columns
     # itc_fraction_res/com/ind by year — the workbook's itc_options
@@ -358,15 +368,11 @@ def scenario_inputs_from_reference(
 
     # --- value of resiliency (apply_value_of_resiliency, elec.py:287;
     # shipped vor_FY20 CSV keys on state_abbr + sector_abbr) ---
-    vdir = os.path.join(input_root, "value_of_resiliency")
-    if os.path.isdir(vdir):
-        vcsvs = sorted(f for f in os.listdir(vdir) if f.endswith(".csv"))
-        vprefer = [c for c in vcsvs if "mid" in c]
-        if vcsvs:
-            vor_g = ingest.load_value_of_resiliency(
-                os.path.join(vdir, (vprefer or vcsvs)[-1]), states)
-            ov["value_of_resiliency"] = jnp.asarray(np.broadcast_to(
-                vor_g[None, :], (len(years), g)).copy())
+    v_path = _pick_csv("value_of_resiliency", "vor", "mid")
+    if v_path:
+        vor_g = ingest.load_value_of_resiliency(v_path, states)
+        ov["value_of_resiliency"] = jnp.asarray(np.broadcast_to(
+            vor_g[None, :], (len(years), g)).copy())
 
     # --- market curves: CSV drop-ins for the reference's Postgres-only
     # tables (max_market_curves_to_model, data_functions.py:370;

@@ -1,0 +1,284 @@
+"""The five BASELINE.json benchmark configurations as runnable presets.
+
+The reference pins its headline numbers to five named configs
+(BASELINE.json "configs"; the operator reproduces them through
+submit_all.sh + the scenario workbook). Here each is one command:
+
+    python -m dgen_tpu.presets delaware-res
+    python -m dgen_tpu.presets national-all-sector --agents 1048576
+
+Populations are synthetic (the reference's real agent pickles live only
+in its Postgres dump) at the config's scale and sector mix; scenario
+trajectories come from the reference's own input_data CSVs when the
+mount exists (io.reference_inputs), else the uniform synthetic
+defaults — the run's meta.json says which.
+
+Each run prints a per-phase breakdown (build / compile / steps /
+exports) and a final one-line JSON so bench.py and operators consume
+the same machinery (``run_preset``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+REFERENCE_INPUT_ROOT = "/root/reference/dgen_os/input_data"
+
+
+@dataclasses.dataclass(frozen=True)
+class Preset:
+    """One BASELINE.json config as a buildable simulation."""
+
+    name: str
+    baseline_config: str       # the BASELINE.json "configs" line
+    states: Optional[list]     # None = all states
+    sector_weights: tuple
+    start_year: int
+    end_year: int
+    storage_enabled: bool
+    with_hourly: bool
+    default_agents: int
+    load_growth_scenario: Optional[str] = None  # substring of the CSV
+
+
+PRESETS: Dict[str, Preset] = {p.name: p for p in (
+    Preset(
+        name="delaware-res",
+        baseline_config="Delaware residential solar-only, 2014–2024 default scenario (small_states single-state)",
+        states=["DE"], sector_weights=(1.0, 0.0, 0.0),
+        start_year=2014, end_year=2024,
+        storage_enabled=False, with_hourly=True, default_agents=1024,
+    ),
+    Preset(
+        name="california-res-com",
+        baseline_config="California residential + commercial solar, default ATB cost trajectory",
+        states=["CA"], sector_weights=(0.7, 0.3, 0.0),
+        start_year=2014, end_year=2040,
+        storage_enabled=False, with_hourly=True, default_agents=8192,
+    ),
+    Preset(
+        name="ercot-all-sector",
+        baseline_config="ERCOT ISO all-sector solar+storage (battery dispatch on, NEM tariffs)",
+        states=["TX"], sector_weights=(0.6, 0.3, 0.1),
+        start_year=2014, end_year=2040,
+        storage_enabled=True, with_hourly=True, default_agents=8192,
+    ),
+    Preset(
+        name="national-res",
+        baseline_config="National residential solar, 2014–2050 biennial, all states sharded over pod",
+        states=None, sector_weights=(1.0, 0.0, 0.0),
+        start_year=2014, end_year=2050,
+        storage_enabled=False, with_hourly=False, default_agents=65536,
+    ),
+    Preset(
+        name="national-all-sector",
+        baseline_config="National all-sector solar+storage, high-electrification load-growth scenario",
+        states=None, sector_weights=(0.7, 0.2, 0.1),
+        start_year=2014, end_year=2050,
+        storage_enabled=True, with_hourly=True, default_agents=1048576,
+        load_growth_scenario="Experimental",
+    ),
+)}
+
+
+def build(
+    name: str,
+    n_agents: Optional[int] = None,
+    input_root: Optional[str] = None,
+    run_config=None,
+    mesh=None,
+):
+    """(Simulation, population, meta) for a named preset."""
+    import jax.numpy as jnp
+
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.io.reference_inputs import (
+        scenario_inputs_from_reference,
+        wholesale_profile_bank,
+    )
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.agents import ProfileBank
+    from dgen_tpu.models.simulation import Simulation
+
+    p = PRESETS[name]
+    cfg = ScenarioConfig(
+        name=p.name, start_year=p.start_year, end_year=p.end_year,
+        storage_enabled=p.storage_enabled, anchor_years=(),
+    )
+    n = int(n_agents or p.default_agents)
+    root = input_root or REFERENCE_INPUT_ROOT
+
+    meta: Dict[str, object] = {
+        "preset": p.name, "baseline_config": p.baseline_config,
+        "n_agents": n,
+    }
+    # inputs always cover the FULL state list: synthetic populations
+    # index global state ids even when only the preset's states are
+    # populated (same contract as parallel.launch.main)
+    states = list(synth.STATES)
+    prefer = (
+        {"load_growth": p.load_growth_scenario}
+        if p.load_growth_scenario else None
+    )
+    if os.path.isdir(root):
+        inputs, ref_meta = scenario_inputs_from_reference(
+            root, cfg, states, prefer=prefer)
+        meta["data_sources"] = ref_meta.get("data_sources", {})
+        meta["market_curves"] = ref_meta["market_curves"]
+        n_regions = len(ref_meta["regions"])
+        wholesale = jnp.asarray(wholesale_profile_bank(ref_meta, root))
+    else:
+        meta["data_sources"] = {"all": "synthetic_default"}
+        meta["market_curves"] = {"mms": "synthetic_default",
+                                 "bass": "synthetic_default"}
+        inputs = None
+        n_regions = 10
+        wholesale = None
+
+    pop = synth.generate_population(
+        n, states=p.states, seed=7, sector_weights=p.sector_weights,
+        n_regions=n_regions,
+    )
+    if inputs is None:
+        inputs = scen.uniform_inputs(
+            cfg, n_groups=pop.table.n_groups, n_regions=n_regions)
+        profiles = pop.profiles
+    else:
+        profiles = ProfileBank(
+            load=pop.profiles.load, solar_cf=pop.profiles.solar_cf,
+            wholesale=wholesale,
+        )
+
+    sim = Simulation(
+        pop.table, profiles, pop.tariffs, inputs, cfg,
+        run_config or RunConfig(), mesh=mesh, with_hourly=p.with_hourly,
+    )
+    meta["agent_chunk"] = sim._agent_chunk
+    return sim, pop, meta
+
+
+class _TimedExporter:
+    """RunExporter wrapper accumulating host-side export seconds."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.seconds = 0.0
+
+    def __call__(self, year, year_idx, outs):
+        t0 = time.time()
+        self.inner(year, year_idx, outs)
+        self.seconds += time.time() - t0
+
+
+def run_preset(
+    name: str,
+    n_agents: Optional[int] = None,
+    run_dir: Optional[str] = None,
+    export: bool = True,
+    checkpoint: bool = False,
+) -> Dict[str, object]:
+    """Build and run a preset end to end; returns the timing record.
+
+    The record is the full-run truth BASELINE.md's north star names:
+    cold start -> every model year -> all three parquet surfaces
+    written (exports on), with the per-phase split.
+    """
+    from dgen_tpu.io.export import RunExporter
+
+    t_start = time.time()
+    sim, pop, meta = build(name, n_agents=n_agents)
+    build_s = time.time() - t_start
+
+    callback = None
+    if export:
+        run_dir = run_dir or os.path.join(
+            "runs", f"preset-{name}-{int(t_start)}")
+        callback = _TimedExporter(RunExporter(
+            run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask,
+            state_names=None, meta=meta,
+        ))
+
+    year_times: list = []
+    orig_step = sim.step
+
+    def timed_step(carry, yi, first_year):
+        t0 = time.time()
+        out = orig_step(carry, yi, first_year)
+        year_times.append(time.time() - t0)
+        return out
+
+    sim.step = timed_step
+    t0 = time.time()
+    res = sim.run(
+        callback=callback, collect=False,
+        checkpoint_dir=(os.path.join(run_dir, "ckpt")
+                        if (checkpoint and run_dir) else None),
+    )
+    run_s = time.time() - t0
+    total_s = time.time() - t_start
+
+    n_real = int(np.asarray(sim.host_mask).sum())
+    n_years = len(res.years)
+    # sim.step times measure DISPATCH (execution completes at the
+    # per-year host sync), so only the first dispatch — which blocks on
+    # compilation — is meaningful; steady per-year time comes from the
+    # run wall net of compile and host export time
+    compile_s = max(
+        year_times[0] - float(np.median(year_times[1:])), 0.0
+    ) if len(year_times) > 2 else 0.0
+    export_s = callback.seconds if callback else 0.0
+    steady = max(run_s - compile_s - export_s, 0.0) / max(n_years, 1)
+    rec = {
+        "preset": name,
+        "agents": n_real,
+        "years": n_years,
+        "agent_chunk": meta["agent_chunk"],
+        "with_hourly": PRESETS[name].with_hourly,
+        "storage": PRESETS[name].storage_enabled,
+        "total_s": round(total_s, 1),
+        "build_s": round(build_s, 1),
+        "run_s": round(run_s, 1),
+        "compile_s": round(compile_s, 1),
+        "steady_year_s": round(steady, 2),
+        "export_s": round(export_s, 1),
+        "agent_years_per_sec": round(n_real * n_years / total_s, 1),
+        "run_dir": run_dir if export else None,
+        "data_sources": meta["data_sources"],
+    }
+    return rec
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Run a BASELINE.json preset end to end")
+    ap.add_argument("name", choices=sorted(PRESETS))
+    ap.add_argument("--agents", type=int, default=None)
+    ap.add_argument("--run-dir", default=None)
+    ap.add_argument("--no-export", action="store_true")
+    ap.add_argument("--checkpoint", action="store_true")
+    args = ap.parse_args(argv)
+
+    p = PRESETS[args.name]
+    print(f"preset {p.name}: {p.baseline_config}")
+    rec = run_preset(
+        args.name, n_agents=args.agents, run_dir=args.run_dir,
+        export=not args.no_export, checkpoint=args.checkpoint,
+    )
+    print(f"build {rec['build_s']}s | compile ~{rec['compile_s']}s | "
+          f"steady year {rec['steady_year_s']}s | "
+          f"exports {rec['export_s']}s | total {rec['total_s']}s "
+          f"({rec['agent_years_per_sec']} agent-years/sec)")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""BASELINE.json preset registry: every config builds, and the small
+one runs end to end with all three export surfaces (VERDICT r3 item 2:
+the five benchmark configs exist as runnable presets)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dgen_tpu import presets
+
+
+def test_registry_covers_baseline_configs():
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BASELINE.json")) as f:
+        base = json.load(f)
+    assert len(presets.PRESETS) == len(base["configs"]) == 5
+    # every BASELINE config line is carried verbatim by exactly one preset
+    carried = {p.baseline_config for p in presets.PRESETS.values()}
+    assert carried == set(base["configs"])
+
+
+@pytest.mark.parametrize("name", sorted(presets.PRESETS))
+def test_presets_build(name):
+    sim, pop, meta = presets.build(name, n_agents=256)
+    p = presets.PRESETS[name]
+    assert sim.scenario.storage_enabled == p.storage_enabled
+    assert sim.with_hourly == p.with_hourly
+    assert list(sim.years)[0] == p.start_year
+    # reference mount present in CI: trajectories must be ingested
+    if os.path.isdir(presets.REFERENCE_INPUT_ROOT):
+        assert meta["data_sources"], meta
+    # sector mix respected (res-only presets carry no com/ind agents)
+    if p.sector_weights[1] == 0.0:
+        keep = np.asarray(pop.table.mask) > 0
+        assert np.all(np.asarray(pop.table.sector_idx)[keep] == 0)
+
+
+def test_delaware_preset_runs_with_exports(tmp_path):
+    rec = presets.run_preset(
+        "delaware-res", n_agents=96, run_dir=str(tmp_path / "run"))
+    assert rec["years"] == 6 and rec["agents"] == 96
+    assert rec["total_s"] > 0 and rec["export_s"] > 0
+
+    from dgen_tpu.io.export import load_surface
+
+    run_dir = str(tmp_path / "run")
+    agent = load_surface(run_dir, "agent_outputs")
+    assert len(agent) == 96 * 6
+    assert len(load_surface(run_dir, "finance_series")) == 96 * 6
+    assert len(load_surface(run_dir, "state_hourly")) > 0
+    with open(os.path.join(run_dir, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["preset"] == "delaware-res"
+    assert "baseline_config" in meta and "data_sources" in meta

@@ -197,9 +197,11 @@ def run_preset(
     build_s = time.time() - t_start
 
     callback = None
-    if export:
+    if export or checkpoint:
+        # checkpointing needs a run dir even when exports are off
         run_dir = run_dir or os.path.join(
             "runs", f"preset-{name}-{int(t_start)}")
+    if export:
         callback = _TimedExporter(RunExporter(
             run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask,
             state_names=None, meta=meta,

@@ -157,12 +157,15 @@ def test_distributed_run_persists_and_resumes(tmp_path):
 
 def test_two_process_distributed_run_persists_shards(tmp_path):
     """TRUE multi-process run: two jax.distributed processes (4 CPU
-    devices each, gloo collectives) over one 8-device global mesh.
-    Exercises the real multi-host surfaces end to end — global-array
-    placement from host copies, shard_map'd kernels over remote
-    meshes, orbax collective checkpointing, and the exporter's
-    addressable-shard parquet parts — and pins the per-agent results
-    against a single-process reference run."""
+    devices each, gloo collectives) over one 8-device global mesh,
+    WITH agent-axis chunking — the national configuration: the
+    shard-major streaming year step (simulation._to_chunks) plus hourly
+    rematerialization run under jax.process_count() > 1. Exercises the
+    real multi-host surfaces end to end — global-array placement from
+    host copies, shard_map'd kernels over remote meshes, orbax
+    collective checkpointing, and the exporter's addressable-shard
+    parquet parts — and pins the per-agent results against a
+    single-process UNCHUNKED reference run."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -202,8 +205,10 @@ def test_two_process_distributed_run_persists_shards(tmp_path):
         inputs = scen.uniform_inputs(cfg, n_groups=pop.table.n_groups,
                                      n_regions=pop.n_regions)
         sim = Simulation(pop.table, pop.profiles, pop.tariffs,
-                         inputs, cfg, RunConfig(sizing_iters={ITERS}),
+                         inputs, cfg,
+                         RunConfig(sizing_iters={ITERS}, agent_chunk=4),
                          mesh=make_mesh(), with_hourly=True)
+        assert sim._agent_chunk == 4, sim._agent_chunk
         exporter = RunExporter(
             run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask)
         res = sim.run(callback=exporter, collect=False,

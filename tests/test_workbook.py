@@ -1,0 +1,100 @@
+"""Scenario-workbook (.xlsm) reader: decode the reference's actual
+input artifact and drive per-family trajectory selection through the
+ingest (VERDICT r3 item 8 / missing item 4: the workbook's 14 named
+ranges become usable without hand-exported CSVs)."""
+
+import os
+
+import pytest
+
+from dgen_tpu.io import workbook as wbk
+
+XLSM = "/root/reference/dgen_os/excel/input_sheet_final.xlsm"
+XLSM_2024 = "/root/reference/dgen_os/excel/2024_input_sheet.xlsm"
+INPUT_ROOT = "/root/reference/dgen_os/input_data"
+
+needs_ref = pytest.mark.skipif(
+    not os.path.exists(XLSM), reason="reference workbook not mounted")
+
+
+@needs_ref
+def test_read_scenario_decodes_reference_workbook():
+    ws = wbk.read_scenario(XLSM)
+    assert ws.name == "reference"
+    assert ws.end_year == 2030
+    assert ws.storage_enabled is True          # "Solar + Storage"
+    assert ws.region == "Delaware"
+    assert ws.markets == "Only Residential"
+    assert ws.seed == 1
+    assert ws.agent_file == "agent_df_base_res_de_revised"
+    # every run-mapped family resolved (table_range_lkup.csv rows);
+    # preset choices come from the Value column, user tables from the
+    # User Defined column
+    assert ws.selections["load_growth"] == "AEO2019 Reference"
+    assert ws.selections["pv_prices"] == "pv_price_atb19_mid"
+    assert ws.selections["financing"] == "financing_atb_FY19"
+    assert set(ws.selections) == set(wbk.SELECTOR_FAMILIES.values())
+
+
+@needs_ref
+def test_scenario_from_workbook_builds_config():
+    cfg, info = wbk.scenario_from_workbook(XLSM)
+    assert cfg.end_year == 2030 and cfg.storage_enabled
+    assert info["states"] == ["DE"]
+    assert info["sector_weights"] == (1.0, 0.0, 0.0)
+    assert info["prefer"]["elec_prices"] == "ATB19_Mid_Case_retail"
+
+
+@needs_ref
+def test_workbook_selections_drive_ingest_file_choice():
+    """The decoded selections must actually pick the named CSVs when
+    threaded through scenario_inputs_from_reference(prefer=...)."""
+    from dgen_tpu.io import synth
+    from dgen_tpu.io.reference_inputs import scenario_inputs_from_reference
+
+    cfg, info = wbk.scenario_from_workbook(XLSM)
+    inputs, meta = scenario_inputs_from_reference(
+        INPUT_ROOT, cfg, list(synth.STATES), prefer=info["prefer"])
+    files = {k: os.path.basename(v) for k, v in meta["files"].items()}
+    assert files["pv_prices"] == "pv_price_atb19_mid.csv"
+    assert files["financing"] == "financing_atb_FY19.csv"
+    assert files["elec_prices"] == "ATB19_Mid_Case_retail.csv"
+    # an FY23 selection (the 2024 workbook) picks the FY23 files
+    cfg2, info2 = wbk.scenario_from_workbook(XLSM_2024)
+    inputs2, meta2 = scenario_inputs_from_reference(
+        INPUT_ROOT, cfg2, list(synth.STATES), prefer=info2["prefer"])
+    files2 = {k: os.path.basename(v) for k, v in meta2["files"].items()}
+    assert files2["financing"] == "financing_atb_FY23.csv"
+    assert files2["elec_prices"] == "ATB23_Mid_Case_retail.csv"
+    # unmatched preferences (Postgres-only presets like the load-growth
+    # name) fall back to defaults instead of failing
+    assert "load_growth" in files
+
+
+@needs_ref
+def test_export_drop_ins_round_trip(tmp_path):
+    out = wbk.export_drop_ins(XLSM, str(tmp_path))
+    assert os.path.exists(out["scenario_options"])
+    assert os.path.exists(out["selections"])
+    import csv
+    import json
+
+    with open(out["scenario_options"]) as f:
+        rows = {r["option"]: r["value"] for r in csv.DictReader(f)}
+    assert rows["Scenario Name"] == "reference"
+    assert rows["Analysis End Year"] == "2030"
+    with open(out["selections"]) as f:
+        sel = json.load(f)
+    assert sel["selections"]["pv_prices"] == "pv_price_atb19_mid"
+    assert sel["agent_file"] == "agent_df_base_res_de_revised"
+
+
+def test_region_and_market_resolution():
+    assert wbk.resolve_states("National") is None
+    assert wbk.resolve_states("Delaware") == ["DE"]
+    assert wbk.resolve_states("ERCOT") == ["TX"]
+    assert wbk.resolve_states("TX") == ["TX"]
+    with pytest.raises(ValueError):
+        wbk.resolve_states("Atlantis")
+    assert wbk.resolve_sector_weights("Only Commercial") == (0.0, 1.0, 0.0)
+    assert wbk.resolve_sector_weights("All") == (0.7, 0.2, 0.1)

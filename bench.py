@@ -171,7 +171,12 @@ def _time_steps(sim, n_rep: int = 3) -> float:
         )
         t0 = time.time()
         _, out = sim.step(pert, 1, first_year=False)
-        jax.block_until_ready(out.system_kw_cum)
+        # scalar fetch, not block_until_ready: the tunnel's block is
+        # unreliable on some programs (returns ~0 ms without executing);
+        # a value fetch always forces real execution. The ~134 ms fetch
+        # latency folds into the wall time like the dispatch overhead
+        # always has.
+        float(jnp.sum(out.system_kw_cum))
         # min over reps: the tunnel to the device adds high-variance
         # host latency that the mean would fold into the step time
         best = min(best, time.time() - t0)
@@ -203,7 +208,7 @@ def _time_sizing(sim, n_rep: int = 3) -> float:
             envs, one_time_charge=envs.one_time_charge + (i + 1) * 1e-3)
         t0 = time.time()
         res = sizing_ops.size_agents(pert, **kw)
-        jax.block_until_ready(res.npv)
+        float(jnp.sum(res.npv))
         total += time.time() - t0
     return total / n_rep
 
@@ -233,7 +238,7 @@ def _trace_step(sim) -> dict | None:
         jax.profiler.start_trace(tdir)
         try:
             _, out2 = sim.step(pert, 1, first_year=False)
-            jax.block_until_ready(out2.system_kw_cum)
+            float(jnp.sum(out2.system_kw_cum))
         finally:
             # a failure mid-window must not leave the profiler running
             # under every subsequent measurement

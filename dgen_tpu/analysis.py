@@ -9,6 +9,13 @@ baseline / PV-only / PV+battery net loads of a converted population
 whose tariffs carry ``d_flat_*`` / ``d_tou_*`` structures
 (io.convert preserves them as each tariff spec's ``"demand"``
 sub-spec).
+
+It also carries the dispatch observability surface
+(:func:`dispatch_diagnostics`) — the analyst tool the reference prints
+per run (``dispatch_export_diags``, batt_dispatch_helpers.py:103-336):
+midday PV-surplus capture, energy routing totals, charge-power vs SOC
+bottleneck hours, and sell/buy-rate revenue splits — vectorized over
+the whole agent table instead of printed one agent at a time.
 """
 
 from __future__ import annotations
@@ -77,4 +84,108 @@ def demand_charge_audit(
         )
         _, _, batt_net = net_hourly_profiles(load, gen, dr.system_out)
         out["with_batt"] = charge(batt_net, at) * table.mask
+    return out
+
+
+def dispatch_diagnostics(
+    load: jax.Array,            # [N, 8760] kWh/h
+    gen: jax.Array,             # [N, 8760] PV output kWh/h
+    dr,                         # DispatchResult (leaves [N, 8760])
+    sell: jax.Array,            # [N, 8760] $/kWh sell rate
+    buy: Optional[jax.Array] = None,   # [N, 8760] $/kWh buy rate
+    batt_kw: Optional[jax.Array] = None,
+    midday_hours: tuple = (11, 15),
+    night_eps: float = 1e-6,
+) -> Dict[str, jax.Array]:
+    """Per-agent dispatch/export diagnostics (all values [N]).
+
+    The table-level analogue of the reference's per-agent printout
+    (``dispatch_export_diags``, batt_dispatch_helpers.py:103-336):
+    midday PV-surplus capture fraction, energy routing totals
+    (PV->batt / PV->grid / PV->load / batt->load), charge-power-bound
+    vs SOC-bound hour counts, day/night sell-rate means, export revenue
+    and avoided retail spend. Differences by design: this framework's
+    greedy self-consumption dispatch (ops.dispatch) never routes
+    battery->grid or grid->battery, so those reference columns are
+    identically zero and omitted.
+
+    ``batt_kw`` defaults to the observed maximum of ``dr``'s charge
+    trace; it only sets the power-bound classification threshold.
+    """
+    hod = jnp.arange(load.shape[1]) % 24
+    midday = (hod >= midday_hours[0]) & (hod <= midday_hours[1])
+    night = gen < night_eps                                  # [N, H]
+
+    surplus = jnp.maximum(gen - load, 0.0)
+    s2b = dr.charge                                          # PV -> batt
+    b2l = dr.discharge                                       # batt -> load
+    # meter-level exports of the battery-modified system output
+    s2g = jnp.maximum(dr.system_out - load, 0.0)
+    s2l = jnp.maximum(jnp.minimum(gen - s2b, load), 0.0)     # PV direct
+
+    msum = lambda x, m: jnp.sum(x * m[None, :], axis=1) if m.ndim == 1 \
+        else jnp.sum(x * m, axis=1)
+    tot = lambda x: jnp.sum(x, axis=1)
+
+    surplus_mid = msum(surplus, midday)
+    s2b_mid = msum(s2b, midday)
+    capture_mid = jnp.where(surplus_mid > 1e-9, s2b_mid / surplus_mid, 0.0)
+
+    # bottlenecks: hours whose surplus the battery did NOT fully
+    # absorb, split by observed cause — the charge trace hit the power
+    # cap, or (otherwise) energy headroom ran out. Cause-accurate where
+    # the reference classifies by SOC threshold alone
+    # (batt_dispatch_helpers.py:216-222).
+    if batt_kw is None:
+        batt_kw = jnp.max(s2b, axis=1)                       # observed cap
+    unabsorbed = (surplus - s2b) > 1e-6
+    power_bound = unabsorbed & (s2b >= batt_kw[:, None] * (1 - 1e-5))
+    soc_bound = unabsorbed & ~power_bound
+    day = ~night
+
+    out: Dict[str, jax.Array] = {
+        "surplus_total_kwh": tot(surplus),
+        "surplus_mid_kwh": surplus_mid,
+        "pv_to_batt_total_kwh": tot(s2b),
+        "pv_to_batt_mid_kwh": s2b_mid,
+        "pv_to_grid_total_kwh": tot(s2g),
+        "pv_to_grid_mid_kwh": msum(s2g, midday),
+        "pv_direct_to_load_total_kwh": tot(s2l),
+        "batt_to_load_kwh": tot(b2l),
+        "capture_mid_frac": capture_mid,
+        "power_bound_hours": jnp.sum(power_bound, axis=1),
+        "soc_bound_hours": jnp.sum(soc_bound, axis=1),
+        "power_bound_mid_hours": msum(power_bound, midday),
+        "soc_bound_mid_hours": msum(soc_bound, midday),
+        "sell_mean_day": jnp.sum(sell * day, axis=1)
+        / jnp.maximum(jnp.sum(day, axis=1), 1),
+        "sell_mean_night": jnp.sum(sell * night, axis=1)
+        / jnp.maximum(jnp.sum(night, axis=1), 1),
+        "pv_export_revenue_usd": tot(s2g * sell),
+        "pv_export_revenue_mid_usd": msum(s2g * sell, midday),
+    }
+    if buy is not None:
+        out["avoided_pv_self_usd"] = tot(s2l * buy)
+        out["avoided_batt_self_usd"] = tot(b2l * buy)
+    return out
+
+
+def summarize_dispatch(diags: Dict[str, jax.Array], mask) -> Dict[str, float]:
+    """Population roll-up of :func:`dispatch_diagnostics` (the concise
+    per-run stats block the reference prints): kWh/$ fields sum over
+    real agents; fractions and rates are surplus- or agent-weighted
+    means."""
+    import numpy as np
+
+    m = np.asarray(mask) > 0
+    d = {k: np.asarray(v)[m] for k, v in diags.items()}
+    w = d["surplus_mid_kwh"]
+    out = {}
+    for k, v in d.items():
+        if k.endswith("_kwh") or k.endswith("_usd") or "hours" in k:
+            out[k] = float(v.sum())
+        elif k == "capture_mid_frac":
+            out[k] = float((v * w).sum() / max(w.sum(), 1e-9))
+        else:
+            out[k] = float(v.mean())
     return out

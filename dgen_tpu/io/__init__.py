@@ -11,4 +11,5 @@ from dgen_tpu.io import (  # noqa: F401
     reference_inputs,
     store,
     synth,
+    workbook,
 )

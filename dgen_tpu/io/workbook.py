@@ -36,6 +36,7 @@ import os
 import re
 import zipfile
 import xml.etree.ElementTree as ET
+from contextlib import nullcontext as _nullcontext
 from typing import Dict, List, Optional, Tuple
 
 _NS = "{http://schemas.openxmlformats.org/spreadsheetml/2006/main}"
@@ -274,19 +275,6 @@ def read_named_ranges(
         return out
 
 
-class _nullcontext:
-    """contextlib.nullcontext for a shared, caller-owned _Workbook."""
-
-    def __init__(self, wb) -> None:
-        self.wb = wb
-
-    def __enter__(self):
-        return self.wb
-
-    def __exit__(self, *exc) -> None:
-        pass
-
-
 def read_scenario(path: str, _wb=None) -> WorkbookScenario:
     """Decode the Main-sheet scenario options + the 14 run selectors.
 
@@ -311,17 +299,14 @@ def _read_scenario(wb: _Workbook, path: str) -> WorkbookScenario:
     vcol, r0 = _split_ref(tl)
     _, r1 = _split_ref(br)
     lcol = _idx_to_col(_col_to_idx(vcol) - 1)
-    ucol = _idx_to_col(_col_to_idx(vcol) + 1)
     cells = wb.sheet_cells(sheet)
 
     options: Dict[str, object] = {}
-    user_by_row: Dict[int, object] = {}
     for r in range(r0, r1 + 1):
         label = cells.get((r, lcol))
         if label is None:
             continue
         options[str(label).strip()] = cells.get((r, vcol))
-        user_by_row[r] = cells.get((r, ucol))
 
     selections: Dict[str, str] = {}
     agent_file = None

@@ -251,7 +251,7 @@ def run_preset(
         "steady_year_s": round(steady, 2),
         "export_s": round(export_s, 1),
         "agent_years_per_sec": round(n_real * n_years / total_s, 1),
-        "run_dir": run_dir if export else None,
+        "run_dir": run_dir,
         "data_sources": meta["data_sources"],
     }
     return rec

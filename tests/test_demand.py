@@ -214,3 +214,52 @@ def test_demand_charge_audit_end_to_end():
     assert demand_charge_audit(
         pop.table, pop.profiles, synth.make_tariff_specs(), load_kwh
     ) is None
+
+
+def test_dispatch_diagnostics_invariants():
+    """analysis.dispatch_diagnostics: the reference's per-run dispatch
+    stats (batt_dispatch_helpers.py:103-336) as table-level arrays —
+    energy-routing identities, capture bounds, bottleneck splits."""
+    from dgen_tpu.analysis import dispatch_diagnostics, summarize_dispatch
+    from dgen_tpu.ops import dispatch as dp
+
+    rng = np.random.default_rng(4)
+    n, H = 16, 8760
+    hod = np.arange(H) % 24
+    sun = np.clip(np.sin((hod - 6) / 12 * np.pi), 0.0, None)
+    load = jnp.asarray(
+        rng.uniform(0.5, 2.0, (n, H)) * (1 + 0.3 * (hod >= 17)[None, :]),
+        jnp.float32)
+    gen = jnp.asarray(
+        sun[None, :] * rng.uniform(2.0, 8.0, (n, 1)), jnp.float32)
+    sell = jnp.full((n, H), 0.04, jnp.float32)
+    buy = jnp.full((n, H), 0.13, jnp.float32)
+    batt_kw, batt_kwh = jnp.full(n, 2.5), jnp.full(n, 5.0)
+    dr = jax.vmap(dp.dispatch_battery)(load, gen, batt_kw, batt_kwh,
+                                       jnp.full(n, 0.92))
+
+    d = dispatch_diagnostics(load, gen, dr, sell, buy=buy,
+                             batt_kw=batt_kw)
+    d = {k: np.asarray(v) for k, v in d.items()}
+
+    # routing bounds: battery charge can't exceed surplus; capture in
+    # [0, 1]; PV direct-to-load ≤ load; exports ≤ system output
+    assert np.all(d["pv_to_batt_total_kwh"] <= d["surplus_total_kwh"] + 1e-3)
+    assert np.all((d["capture_mid_frac"] >= 0) & (d["capture_mid_frac"] <= 1 + 1e-6))
+    # greedy self-consumption charges from surplus before exporting:
+    # with a modest battery, some midday surplus is captured
+    assert d["pv_to_batt_mid_kwh"].sum() > 0
+    # bottleneck split covers all surplus-hours-not-captured causes
+    assert np.all(d["power_bound_hours"] + d["soc_bound_hours"] <= H)
+    # revenue = exports x sell; avoided spend uses the buy rate
+    np.testing.assert_allclose(
+        d["pv_export_revenue_usd"],
+        d["pv_to_grid_total_kwh"] * 0.04, rtol=1e-5)
+    np.testing.assert_allclose(
+        d["avoided_batt_self_usd"], d["batt_to_load_kwh"] * 0.13,
+        rtol=1e-5)
+
+    s = summarize_dispatch(d, np.ones(n))
+    assert s["surplus_total_kwh"] == pytest.approx(
+        float(d["surplus_total_kwh"].sum()), rel=1e-6)
+    assert 0.0 <= s["capture_mid_frac"] <= 1.0

@@ -66,4 +66,25 @@ for k in ("baseline", "pv_only", "with_batt"):
           f"median ${np.median(v):,.0f}/yr")
 sav = np.asarray(audit["baseline"] - audit["with_batt"])[m][priced]
 print(f"PV+battery demand-charge savings: mean ${sav.mean():,.0f}/yr")
+
+# --- dispatch observability (the reference's per-run dispatch stats,
+# batt_dispatch_helpers.py:103-336) over the same sized systems ---
+import jax
+
+from dgen_tpu.analysis import dispatch_diagnostics, summarize_dispatch
+from dgen_tpu.ops import dispatch as dp
+from dgen_tpu.ops.sizing import INV_EFF
+
+load = sim.profiles.load[sim.table.load_idx] * ya.load_kwh_per_customer[:, None]
+gen = sim.profiles.solar_cf[sim.table.cf_idx] * (outs.system_kw * INV_EFF)[:, None]
+dr = jax.vmap(dp.dispatch_battery)(load, gen, outs.batt_kw, outs.batt_kwh,
+                                   ya.batt_rt_eff)
+sell = jnp.full_like(load, 0.04)
+diags = dispatch_diagnostics(load, gen, dr, sell, batt_kw=outs.batt_kw)
+stats = summarize_dispatch(diags, np.asarray(sim.table.mask))
+print(f"midday PV-surplus capture: {stats['capture_mid_frac']:.2f} "
+      f"(batt absorbed {stats['pv_to_batt_mid_kwh']:,.0f} of "
+      f"{stats['surplus_mid_kwh']:,.0f} kWh)")
+print(f"bottlenecks: {stats['power_bound_hours']:,.0f} power-bound / "
+      f"{stats['soc_bound_hours']:,.0f} headroom-bound agent-hours")
 print("DEMAND AUDIT OK")

@@ -115,15 +115,20 @@ def test_pallas_matches_xla_on_tpu(setup):
         np.abs(rng.normal(2.0, 1.5, (load.shape[0], 9))).astype(np.float32)
     )
     for fn in (bp.bucket_sums, lambda *a, impl: bp.import_sums(*a, impl=impl)):
-        outs_p = fn(load, gen, sell, bucket, scales, b, impl="pallas")
         outs_x = fn(load, gen, sell, bucket, scales, b, impl="xla")
-        for op, ox in zip(outs_p, outs_x):
-            # tolerance covers the engines' different f32 accumulation
-            # orders + XLA's default TPU matmul precision (~1.5e-3 rel
-            # observed); layout/bucketing regressions are orders larger
-            np.testing.assert_allclose(
-                np.asarray(op), np.asarray(ox), rtol=5e-3, atol=2.0
-            )
+        # the month-blocked default AND the retained round-3 dot engine
+        # must both agree with the XLA twin
+        for impl in ("pallas", "pallas_dot"):
+            outs_p = fn(load, gen, sell, bucket, scales, b, impl=impl)
+            for op, ox in zip(outs_p, outs_x):
+                # tolerance covers the engines' different f32
+                # accumulation orders + XLA's default TPU matmul
+                # precision (~1.5e-3 rel observed); layout/bucketing
+                # regressions are orders larger
+                np.testing.assert_allclose(
+                    np.asarray(op), np.asarray(ox), rtol=5e-3, atol=2.0,
+                    err_msg=impl,
+                )
 
 
 def test_fast_sizing_matches_oracle(setup):

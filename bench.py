@@ -443,6 +443,42 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001
                 config_points[key] = {"failed": str(e)[:200]}
 
+    if os.environ.get("DGEN_TPU_BENCH_SKIP_CPU"):
+        baseline = FALLBACK_BASELINE_AGENT_YEARS_PER_SEC
+    else:
+        baseline = _cpu_baseline(sim, pop)
+
+    payload = {
+        "metric": "sizing+market agent-years/sec "
+                  f"({n_real} agents, {n_years} model years, "
+                  f"{jax.devices()[0].platform})",
+        "value": round(agent_years_per_sec, 2),
+        "unit": "agent-years/sec",
+        "vs_baseline": round(agent_years_per_sec / max(baseline, 1e-9), 2),
+        "baseline_kind": "proxy: this framework's kernel, 1 agent "
+                         "sequential on CPU x 8 workers (reference "
+                         "LOCAL_CORES=8 shape); not a PySAM measurement",
+        "mfu": round(mfu, 4),
+        "mfu_note": "PADDED dot-equivalent FLOPs (round-3 kernel model, "
+                    "kept for comparability) over the year-step time / "
+                    "v5e bf16 peak",
+        "mfu_effective": round(mfu_eff, 4),
+        "mfu_effective_note": "useful-arithmetic FLOPs of the month "
+                              "kernel (no padded 128-wide contraction "
+                              "counted) over the same time",
+        "phases": phases,
+        "trace": trace,
+        "scale_curve": scale_curve,
+        "config_points": config_points,
+        "big_run": big_run,
+        "full_run": None,
+    }
+    # print the complete headline line BEFORE the long full run: the
+    # remote-device transport can stall for minutes at a time, and the
+    # driver must always find a parseable result (the post-full-run
+    # line below supersedes this one when everything finishes)
+    print(json.dumps(payload), flush=True)
+
     # --- FULL national run, end to end (VERDICT r3 item 2): cold start
     # -> every model year -> all three parquet surfaces written, hourly
     # aggregation ON, chunked — the number BASELINE.md's north star
@@ -476,36 +512,8 @@ def main() -> None:
         finally:
             shutil.rmtree(fr_dir, ignore_errors=True)
 
-    if os.environ.get("DGEN_TPU_BENCH_SKIP_CPU"):
-        baseline = FALLBACK_BASELINE_AGENT_YEARS_PER_SEC
-    else:
-        baseline = _cpu_baseline(sim, pop)
-
-    print(json.dumps({
-        "metric": "sizing+market agent-years/sec "
-                  f"({n_real} agents, {n_years} model years, "
-                  f"{jax.devices()[0].platform})",
-        "value": round(agent_years_per_sec, 2),
-        "unit": "agent-years/sec",
-        "vs_baseline": round(agent_years_per_sec / max(baseline, 1e-9), 2),
-        "baseline_kind": "proxy: this framework's kernel, 1 agent "
-                         "sequential on CPU x 8 workers (reference "
-                         "LOCAL_CORES=8 shape); not a PySAM measurement",
-        "mfu": round(mfu, 4),
-        "mfu_note": "PADDED dot-equivalent FLOPs (round-3 kernel model, "
-                    "kept for comparability) over the year-step time / "
-                    "v5e bf16 peak",
-        "mfu_effective": round(mfu_eff, 4),
-        "mfu_effective_note": "useful-arithmetic FLOPs of the month "
-                              "kernel (no padded 128-wide contraction "
-                              "counted) over the same time",
-        "phases": phases,
-        "trace": trace,
-        "scale_curve": scale_curve,
-        "config_points": config_points,
-        "big_run": big_run,
-        "full_run": full_run,
-    }))
+    payload["full_run"] = full_run
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -3,9 +3,13 @@ default accelerator, reported as agent-years/sec, with a population
 scale curve, an MFU estimate for the sizing engine, and a per-phase
 breakdown.
 
-Prints ONE JSON line (driver contract):
+Prints the headline JSON line, then — after the long full-run
+measurement — re-prints the SAME schema with the full_run block filled
+in; consumers take the LAST parseable line (the early print guarantees
+a result even if the remote transport stalls mid-full-run):
   {"metric": ..., "value": N, "unit": "agent-years/sec",
-   "vs_baseline": N, "mfu": ..., "scale_curve": [...], "phases": {...}}
+   "vs_baseline": N, "mfu": ..., "scale_curve": [...], "phases": {...},
+   "full_run": {...}|null}
 
 ``vs_baseline`` compares against a PROXY of the reference's execution
 model — a process pool of per-agent sequential sizing calls (reference

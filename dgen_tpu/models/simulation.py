@@ -988,15 +988,23 @@ class Simulation:
         profiled = False
 
         # per-year host sync is only needed when something consumes the
-        # year's results on host (exports, checkpoints, collection,
-        # invariants, tracing). Otherwise years are DISPATCHED back to
-        # back and the device pipelines them — the per-step host/dispatch
-        # overhead (~40% of wall time at 8k agents through a remote
-        # tunnel) is paid once per run instead of once per year.
+        # year's results on host (checkpoints, collection, invariants,
+        # tracing). Otherwise years are DISPATCHED back to back and the
+        # device pipelines them — the per-step host/dispatch overhead
+        # (~40% of wall time at 8k agents through a remote tunnel) is
+        # paid once per run instead of once per year.
+        #
+        # A callback alone (the export path) does NOT force sync:
+        # callbacks are deferred ONE year, invoked after the next year's
+        # step is dispatched, so the host-side fetch/write of year N
+        # overlaps the device executing year N+1 — at 1M agents the
+        # exports were ~half the full-run wall when serialized. The
+        # callback's own device_get throttles lookahead to one year.
         sync_per_year = bool(
-            callback is not None or ckpt_writer is not None or collect
-            or debug or profile_dir
+            ckpt_writer is not None or collect or debug or profile_dir
         )
+        defer_callback = callback is not None and not sync_per_year
+        pending_cb = None                    # (year, yi, outs)
         # pipelined mode still bounds in-flight years: every queued
         # step's YearOutputs buffers stay live until it executes, so an
         # unthrottled queue holds queue-depth x per-year-outputs of
@@ -1004,91 +1012,110 @@ class Simulation:
         # cap that at ~2 GB; at small populations this never triggers.
         sync_every: Optional[int] = None
 
-        for yi, year in enumerate(self.years):
-            if yi < start_idx:
-                continue
-            t0 = time.time()
-            # trace the second executed step (post-compile) — or the
-            # only step when the run has just one
-            trace_now = profile_dir and not profiled and (
-                yi == start_idx + 1
-                or (yi == start_idx and len(self.years) - start_idx == 1)
-            )
-            if trace_now:
-                jax.profiler.start_trace(profile_dir)
-            try:
-                with timing.timer("year_step"):
-                    prev_carry = carry
-                    carry, outs = self.step(carry, yi, first_year=(yi == 0))
-                    if sync_per_year:
-                        jax.block_until_ready(carry.market.market_share)
-                    else:
-                        if sync_every is None:
-                            per_year = sum(
-                                l.size * l.dtype.itemsize
-                                for l in jax.tree.leaves(outs)
-                            )
-                            sync_every = max(
-                                1, int(2e9 // max(per_year, 1))
-                            )
-                        if (yi - start_idx) % sync_every == sync_every - 1:
-                            jax.block_until_ready(carry.market.market_share)
-            finally:
+        # the deferred-callback flush lives in a finally: year N's
+        # results exist on device once its step ran, and a failure while
+        # dispatching year N+1 must not lose year N's export
+        try:
+            for yi, year in enumerate(self.years):
+                if yi < start_idx:
+                    continue
+                t0 = time.time()
+                # trace the second executed step (post-compile) — or the
+                # only step when the run has just one
+                trace_now = profile_dir and not profiled and (
+                    yi == start_idx + 1
+                    or (yi == start_idx and len(self.years) - start_idx == 1)
+                )
                 if trace_now:
-                    jax.profiler.stop_trace()
-                    profiled = True
-                    logger.info("device trace written to %s", profile_dir)
-            if debug:
-                # the reference runs its dataframe invariants after
-                # every on_frame transform (agents.py:149-262); here the
-                # carry pytree is checked after every year step
-                invariants.check_transform(
-                    prev_carry, carry, context=f"year {year} carry"
-                )
-                invariants.check_finite(
-                    carry, context=f"year {year} carry"
-                )
-                invariants.check_finite(
-                    outs, context=f"year {year} outputs"
-                )
-                if not self._net_billing:
-                    # the static all-NEM proof evaluated the cap gate at
-                    # STATE_KW_BOUND; it stays sound only while the live
-                    # state totals remain under that bound
-                    kw = np.asarray(
-                        jax.device_get(carry.market.system_kw_cum)
+                    jax.profiler.start_trace(profile_dir)
+                try:
+                    with timing.timer("year_step"):
+                        prev_carry = carry
+                        carry, outs = self.step(carry, yi, first_year=(yi == 0))
+                        if sync_per_year:
+                            jax.block_until_ready(carry.market.market_share)
+                        else:
+                            if sync_every is None:
+                                per_year = sum(
+                                    l.size * l.dtype.itemsize
+                                    for l in jax.tree.leaves(outs)
+                                )
+                                sync_every = max(
+                                    1, int(2e9 // max(per_year, 1))
+                                )
+                            if (yi - start_idx) % sync_every == sync_every - 1:
+                                jax.block_until_ready(carry.market.market_share)
+                finally:
+                    if trace_now:
+                        jax.profiler.stop_trace()
+                        profiled = True
+                        logger.info("device trace written to %s", profile_dir)
+                if debug:
+                    # the reference runs its dataframe invariants after
+                    # every on_frame transform (agents.py:149-262); here the
+                    # carry pytree is checked after every year step
+                    invariants.check_transform(
+                        prev_carry, carry, context=f"year {year} carry"
                     )
-                    state_kw = np.zeros(self.table.n_states, np.float64)
-                    np.add.at(
-                        state_kw, np.asarray(self.table.state_idx), kw
+                    invariants.check_finite(
+                        carry, context=f"year {year} carry"
                     )
-                    if not np.all(state_kw < STATE_KW_BOUND):
-                        raise AssertionError(
-                            f"year {year}: state capacity exceeds "
-                            "STATE_KW_BOUND; the static all-NEM kernel "
-                            "skip is unsound for this run"
+                    invariants.check_finite(
+                        outs, context=f"year {year} outputs"
+                    )
+                    if not self._net_billing:
+                        # the static all-NEM proof evaluated the cap gate at
+                        # STATE_KW_BOUND; it stays sound only while the live
+                        # state totals remain under that bound
+                        kw = np.asarray(
+                            jax.device_get(carry.market.system_kw_cum)
                         )
-            logger.info("year %d (%d/%d) %.2fs%s", year, yi + 1,
-                        len(self.years), time.time() - t0,
-                        "" if sync_per_year else " (queued)")
-            if callback is not None:
-                callback(year, yi, outs)
-            if ckpt_writer is not None:
-                ckpt_writer.save(year, carry)
-            if collect:
-                # ONE batched device_get per year: per-leaf np.asarray
-                # costs a full host round trip each (~130 ms through a
-                # remote tunnel), turning collection into the dominant
-                # cost of small runs
-                to_fetch = {k: getattr(outs, k) for k in agent_fields}
-                if self.with_hourly:
-                    to_fetch["_hourly"] = outs.state_hourly_net_mw
-                host = jax.device_get(to_fetch)
-                for k in agent_fields:
-                    collected[k].append(host[k])
-                if self.with_hourly:
-                    hourly.append(host["_hourly"])
+                        state_kw = np.zeros(self.table.n_states, np.float64)
+                        np.add.at(
+                            state_kw, np.asarray(self.table.state_idx), kw
+                        )
+                        if not np.all(state_kw < STATE_KW_BOUND):
+                            raise AssertionError(
+                                f"year {year}: state capacity exceeds "
+                                "STATE_KW_BOUND; the static all-NEM kernel "
+                                "skip is unsound for this run"
+                            )
+                logger.info("year %d (%d/%d) %.2fs%s", year, yi + 1,
+                            len(self.years), time.time() - t0,
+                            "" if sync_per_year else " (queued)")
+                if callback is not None:
+                    if defer_callback:
+                        if pending_cb is not None:
+                            callback(*pending_cb)
+                        pending_cb = (year, yi, outs)
+                    else:
+                        callback(year, yi, outs)
+                if ckpt_writer is not None:
+                    ckpt_writer.save(year, carry)
+                if collect:
+                    # ONE batched device_get per year: per-leaf np.asarray
+                    # costs a full host round trip each (~130 ms through a
+                    # remote tunnel), turning collection into the dominant
+                    # cost of small runs
+                    to_fetch = {k: getattr(outs, k) for k in agent_fields}
+                    if self.with_hourly:
+                        to_fetch["_hourly"] = outs.state_hourly_net_mw
+                    host = jax.device_get(to_fetch)
+                    for k in agent_fields:
+                        collected[k].append(host[k])
+                    if self.with_hourly:
+                        hourly.append(host["_hourly"])
 
+        finally:
+            if pending_cb is not None:
+                # flush the deferred trailing callback (the final year
+                # on success; the last completed year on failure)
+                try:
+                    callback(*pending_cb)
+                except Exception:  # noqa: BLE001 — don't mask the
+                    # original error with a flush failure
+                    logger.exception("deferred year export failed")
+                pending_cb = None
         if not sync_per_year:
             # drain the queued year pipeline before returning; the
             # scalar fetch (not just block_until_ready) guarantees the

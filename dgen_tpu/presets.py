@@ -228,15 +228,19 @@ def run_preset(
 
     n_real = int(np.asarray(sim.host_mask).sum())
     n_years = len(res.years)
-    # sim.step times measure DISPATCH (execution completes at the
-    # per-year host sync), so only the first dispatch — which blocks on
-    # compilation — is meaningful; steady per-year time comes from the
-    # run wall net of compile and host export time
+    # sim.step times measure DISPATCH, so only the first dispatch —
+    # which blocks on compilation — is meaningful. Exports are DEFERRED
+    # one year by Simulation.run and overlap device compute, so
+    # export_s (the callback wall, which includes waiting for the
+    # overlapped year to finish) cannot be subtracted from the run wall
+    # as if it were serial: steady per-year time is the run wall net of
+    # compile only, and export_overlapped_s reports the export wall for
+    # what it is.
     compile_s = max(
         year_times[0] - float(np.median(year_times[1:])), 0.0
     ) if len(year_times) > 2 else 0.0
     export_s = callback.seconds if callback else 0.0
-    steady = max(run_s - compile_s - export_s, 0.0) / max(n_years, 1)
+    steady = max(run_s - compile_s, 0.0) / max(n_years, 1)
     rec = {
         "preset": name,
         "agents": n_real,
@@ -249,7 +253,7 @@ def run_preset(
         "run_s": round(run_s, 1),
         "compile_s": round(compile_s, 1),
         "steady_year_s": round(steady, 2),
-        "export_s": round(export_s, 1),
+        "export_overlapped_s": round(export_s, 1),
         "agent_years_per_sec": round(n_real * n_years / total_s, 1),
         "run_dir": run_dir,
         "data_sources": meta["data_sources"],
@@ -277,7 +281,7 @@ def main(argv=None) -> None:
     )
     print(f"build {rec['build_s']}s | compile ~{rec['compile_s']}s | "
           f"steady year {rec['steady_year_s']}s | "
-          f"exports {rec['export_s']}s | total {rec['total_s']}s "
+          f"exports(overlapped) {rec['export_overlapped_s']}s | total {rec['total_s']}s "
           f"({rec['agent_years_per_sec']} agent-years/sec)")
     print(json.dumps(rec))
 

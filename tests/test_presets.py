@@ -41,7 +41,7 @@ def test_delaware_preset_runs_with_exports(tmp_path):
     rec = presets.run_preset(
         "delaware-res", n_agents=96, run_dir=str(tmp_path / "run"))
     assert rec["years"] == 6 and rec["agents"] == 96
-    assert rec["total_s"] > 0 and rec["export_s"] >= 0
+    assert rec["total_s"] > 0 and rec["export_overlapped_s"] >= 0
 
     from dgen_tpu.io.export import load_surface
 

@@ -61,7 +61,7 @@ V5E_PEAK_FLOPS = 197e12
 
 def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
            agent_chunk: int = 0, with_hourly: bool = False,
-           binding_nem_caps: bool = False):
+           binding_nem_caps: bool = False, seed: int = 42):
     from dgen_tpu.config import RunConfig, ScenarioConfig
     from dgen_tpu.io import synth
     from dgen_tpu.models import scenario as scen
@@ -69,7 +69,7 @@ def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
 
     cfg = ScenarioConfig(name="bench", start_year=2014, end_year=end_year,
                          anchor_years=())
-    pop = synth.generate_population(n_agents, seed=42, pad_multiple=256)
+    pop = synth.generate_population(n_agents, seed=seed, pad_multiple=256)
     overrides = {"attachment_rate": jnp.full((pop.table.n_groups,), 0.3)}
     if binding_nem_caps:
         # caps that close the NEM gate for most states after year 2:
@@ -349,9 +349,18 @@ def main() -> None:
     carry_w, out_w = sim.step(carry_w, 1, first_year=False)
     jax.block_until_ready(out_w.system_kw_cum)
 
+    # min of two full runs over DISTINCT populations (same shapes ->
+    # same executable; different values -> no execution-cache hits):
+    # the remote transport stalls for seconds-to-minutes at random, and
+    # a single sample can fold one stall into the headline
     t0 = time.time()
     res = sim.run(collect=False)
     elapsed = time.time() - t0
+    sim2, _ = _build(n_agents, end_year, seed=43)
+    t0 = time.time()
+    sim2.run(collect=False)
+    elapsed = min(elapsed, time.time() - t0)
+    del sim2
     agent_years_per_sec = n_real * n_years / elapsed
 
     # --- per-phase breakdown + MFU at the headline size ---

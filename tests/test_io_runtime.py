@@ -215,6 +215,38 @@ def test_exporter_mixed_leaf_shardings(tmp_path):
         ex._local_fields([Repl(), Sharded()])
 
 
+def test_deferred_export_survives_midrun_crash(tmp_path):
+    """Export-only runs defer each year's callback until the next
+    year's step is dispatched; a failure mid-run must still flush the
+    last completed year's export (the finally-flush in Simulation.run)
+    — otherwise a computed year's parquet partitions vanish."""
+    sim, pop = make_sim()
+    exporter = exp.RunExporter(
+        str(tmp_path / "run"),
+        agent_id=np.asarray(pop.table.agent_id),
+        mask=np.asarray(pop.table.mask),
+    )
+    calls = {"n": 0}
+    orig_step = sim.step
+
+    def flaky_step(carry, yi, first_year):
+        calls["n"] += 1
+        if calls["n"] == 3:   # die while dispatching year 3
+            raise RuntimeError("injected dispatch failure")
+        return orig_step(carry, yi, first_year)
+
+    sim.step = flaky_step
+    import pytest
+
+    with pytest.raises(RuntimeError, match="injected"):
+        sim.run(callback=exporter, collect=False)
+
+    # years 1 and 2 completed on device; BOTH must be exported (year 1
+    # via the in-loop deferred flush, year 2 via the finally flush)
+    ao = exp.load_surface(str(tmp_path / "run"), "agent_outputs")
+    assert set(ao["year"]) == {2014, 2016}
+
+
 def test_exporter_surfaces(tmp_path):
     sim, pop = make_sim(with_hourly=True)
     exporter = exp.RunExporter(

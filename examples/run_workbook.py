@@ -1,0 +1,64 @@
+"""Drive a run straight off the reference's scenario workbook
+(.xlsm): the artifact the reference's operator edits
+(excel/excel_functions.py load_scenario) becomes a runnable
+configuration with no Postgres and no hand-exported CSVs.
+
+io.workbook decodes the Main-sheet options (region, markets,
+technology, end year, seed) plus all 14 run-mapped trajectory
+selectors; the selections pick the matching input_data CSVs through
+scenario_inputs_from_reference(prefer=...)."""
+import dataclasses as dc
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.config import RunConfig
+from dgen_tpu.io import synth
+from dgen_tpu.io import workbook as wbk
+from dgen_tpu.io.reference_inputs import (
+    scenario_inputs_from_reference,
+    wholesale_profile_bank,
+)
+from dgen_tpu.models.agents import ProfileBank
+from dgen_tpu.models.simulation import Simulation
+
+XLSM = "/root/reference/dgen_os/excel/input_sheet_final.xlsm"
+ROOT = "/root/reference/dgen_os/input_data"
+
+cfg, info = wbk.scenario_from_workbook(XLSM)
+print(f"workbook scenario: {cfg.name} | region -> {info['states']} | "
+      f"markets -> {info['sector_weights']} | storage {cfg.storage_enabled} "
+      f"| {cfg.start_year}-{cfg.end_year}")
+print(f"trajectory selections: {info['prefer']}")
+
+inputs, meta = scenario_inputs_from_reference(
+    ROOT, cfg, list(synth.STATES), prefer=info["prefer"])
+picked = {k: meta["files"][k].split("/")[-1]
+          for k in ("pv_prices", "financing", "elec_prices")}
+print(f"CSV files picked by the workbook's selections: {picked}")
+
+pop = synth.generate_population(
+    1024, states=info["states"], seed=info["seed"],
+    sector_weights=info["sector_weights"], n_regions=len(meta["regions"]),
+)
+profiles = ProfileBank(
+    load=pop.profiles.load, solar_cf=pop.profiles.solar_cf,
+    wholesale=jnp.asarray(wholesale_profile_bank(meta, ROOT)),
+)
+sim = Simulation(pop.table, profiles, pop.tariffs, inputs, cfg,
+                 RunConfig(sizing_iters=10))
+t0 = time.time()
+res = sim.run()
+elapsed = time.time() - t0
+
+m = np.asarray(pop.table.mask)
+s = res.summary(m)
+n_real = int(m.sum())
+print(f"{n_real} agents x {len(res.years)} years in {elapsed:.1f}s "
+      f"({n_real * len(res.years) / elapsed:,.0f} agent-years/sec)")
+print(f"final: {s['adopters'][-1]:,.0f} adopters, "
+      f"{s['system_kw_cum'][-1] / 1e3:,.1f} MW cum")
+assert s["system_kw_cum"][-1] > 0
+assert np.all(np.diff(s["system_kw_cum"]) >= -1e-3)
+print("WORKBOOK RUN OK")

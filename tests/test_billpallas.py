@@ -175,3 +175,34 @@ def test_fast_sizing_matches_oracle(setup):
     np.testing.assert_allclose(
         np.asarray(rf.first_year_bill_with_batt),
         np.asarray(rs.first_year_bill_with_batt), rtol=2e-2, atol=5.0)
+
+
+@pytest.mark.tpu_hw
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="Pallas kernel parity needs a TPU (set DGEN_TPU_TESTS=1)",
+)
+def test_month_kernel_period_count_corners():
+    """The month kernel's P-1 mask + subtraction structure must hold at
+    every TOU period count the tariff layer produces — including P=1
+    (flat-only populations: zero masks, every bucket the month total)
+    and the 5-period upper range."""
+    rng_key = jax.random.key(0)
+    for p_count in (1, 3, 5):
+        n, h, r = 64, 8760, 17
+        ks = jax.random.split(jax.random.fold_in(rng_key, p_count), 5)
+        load = jax.random.uniform(ks[0], (n, h), jnp.float32, 0.2, 3.0)
+        gen = jax.random.uniform(ks[1], (n, h), jnp.float32, 0.0, 1.0)
+        sell = jax.random.uniform(ks[2], (n, h), jnp.float32, 0.02, 0.08)
+        period = jax.random.randint(ks[3], (n, h), 0, p_count, jnp.int32)
+        bucket = bp.hourly_bucket_ids(period, p_count)
+        scales = jax.random.uniform(ks[4], (n, r), jnp.float32, 0.1, 6.0)
+        nb = 12 * p_count
+        for fn in (bp.import_sums, bp.bucket_sums):
+            outs_p = fn(load, gen, sell, bucket, scales, nb, impl="pallas")
+            outs_x = fn(load, gen, sell, bucket, scales, nb, impl="xla")
+            for op, ox in zip(outs_p, outs_x):
+                a, b = np.asarray(op), np.asarray(ox)
+                scale = max(float(np.max(np.abs(b))), 1.0)
+                assert float(np.max(np.abs(a - b))) / scale < 5e-3, (
+                    p_count, fn.__name__)

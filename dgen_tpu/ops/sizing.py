@@ -462,22 +462,25 @@ def _size_agents_fast(
                 return bills_sw, None
             return bills_sw, billpallas.bills_linear_nem(
                 lin_wo, scales, envs.tariff, n_periods)
-        # bf16=False: the flag is inert on this stack — the runtime's
-        # --xla_allow_excess_precision already runs the f32 contraction
-        # at the MXU's native bf16 input precision (bit-identical
-        # outputs, same speed; see billpallas._kernel docstring)
-        imports, imp_sell = billpallas.import_sums(
-            envs.load, gen_shape, sell, bucket, scales, n_buckets, impl,
-            bf16=False, mesh=mesh,
+        if not has_switch:
+            imports, imp_sell = billpallas.import_sums(
+                envs.load, gen_shape, sell, bucket, scales, n_buckets,
+                impl, mesh=mesh,
+            )
+            return billpallas.bills_linear_nb(
+                lin, imports, imp_sell, scales, tw, n_periods
+            ), None
+        # switch populations price every candidate on BOTH tariffs over
+        # the same relu(net) grid — one fused kernel call (the net build
+        # dominates; see billpallas.import_sums_pair)
+        imports, imp_sell, imports_o, imp_sell_o = (
+            billpallas.import_sums_pair(
+                envs.load, gen_shape, sell, bucket, sell_wo, bucket_wo,
+                scales, n_buckets, impl, mesh=mesh,
+            )
         )
         bills_sw = billpallas.bills_linear_nb(
             lin, imports, imp_sell, scales, tw, n_periods
-        )
-        if not has_switch:
-            return bills_sw, None
-        imports_o, imp_sell_o = billpallas.import_sums(
-            envs.load, gen_shape, sell_wo, bucket_wo, scales, n_buckets,
-            impl, bf16=False, mesh=mesh,
         )
         bills_o = billpallas.bills_linear_nb(
             lin_wo, imports_o, imp_sell_o, scales, envs.tariff, n_periods

@@ -97,6 +97,17 @@ def test_sharded_engine_matches_unsharded(setup):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(bb), rtol=1e-5, atol=1e-3
         )
+    # the fused rate-switch pair engine under the mesh (n_in=7 shard
+    # plumbing on the pallas path; the xla fallback shards two passes)
+    p_plain = bp.import_sums_pair(
+        load, gen, sell, bucket, sell, bucket, scales, b, impl="xla")
+    p_sharded = bp.import_sums_pair(
+        load, gen, sell, bucket, sell, bucket, scales, b, impl="xla",
+        mesh=mesh)
+    for a, bb in zip(p_plain, p_sharded):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=1e-5, atol=1e-3
+        )
 
 
 @pytest.mark.tpu_hw
@@ -206,3 +217,21 @@ def test_month_kernel_period_count_corners():
                 scale = max(float(np.max(np.abs(b))), 1.0)
                 assert float(np.max(np.abs(a - b))) / scale < 5e-3, (
                     p_count, fn.__name__)
+        # the fused rate-switch pair engine shares the net grid but must
+        # match two independent single-tariff passes
+        sell_b = jax.random.uniform(
+            jax.random.fold_in(ks[4], 1), (n, h), jnp.float32, 0.01, 0.05)
+        period_b = jax.random.randint(
+            jax.random.fold_in(ks[3], 1), (n, h), 0, p_count, jnp.int32)
+        bucket_b = bp.hourly_bucket_ids(period_b, p_count)
+        pair = bp.import_sums_pair(
+            load, gen, sell, bucket, sell_b, bucket_b, scales, nb,
+            impl="pallas")
+        ref_a = bp.import_sums(load, gen, sell, bucket, scales, nb,
+                               impl="xla")
+        ref_b = bp.import_sums(load, gen, sell_b, bucket_b, scales, nb,
+                               impl="xla")
+        for got, want in zip(pair, ref_a + ref_b):
+            a, b = np.asarray(got), np.asarray(want)
+            scale = max(float(np.max(np.abs(b))), 1.0)
+            assert float(np.max(np.abs(a - b))) / scale < 5e-3, p_count

@@ -47,6 +47,10 @@ import json
 import os
 import time
 
+from dgen_tpu.utils import compilecache
+
+compilecache.enable()
+
 import jax
 import jax.numpy as jnp
 import numpy as np

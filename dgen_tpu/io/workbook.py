@@ -156,13 +156,29 @@ class _Workbook:
                     t if t.startswith("xl/") else f"xl/{t.lstrip('/')}")
         return out
 
+    @staticmethod
+    def _is_multi_area(target: str) -> bool:
+        """True for multi-area targets ('Sheet1!$A$1,Sheet1!$B$2') —
+        commas INSIDE a quoted sheet name ('Summary, FY24'!$A$1) are
+        not area separators and must not trigger the skip."""
+        in_quote = False
+        for ch in target:
+            if ch == "'":
+                in_quote = not in_quote
+            elif ch == "," and not in_quote:
+                return True
+        return False
+
     def defined_names(self) -> Dict[str, Tuple[str, str]]:
-        """{name: (sheet, cell_range)}; broken (#REF!) names skipped."""
+        """{name: (sheet, cell_range)}; broken (#REF!) and multi-area
+        names (rsplit would mangle the sheet and a later lookup would
+        KeyError) are skipped."""
         wb = ET.parse(io.BytesIO(self.z.read("xl/workbook.xml"))).getroot()
         out = {}
         for dn in wb.iter(f"{_NS}definedName"):
             target = (dn.text or "").strip()
-            if "#REF!" in target or "!" not in target:
+            if ("#REF!" in target or "!" not in target
+                    or self._is_multi_area(target)):
                 continue
             sheet, ref = target.rsplit("!", 1)
             out[dn.get("name")] = (sheet.strip("'"), ref)

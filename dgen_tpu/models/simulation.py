@@ -902,6 +902,20 @@ class Simulation:
             net_billing=self._net_billing,
         )
 
+    def _check_state_kw_bound(self, carry: SimCarry, context: str) -> None:
+        """Raise if any state's cumulative capacity reaches
+        STATE_KW_BOUND — the value at which the static all-NEM proof
+        (the compile-time skip of the net-billing bill path) would stop
+        being sound.  Host-side check on fetched carry data."""
+        kw = np.asarray(jax.device_get(carry.market.system_kw_cum))
+        state_kw = np.zeros(self.table.n_states, np.float64)
+        np.add.at(state_kw, np.asarray(self.table.state_idx), kw)
+        if not np.all(state_kw < STATE_KW_BOUND):
+            raise AssertionError(
+                f"{context}: state capacity exceeds STATE_KW_BOUND; "
+                "the static all-NEM kernel skip is unsound for this run"
+            )
+
     def init_carry(self) -> SimCarry:
         carry = SimCarry.zeros(self.table.n_agents)
         if self._shard is not None:
@@ -1015,6 +1029,9 @@ class Simulation:
         # the deferred-callback flush lives in a finally: year N's
         # results exist on device once its step ran, and a failure while
         # dispatching year N+1 must not lose year N's export
+        loop_failed = False   # own-loop failure flag; NOT sys.exc_info()
+        # (a caller invoking run() inside an active except handler would
+        # make exc_info a false positive and re-swallow flush failures)
         try:
             for yi, year in enumerate(self.years):
                 if yi < start_idx:
@@ -1067,26 +1084,19 @@ class Simulation:
                         # the static all-NEM proof evaluated the cap gate at
                         # STATE_KW_BOUND; it stays sound only while the live
                         # state totals remain under that bound
-                        kw = np.asarray(
-                            jax.device_get(carry.market.system_kw_cum)
-                        )
-                        state_kw = np.zeros(self.table.n_states, np.float64)
-                        np.add.at(
-                            state_kw, np.asarray(self.table.state_idx), kw
-                        )
-                        if not np.all(state_kw < STATE_KW_BOUND):
-                            raise AssertionError(
-                                f"year {year}: state capacity exceeds "
-                                "STATE_KW_BOUND; the static all-NEM kernel "
-                                "skip is unsound for this run"
-                            )
+                        self._check_state_kw_bound(carry, f"year {year}")
                 logger.info("year %d (%d/%d) %.2fs%s", year, yi + 1,
                             len(self.years), time.time() - t0,
                             "" if sync_per_year else " (queued)")
                 if callback is not None:
                     if defer_callback:
                         if pending_cb is not None:
-                            callback(*pending_cb)
+                            # hand off before invoking: if the exporter
+                            # raises partway, the finally flush must not
+                            # re-write the same year's partition on top
+                            # of partially-written parquet parts
+                            prev, pending_cb = pending_cb, None
+                            callback(*prev)
                         pending_cb = (year, yi, outs)
                     else:
                         callback(year, yi, outs)
@@ -1106,14 +1116,22 @@ class Simulation:
                     if self.with_hourly:
                         hourly.append(host["_hourly"])
 
+        except BaseException:
+            loop_failed = True
+            raise
         finally:
             if pending_cb is not None:
                 # flush the deferred trailing callback (the final year
                 # on success; the last completed year on failure)
                 try:
                     callback(*pending_cb)
-                except Exception:  # noqa: BLE001 — don't mask the
-                    # original error with a flush failure
+                except Exception:  # noqa: BLE001
+                    if not loop_failed:
+                        # success path: a failed final-year export must
+                        # surface, not return a silently truncated run
+                        raise
+                    # failure path: don't mask the original error with
+                    # the flush failure
                     logger.exception("deferred year export failed")
                 pending_cb = None
         if not sync_per_year:
@@ -1124,6 +1142,16 @@ class Simulation:
             with timing.timer("device_drain"):
                 jax.block_until_ready(carry.market.market_share)
                 float(jnp.sum(carry.batt_adopters_cum))
+        if (not self._net_billing and not debug
+                and jax.process_count() == 1):
+            # always-on soundness check for the static all-NEM skip:
+            # system_kw_cum is monotone, so one end-of-run bound check
+            # covers every year's gate evaluation at the cost of a
+            # single host fetch (the per-year variant runs under debug;
+            # multi-process runs skip it — device_get on an array
+            # spanning non-addressable devices raises, and the bound is
+            # still enforced by any shard run under debug)
+            self._check_state_kw_bound(carry, "end of run")
         if ckpt_writer is not None:
             ckpt_writer.close()
         agent = (

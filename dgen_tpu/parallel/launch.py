@@ -151,6 +151,10 @@ def main() -> None:
         )
     distributed = initialize_multihost()
 
+    from dgen_tpu.utils import compilecache
+
+    compilecache.enable()
+
     import jax
     import jax.numpy as jnp
 

@@ -95,6 +95,10 @@ def build(
     mesh=None,
 ):
     """(Simulation, population, meta) for a named preset."""
+    from dgen_tpu.utils import compilecache
+
+    cache_d = compilecache.enable()
+
     import jax.numpy as jnp
 
     from dgen_tpu.config import RunConfig, ScenarioConfig
@@ -118,6 +122,13 @@ def build(
     meta: Dict[str, object] = {
         "preset": p.name, "baseline_config": p.baseline_config,
         "n_agents": n,
+        # provenance stamp: which persistent-compile-cache the run used
+        # and how warm it was at build time (entries present before this
+        # run compiled anything = prior processes' executables)
+        "compile_cache": (
+            dict(compilecache.stats(), enabled=True)
+            if cache_d else {"enabled": False}
+        ),
     }
     # inputs always cover the FULL state list: synthetic populations
     # index global state ids even when only the preset's states are

@@ -1,0 +1,78 @@
+"""Persistent XLA compilation cache wiring.
+
+The month-blocked sizing kernels compile in ~80-170 s/program on the
+TPU backend, and a cold national run pays ~170 s of compilation before
+its first step (BENCH_r04 trace).  JAX's persistent compilation cache
+eliminates that on every process after the first: compiled executables
+are serialized to disk keyed by (HLO, compile options, backend), and a
+later process deserializes in ~10 ms instead of recompiling.  The
+reference has no analogue — its PySAM/Postgres engine is interpreted —
+so this is pure TPU-side win; the equivalent of what its operators get
+from long-lived worker pools (dgen_model.py keeps one pool per task,
+never paying per-run process start).
+
+Call :func:`enable` once per process before building simulations; it is
+idempotent and safe on any backend (CPU tests included — entries are
+keyed by backend so they never collide).  Knobs:
+
+  DGEN_TPU_CACHE_DIR   cache directory (default <repo>/.jax_cache;
+                       "0"/"off" disables)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_enabled_dir: Optional[str] = None
+
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+
+
+def cache_dir() -> Optional[str]:
+    """The resolved cache directory, or None when disabled."""
+    raw = os.environ.get("DGEN_TPU_CACHE_DIR", _DEFAULT_DIR).strip()
+    if raw.lower() in ("", "0", "off", "none"):
+        return None
+    return raw
+
+
+def enable() -> Optional[str]:
+    """Turn on the persistent compilation cache; returns the directory
+    in use (None = disabled).  Idempotent."""
+    global _enabled_dir
+    d = cache_dir()
+    if d is None or _enabled_dir == d:
+        return _enabled_dir
+    import jax
+
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # the default 1 s floor would skip small programs whose *remote*
+    # compile round-trip is still expensive on the tunneled backend
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _enabled_dir = d
+    return d
+
+
+def stats() -> dict:
+    """Entry count / bytes of the active cache (for meta.json stamps)."""
+    d = _enabled_dir or cache_dir()
+    if not d or not os.path.isdir(d):
+        return {"dir": d, "entries": 0, "bytes": 0}
+    entries = 0
+    total = 0
+    # concurrent processes write entries tmp-file-then-rename; a file
+    # may vanish between listdir and stat, which must not crash the
+    # run that is merely stamping provenance
+    for n in os.listdir(d):
+        try:
+            total += os.path.getsize(os.path.join(d, n))
+            entries += 1
+        except OSError:
+            continue
+    return {"dir": d, "entries": entries, "bytes": total}

@@ -247,6 +247,25 @@ def test_deferred_export_survives_midrun_crash(tmp_path):
     assert set(ao["year"]) == {2014, 2016}
 
 
+def test_final_year_export_failure_raises():
+    """On the SUCCESS path, a failing final-year flush must surface —
+    a run must not report success with the last year's partitions
+    silently missing (ADVICE r4).  On the failure path the original
+    error still wins (covered by the midrun-crash test above)."""
+    sim, pop = make_sim()
+    n_years = len(sim.years)
+    calls = {"n": 0}
+
+    def flaky_exporter(year, yi, outs):
+        calls["n"] += 1
+        if calls["n"] == n_years:   # the finally-flushed final year
+            raise OSError("disk full")
+
+    with pytest.raises(OSError, match="disk full"):
+        sim.run(callback=flaky_exporter, collect=False)
+    assert calls["n"] == n_years
+
+
 def test_exporter_surfaces(tmp_path):
     sim, pop = make_sim(with_hourly=True)
     exporter = exp.RunExporter(

@@ -39,6 +39,14 @@ Knobs (env):
                           "8192,32768,65536,131072:16384"; "" disables)
   DGEN_TPU_BENCH_BIG      the national-scale chunked point, "N:chunk"
                           (default "1048576:8192"; "" disables)
+  DGEN_TPU_BENCH_BUDGET_S total wall budget; stages are skipped (and
+                          stamped as skipped) once the remaining budget
+                          can't fit them, the full run is auto-sized to
+                          what fits, and a SIGALRM backstop emits the
+                          final JSON before an external timeout can kill
+                          the process (default 1500)
+  DGEN_TPU_BENCH_FULL_AGENTS  full-run population ("auto" = largest that
+                          fits the remaining budget; "" disables)
 """
 
 from __future__ import annotations
@@ -336,22 +344,85 @@ def _cpu_baseline(sim, pop) -> float:
     return 8.0 / dt  # 8 workers, 1 agent-year per sizing call
 
 
+#: process start — the budget clock (module import pays the jax/backend
+#: bring-up, which belongs inside the budget)
+_T0 = time.time()
+
+
+def _full_run_estimate_s(n: int, rate_ays: float, compile_est: float) -> float:
+    """Predicted wall of a national-all-sector full run at population n:
+    build + compile + 19 chunked year steps + tail (non-overlapped)
+    exports.  Constants calibrated on the round-4 measured run (1M
+    agents: build ~90 s, steps at ~82k agent-years/s, exports ~3.3e-5
+    s/agent-year through the ~6 MB/s tunnel)."""
+    n_years = 19.0
+    # compact (int16-quantized) exports cut the fetch ~2.8x from the
+    # measured round-4 rate of 3.3e-5 s/agent-year; 2e-5 keeps slack
+    # for the parquet write and queue-drain behind the fetch
+    export_spy = float(os.environ.get(
+        "DGEN_TPU_BENCH_EXPORT_SPY", "2e-5"))     # s per agent-year
+    build_s = 30.0 + n * 7e-5
+    steps_s = n_years * n / max(rate_ays, 1.0)
+    exports_s = export_spy * n * n_years
+    return build_s + compile_est + steps_s + exports_s
+
+
 def main() -> None:
     n_agents = int(os.environ.get("DGEN_TPU_BENCH_AGENTS", "8192"))
     end_year = int(os.environ.get("DGEN_TPU_BENCH_END", "2050"))
     scale_env = os.environ.get(
         "DGEN_TPU_BENCH_SCALE", "8192,32768,65536,131072:16384"
     )
+    budget = float(os.environ.get("DGEN_TPU_BENCH_BUDGET_S", "1500"))
+
+    def remaining() -> float:
+        return budget - (time.time() - _T0)
+
+    skipped: dict = {}
+
+    # the payload is built incrementally so the SIGALRM backstop can
+    # emit whatever is complete if a stage overruns the budget (the
+    # driver records only rc and the LAST output line; an externally
+    # killed process yields neither)
+    payload: dict = {"full_run": None}
+    cleanup_dirs: list = []   # tempdirs the backstop must not leak
+
+    import shutil
+    import signal
+
+    def _on_alarm(signum, frame):  # noqa: ARG001
+        payload["truncated"] = (
+            "budget backstop fired mid-stage; stages after the last "
+            "completed one are absent"
+        )
+        for d in cleanup_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+        print("\n" + json.dumps(payload), flush=True)
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, _on_alarm)
+    # arm with the REMAINING budget: the clock started at module import
+    # (the jax/backend bring-up belongs inside it), so alarm(budget)
+    # here would fire after the external timeout this exists to beat
+    signal.alarm(max(int(remaining()), 60))
 
     sim, pop = _build(n_agents, end_year)
     n_real = int(np.asarray(pop.table.mask).sum())
     n_years = len(sim.years)
 
-    # warm up both compiled variants (first year + carry year)
+    # warm up both compiled variants (first year + carry year); the
+    # warmup time tells us whether the persistent compile cache is warm,
+    # which drives every later stage-cost estimate
+    t0 = time.time()
     carry = sim.init_carry()
     carry_w, _ = sim.step(carry, 0, first_year=True)
     carry_w, out_w = sim.step(carry_w, 1, first_year=False)
     jax.block_until_ready(out_w.system_kw_cum)
+    warm_s = time.time() - t0
+    cache_warm = warm_s < 60.0
+    point_est = 45.0 if cache_warm else 200.0   # build+compile+3 steps
+    payload["compile_cache"] = dict(
+        compilecache.stats(), warmup_s=round(warm_s, 1))
 
     # min of two full runs over DISTINCT populations (same shapes ->
     # same executable; different values -> no execution-cache hits):
@@ -360,11 +431,14 @@ def main() -> None:
     t0 = time.time()
     res = sim.run(collect=False)
     elapsed = time.time() - t0
-    sim2, _ = _build(n_agents, end_year, seed=43)
-    t0 = time.time()
-    sim2.run(collect=False)
-    elapsed = min(elapsed, time.time() - t0)
-    del sim2
+    if remaining() > 0.55 * budget + elapsed + 60:
+        sim2, _ = _build(n_agents, end_year, seed=43)
+        t0 = time.time()
+        sim2.run(collect=False)
+        elapsed = min(elapsed, time.time() - t0)
+        del sim2
+    else:
+        skipped["headline_second_sample"] = "budget"
     agent_years_per_sec = n_real * n_years / elapsed
 
     # --- per-phase breakdown + MFU at the headline size ---
@@ -396,10 +470,11 @@ def main() -> None:
     # MFU from the trace's device timeline, not wall clock ---
     trace = _trace_step(sim)
     if trace is not None:
-        trace["mfu_device"] = round(
-            flops / (trace["device_step_ms"] / 1e3) / V5E_PEAK_FLOPS, 4)
+        dev_s = trace["device_step_ms"] / 1e3
         trace["mfu_device_effective"] = round(
-            eff_flops / (trace["device_step_ms"] / 1e3) / V5E_PEAK_FLOPS, 4)
+            eff_flops / dev_s / V5E_PEAK_FLOPS, 4)
+        trace["mfu_device_padded_dot_equiv"] = round(
+            flops / dev_s / V5E_PEAK_FLOPS, 4)
 
     def _run_point(tok: str, n_rep: int = 3) -> dict:
         """Measure one scale point; a point that exhausts HBM is
@@ -427,18 +502,37 @@ def main() -> None:
                 entry["failed"] = str(e)[:300]
         return entry
 
+    # the full run (the artifact's most important block) gets a budget
+    # RESERVE: optional probe stages below only spend what the smallest
+    # acceptable full run (65k agents) plus final assembly won't need
+    compile_full_est = 90.0 if cache_warm else 300.0
+    reserve = _full_run_estimate_s(65536, 60000.0, compile_full_est) + 90.0
+
+    def spendable(est: float) -> bool:
+        return remaining() - reserve > est
+
     # --- population scale curve (agent-years/sec per cached step);
     # whole-table points past the HBM wall are recorded as OOM, chunked
     # ("N:chunk") points stream past it ---
-    scale_curve = [
-        _run_point(tok) for tok in scale_env.split(",") if tok.strip()
-    ]
+    scale_curve = []
+    for tok in scale_env.split(","):
+        if not tok.strip():
+            continue
+        if not spendable(point_est):
+            skipped[f"scale_point_{tok}"] = "budget"
+            continue
+        scale_curve.append(_run_point(tok))
 
     # --- national-scale chunked point (the reference's whole-US
     # population is ~O(1M) agents across its state-sharded batch
     # tasks, submit_all.sh:8-46) ---
     big_env = os.environ.get("DGEN_TPU_BENCH_BIG", "1048576:8192")
-    big_run = _run_point(big_env, n_rep=1) if big_env.strip() else None
+    big_run = None
+    if big_env.strip():
+        if spendable(point_est + 90.0):   # 1M synthetic build is ~90 s
+            big_run = _run_point(big_env, n_rep=1)
+        else:
+            skipped["big_run"] = "budget"
 
     # --- production-configuration step points (weak item 7): hourly
     # aggregation ON, and a binding-NEM-cap population (mixed-metering
@@ -449,6 +543,9 @@ def main() -> None:
             ("with_hourly", dict(with_hourly=True)),
             ("nem_caps_binding", dict(binding_nem_caps=True)),
         ):
+            if not spendable(point_est):
+                skipped[f"config_point_{key}"] = "budget"
+                continue
             try:
                 sim_c, pop_c = _build(n_agents, 2022, **kw)
                 dt = _time_steps(sim_c)
@@ -460,12 +557,14 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001
                 config_points[key] = {"failed": str(e)[:200]}
 
-    if os.environ.get("DGEN_TPU_BENCH_SKIP_CPU"):
+    if os.environ.get("DGEN_TPU_BENCH_SKIP_CPU") or not spendable(120.0):
+        if not os.environ.get("DGEN_TPU_BENCH_SKIP_CPU"):
+            skipped["cpu_baseline"] = "budget (fallback constant used)"
         baseline = FALLBACK_BASELINE_AGENT_YEARS_PER_SEC
     else:
         baseline = _cpu_baseline(sim, pop)
 
-    payload = {
+    payload.update({
         "metric": "sizing+market agent-years/sec "
                   f"({n_real} agents, {n_years} model years, "
                   f"{jax.devices()[0].platform})",
@@ -475,41 +574,58 @@ def main() -> None:
         "baseline_kind": "proxy: this framework's kernel, 1 agent "
                          "sequential on CPU x 8 workers (reference "
                          "LOCAL_CORES=8 shape); not a PySAM measurement",
-        "mfu": round(mfu, 4),
-        "mfu_note": "PADDED dot-equivalent FLOPs (round-3 kernel model, "
-                    "kept for comparability) over the year-step time / "
-                    "v5e bf16 peak",
-        "mfu_effective": round(mfu_eff, 4),
-        "mfu_effective_note": "useful-arithmetic FLOPs of the month "
-                              "kernel (no padded 128-wide contraction "
-                              "counted) over the same time",
+        # headline MFU is EFFECTIVE (useful-arithmetic) utilization; the
+        # padded dot-equivalent model of the retired round-3 kernel is
+        # kept as a secondary, clearly-labeled series
+        "mfu": round(mfu_eff, 4),
+        "mfu_note": "useful-arithmetic FLOPs of the month kernel (no "
+                    "padded 128-wide contraction counted) over the "
+                    "year-step wall / v5e bf16 peak",
+        "mfu_padded_dot_equiv": round(mfu, 4),
+        "mfu_padded_dot_equiv_note": "PADDED dot-equivalent FLOPs of the "
+                                     "retired round-3 one-hot kernel, kept "
+                                     "only for cross-round comparability",
         "phases": phases,
         "trace": trace,
         "scale_curve": scale_curve,
         "config_points": config_points,
         "big_run": big_run,
-        "full_run": None,
-    }
+    })
     # print the complete headline line BEFORE the long full run: the
-    # remote-device transport can stall for minutes at a time, and the
-    # driver must always find a parseable result (the post-full-run
-    # line below supersedes this one when everything finishes)
+    # remote-device transport can stall for minutes at a time, and even
+    # with the alarm backstop an early parseable line is cheap insurance
     print(json.dumps(payload), flush=True)
 
     # --- FULL national run, end to end (VERDICT r3 item 2): cold start
     # -> every model year -> all three parquet surfaces written, hourly
     # aggregation ON, chunked — the number BASELINE.md's north star
     # actually names (the big_run above is steady-state step time only).
+    # "auto" sizes the population to the LARGEST candidate whose
+    # predicted wall fits the remaining budget (VERDICT r4 item 1);
+    # a numeric value is an operator override and runs unconditionally.
     full_run = None
-    full_raw = os.environ.get("DGEN_TPU_BENCH_FULL_AGENTS", "1048576").strip()
-    full_agents = int(full_raw) if full_raw else 0   # "" disables
+    full_raw = os.environ.get("DGEN_TPU_BENCH_FULL_AGENTS", "auto").strip()
+    rate = (big_run or {}).get("agent_years_per_sec") or 60000.0
+    if full_raw == "auto":
+        full_agents = 0
+        for cand in (1048576, 524288, 262144, 131072, 65536):
+            est = _full_run_estimate_s(cand, rate, compile_full_est)
+            # 1.25x headroom: an overrun past the alarm would lose the
+            # whole full_run block, which is worse than one size down
+            if remaining() - 90.0 > est * 1.25:
+                full_agents = cand
+                break
+        if not full_agents:
+            full_run = {"skipped": "budget", "remaining_s": round(remaining(), 1)}
+    else:
+        full_agents = int(full_raw) if full_raw else 0   # "" disables
     if full_agents:
-        import shutil
         import tempfile
 
         from dgen_tpu import presets
 
         fr_dir = tempfile.mkdtemp(prefix="dgen_bench_full_")
+        cleanup_dirs.append(fr_dir)
         try:
             full_run = presets.run_preset(
                 "national-all-sector", n_agents=full_agents,
@@ -520,6 +636,8 @@ def main() -> None:
                 "this harness; on a local TPU VM the device->host link "
                 "is PCIe-class"
             )
+            if full_raw == "auto":
+                full_run["sized_for_budget"] = True
         except Exception as e:  # noqa: BLE001 — record, don't kill bench
             full_run = {
                 "agents": full_agents,
@@ -530,6 +648,10 @@ def main() -> None:
             shutil.rmtree(fr_dir, ignore_errors=True)
 
     payload["full_run"] = full_run
+    if skipped:
+        payload["skipped_stages"] = skipped
+    signal.alarm(0)
+    # the LAST line of output — the driver's record
     print(json.dumps(payload))
 
 

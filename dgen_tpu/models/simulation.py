@@ -320,8 +320,17 @@ def nem_gate_never_closes(
 #: calibrated against the v5e whole-table wall (32k agents fit a 16 GB
 #: chip, 65k does not -> true footprint is 250-490 KB/agent; 10 hour
 #: arrays + the [r_pad, B_PAD] kernel outputs model that window).
+#: Per-configuration deltas (validated by the end-of-run modeled-vs-
+#: actual peak log and tests/test_hbm_model.py's hardware grid):
 _LIVE_HOUR_ARRAYS = 10
 _LIVE_HOUR_ARRAYS_HOURLY = 3   # keep_hourly net profiles (with_hourly)
+#: rate-switch runs feed the fused pair kernel two extra month-padded
+#: (sell, period) streams and keep a second [r_pad, B_PAD] output live
+_LIVE_HOUR_ARRAYS_RATE_SWITCH = 2
+#: statically-proven all-NEM runs never build per-candidate hour grids
+#: (linear identity only): load/gen/sell/period for linear_sums plus
+#: dispatch traces
+_LIVE_HOUR_ARRAYS_ALL_NEM = 6
 _HBM_RESERVE_FRAC = 0.2        # compiler scratch / fragmentation
 
 
@@ -340,6 +349,34 @@ def default_hbm_bytes() -> Optional[int]:
     return 16 * 1024**3  # v5e/v6e-class default
 
 
+def _per_agent_step_bytes(
+    *,
+    sizing_iters: int,
+    econ_years: int,
+    with_hourly: bool,
+    net_billing: bool = True,
+    rate_switch: bool = False,
+) -> int:
+    """Modeled peak HBM bytes per agent of one streaming-chunk step —
+    the single footprint model shared by the chunk chooser and the
+    end-of-run modeled-vs-actual validation log."""
+    from dgen_tpu.ops.billpallas import B_PAD, H_PAD, _round8
+
+    r_pad = _round8(max(sizing_iters, 4) * econ_years)
+    if not net_billing:
+        hour_arrays = _LIVE_HOUR_ARRAYS_ALL_NEM
+        kernel_outs = 0          # no bucket-sums kernel at all
+    else:
+        hour_arrays = _LIVE_HOUR_ARRAYS
+        kernel_outs = 2
+        if rate_switch:
+            hour_arrays += _LIVE_HOUR_ARRAYS_RATE_SWITCH
+            kernel_outs += 1     # second tariff's [r_pad, B_PAD] sums
+    if with_hourly:
+        hour_arrays += _LIVE_HOUR_ARRAYS_HOURLY
+    return 4 * (hour_arrays * H_PAD + kernel_outs * r_pad * B_PAD)
+
+
 def auto_agent_chunk(
     n_local: int,
     *,
@@ -347,6 +384,8 @@ def auto_agent_chunk(
     econ_years: int,
     with_hourly: bool,
     hbm_bytes: Optional[int],
+    net_billing: bool = True,
+    rate_switch: bool = False,
 ) -> int:
     """Derive the per-device streaming chunk from the HBM budget.
 
@@ -359,13 +398,11 @@ def auto_agent_chunk(
     """
     if not hbm_bytes or n_local <= 0:
         return 0
-    from dgen_tpu.ops.billpallas import B_PAD, H_PAD, _round8
-
-    r_pad = _round8(max(sizing_iters, 4) * econ_years)
-    hour_arrays = _LIVE_HOUR_ARRAYS + (
-        _LIVE_HOUR_ARRAYS_HOURLY if with_hourly else 0
+    per_agent = _per_agent_step_bytes(
+        sizing_iters=sizing_iters, econ_years=econ_years,
+        with_hourly=with_hourly, net_billing=net_billing,
+        rate_switch=rate_switch,
     )
-    per_agent = 4 * (hour_arrays * H_PAD + 2 * r_pad * B_PAD)
     budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
     # persistent whole-table state ([N] outputs/carry, ~50 f32 fields)
     budget -= n_local * 50 * 4
@@ -746,6 +783,29 @@ class Simulation:
                 f"{len(self.years)}"
             )
 
+        # static flags, computed BEFORE chunking/partitioning (padding
+        # only adds masked rows and partitioning only reorders, so the
+        # predicates are invariant — and the HBM chunk model needs them)
+        keep0 = np.asarray(table.mask) > 0
+        self._rate_switch = bool(np.any(
+            np.asarray(table.tariff_switch_idx)
+            != np.asarray(table.tariff_idx)
+        ))
+        metering = np.asarray(tariffs.metering)
+        used = np.unique(np.concatenate([
+            np.asarray(table.tariff_idx)[keep0],
+            np.asarray(table.tariff_switch_idx)[keep0],
+        ]))
+        any_nb_tariff = bool(np.any(metering[used] == NET_BILLING))
+        self._net_billing = any_nb_tariff or not nem_gate_never_closes(
+            np.asarray(table.state_idx)[keep0],
+            np.asarray(inputs.nem_cap_kw),
+            np.asarray(table.nem_first_year)[keep0],
+            np.asarray(table.nem_sunset_year)[keep0],
+            np.asarray(table.nem_kw_limit)[keep0],
+            self.years,
+        )
+
         # state-local shard layout (the reference's per-state task
         # binning, SURVEY.md §2.6); results are keyed by agent_id and
         # invariant under the reordering
@@ -760,6 +820,8 @@ class Simulation:
                 econ_years=econ_years,
                 with_hourly=with_hourly,
                 hbm_bytes=default_hbm_bytes(),
+                net_billing=self._net_billing,
+                rate_switch=self._rate_switch,
             )
             if chunk:
                 logger.info(
@@ -806,36 +868,14 @@ class Simulation:
         # a globally-sharded table would fail under true multi-host
         self.host_agent_id = np.asarray(table.agent_id)
         self.host_mask = np.asarray(table.mask)
-        # static: whether any agent's post-adoption DG rate differs
-        # (skips the second tariff gather + bill structure when not)
-        self._rate_switch = bool(np.any(
-            np.asarray(table.tariff_switch_idx)
-            != np.asarray(table.tariff_idx)
-        ))
-        # static: whether net-billing bills can EVER price in this run.
-        # False only when (a) every tariff a real agent references —
-        # including DG-switch targets — is net-metering AND (b) the NEM
-        # policy gate provably never closes (unbounded caps, windows
-        # covering every model year, positive limits): the gate forces
-        # NET_BILLING at runtime when it closes (build_econ_inputs), so
-        # a binding cap or sunset makes the static skip unsound. When
-        # False, the sizing search prices bills by the linear NEM
-        # identity and skips its hourly bucket-sums kernel entirely.
-        keep = self.host_mask > 0
-        metering = np.asarray(tariffs.metering)
-        used = np.unique(np.concatenate([
-            np.asarray(table.tariff_idx)[keep],
-            np.asarray(table.tariff_switch_idx)[keep],
-        ]))
-        any_nb_tariff = bool(np.any(metering[used] == NET_BILLING))
-        self._net_billing = any_nb_tariff or not nem_gate_never_closes(
-            np.asarray(table.state_idx)[keep],
-            np.asarray(inputs.nem_cap_kw),
-            np.asarray(table.nem_first_year)[keep],
-            np.asarray(table.nem_sunset_year)[keep],
-            np.asarray(table.nem_kw_limit)[keep],
-            self.years,
-        )
+        # _rate_switch (skip the second tariff gather + bill structure
+        # when no agent's post-adoption DG rate differs) and
+        # _net_billing (whether net-billing bills can EVER price: any
+        # net-billing tariff in use, or a NEM gate that can close —
+        # build_econ_inputs forces NET_BILLING at runtime when it does;
+        # False statically skips the hourly bucket-sums kernel and
+        # prices bills by the linear NEM identity) were computed above,
+        # before chunking, because the HBM chunk model depends on them.
 
         if mesh is not None:
             shard = NamedSharding(mesh, P(AGENT_AXIS))
@@ -901,6 +941,63 @@ class Simulation:
             agent_chunk=self._agent_chunk,
             net_billing=self._net_billing,
         )
+
+    def _hbm_check(self) -> Optional[dict]:
+        """Modeled-vs-actual device memory: compare the chunk model's
+        predicted step working set against the device's observed peak
+        (memory_stats), so a mis-modeled configuration is VISIBLE in
+        the logs instead of discovered as a year-1 OOM on a national
+        run.  Returns the record (also kept as ``self.hbm_check``);
+        None when the backend exposes no stats."""
+        if jax.default_backend() != "tpu":
+            return None
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+        except Exception:  # noqa: BLE001 — tunneled devices may not expose
+            stats = {}
+        # tunneled/virtual devices report no stats: still record the
+        # model's prediction (peak None) so operators see what was
+        # assumed; on a real TPU VM the comparison is live
+        peak = stats.get("peak_bytes_in_use")
+        n_dev = int(self.mesh.devices.size) if self.mesh is not None else 1
+        n_local = self.table.n_agents // n_dev
+        rows = self._agent_chunk or n_local
+        per_agent = _per_agent_step_bytes(
+            sizing_iters=self.run_config.sizing_iters,
+            econ_years=self.econ_years,
+            with_hourly=self.with_hourly,
+            net_billing=self._net_billing,
+            rate_switch=self._rate_switch,
+        )
+        modeled = rows * per_agent + n_local * 50 * 4
+        rec = {
+            "modeled_step_bytes": int(modeled),
+            "device_peak_bytes": int(peak) if peak else None,
+            "peak_over_model": round(peak / modeled, 3) if peak else None,
+            "agent_chunk": self._agent_chunk,
+        }
+        self.hbm_check = rec
+        if not peak:
+            logger.info(
+                "HBM model: modeled step %.2f GB (device reports no "
+                "memory stats; comparison unavailable)", modeled / 2**30,
+            )
+            return rec
+        logger.info(
+            "HBM model: modeled step %.2f GB vs device peak %.2f GB "
+            "(peak/model %.2f; peak includes persistent banks)",
+            modeled / 2**30, peak / 2**30, rec["peak_over_model"],
+        )
+        if peak > modeled * 3 and self._agent_chunk:
+            logger.warning(
+                "device peak is %.1fx the chunk model — the footprint "
+                "model under-counts this configuration (net_billing=%s "
+                "rate_switch=%s with_hourly=%s); a larger population "
+                "may OOM at the chosen chunk",
+                rec["peak_over_model"], self._net_billing,
+                self._rate_switch, self.with_hourly,
+            )
+        return rec
 
     def _check_state_kw_bound(self, carry: SimCarry, context: str) -> None:
         """Raise if any state's cumulative capacity reaches
@@ -1142,6 +1239,7 @@ class Simulation:
             with timing.timer("device_drain"):
                 jax.block_until_ready(carry.market.market_share)
                 float(jnp.sum(carry.batt_adopters_cum))
+        self._hbm_check()
         if (not self._net_billing and not debug
                 and jax.process_count() == 1):
             # always-on soundness check for the static all-NEM skip:

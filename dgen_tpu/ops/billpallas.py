@@ -35,6 +35,27 @@ reference re-runs its C++ rate engine per (agent, candidate)
 The pure-XLA twins (``impl="xla"``) keep CPU tests and
 virtually-sharded runs working; parity is asserted in
 tests/test_billpallas.py.
+
+**The 89.5 ms/call month kernel is a measured VPU-compute floor, not a
+scheduling artifact** (round-5 negative results, all at the 8k x 250
+microbench point, tools/kernel_microbench.py):
+
+  * exact piecewise-linear / sorted-hinge formulation (imports(s) is
+    piecewise linear in s; candidate-bin histogram + suffix sums,
+    O(H log R + B*R) arithmetic): 27,205 ms in XLA — 300x SLOWER.
+    TPUs have no vectorized VMEM gather, so searchsorted/scatter
+    serialize; any vectorized evaluation touches R x H lanes anyway,
+    at which point the direct relu pass is optimal.
+  * prebuilt-mask narrow MXU dot (VPU does only fma+relu, all masked
+    reductions as [r,768]x[768,P+1] dots): 98.8 ms — the narrow dot
+    costs more than the VPU masked reductions it replaces.
+  * rank-1 MXU net build ([r,2]x[2,768] so the VPU does ONLY relu):
+    149.0 ms — a K=2 contraction wastes the systolic array and stalls
+    the VPU/MXU pipeline; with Precision.HIGHEST (3-pass f32): 652 ms.
+
+  The kernel's 38.6G lane-ops of fma+relu at the v5e VPU's ~1G
+  lane-op/s/lane-group rate bound the call at ~75-80 ms; 89.5 ms is
+  ~97% of that bound with the masked reductions riding along.
 """
 
 from __future__ import annotations

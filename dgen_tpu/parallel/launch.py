@@ -208,9 +208,15 @@ def main() -> None:
     # process's local shard rows (io.export) — the distributed-run
     # analogue of the reference's always-persisted per-task outputs
     # (dgen_model.py:459-462)
+    from dgen_tpu.io.export import static_frame_from_table
+
     exporter = RunExporter(
         run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask,
         state_names=list(input_states),
+        static_frame=(
+            static_frame_from_table(pop.table, states=list(input_states))
+            if jax.process_count() == 1 else None
+        ),
         meta={
             "scenario": cfg.name, "shard": shard,
             "states": list(states),

@@ -213,9 +213,14 @@ def run_preset(
         run_dir = run_dir or os.path.join(
             "runs", f"preset-{name}-{int(t_start)}")
     if export:
+        from dgen_tpu.io import synth
+        from dgen_tpu.io.export import static_frame_from_table
+
         callback = _TimedExporter(RunExporter(
             run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask,
             state_names=None, meta=meta,
+            static_frame=static_frame_from_table(
+                pop.table, states=list(synth.STATES)),
         ))
 
     year_times: list = []

@@ -142,6 +142,7 @@ def test_pallas_matches_xla_on_tpu(setup):
                 )
 
 
+@pytest.mark.slow
 def test_fast_sizing_matches_oracle(setup):
     pop, load, gen, ts, at = setup
     t = pop.table

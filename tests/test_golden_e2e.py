@@ -27,6 +27,8 @@ from dgen_tpu.io import convert, package
 from dgen_tpu.models import scenario as scen
 from dgen_tpu.models.simulation import Simulation
 
+pytestmark = pytest.mark.slow
+
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "fixtures")
 GOLDEN_PATH = os.path.join(FIXTURES, "golden_adoption.json")

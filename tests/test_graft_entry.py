@@ -9,8 +9,10 @@ single-chip ``entry()`` contract.
 
 import jax
 import numpy as np
+import pytest
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 

@@ -66,6 +66,7 @@ def test_exporter_rejects_wrong_state_names(tmp_path):
         ex.write_state_hourly(2014, np.zeros((49, 8760), np.float32))
 
 
+@pytest.mark.slow
 def test_resume_matches_uninterrupted(tmp_path):
     sim, pop = make_sim()
     full = sim.run()
@@ -247,6 +248,49 @@ def test_deferred_export_survives_midrun_crash(tmp_path):
     assert set(ao["year"]) == {2014, 2016}
 
 
+def test_compact_export_quantization(tmp_path):
+    """Compact (default) exports int16-quantize the bulky float columns
+    on device and drop energy_value; values must reconstruct within the
+    quantization bound (max|x|/65532 per column), cumulative fields must
+    stay bit-exact f32, and compact=False must restore the full-f32
+    schema including energy_value."""
+    sim, pop = make_sim()
+    kw = dict(agent_id=np.asarray(pop.table.agent_id),
+              mask=np.asarray(pop.table.mask))
+    full = exp.RunExporter(str(tmp_path / "full"), compact=False, **kw)
+    comp = exp.RunExporter(str(tmp_path / "comp"), compact=True, **kw)
+
+    def both(year, yi, outs):
+        full(year, yi, outs)
+        comp(year, yi, outs)
+
+    sim.run(callback=both, collect=False)
+
+    ao_f = exp.load_surface(str(tmp_path / "full"), "agent_outputs")
+    ao_c = exp.load_surface(str(tmp_path / "comp"), "agent_outputs")
+    assert len(ao_f) == len(ao_c)
+    for col in exp.AGENT_OUTPUT_FIELDS:
+        a, b = ao_f[col].to_numpy(), ao_c[col].to_numpy()
+        if col in exp._EXACT_FIELDS:
+            np.testing.assert_array_equal(a, b, err_msg=col)
+        else:
+            tol = max(np.abs(a).max(), 1e-9) / 65532 * 1.01
+            np.testing.assert_allclose(a, b, atol=tol, err_msg=col)
+
+    fs_f = exp.load_surface(str(tmp_path / "full"), "finance_series")
+    fs_c = exp.load_surface(str(tmp_path / "comp"), "finance_series")
+    assert "energy_value" in fs_f.columns
+    assert "energy_value" not in fs_c.columns
+    cf_f = np.stack(fs_f["cash_flow"].to_numpy())
+    cf_c = np.stack(fs_c["cash_flow"].to_numpy())
+    # per-column scales: each year column meets its own bound
+    col_tol = np.abs(cf_f).max(axis=0) / 65532 * 1.01 + 1e-9
+    assert (np.abs(cf_f - cf_c) <= col_tol[None, :]).all()
+    # provenance stamped
+    assert full.meta["export_compact"] is False
+    assert comp.meta["export_compact"] is True
+
+
 def test_final_year_export_failure_raises():
     """On the SUCCESS path, a failing final-year flush must surface —
     a run must not report success with the last year's partitions
@@ -266,6 +310,7 @@ def test_final_year_export_failure_raises():
     assert calls["n"] == n_years
 
 
+@pytest.mark.slow
 def test_exporter_surfaces(tmp_path):
     sim, pop = make_sim(with_hourly=True)
     exporter = exp.RunExporter(

@@ -9,6 +9,7 @@ import sys
 import textwrap
 
 import numpy as np
+import pytest
 
 from dgen_tpu.models.scenario import federal_itc_schedule
 from dgen_tpu.parallel.launch import (
@@ -17,6 +18,8 @@ from dgen_tpu.parallel.launch import (
     shard_commands,
     shard_states_from_env,
 )
+
+pytestmark = pytest.mark.slow
 
 
 def test_bin_states_size_ordering():

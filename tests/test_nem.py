@@ -163,6 +163,7 @@ def test_nem_kw_limit_caps_sizing_bracket():
         "unlimited twin should size beyond the limit for some agents"
 
 
+@pytest.mark.slow
 def test_rate_switch_is_size_conditioned():
     """The same population switches on the DG rate only when sized kW
     lands inside [switch_min_kw, switch_max_kw); the one-time charge

@@ -12,6 +12,7 @@ from dgen_tpu.models import scenario as scen
 from dgen_tpu.models.simulation import Simulation
 
 
+@pytest.mark.slow
 def test_roundtrip_identical_results(tmp_path):
     pop = synth.generate_population(70, states=["DE", "TX"], seed=4,
                                     pad_multiple=32)

@@ -37,6 +37,7 @@ def test_presets_build(name):
         assert np.all(np.asarray(pop.table.sector_idx)[keep] == 0)
 
 
+@pytest.mark.slow
 def test_delaware_preset_runs_with_exports(tmp_path):
     rec = presets.run_preset(
         "delaware-res", n_agents=96, run_dir=str(tmp_path / "run"))

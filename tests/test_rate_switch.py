@@ -83,6 +83,7 @@ def test_fast_matches_slow_under_switch():
         np.asarray(rf.payback_period), np.asarray(rs.payback_period), atol=0.21)
 
 
+@pytest.mark.slow
 def test_simulation_with_rate_switch_population():
     cfg = ScenarioConfig(name="rs", start_year=2014, end_year=2018,
                          anchor_years=())

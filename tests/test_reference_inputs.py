@@ -85,6 +85,7 @@ def test_starting_capacity_matches_csv(ref_scenario):
     assert got == pytest.approx(want_kw, rel=1e-6)
 
 
+@pytest.mark.slow
 def test_end_to_end_with_reference_inputs(ref_scenario):
     cfg, states, inputs, meta = ref_scenario
     pop = synth.generate_population(

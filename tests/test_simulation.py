@@ -86,6 +86,7 @@ def test_padding_agents_stay_zero(base_run):
     assert np.all(res.agent["new_batt_adopters"][:, pad] == 0.0)
 
 
+@pytest.mark.slow
 def test_sharded_matches_unsharded():
     mesh = make_mesh()
     assert mesh.devices.size == 8, "conftest should provide 8 CPU devices"
@@ -115,6 +116,7 @@ def test_sharded_matches_unsharded():
     np.testing.assert_allclose(kw_s, kw_u, rtol=5e-4, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_chunked_matches_whole_table():
     """The streaming (agent-chunked) year step must reproduce the
     whole-table path exactly: same sizing, same diffusion, and the same
@@ -142,6 +144,7 @@ def test_chunked_matches_whole_table():
     )
 
 
+@pytest.mark.slow
 def test_chunked_sharded_matches_whole_table():
     """Chunking composes with the mesh: the shard-major chunk layout
     ([d, K, c] -> [K, d*c]) must keep per-agent results keyed by
@@ -171,6 +174,7 @@ def test_chunked_sharded_matches_whole_table():
     )
 
 
+@pytest.mark.slow
 def test_all_nem_population_skips_kernel_with_exact_parity():
     """When every referenced tariff is net-metering AND the NEM gate
     provably never closes, the driver statically drops to the linear
@@ -342,6 +346,7 @@ def test_partition_states_are_shard_local():
         assert np.all(seg == d)
 
 
+@pytest.mark.slow
 def test_invariant_harness_catches_corruption():
     from dgen_tpu.utils.invariants import InvariantViolation
 
@@ -381,6 +386,7 @@ def test_timing_report_collects_year_steps():
     assert rep["year_step"]["total"] > 0
 
 
+@pytest.mark.slow
 def test_anchoring_rescales_to_observed():
     # observe 5000 kW in every group in the 2016 anchor year; the model
     # must land exactly on the observed state x sector totals
@@ -403,6 +409,7 @@ def test_anchoring_rescales_to_observed():
     np.testing.assert_allclose(group_kw[present], 5000.0, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_nem_cap_gate_reduces_value():
     # with NEM shut off from the start (cap 0), bills savings fall ->
     # fewer adopters than with NEM available
@@ -420,6 +427,7 @@ def test_nem_cap_gate_reduces_value():
     assert a_no < a_nem, f"NEM-off should adopt less ({a_no} !< {a_nem})"
 
 
+@pytest.mark.slow
 def test_hourly_aggregation_consistency():
     sim, pop = make_sim(with_hourly=True)
     res = sim.run()

@@ -57,6 +57,7 @@ def _make_env(seed=0, tariff_k=1, load_kwh=9000.0):
     ), bank
 
 
+@pytest.mark.slow
 def test_size_one_agent_outputs_consistent():
     env, bank = _make_env()
     res = sizing.size_one_agent(env, n_periods=bank.max_periods, n_years=25)
@@ -84,6 +85,7 @@ def test_size_one_agent_outputs_consistent():
     assert np.all(net <= np.asarray(env.load) + 1e-5)
 
 
+@pytest.mark.slow
 def test_kw_star_beats_neighbors():
     """The found size is at least as good as nearby alternatives."""
     env, bank = _make_env(tariff_k=0)
@@ -97,6 +99,7 @@ def test_kw_star_beats_neighbors():
         assert npv_star >= npv_alt - max(abs(npv_star) * 5e-3, 2.0)
 
 
+@pytest.mark.slow
 def test_fast_path_matches_slow_path():
     """The scale-parameterized fast path must agree with the direct
     hourly path on every output of the full kernel."""

@@ -371,6 +371,259 @@ def sums_variant(load, gen, sell, bucket_id, scales, *, b_pad=128,
     return out[0]
 
 
+# ------------------------------------------- prebuilt-mask MXU variant
+
+def _kernel_mdp(scales_ref, load_ref, gen_ref, m_ref, out_ref, *,
+                r_pad, n_periods, c_pad, b_pad):
+    """Month-blocked dot against PREBUILT mask columns: the VPU does
+    ONLY the net fma+relu; every reduction (P-1 period sums, month
+    total, sell-weighted sum) is one narrow [r,768]x[C,768]^T dot on
+    the MXU.  The round-4 monthdot variant lost because it built its
+    one-hot IN-KERNEL (iota-compare-select ~= the masked reductions it
+    replaced); here M comes from HBM, built once in XLA and reusable
+    across every kernel call of a year step.
+
+    M layout per agent: [c_pad, 12*768]; rows 0..P-2 = period one-hots,
+    row P-1 = ones (month total), row P = sell rate, rest zero pad.
+    Output keeps the library layout: [r_pad, b_pad] month-major bucket
+    cols + sell in the last col.
+    """
+    scales = scales_ref[0, 0, :]
+    cols = []
+    sell_acc = jnp.zeros((r_pad,), jnp.float32)
+    for m in range(12):
+        lo = m * 768
+        load = load_ref[0, 0, lo:lo + 768]
+        gen = gen_ref[0, 0, lo:lo + 768]
+        mm = m_ref[0, :, lo:lo + 768]                       # [c_pad, 768]
+
+        netv = load[None, :] - scales[:, None] * gen[None, :]
+        pos = jnp.maximum(netv, 0.0)                        # [r_pad, 768]
+        sums = jax.lax.dot_general(
+            pos, mm, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                   # [r_pad, c_pad]
+        rem = sums[:, n_periods - 1]                        # month total
+        for p in range(n_periods - 1):
+            cols.append(sums[:, p])
+            rem = rem - sums[:, p]
+        cols.append(rem)
+        sell_acc = sell_acc + sums[:, n_periods]
+    out = jnp.stack(cols, axis=1)                           # [r_pad, 12*P]
+    nb = 12 * n_periods
+    fill = jnp.zeros((r_pad, b_pad - nb - 1), jnp.float32)
+    out_ref[0] = jnp.concatenate([out, fill, sell_acc[:, None]], axis=1)
+
+
+def build_mask_cols(sell, period, valid, idx, n_periods, c_pad=8):
+    """[N, c_pad, 12*768] prebuilt mask columns (XLA, once per step)."""
+    n = sell.shape[0]
+    H12 = idx.shape[0]
+    sell_p = sell[:, idx] * valid[None, :]
+    per_p = jnp.where(valid[None, :] > 0, period[:, idx], n_periods + 7)
+    rows = []
+    for p in range(n_periods - 1):
+        rows.append((per_p == p).astype(jnp.float32))
+    rows.append(jnp.broadcast_to(valid[None, :], (n, H12)))   # ones
+    rows.append(sell_p)
+    m = jnp.stack(rows, axis=1)                  # [N, P+1, H12]
+    return jnp.pad(m, ((0, 0), (0, c_pad - (n_periods + 1)), (0, 0)))
+
+
+def sums_monthdot_pre(load, gen, sell, bucket_id, scales, *, n_periods=2,
+                      b_pad=128, c_pad=8, prebuilt=None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = load.shape[0]
+    r = scales.shape[1]
+    r_pad = bp._round8(r)
+    idx_np, valid_np = _month_layout()
+    idx, valid = jnp.asarray(idx_np), jnp.asarray(valid_np)
+    H12 = 12 * 768
+
+    period = (bucket_id % n_periods).astype(jnp.int32)
+    rep = lambda x: x[:, idx] * valid[None, :]
+    load_p = rep(load)[:, None, :]
+    gen_p = rep(gen)[:, None, :]
+    m = (build_mask_cols(sell, period, valid, idx, n_periods, c_pad)
+         if prebuilt is None else prebuilt)
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
+
+    out3 = lambda i: (i, 0, 0)
+    out = pl.pallas_call(
+        partial(_kernel_mdp, r_pad=r_pad, n_periods=n_periods,
+                c_pad=c_pad, b_pad=b_pad),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c_pad, H12), out3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((1, r_pad, b_pad), out3,
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((n, r_pad, b_pad), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * r_pad * H12 * c_pad,
+            bytes_accessed=(2 + c_pad) * n * H12 * 4,
+            transcendentals=0,
+        ),
+    )(scales_p, load_p, gen_p, m)
+    return out[0]
+
+
+# ------------------------------------- MXU net-build (rank-1) variant
+
+def _kernel_mnet(scales_ref, load_ref, gen_ref, m_ref, out_ref, *,
+                 r_pad, n_periods, c_pad, b_pad, hi):
+    """Everything-on-MXU month kernel: net = load - s*gen is RANK-1
+    ([r,2] @ [2,768] coeff x (load;gen) rows), so the fma moves to the
+    MXU too — the VPU does ONLY the relu.  Masked reductions as in
+    _kernel_mdp (prebuilt M).  ``hi`` = Precision.HIGHEST on both dots
+    (3-pass f32 emulation) to quantify the parity/speed trade."""
+    prec = jax.lax.Precision.HIGHEST if hi else None
+    scales = scales_ref[0, 0, :]
+    ones = jnp.ones((r_pad,), jnp.float32)
+    coeff = jnp.stack([ones, -scales], axis=1)              # [r_pad, 2]
+    cols = []
+    sell_acc = jnp.zeros((r_pad,), jnp.float32)
+    for m in range(12):
+        lo = m * 768
+        load = load_ref[0, 0, lo:lo + 768]
+        gen = gen_ref[0, 0, lo:lo + 768]
+        mm = m_ref[0, :, lo:lo + 768]                       # [c_pad, 768]
+
+        lg = jnp.stack([load, gen], axis=0)                 # [2, 768]
+        netv = jax.lax.dot_general(
+            coeff, lg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )                                                   # [r_pad, 768]
+        pos = jnp.maximum(netv, 0.0)
+        sums = jax.lax.dot_general(
+            pos, mm, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )                                                   # [r_pad, c_pad]
+        rem = sums[:, n_periods - 1]
+        for p in range(n_periods - 1):
+            cols.append(sums[:, p])
+            rem = rem - sums[:, p]
+        cols.append(rem)
+        sell_acc = sell_acc + sums[:, n_periods]
+    out = jnp.stack(cols, axis=1)
+    nb = 12 * n_periods
+    fill = jnp.zeros((r_pad, b_pad - nb - 1), jnp.float32)
+    out_ref[0] = jnp.concatenate([out, fill, sell_acc[:, None]], axis=1)
+
+
+def sums_mnet(load, gen, sell, bucket_id, scales, *, n_periods=2,
+              b_pad=128, c_pad=8, hi=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = load.shape[0]
+    r = scales.shape[1]
+    r_pad = bp._round8(r)
+    idx_np, valid_np = _month_layout()
+    idx, valid = jnp.asarray(idx_np), jnp.asarray(valid_np)
+    H12 = 12 * 768
+
+    period = (bucket_id % n_periods).astype(jnp.int32)
+    rep = lambda x: x[:, idx] * valid[None, :]
+    load_p = rep(load)[:, None, :]
+    gen_p = rep(gen)[:, None, :]
+    m = build_mask_cols(sell, period, valid, idx, n_periods, c_pad)
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
+
+    out3 = lambda i: (i, 0, 0)
+    out = pl.pallas_call(
+        partial(_kernel_mnet, r_pad=r_pad, n_periods=n_periods,
+                c_pad=c_pad, b_pad=b_pad, hi=hi),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H12), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, c_pad, H12), out3, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[pl.BlockSpec((1, r_pad, b_pad), out3,
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((n, r_pad, b_pad), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * r_pad * H12 * (2 + c_pad),
+            bytes_accessed=(2 + c_pad) * n * H12 * 4,
+            transcendentals=0,
+        ),
+    )(scales_p, load_p, gen_p, m)
+    return out[0]
+
+
+# --------------------------- piecewise-linear (sorted-hinge) XLA variant
+
+def sums_piecewise(load, gen, sell, bucket_id, scales, *, n_periods=2,
+                   b_pad=128):
+    """Exact piecewise-linear formulation (VERDICT r4 item 2), pure XLA:
+
+    imports_b(s) = L_b(s) - s * G_b(s) with L/G = sums of load/gen over
+    hours whose ratio load/gen exceeds s.  Per agent: sort hours by
+    ratio once, candidate-bin each hour (k_h = #candidates < ratio_h),
+    scatter (load, gen, sell*load, sell*gen) into (bucket, k) bins, and
+    suffix-sum over k — every candidate then reads its bucket row.
+    O(H log R + B*R) per agent instead of O(H*R)."""
+    n, h = load.shape
+    r = scales.shape[1]
+    nb = 12 * n_periods
+    eps = 1e-30
+
+    ratio = load / jnp.maximum(gen, eps)          # gen==0 -> huge ratio
+    ratio = jnp.where(gen > 0, ratio, jnp.inf)
+
+    s_sorted = jnp.sort(scales, axis=1)                     # [N, R]
+    k = jax.vmap(
+        lambda sr, rr: jnp.searchsorted(sr, rr)
+    )(s_sorted, ratio).astype(jnp.int32)                    # [N, H] in 0..R
+
+    # bin = bucket * (R+1) + k ; segment-sum the four weighted streams
+    bins = bucket_id * (r + 1) + k
+    nseg = nb * (r + 1)
+
+    def seg(x):
+        return jax.vmap(
+            lambda v, b: jax.ops.segment_sum(v, b, num_segments=nseg)
+        )(x, bins).reshape(n, nb, r + 1)
+
+    w_l, w_g = seg(load), seg(jnp.where(jnp.isinf(ratio), 0.0, gen))
+    # suffix sums over k: hours active for candidate j are those with
+    # k > j  ->  L_b(s_j) = sum_{k>j} w[b, k]
+    suf = lambda w: jnp.flip(
+        jnp.cumsum(jnp.flip(w, axis=2), axis=2), axis=2
+    )[:, :, 1:]                                             # [N, nb, R]
+    L, G = suf(w_l), suf(w_g)
+    imports_sorted = L - s_sorted[:, None, :] * G           # [N, nb, R]
+    # gen==0 hours contribute load unconditionally (ratio inf -> k=R,
+    # always in the suffix) — already included via w_l at k=R.
+
+    # sell-weighted sum (global, not bucketed)
+    sl = seg(sell * load).sum(axis=1)                       # [N, R+1]
+    sg = seg(sell * jnp.where(jnp.isinf(ratio), 0.0, gen)).sum(axis=1)
+    sufv = lambda w: jnp.flip(
+        jnp.cumsum(jnp.flip(w, axis=1), axis=1), axis=1
+    )[:, 1:]
+    sell_sorted = sufv(sl) - s_sorted * sufv(sg)            # [N, R]
+
+    # un-sort back to the caller's candidate order
+    order = jnp.argsort(scales, axis=1)
+    inv = jnp.argsort(order, axis=1)
+    take = jax.vmap(lambda x, i: x[:, i])
+    imports = jnp.swapaxes(take(imports_sorted, inv), 1, 2)  # [N, R, nb]
+    sell_out = jnp.take_along_axis(sell_sorted, inv, axis=1)
+
+    out = jnp.zeros((n, r, b_pad), jnp.float32)
+    out = out.at[:, :, :nb].set(imports)
+    out = out.at[:, :, b_pad - 1].set(sell_out)
+    return out
+
+
 # ------------------------------------------------------------------ timing
 #
 # Fresh executables compile in 1-3 min through the tunnel, so each
@@ -405,6 +658,25 @@ def _device_ms_per_rep(run_reps, reps: int) -> float:
         if e.get("ph") == "X" and e.get("pid") in dev
     )
     return total_us / 1e3 / reps
+
+
+def check_parity(name, variant_fn, data, n_periods, k=32):
+    """Max abs error of a variant vs the library engine on a k-agent
+    slice (bucket cols + sell col), printed one line per variant."""
+    sl = jax.jit(
+        lambda l, g, s, b, sc: (
+            bp._sums_pallas(l, g, s, b, sc, with_signed=False,
+                            n_periods=n_periods)[0],
+            variant_fn(l, g, s, b, sc),
+        )
+    )
+    a, b_ = jax.device_get(sl(*(d[:k] for d in data)))
+    nb = 12 * n_periods
+    err_b = np.max(np.abs(a[:, :250, :nb] - b_[:, :250, :nb]))
+    rel = err_b / max(np.max(np.abs(a[:, :250, :nb])), 1e-9)
+    err_s = np.max(np.abs(a[:, :250, 127] - b_[:, :250, 127]))
+    print(f"parity {name} vs lib: max|d| buckets {err_b:.3e} "
+          f"(rel {rel:.2e}) sell {err_s:.3e}", flush=True)
 
 
 def time_variant(name, variant_fn, data, reps=3):
@@ -486,19 +758,30 @@ def main():
             l, g, s, b, sc, n_periods=n_periods)
         results["monthdot(positional M,dot)"] = time_variant(
             "monthdot(positional M,dot)", fn, data)
-        k = 32
-        sl = jax.jit(
-            lambda l, g, s, b, sc: (
-                bp._sums_pallas(l, g, s, b, sc, with_signed=False, n_periods=n_periods)[0],
-                sums_monthdot(l, g, s, b, sc, n_periods=n_periods),
-            )
-        )
-        a, b_ = jax.device_get(sl(*(d[:k] for d in data)))
-        nb = 12 * n_periods
-        err_b = np.max(np.abs(a[:, :250, :nb] - b_[:, :250, :nb]))
-        err_s = np.max(np.abs(a[:, :250, 127] - b_[:, :250, 127]))
-        print(f"parity monthdot vs base: max|d| buckets {err_b:.3e} "
-              f"sell {err_s:.3e}", flush=True)
+        check_parity("monthdot", fn, data, n_periods)
+
+    if not which or "monthdot_pre" in which:
+        fn = lambda l, g, s, b, sc: sums_monthdot_pre(
+            l, g, s, b, sc, n_periods=n_periods)
+        results["monthdot_pre(prebuilt M,MXU)"] = time_variant(
+            "monthdot_pre(prebuilt M,MXU)", fn, data)
+        check_parity("monthdot_pre", fn, data, n_periods)
+
+    for nm, hi in (("mnet", False), ("mnet_hi", True)):
+        if which and nm not in which:
+            continue
+        fn = lambda l, g, s, b, sc, hi=hi: sums_mnet(
+            l, g, s, b, sc, n_periods=n_periods, hi=hi)
+        results[nm] = time_variant(
+            f"{nm}(rank-1 MXU net{'/hi' if hi else ''})", fn, data)
+        check_parity(nm, fn, data, n_periods)
+
+    if "piecewise" in which:
+        fn = lambda l, g, s, b, sc: sums_piecewise(
+            l, g, s, b, sc, n_periods=n_periods)
+        results["piecewise(sorted-hinge,XLA)"] = time_variant(
+            "piecewise(sorted-hinge,XLA)", fn, data)
+        check_parity("piecewise", fn, data, n_periods)
 
     # library baseline for cross-check
     def lib(l, g, s, b, sc):

@@ -44,7 +44,7 @@ Knobs (env):
                           can't fit them, the full run is auto-sized to
                           what fits, and a SIGALRM backstop emits the
                           final JSON before an external timeout can kill
-                          the process (default 1500)
+                          the process (default 1680)
   DGEN_TPU_BENCH_FULL_AGENTS  full-run population ("auto" = largest that
                           fits the remaining budget; "" disables)
 """
@@ -373,7 +373,9 @@ def main() -> None:
     scale_env = os.environ.get(
         "DGEN_TPU_BENCH_SCALE", "8192,32768,65536,131072:16384"
     )
-    budget = float(os.environ.get("DGEN_TPU_BENCH_BUDGET_S", "1500"))
+    # default sized against the driver's observed tolerance: round 4 was
+    # killed after >24 min of output, so 28 min of work + backstop margin
+    budget = float(os.environ.get("DGEN_TPU_BENCH_BUDGET_S", "1680"))
 
     def remaining() -> float:
         return budget - (time.time() - _T0)

@@ -57,6 +57,10 @@ def _quantize_i16(xs):
 
     qs, scales = [], []
     for x in xs:
+        # a single non-finite element must not poison the whole column
+        # (scale would become inf/NaN); zero it like the reference's
+        # own _norm25 rule for malformed cells
+        x = jnp.where(jnp.isfinite(x), x, 0.0)
         # 2-D series ([n_agents, n_years]) get PER-COLUMN scales: the
         # year-0 capex column is orders of magnitude larger than the
         # out-year cash flows and a global max would waste the range

@@ -22,6 +22,13 @@ if not _TPU_HW_RUN:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)
 
+# persistent compile cache: entries are keyed by backend so CPU test
+# programs coexist with the TPU entries; repeat suite runs skip the
+# recompiles (the scan-heavy simulation tests compile 10-30 s each)
+from dgen_tpu.utils import compilecache  # noqa: E402
+
+compilecache.enable()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -127,6 +127,12 @@ _EXACT_FIELDS = frozenset(
     f for f in AGENT_OUTPUT_FIELDS if f.endswith("_cum")
 ) | {"number_of_adopters"}
 
+#: quantization mask in AGENT_OUTPUT_FIELDS order (shared by the
+#: deferred prepare() dispatch and the write-time fallback)
+_AGENT_OUTPUT_QUANT = tuple(
+    f not in _EXACT_FIELDS for f in AGENT_OUTPUT_FIELDS
+)
+
 
 def _dir(run_dir: str, name: str) -> str:
     d = os.path.join(run_dir, name)
@@ -166,6 +172,7 @@ class RunExporter:
                 "DGEN_TPU_EXPORT_COMPACT", "1"
             ).lower() not in ("0", "off", "false")
         self.compact = bool(compact)
+        self._prepared: Dict[int, dict] = {}   # year_idx -> dispatched
         os.makedirs(run_dir, exist_ok=True)
         # provenance stamp: ``meta`` (notably market_curves:
         # synthetic_default vs ingested, from scenario ingest) is written
@@ -202,7 +209,22 @@ class RunExporter:
         (rows,), ids = self._local_fields([arr])
         return rows, ids
 
-    def _local_fields(self, arrs, quant=None) -> tuple[list, np.ndarray]:
+    @staticmethod
+    def _quant_dispatch(arrs, quant):
+        """Enqueue the on-device quantization of the True-masked fields;
+        returns (qs, scales, rest) device arrays WITHOUT fetching.  Used
+        at prepare() time so the ops land on the device queue right
+        behind the step that produced ``arrs`` — dispatching them at
+        callback time instead would queue them behind the NEXT year's
+        step and serialize the export pipeline against device compute
+        (measured: 1M-agent exports 1492 s vs ~130 s prepared)."""
+        q_in = [a for a, q in zip(arrs, quant) if q]
+        qs, scales = _quantize_i16_jit(q_in)
+        rest = [a for a, q in zip(arrs, quant) if not q]
+        return qs, scales, rest
+
+    def _local_fields(self, arrs, quant=None, prepared=None
+                      ) -> tuple[list, np.ndarray]:
         """(rows per field, ids): the fast path reuses the first field's
         shard index for follow-up fields; any field whose sharding
         differs (GSPMD may replicate one YearOutputs leaf while sharding
@@ -212,16 +234,18 @@ class RunExporter:
         ``quant``: optional per-field bools — True fields travel
         device->host int16-quantized (compact mode, single-controller
         fast path only; multi-host shard writes never cross a tunnel)
-        and are reconstructed to f32 here."""
+        and are reconstructed to f32 here.  ``prepared``: the
+        already-dispatched (qs, scales, rest) from :meth:`prepare`."""
         if not any(
             getattr(a, "is_fully_addressable", True) is False for a in arrs
         ):
             # single-controller: ONE batched transfer for all fields
             # (per-leaf np.asarray costs a host round trip each)
-            if self.compact and quant is not None and any(quant):
-                q_in = [a for a, q in zip(arrs, quant) if q]
-                qs, scales = _quantize_i16_jit(q_in)
-                rest = [a for a, q in zip(arrs, quant) if not q]
+            if (prepared is None and self.compact and quant is not None
+                    and any(quant)):
+                prepared = self._quant_dispatch(arrs, quant)
+            if prepared is not None:
+                qs, scales, rest = prepared
                 h_q, h_s, h_rest = jax.device_get([qs, scales, rest])
                 qi = iter(zip(h_q, h_s))
                 ri = iter(h_rest)
@@ -283,10 +307,36 @@ class RunExporter:
                 f"hourly aggregate covers {n_states} states"
             )
 
-    def __call__(self, year: int, year_idx: int, outs) -> None:
-        self.write_agent_outputs(year, outs)
+    def prepare(self, year: int, year_idx: int, outs) -> None:
+        """Dispatch the compact-transfer quantization for a year whose
+        export callback is DEFERRED (Simulation.run calls this when it
+        stashes the callback): the quantize ops execute right after the
+        step that produced ``outs``, so the later callback only
+        transfers ready arrays instead of waiting behind the next
+        year's device step.  No-op for full-precision or multi-host
+        runs."""
+        if not self.compact:
+            return
+        ao = [getattr(outs, f) for f in AGENT_OUTPUT_FIELDS]
+        fin = [outs.cash_flow] if self.finance_series else []
+        if any(
+            getattr(a, "is_fully_addressable", True) is False
+            for a in ao + fin
+        ):
+            return   # multi-host shard writes never quantize
+        pre = {"agent_outputs": self._quant_dispatch(
+            ao, _AGENT_OUTPUT_QUANT)}
         if self.finance_series:
-            self.write_finance_series(year, outs)
+            pre["finance"] = self._quant_dispatch(fin, (True,))
+        self._prepared[int(year_idx)] = pre
+
+    def __call__(self, year: int, year_idx: int, outs) -> None:
+        pre = self._prepared.pop(int(year_idx), {})
+        self.write_agent_outputs(
+            year, outs, prepared=pre.get("agent_outputs"))
+        if self.finance_series:
+            self.write_finance_series(
+                year, outs, prepared=pre.get("finance"))
         # the state aggregate is replicated across hosts; one writer
         if (
             getattr(outs.state_hourly_net_mw, "size", 0)
@@ -297,10 +347,11 @@ class RunExporter:
             )
 
     # --- agent_outputs (reference dgen_model.py:460-462) ---
-    def write_agent_outputs(self, year: int, outs) -> None:
+    def write_agent_outputs(self, year: int, outs, prepared=None) -> None:
         rows, ids = self._local_fields(
             [getattr(outs, f) for f in AGENT_OUTPUT_FIELDS],
-            quant=[f not in _EXACT_FIELDS for f in AGENT_OUTPUT_FIELDS],
+            quant=_AGENT_OUTPUT_QUANT,
+            prepared=prepared,
         )
         cols = dict(zip(AGENT_OUTPUT_FIELDS, rows))
         df = pd.DataFrame({"agent_id": ids, "year": year, **cols})
@@ -311,13 +362,14 @@ class RunExporter:
         )
 
     # --- agent_finance_series (reference finance_series_export.py:22) ---
-    def write_finance_series(self, year: int, outs) -> None:
+    def write_finance_series(self, year: int, outs, prepared=None) -> None:
         if self.compact:
             # energy_value is the detail column analysts rarely read and
             # HALF this surface's bytes; compact runs drop it (the
             # cash-flow series, the surface's point, stays)
             (cf,), ids = self._local_fields(
-                [outs.cash_flow], quant=[True]   # [n, Y+1]
+                [outs.cash_flow], quant=(True,),   # [n, Y+1]
+                prepared=prepared,
             )
             ev = None
         else:

@@ -28,15 +28,14 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from dgen_tpu.ops.tariff import BIG_CAP, NET_METERING
+from dgen_tpu.ops.tariff import (
+    BIG_CAP,
+    NET_METERING,
+    expand_schedule_8760,
+    hour_month_map,
+)
 
 URDB_API_URL = "https://api.openei.org/utility_rates"
-
-#: month lengths in hours (non-leap), the reference's month_hours table
-#: (tariff_functions.py:1191)
-_MONTH_HOURS = np.array(
-    [0, 744, 1416, 2160, 2880, 3624, 4344, 5088, 5832, 6552, 7296, 8016,
-     8760], np.int64)
 
 
 def _rate_matrix(structure: List[List[dict]]) -> Tuple[np.ndarray, np.ndarray]:
@@ -82,11 +81,13 @@ def urdb_rate_to_specs(
     None when the record prices no demand. Metering defaults to net
     metering, the reference's assumption for URDB pulls.
     """
+    # .get defaults don't cover explicit JSON nulls (the API emits them)
+    fixed = record.get("fixedmonthlycharge")
+    if fixed is None:
+        fixed = record.get("fixedchargefirstmeter")
     energy: Dict[str, Any] = {
-        "fixed_charge": float(
-            record.get("fixedmonthlycharge",
-                       record.get("fixedchargefirstmeter", 0.0)) or 0.0),
-        "metering": int(record.get("metering", NET_METERING)),
+        "fixed_charge": float(fixed or 0.0),
+        "metering": int(record.get("metering") or NET_METERING),
     }
     es = record.get("energyratestructure")
     if es:
@@ -172,24 +173,6 @@ def download_tariffs_from_urdb(
         offset += limit
 
 
-def build_8760_from_12by24s(
-    wkday: np.ndarray, wkend: np.ndarray, start_day: int = 6,
-) -> np.ndarray:
-    """Hourly period map from weekday/weekend 12x24 schedules (the
-    reference's builder, tariff_functions.py:1100-1131; start_day=6 =
-    2018's Monday-offset convention)."""
-    month_idx = np.repeat(np.arange(12), np.diff(_MONTH_HOURS))
-    hour_of_day = np.arange(8760) % 24
-    day_number = np.arange(8760) // 24
-    weekend = ((day_number + start_day) % 7) >= 5
-    wkday = np.asarray(wkday, np.int64)
-    wkend = np.asarray(wkend, np.int64)
-    return np.where(
-        weekend, wkend[month_idx, hour_of_day],
-        wkday[month_idx, hour_of_day],
-    ).astype(np.int32)
-
-
 def design_tariff_for_portfolio(
     loads: np.ndarray,                 # [N, 8760] kW
     weights: np.ndarray,               # [N] customers represented
@@ -197,7 +180,7 @@ def design_tariff_for_portfolio(
     peak_hour_indices: Sequence[int],  # hours-of-day that are on-peak
     summer_month_indices: Sequence[int],
     rev_f_d: Sequence[float],          # [frac of rev, tou frac, flat frac]
-    rev_f_e: Sequence[float],          # [frac of rev, offpeak frac, peak frac]
+    rev_f_e: Sequence[float],          # [frac of rev, peak frac, offpeak frac]
     rev_f_fixed: Sequence[float],      # [frac of rev]
 ) -> Dict[str, Any]:
     """Design a 2-period TOU + demand + fixed tariff extracting
@@ -208,6 +191,19 @@ def design_tariff_for_portfolio(
     the two framework spec dicts plus the solved charge levels and the
     achieved revenue decomposition (the reference returns a Tariff
     object and leaves verification to a bill_calculator loop).
+
+    Divergences from the reference, both deliberate:
+
+    * the peak/off-peak windows use THIS framework's calendar
+      (``expand_schedule_8760``, Jan-1 = Monday) rather than the
+      reference's hard-coded Sunday-start — so ``revenue_check`` holds
+      exactly under the framework's own bill engine for the emitted
+      spec, which is the point of designing a tariff here;
+    * the ``rev_f_e`` element order follows the reference's CODE
+      (index 1 = peak, index 2 = off-peak,
+      tariff_functions.py:1227-1228), not its docstring, which states
+      the opposite — same docstring-vs-code resolution as the payback
+      sentinel (ops/cashflow.py).
     """
     loads = np.asarray(loads, np.float64)
     weights = np.asarray(weights, np.float64)
@@ -219,8 +215,8 @@ def design_tariff_for_portfolio(
     wkend = np.zeros((12, 24), np.int64)
     for h in peak_hour_indices:
         wkday[np.asarray(summer_month_indices, np.int64), h] = 1
-    period_8760 = build_8760_from_12by24s(wkday, wkend)
-    month_idx = np.repeat(np.arange(12), np.diff(_MONTH_HOURS))
+    period_8760 = np.asarray(expand_schedule_8760(wkday, wkend))
+    month_idx = np.asarray(hour_month_map())
 
     # per-agent per-(month, period) maxes and sums, vectorized
     peak_d = np.zeros(n)     # sum over months of on-peak max kW
@@ -243,8 +239,11 @@ def design_tariff_for_portfolio(
     rev = {
         "d_tou": norm_rev * rev_f_d[0] * rev_f_d[1],
         "d_flat": norm_rev * rev_f_d[0] * rev_f_d[2],
-        "e_off": norm_rev * rev_f_e[0] * rev_f_e[1],
-        "e_peak": norm_rev * rev_f_e[0] * rev_f_e[2],
+        # reference CODE order: [1] = peak, [2] = off-peak
+        # (tariff_functions.py:1227-1228; its docstring says the
+        # opposite — see the function docstring above)
+        "e_peak": norm_rev * rev_f_e[0] * rev_f_e[1],
+        "e_off": norm_rev * rev_f_e[0] * rev_f_e[2],
         "fixed": norm_rev * rev_f_fixed[0],
     }
 

@@ -1195,6 +1195,14 @@ class Simulation:
                             prev, pending_cb = pending_cb, None
                             callback(*prev)
                         pending_cb = (year, yi, outs)
+                        # let the exporter enqueue its device-side
+                        # transfer prep (e.g. compact quantization) NOW,
+                        # right behind this year's step — at callback
+                        # time those ops would queue behind the NEXT
+                        # year's step and serialize the pipeline
+                        prep = getattr(callback, "prepare", None)
+                        if prep is not None:
+                            prep(year, yi, outs)
                     else:
                         callback(year, yi, outs)
                 if ckpt_writer is not None:

@@ -182,6 +182,13 @@ class _TimedExporter:
         self.inner = inner
         self.seconds = 0.0
 
+    def prepare(self, year, year_idx, outs):
+        # dispatch-only (no fetch); forwarded so the deferred-transfer
+        # prep still lands right behind the producing step
+        prep = getattr(self.inner, "prepare", None)
+        if prep is not None:
+            prep(year, year_idx, outs)
+
     def __call__(self, year, year_idx, outs):
         t0 = time.time()
         self.inner(year, year_idx, outs)

@@ -107,9 +107,39 @@ def test_design_tariff_extracts_target_revenue():
     assert chk["achieved_usd"] == pytest.approx(chk["target_usd"], rel=1e-9)
     assert chk["avg_rev_per_kwh"] == pytest.approx(0.15, rel=1e-9)
     assert out["charges"]["e_peak"] > out["charges"]["e_offpeak"] > 0
-    # the energy spec prices a real bill through the framework engine
     dense = normalize_tariff_spec(out["energy_spec"])
     assert dense["price"][1, 0] == pytest.approx(out["charges"]["e_peak"])
     from dgen_tpu.ops.demand import compile_demand_bank
 
     assert compile_demand_bank([out["demand_spec"]]) is not None
+
+    # ENGINE cross-check: billing the portfolio through the framework's
+    # own bill engine with the designed tariff must collect exactly the
+    # designed energy+fixed revenue — this is why the design uses the
+    # framework's calendar, not the reference's Sunday-start constant
+    import jax.numpy as jnp
+
+    from dgen_tpu.ops import bill as bill_ops
+    from dgen_tpu.ops.tariff import compile_tariffs, expand_schedule_8760
+
+    bank = compile_tariffs([out["energy_spec"]])
+    at = bill_ops.gather_tariff(bank, jnp.asarray(0))
+    period = np.asarray(expand_schedule_8760(
+        np.asarray(out["energy_spec"]["e_wkday_12by24"]),
+        np.asarray(out["energy_spec"]["e_wkend_12by24"]),
+    ))
+    take = range(0, n, 6)
+    bills = np.array([
+        float(bill_ops.annual_bill(
+            jnp.asarray(loads[i], jnp.float32), at,
+            jnp.zeros(8760, jnp.float32), bank.max_periods,
+        ))
+        for i in take
+    ])
+    expect = np.array([
+        out["charges"]["e_peak"] * float(loads[i][period == 1].sum())
+        + out["charges"]["e_offpeak"] * float(loads[i][period == 0].sum())
+        + out["charges"]["fixed_monthly"] * 12.0
+        for i in take
+    ])
+    np.testing.assert_allclose(bills, expect, rtol=1e-4)

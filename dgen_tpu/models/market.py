@@ -115,6 +115,25 @@ def equivalent_time(
     return jnp.log((1.0 - ratio) / (1.0 + ratio * (q / p))) / (-(p + q))
 
 
+def _bass_floored_share(
+    market_share_last: jax.Array,
+    mms: jax.Array,
+    bass_p: jax.Array,
+    bass_q: jax.Array,
+    teq_yr1: jax.Array,
+    is_first_year: bool,
+    year_step: float,
+) -> jax.Array:
+    """The Bass solve shared by the solar and tech-choice paths: invert
+    to equivalent time, step forward, take the new cumulative share,
+    floored at last year's (reference diffusion_functions_elec.py:75
+    and :290)."""
+    teq = equivalent_time(market_share_last, mms, bass_p, bass_q)
+    teq2 = teq + (teq_yr1 if is_first_year else year_step)
+    bass_ms = mms * bass_new_adopt_fraction(bass_p, bass_q, teq2)
+    return jnp.maximum(market_share_last, bass_ms)
+
+
 def diffusion_step(
     state: MarketState,
     mms: jax.Array,
@@ -131,13 +150,8 @@ def diffusion_step(
     diffusion_functions_elec.py:24-96 ``calc_diffusion_solar``; battery
     flows deferred to :func:`allocate_battery_adopters`)."""
     msly = state.market_share
-    teq = equivalent_time(msly, mms, bass_p, bass_q)
-    teq2 = teq + (teq_yr1 if is_first_year else year_step)
-    new_adopt_fraction = bass_new_adopt_fraction(bass_p, bass_q, teq2)
-
-    bass_ms = mms * new_adopt_fraction
-    # market-share floor vs last year (reference diffusion_functions_elec.py:75)
-    market_share = jnp.maximum(msly, bass_ms)
+    market_share = _bass_floored_share(
+        msly, mms, bass_p, bass_q, teq_yr1, is_first_year, year_step)
     new_ms = market_share - msly
     # zero the step where share already exceeds the (possibly shrunken)
     # max market share (reference diffusion_functions_elec.py:77)
@@ -157,6 +171,80 @@ def diffusion_step(
         system_kw_cum=state.system_kw_cum + new_system_kw,
         market_value=state.market_value + new_market_value,
     )
+
+
+def diffusion_step_tech_choice(
+    market_share_last: jax.Array,      # [N, T]
+    adopters_cum_last: jax.Array,      # [N, T]
+    capacity_cum_last: jax.Array,      # [N, T]
+    market_value_last: jax.Array,      # [N, T]
+    selected: jax.Array,               # [N, T] 1.0 for the chosen tech
+    mms: jax.Array,                    # [N, T]
+    system_kw: jax.Array,              # [N, T]
+    system_capex_per_kw: jax.Array,    # [N, T]
+    developable_agent_weight: jax.Array,  # [N]
+    bass_p: jax.Array,                 # [N, T]
+    bass_q: jax.Array,                 # [N, T]
+    teq_yr1: jax.Array,                # [N, T]
+    is_first_year: bool,
+    year_step: float = 2.0,
+) -> dict:
+    """The reference's legacy multi-technology diffusion solve
+    (``calc_diffusion``, diffusion_functions_elec.py:162-245 — the
+    wind-era tech-choice path its solar driver no longer calls, kept
+    here for the same multi-tech scenarios).  Agents carry one row per
+    candidate technology; ``selected`` marks this year's chosen option.
+
+    Semantics mirrored exactly:
+
+      * Bass share floored at last year's (elec.py:290 then :206);
+      * diffusion share zeroed for NON-selected techs (:203) — their
+        share holds at last year's via the floor;
+      * tech-choice cap: the selected tech's share is capped at
+        ``1 - sum(unselected shares)`` within the agent (:209-227), so
+        total share never exceeds 1;
+      * the new-share step zeroes where share exceeds the (possibly
+        shrunken) max market share (:230-231);
+      * adopters/capacity/value flows gated on a nonzero system size
+        (:234-236) and accumulated onto last year's (:239-241).
+
+    Returns the dict of [N, T] outputs plus the carry fields for the
+    next solve year (the reference's ``market_last_year`` frame).
+    """
+    sel = selected.astype(market_share_last.dtype)
+    diffusion_ms = _bass_floored_share(
+        market_share_last, mms, bass_p, bass_q, teq_yr1, is_first_year,
+        year_step)                                          # elec.py:290
+    diffusion_ms = diffusion_ms * sel                       # elec.py:203
+    market_share = jnp.maximum(diffusion_ms, market_share_last)
+
+    # cap the SELECTED tech at 1 - (sum of unselected shares) per agent
+    unselected_sum = jnp.sum(
+        market_share * (1.0 - sel), axis=1, keepdims=True
+    )
+    cap = 1.0 - unselected_sum
+    market_share = jnp.where(
+        sel > 0, jnp.minimum(market_share, cap), market_share
+    )
+
+    new_ms = market_share - market_share_last
+    new_ms = jnp.where(market_share > mms, 0.0, new_ms)
+
+    w = developable_agent_weight[:, None]
+    new_adopters = jnp.where(system_kw == 0.0, 0.0, new_ms * w)
+    new_capacity = new_adopters * system_kw
+    new_value = new_adopters * system_kw * system_capex_per_kw
+
+    return {
+        "market_share": market_share,
+        "new_market_share": new_ms,
+        "new_adopters": new_adopters,
+        "new_capacity_kw": new_capacity,
+        "new_market_value": new_value,
+        "number_of_adopters": adopters_cum_last + new_adopters,
+        "installed_capacity_kw": capacity_cum_last + new_capacity,
+        "market_value": market_value_last + new_value,
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -151,21 +151,13 @@ def main() -> None:
         )
     distributed = initialize_multihost()
 
-    import jax
-
     from dgen_tpu.utils import compilecache
 
-    if not (distributed and jax.default_backend() == "cpu"):
-        # multi-process CPU (gloo) runs must compile SYMMETRICALLY: a
-        # process that hits the persistent cache reaches the first
-        # collective while its peer is still compiling, the gloo
-        # context's fixed 30 s key-value rendezvous times out, and the
-        # coordination service kills the compiling peer (no jax knob
-        # raises that timeout). TPU multihost keeps the cache — its
-        # collectives rendezvous through the coordination service's
-        # own, much longer barriers.
-        compilecache.enable()
+    # no-op on multi-process CPU (gloo) backends — enable() itself
+    # refuses there; see its docstring for the rendezvous-timeout story
+    compilecache.enable()
 
+    import jax
     import jax.numpy as jnp
 
     from dgen_tpu.config import RunConfig, ScenarioConfig

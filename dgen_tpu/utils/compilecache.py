@@ -12,8 +12,10 @@ from long-lived worker pools (dgen_model.py keeps one pool per task,
 never paying per-run process start).
 
 Call :func:`enable` once per process before building simulations; it is
-idempotent and safe on any backend (CPU tests included — entries are
-keyed by backend so they never collide).  Knobs:
+idempotent, keys entries by backend (CPU test entries never collide
+with TPU ones), and refuses to engage on multi-process CPU (gloo)
+backends where asymmetric cache hits deadlock the first collective
+(see :func:`enable`).  Knobs:
 
   DGEN_TPU_CACHE_DIR   cache directory (default <repo>/.jax_cache;
                        "0"/"off" disables)
@@ -42,12 +44,29 @@ def cache_dir() -> Optional[str]:
 
 def enable() -> Optional[str]:
     """Turn on the persistent compilation cache; returns the directory
-    in use (None = disabled).  Idempotent."""
+    in use (None = disabled).  Idempotent.
+
+    Refuses on multi-process CPU (gloo) backends: processes there must
+    compile SYMMETRICALLY — one process hitting the cache reaches the
+    first collective while its peer is still compiling, gloo's fixed
+    30 s key-value rendezvous times out, and the coordination service
+    kills the peer (no jax knob raises that timeout).  TPU multihost
+    keeps the cache; its collectives rendezvous through the
+    coordination service's own, much longer barriers.  The probe only
+    touches the backend when jax.distributed is already initialized,
+    so import-time callers don't trigger backend bring-up."""
     global _enabled_dir
     d = cache_dir()
     if d is None or _enabled_dir == d:
         return _enabled_dir
     import jax
+
+    if (
+        jax.distributed.is_initialized()
+        and jax.process_count() > 1
+        and jax.default_backend() == "cpu"
+    ):
+        return None
 
     os.makedirs(d, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", d)

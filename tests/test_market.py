@@ -195,3 +195,82 @@ def test_anchor_zero_weight_gives_zero_share():
         kw_cum, jnp.zeros(1, jnp.int32), jnp.asarray([50.0], jnp.float32),
         jnp.ones(1, bool), jnp.zeros(1, jnp.float32), 1)
     assert float(a_sh[0]) == 0.0
+
+
+def _tech_choice_oracle(msl, adl, cpl, mvl, sel, mms, kw, capex, w,
+                        p, q, teq_yr1, first, year_step=2.0):
+    """Loop-based mirror of the reference's calc_diffusion tech-choice
+    path (diffusion_functions_elec.py:162-245) for one agent at a time."""
+    n, t = msl.shape
+    out_ms = np.zeros_like(msl)
+    new_ms = np.zeros_like(msl)
+    for i in range(n):
+        shares = np.zeros(t)
+        for j in range(t):
+            mms_fz = max(mms[i, j], 1e-9)
+            ratio = 0.0 if msl[i, j] > mms_fz else msl[i, j] / mms_fz
+            teq = np.log((1 - ratio) / (1 + ratio * q[i, j] / p[i, j])) / (
+                -(p[i, j] + q[i, j]))
+            teq2 = teq + (teq_yr1[i, j] if first else year_step)
+            f = np.exp(-(p[i, j] + q[i, j]) * teq2)
+            naf = (1 - f) / (1 + (q[i, j] / p[i, j]) * f)
+            bass = mms[i, j] * naf
+            diff = max(msl[i, j], bass) * sel[i, j]      # :290 then :203
+            shares[j] = max(diff, msl[i, j])             # :206
+        cap = 1.0 - shares[sel[i] == 0].sum()            # :209-227
+        for j in range(t):
+            if sel[i, j]:
+                shares[j] = min(shares[j], cap)
+        out_ms[i] = shares
+        for j in range(t):
+            ns = shares[j] - msl[i, j]
+            if shares[j] > mms[i, j]:                    # :230-231
+                ns = 0.0
+            new_ms[i, j] = ns
+    new_ad = np.where(kw == 0.0, 0.0, new_ms * w[:, None])
+    return out_ms, new_ms, new_ad
+
+
+def test_tech_choice_diffusion_matches_reference_semantics():
+    from dgen_tpu.models.market import diffusion_step_tech_choice
+
+    rng = np.random.default_rng(7)
+    n, t = 48, 3
+    msl = rng.uniform(0.0, 0.3, (n, t)).astype(np.float32)
+    mms = rng.uniform(0.2, 0.6, (n, t)).astype(np.float32)
+    sel = np.zeros((n, t), np.float32)
+    sel[np.arange(n), rng.integers(0, t, n)] = 1.0
+    kw = rng.uniform(0.0, 10.0, (n, t)).astype(np.float32)
+    kw[rng.random((n, t)) < 0.1] = 0.0          # some zero-size options
+    capex = rng.uniform(1000, 4000, (n, t)).astype(np.float32)
+    w = rng.uniform(10, 500, n).astype(np.float32)
+    p = rng.uniform(0.001, 0.01, (n, t)).astype(np.float32)
+    q = rng.uniform(0.3, 0.5, (n, t)).astype(np.float32)
+    teq1 = rng.uniform(0.0, 4.0, (n, t)).astype(np.float32)
+    adl = rng.uniform(0, 50, (n, t)).astype(np.float32)
+    cpl = rng.uniform(0, 500, (n, t)).astype(np.float32)
+    mvl = rng.uniform(0, 5e5, (n, t)).astype(np.float32)
+
+    for first in (True, False):
+        out = diffusion_step_tech_choice(
+            *(jnp.asarray(x) for x in (msl, adl, cpl, mvl, sel, mms, kw,
+                                       capex, w, p, q, teq1)),
+            is_first_year=first,
+        )
+        o_ms, o_new, o_ad = _tech_choice_oracle(
+            msl, adl, cpl, mvl, sel, mms, kw, capex, w, p, q, teq1, first)
+        np.testing.assert_allclose(
+            np.asarray(out["market_share"]), o_ms, rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(out["new_market_share"]), o_new, rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(out["new_adopters"]), o_ad, rtol=2e-5, atol=1e-3)
+        # tech-choice invariant: total share per agent never exceeds 1
+        assert np.asarray(out["market_share"]).sum(axis=1).max() <= 1.0 + 1e-5
+        # unselected techs hold last year's share exactly
+        held = np.asarray(out["market_share"])[sel == 0]
+        np.testing.assert_allclose(held, msl[sel == 0], rtol=1e-6)
+        # cumulative accounting
+        np.testing.assert_allclose(
+            np.asarray(out["number_of_adopters"]),
+            adl + np.asarray(out["new_adopters"]), rtol=1e-6)

@@ -111,6 +111,12 @@ def initialize_multihost(
         num_processes=num_processes,
         process_id=process_id,
     )
+    # an import-time compilecache.enable() (bench, conftest, graft
+    # entry) could not see the backend yet; re-check the gloo refusal
+    # now that process_count/backend are known
+    from dgen_tpu.utils import compilecache
+
+    compilecache.ensure_safe_for_backend()
     return True
 
 

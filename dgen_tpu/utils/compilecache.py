@@ -78,6 +78,38 @@ def enable() -> Optional[str]:
     return d
 
 
+def disable() -> None:
+    """Turn the persistent cache back off for this process (clears the
+    jax config; on-disk entries are untouched)."""
+    global _enabled_dir
+    if _enabled_dir is None:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    _enabled_dir = None
+
+
+def ensure_safe_for_backend() -> None:
+    """Re-check the gloo refusal AFTER distributed bring-up.
+
+    :func:`enable` can only refuse when jax.distributed is already
+    initialized, but several entry points enable the cache at module
+    import — before any ``initialize()``.  Call this right after
+    ``jax.distributed.initialize`` (``parallel.launch`` does) to
+    disable a cache that import-time enabling armed on a
+    multi-process CPU (gloo) backend."""
+    import jax
+
+    if (
+        _enabled_dir is not None
+        and jax.distributed.is_initialized()
+        and jax.process_count() > 1
+        and jax.default_backend() == "cpu"
+    ):
+        disable()
+
+
 def stats() -> dict:
     """Entry count / bytes of the active cache (for meta.json stamps)."""
     d = _enabled_dir or cache_dir()

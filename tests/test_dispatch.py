@@ -111,3 +111,43 @@ def test_batt_size_from_pv_reference_ratios():
     kw, kwh = dp.batt_size_from_pv(jnp.float32(8.0))
     assert float(kwh) == pytest.approx(10.0)   # 8 / 0.8
     assert float(kw) == pytest.approx(5.0)     # 10 / 2
+
+
+def test_pscan_matches_sequential_scan():
+    """The saturating-accumulator parallel-prefix engine (kept as a
+    measured negative result; "scan" is the default) must reproduce
+    the sequential 8760-step scan up to f32 regrouping: same SOC
+    path, flows, and meter output."""
+    rng = np.random.default_rng(3)
+    n = 16
+    load = rng.uniform(0.1, 4.0, (n, 8760)).astype(np.float32)
+    gen = (rng.uniform(0.0, 1.2, (n, 8760))
+           * (rng.random((n, 8760)) > 0.4)).astype(np.float32)
+    kw = rng.uniform(0.0, 4.0, n).astype(np.float32)
+    kwh = kw * 2.0
+    kwh[0] = 0.0   # no-battery edge: both engines must emit zero flows
+    kw[0] = 0.0
+    eff = rng.uniform(0.85, 0.95, n).astype(np.float32)
+
+    ps = jax.vmap(
+        lambda l, g, p, e, f: dp.dispatch_battery(l, g, p, e, f,
+                                                  impl="pscan")
+    )(*map(jnp.asarray, (load, gen, kw, kwh, eff)))
+    sq = jax.vmap(
+        lambda l, g, p, e, f: dp.dispatch_battery(l, g, p, e, f,
+                                                  impl="scan")
+    )(*map(jnp.asarray, (load, gen, kw, kwh, eff)))
+
+    np.testing.assert_allclose(
+        np.asarray(ps.soc), np.asarray(sq.soc), rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ps.charge), np.asarray(sq.charge), rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ps.discharge), np.asarray(sq.discharge),
+        rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(ps.system_out), np.asarray(sq.system_out),
+        rtol=1e-5, atol=2e-4)
+    # zero-battery row: no flows at all
+    assert np.abs(np.asarray(ps.charge)[0]).max() == 0.0
+    assert np.abs(np.asarray(ps.discharge)[0]).max() == 0.0

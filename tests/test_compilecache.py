@@ -1,0 +1,78 @@
+"""Persistent compile-cache wiring: enable/disable lifecycle, the
+multi-process CPU (gloo) refusal, and stats tolerance."""
+
+import os
+
+import pytest
+
+from dgen_tpu.utils import compilecache as cc
+
+
+@pytest.fixture(autouse=True)
+def _restore_state(tmp_path, monkeypatch):
+    """Isolate each test: point the cache at a temp dir and restore the
+    module/global jax config afterwards."""
+    monkeypatch.setenv("DGEN_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    prev = cc._enabled_dir
+    cc.disable()   # conftest enables the session cache; start clean
+    yield
+    cc.disable()
+    if prev is not None:
+        # restore the session cache the conftest set up
+        os.environ["DGEN_TPU_CACHE_DIR"] = prev
+        cc.enable()
+
+
+def test_enable_disable_roundtrip(tmp_path):
+    import jax
+
+    d = cc.enable()
+    assert d == str(tmp_path / "cache")
+    assert os.path.isdir(d)
+    assert jax.config.jax_compilation_cache_dir == d
+    assert cc.enable() == d   # idempotent
+    cc.disable()
+    assert jax.config.jax_compilation_cache_dir is None
+    assert cc._enabled_dir is None
+
+
+def test_env_disables(monkeypatch):
+    monkeypatch.setenv("DGEN_TPU_CACHE_DIR", "off")
+    assert cc.cache_dir() is None
+    assert cc.enable() is None
+
+
+def test_refuses_multiprocess_cpu(monkeypatch):
+    """enable() must refuse when jax.distributed reports a multi-process
+    CPU backend (the gloo rendezvous deadlock), and
+    ensure_safe_for_backend() must revoke an import-time enable once
+    the backend is known."""
+    import jax
+
+    # import-time enable: distributed not initialized -> engages
+    d = cc.enable()
+    assert d is not None
+
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+
+    # post-init re-check revokes it
+    cc.ensure_safe_for_backend()
+    assert cc._enabled_dir is None
+    assert jax.config.jax_compilation_cache_dir is None
+
+    # and a fresh enable() under the same conditions refuses outright
+    assert cc.enable() is None
+
+    # TPU multihost keeps the cache
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert cc.enable() is not None
+
+
+def test_stats_counts_entries(tmp_path):
+    d = cc.enable()
+    with open(os.path.join(d, "entry-a"), "wb") as f:
+        f.write(b"x" * 10)
+    s = cc.stats()
+    assert s["entries"] == 1 and s["bytes"] == 10

@@ -192,9 +192,13 @@ def _time_steps(sim, n_rep: int = 3) -> float:
     best = float("inf")
     eps = _pert_eps()
     for i in range(n_rep):
+        # year_step donates the carry (dgenlint L7): hand each rep a
+        # fresh copy so the donated buffers are never the loop's shared
+        # `carry` leaves (the copy happens before t0, untimed)
+        pert = jax.tree.map(jnp.copy, carry)
         pert = dc.replace(
-            carry,
-            batt_adopters_cum=carry.batt_adopters_cum + (i + 1) * eps,
+            pert,
+            batt_adopters_cum=pert.batt_adopters_cum + (i + 1) * eps,
         )
         t0 = time.time()
         _, out = sim.step(pert, 1, first_year=False)
@@ -318,7 +322,11 @@ def _cpu_baseline(sim, pop) -> float:
                 jnp.asarray(1, dtype=jnp.int32))
         kw = sim._step_kwargs(first_year=False)
         kw["sizing_impl"] = "xla"  # Pallas kernel is TPU-only
-        out = year_step(*args, **kw)   # compile
+        # year_step donates the carry (dgenlint L7): every invocation
+        # gets its own copy so carry1's buffers survive for the reps
+        compile_args = list(args)
+        compile_args[4] = jax.tree.map(jnp.copy, carry1)
+        out = year_step(*compile_args, **kw)   # compile
         jax.block_until_ready(out)
         n_rep = 8
         # build distinct inputs OUTSIDE the timed region (identical
@@ -328,9 +336,10 @@ def _cpu_baseline(sim, pop) -> float:
         perturbed = []
         eps = _pert_eps()
         for i in range(n_rep):
+            c_i = jax.tree.map(jnp.copy, carry1)
             c_i = dc.replace(
-                carry1,
-                batt_adopters_cum=carry1.batt_adopters_cum + (i + 1) * eps,
+                c_i,
+                batt_adopters_cum=c_i.batt_adopters_cum + (i + 1) * eps,
             )
             a = list(args)
             a[4] = c_i

@@ -102,6 +102,12 @@ class RunConfig:
     #: run the invariant harness every year step (utils.invariants —
     #: the reference's run_with_runtime_tests analogue; host sync cost)
     debug_invariants: bool = False
+    #: arm the steady-state retrace guard (lint.guard.RetraceGuard):
+    #: once the first two executed years have compiled the
+    #: first_year=True/False program pair, any FRESH XLA compile or
+    #: jaxpr trace in a later year fails the run — retrace storms
+    #: surface as errors at year 3, not as a 10x wall-time report
+    guard_retrace: bool = False
     seed: int = 0
 
     def __post_init__(self) -> None:
@@ -117,7 +123,12 @@ class RunConfig:
         if "agent_chunk" not in overrides and \
                 os.environ.get("DGEN_TPU_AGENT_CHUNK"):
             overrides["agent_chunk"] = int(os.environ["DGEN_TPU_AGENT_CHUNK"])
-        if "debug_invariants" not in overrides and \
-                os.environ.get("DGEN_TPU_DEBUG"):
+        # "0"/"false" mean OFF (same convention as DGEN_TPU_TESTS)
+        def flag(name: str) -> bool:
+            return os.environ.get(name, "") not in ("", "0", "false")
+
+        if "debug_invariants" not in overrides and flag("DGEN_TPU_DEBUG"):
             overrides["debug_invariants"] = True
+        if "guard_retrace" not in overrides and flag("DGEN_TPU_GUARD"):
+            overrides["guard_retrace"] = True
         return cls(**overrides)

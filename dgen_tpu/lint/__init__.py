@@ -1,0 +1,88 @@
+"""dgenlint: JAX/TPU anti-pattern linter + recompilation guard.
+
+Static half (no jax import, safe anywhere):
+
+    python -m dgen_tpu.lint                # lint the dgen_tpu package
+    python -m dgen_tpu.lint path/ file.py  # lint specific paths
+
+or programmatically::
+
+    from dgen_tpu import lint
+    findings = lint.lint_paths(["dgen_tpu"])      # [] when clean
+    findings = lint.lint_source(src)              # one snippet
+
+Runtime half: :class:`dgen_tpu.lint.guard.RetraceGuard` counts fresh
+XLA compiles per simulation year and fails when a steady-state year
+retraces (imported lazily — the static linter must not initialize a
+backend just to parse files).
+
+Rules are documented in ``docs/lint.md``; suppress a finding with
+``# dgenlint: disable=<rule>`` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional
+
+from dgen_tpu.lint.core import (  # noqa: F401  (public API)
+    Finding,
+    ModuleInfo,
+    ProjectIndex,
+    parse_file,
+    parse_source,
+)
+from dgen_tpu.lint.rules import RULES, run_rules  # noqa: F401
+
+#: the default lint target: the dgen_tpu package itself
+PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def collect_files(paths: Iterable[str]) -> List[str]:
+    """Expand files/directories into a sorted .py file list."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", ".jax_cache")
+                ]
+                out.extend(
+                    os.path.join(dirpath, f)
+                    for f in filenames if f.endswith(".py")
+                )
+        else:
+            out.append(p)
+    return sorted(set(out))
+
+
+def lint_paths(
+    paths: Optional[Iterable[str]] = None,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Lint files/directories (default: the dgen_tpu package).
+
+    The reachability index always includes the whole package so that
+    cross-module jit edges resolve even when only a subset is linted.
+    """
+    targets = collect_files(paths if paths is not None else [PACKAGE_ROOT])
+    index_files = sorted(set(targets) | set(collect_files([PACKAGE_ROOT])))
+    by_path = {}
+    for f in index_files:
+        by_path[os.path.abspath(f)] = parse_file(f)
+    index = ProjectIndex(by_path.values())
+    lint_mods = [by_path[os.path.abspath(f)] for f in targets]
+    return run_rules(index, modules=lint_mods, select=select)
+
+
+def lint_source(
+    src: str,
+    filename: str = "<snippet>",
+    modname: str = "snippet",
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Lint one source string (unit tests / fixtures). ``modname``
+    controls which layering rules apply (e.g. ``dgen_tpu.ops.bad``)."""
+    m = parse_source(src, filename=filename, modname=modname)
+    return run_rules(ProjectIndex([m]), select=select)

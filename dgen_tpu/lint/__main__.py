@@ -1,0 +1,76 @@
+"""CLI: ``python -m dgen_tpu.lint [paths...]``.
+
+Exit status: 0 clean, 1 findings, 2 usage error. ``--json`` emits a
+machine-readable finding list (one object per finding); the default
+text format is ``path:line: RULE message``, one per line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dgen_tpu.lint import PACKAGE_ROOT, RULES, lint_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dgen_tpu.lint",
+        description="dgenlint: JAX/TPU anti-pattern linter "
+                    "(rules documented in docs/lint.md)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help=f"files/directories to lint (default: {PACKAGE_ROOT})",
+    )
+    ap.add_argument(
+        "--select", metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="emit findings as JSON",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule ids and summaries, then exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, (summary, _impl) in RULES.items():
+            print(f"{rule_id}  {summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+    try:
+        findings = lint_paths(args.paths or None, select=select)
+    except (ValueError, OSError, SyntaxError) as e:
+        print(f"dgenlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(
+            [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in findings
+            ],
+            indent=1,
+        ))
+    else:
+        for f in findings:
+            print(f)
+        n = len(findings)
+        print(
+            f"dgenlint: {n} finding{'s' if n != 1 else ''}"
+            if n else "dgenlint: clean",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,359 @@
+"""dgenlint core: module indexing, jit-reachability, suppression.
+
+The rules in :mod:`dgen_tpu.lint.rules` need to know which functions
+can execute *inside an XLA trace* — a host sync (``.item()``,
+``np.asarray``) is an anti-pattern only there, while the same call in
+the tariff compiler or the run driver is correct host code. This module
+builds that context once per lint run:
+
+  * every ``.py`` file is parsed into a :class:`ModuleInfo` (functions,
+    import aliases, per-line suppressions, resolved module name);
+  * jit ROOTS are functions decorated with ``jax.jit`` (bare, called,
+    or via ``partial(jax.jit, ...)``) plus module-level
+    ``f = jax.jit(g)`` wrappings;
+  * a cross-module call graph is built from dotted call targets and
+    bare function references passed as arguments (covers ``lax.scan``
+    bodies, ``vmap`` targets, ``pallas_call`` kernels and ``partial``
+    closures), and reachability is the BFS closure from the roots.
+    Nested functions of a reachable function are reachable.
+
+The call graph is an over-approximation (a function *referenced* from
+jitted code counts as jit-reachable) — for a linter that errs on the
+strict side, which is the useful direction.
+
+Suppression: append ``# dgenlint: disable=L1`` (comma-separate several
+rule ids, or ``all``) to the flagged line; a file-wide opt-out is
+``# dgenlint: disable-file=L3`` on its own line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*dgenlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*dgenlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, printable as ``path:line: RULE message``."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    d = dotted(node)
+    return d is not None and (d == "jit" or d.endswith(".jit"))
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit``, ``@jax.jit(...)``, ``@partial(jax.jit, ...)``."""
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            return True
+        d = dotted(dec.func)
+        if d in ("partial", "functools.partial") and dec.args:
+            return _is_jit_expr(dec.args[0])
+    return False
+
+
+def jit_decorator_call(func: ast.AST) -> Optional[ast.Call]:
+    """The Call form of a jit decorator (None for bare ``@jax.jit``)."""
+    for dec in getattr(func, "decorator_list", ()):
+        if isinstance(dec, ast.Call) and is_jit_decorator(dec):
+            return dec
+    return None
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function (or method / nested function) definition."""
+
+    qualname: str                  # "year_step", "Cls.meth", "f.inner"
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef
+    module: "ModuleInfo"
+    parent: Optional["FuncInfo"]
+    class_name: Optional[str]      # enclosing class, for self.* edges
+    is_jit_root: bool = False
+    calls: Set[str] = dataclasses.field(default_factory=set)
+
+    @property
+    def fullname(self) -> str:
+        return f"{self.module.modname}.{self.qualname}"
+
+
+class ModuleInfo:
+    """Parsed view of one source file."""
+
+    def __init__(self, path: str, modname: str, source: str) -> None:
+        self.path = path
+        self.modname = modname
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.is_package = os.path.basename(path) == "__init__.py"
+        self.imports: Dict[str, str] = {}      # alias -> dotted target
+        self.import_nodes: List[Tuple[int, str]] = []  # (line, module)
+        self.functions: List[FuncInfo] = []
+        self.constants: Dict[str, int] = {}    # module-level int consts
+        self.suppressed: Dict[int, Set[str]] = {}
+        self.file_suppressed: Set[str] = set()
+        self._scan_suppressions()
+        _Indexer(self).visit(self.tree)
+        self._fold_constants()
+
+    # -- suppressions ---------------------------------------------------
+    def _scan_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self.suppressed.setdefault(i, set()).update(rules)
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self.file_suppressed.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressed or "all" in self.file_suppressed:
+            return True
+        at = self.suppressed.get(line, ())
+        return rule in at or "all" in at
+
+    # -- tiny constant folder (for Pallas block shapes) -----------------
+    def _fold_constants(self) -> None:
+        for node in self.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                val = self.const_value(node.value)
+                if val is not None:
+                    self.constants[node.targets[0].id] = val
+
+    def const_value(self, node: ast.AST) -> Optional[int]:
+        """Evaluate int constants / module constant names / + - * //."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.constants.get(node.id)
+        if isinstance(node, ast.BinOp):
+            left = self.const_value(node.left)
+            right = self.const_value(node.right)
+            if left is None or right is None:
+                return None
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv) and right != 0:
+                return left // right
+        return None
+
+
+class _Indexer(ast.NodeVisitor):
+    """One pass: imports, functions, jit roots, call edges."""
+
+    def __init__(self, module: ModuleInfo) -> None:
+        self.m = module
+        self.func_stack: List[FuncInfo] = []
+        self.class_stack: List[str] = []
+
+    # -- imports --------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.m.imports[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+            self.m.import_nodes.append((node.lineno, a.name))
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        base = node.module or ""
+        if node.level:  # relative: resolve against this module's package
+            # a package __init__'s own package IS its modname; a plain
+            # module's package drops the final segment first
+            drop = node.level - 1 if self.m.is_package else node.level
+            pkg_parts = self.m.modname.split(".")
+            if drop:
+                pkg_parts = pkg_parts[:-drop]
+            base = ".".join(pkg_parts + ([node.module] if node.module else []))
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.m.imports[a.asname or a.name] = f"{base}.{a.name}"
+            self.m.import_nodes.append((node.lineno, f"{base}.{a.name}"))
+        self.generic_visit(node)
+
+    # -- scopes ---------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        parent = self.func_stack[-1] if self.func_stack else None
+        prefix = (
+            f"{parent.qualname}." if parent
+            else (f"{self.class_stack[-1]}." if self.class_stack else "")
+        )
+        info = FuncInfo(
+            qualname=f"{prefix}{node.name}",
+            node=node,
+            module=self.m,
+            parent=parent,
+            class_name=self.class_stack[-1] if self.class_stack else None,
+            is_jit_root=any(
+                is_jit_decorator(d) for d in node.decorator_list
+            ),
+        )
+        self.m.functions.append(info)
+        self.func_stack.append(info)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- call edges -----------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.func_stack:
+            owner = self.func_stack[-1]
+            d = dotted(node.func)
+            if d:
+                owner.calls.add(d)
+            # bare function references passed as arguments: scan/vmap
+            # bodies, pallas kernels, partial closures
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                ref = dotted(arg)
+                if ref:
+                    owner.calls.add(ref)
+        else:
+            # module level: f = jax.jit(g) marks g as a root
+            if _is_jit_expr(node.func) and node.args:
+                ref = dotted(node.args[0])
+                if ref:
+                    for fn in self.m.functions:
+                        if fn.qualname == ref:
+                            fn.is_jit_root = True
+        self.generic_visit(node)
+
+
+class ProjectIndex:
+    """All modules plus the jit-reachability closure."""
+
+    def __init__(self, modules: Iterable[ModuleInfo]) -> None:
+        self.modules: List[ModuleInfo] = list(modules)
+        self.functions: Dict[str, FuncInfo] = {}
+        for m in self.modules:
+            for fn in m.functions:
+                self.functions[fn.fullname] = fn
+        self.reachable: Set[str] = set()
+        self._close_reachability()
+
+    # -- edge resolution ------------------------------------------------
+    def _resolve(self, caller: FuncInfo, target: str) -> List[FuncInfo]:
+        m = caller.module
+        head, _, rest = target.partition(".")
+        out: List[str] = []
+        if head == "self" and caller.class_name and rest:
+            out.append(f"{m.modname}.{caller.class_name}.{rest}")
+        elif head in m.imports:
+            base = m.imports[head]
+            out.append(f"{base}.{rest}" if rest else base)
+        elif not rest:
+            # bare name: sibling module function (any nesting level) or
+            # a local function in an enclosing scope
+            out.append(f"{m.modname}.{target}")
+            scope = caller
+            while scope is not None:
+                out.append(f"{m.modname}.{scope.qualname}.{target}")
+                scope = scope.parent
+        return [self.functions[n] for n in out if n in self.functions]
+
+    def _close_reachability(self) -> None:
+        work = [fn for fn in self.functions.values() if fn.is_jit_root]
+        while work:
+            fn = work.pop()
+            if fn.fullname in self.reachable:
+                continue
+            self.reachable.add(fn.fullname)
+            # nested defs run inside the same trace
+            prefix = fn.qualname + "."
+            for other in fn.module.functions:
+                if other.qualname.startswith(prefix):
+                    work.append(other)
+            for target in fn.calls:
+                work.extend(self._resolve(fn, target))
+
+    def is_reachable(self, fn: FuncInfo) -> bool:
+        return fn.fullname in self.reachable
+
+    def reachable_in(self, module: ModuleInfo) -> List[FuncInfo]:
+        return [fn for fn in module.functions if self.is_reachable(fn)]
+
+
+def walk_own_body(fn: FuncInfo) -> Iterable[ast.AST]:
+    """Walk a function's body without descending into nested function
+    or class definitions (those have their own FuncInfo); lambdas are
+    walked as part of the enclosing function."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn.node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name, walking up while ``__init__.py`` exists."""
+    path = os.path.abspath(path)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if stem == "__init__" else [stem]
+    d = os.path.dirname(path)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        d = os.path.dirname(d)
+    return ".".join(parts) if parts else stem
+
+
+def parse_file(path: str) -> ModuleInfo:
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return ModuleInfo(path, module_name_for(path), src)
+
+
+def parse_source(src: str, filename: str = "<snippet>",
+                 modname: str = "snippet") -> ModuleInfo:
+    return ModuleInfo(filename, modname, src)

@@ -1,0 +1,166 @@
+"""Runtime retrace / transfer guard — the dynamic half of dgenlint.
+
+The whole performance design of dgen-tpu is ONE compiled XLA program
+per model year: a steady-state year that triggers a fresh compile means
+a static argument is churning (a python float sneaking into
+``static_argnames``, a shape changing with data, a host branch on a
+traced value) and the 10-minute national-run budget is silently gone —
+80-170 s per recompile on the TPU backend. The linter's static rules
+catch the code shapes that cause this; :class:`RetraceGuard` catches
+the fact itself, cheaply enough to stay on in tests.
+
+Counting uses ``jax.monitoring`` duration events:
+
+  * ``.../backend_compile_duration`` — one per fresh XLA compilation
+    (persistent-cache hits do NOT fire it);
+  * ``.../jaxpr_trace_duration``    — one per jaxpr trace (fires even
+    when the persistent cache then serves the executable, so it also
+    catches retrace storms hidden by a warm on-disk cache).
+
+A steady-state simulation year must produce ZERO of both. Device-to-
+host transfer policing rides along via ``jax.transfer_guard`` when
+requested (effective on accelerator backends; the CPU test platform
+does not model host transfers).
+
+Usage::
+
+    with RetraceGuard(context="year 2040") as g:
+        carry, outs = sim.step(carry, yi, first_year=False)
+    # raises RetraceError on exit if anything compiled
+
+or imperative (the Simulation.run wiring)::
+
+    g = RetraceGuard().start()
+    ...per year: g.check(f"year {year}")...
+    g.stop()
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+
+class RetraceError(AssertionError):
+    """A guarded region compiled or traced when it must not have."""
+
+
+class RetraceGuard:
+    """Counts fresh XLA compiles / jaxpr traces while active and fails
+    when a guarded region exceeds its budget (default: zero of both).
+
+    Parameters
+    ----------
+    max_compiles : compile budget inside the guarded region (0 = any
+        fresh XLA compilation fails).
+    max_traces : trace budget; None disables trace enforcement (traces
+        are still counted and reported).
+    d2h : optional ``jax.transfer_guard_device_to_host`` level to apply
+        while active (e.g. ``"disallow"`` or ``"log"``); None leaves
+        the transfer policy untouched.
+    context : label prefixed to failure messages.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_compiles: int = 0,
+        max_traces: Optional[int] = 0,
+        d2h: Optional[str] = None,
+        context: str = "",
+    ) -> None:
+        self.max_compiles = max_compiles
+        self.max_traces = max_traces
+        self.d2h = d2h
+        self.context = context
+        self.n_compiles = 0
+        self.n_traces = 0
+        self._active = False
+        self._stack: Optional[contextlib.ExitStack] = None
+
+    # -- counting -------------------------------------------------------
+    def _on_duration(self, event: str, duration, **kwargs) -> None:
+        if not self._active:
+            return
+        if event == _COMPILE_EVENT:
+            self.n_compiles += 1
+        elif event == _TRACE_EVENT:
+            self.n_traces += 1
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "RetraceGuard":
+        if self._active:
+            return self
+        import jax
+        from jax._src import monitoring
+
+        monitoring.register_event_duration_secs_listener(self._on_duration)
+        self._active = True
+        self._stack = contextlib.ExitStack()
+        if self.d2h is not None:
+            self._stack.enter_context(
+                jax.transfer_guard_device_to_host(self.d2h)
+            )
+        return self
+
+    def stop(self) -> None:
+        """Stop counting without checking (failure paths)."""
+        if not self._active:
+            return
+        self._active = False
+        if self._stack is not None:
+            self._stack.close()
+            self._stack = None
+        from jax._src import monitoring
+
+        try:
+            monitoring._unregister_event_duration_listener_by_callback(
+                self._on_duration
+            )
+        except (AttributeError, ValueError):  # pragma: no cover
+            pass  # listener stays registered but self._active gates it
+
+    def reset(self) -> None:
+        self.n_compiles = 0
+        self.n_traces = 0
+
+    # -- enforcement ----------------------------------------------------
+    def check(self, context: str = "") -> None:
+        """Raise :class:`RetraceError` if the budget is exceeded; on
+        success resets the counters so per-year checks compose."""
+        label = ": ".join(x for x in (self.context, context) if x)
+        if self.n_compiles > self.max_compiles:
+            n = self.n_compiles
+            self.stop()
+            raise RetraceError(
+                f"{label}: {n} fresh XLA compilation(s) in a guarded "
+                f"steady-state region (budget {self.max_compiles}) — a "
+                "static argument or shape is churning per step; rerun "
+                "with JAX_LOG_COMPILES=1 to see which program"
+            )
+        if self.max_traces is not None and self.n_traces > self.max_traces:
+            n = self.n_traces
+            self.stop()
+            raise RetraceError(
+                f"{label}: {n} fresh jaxpr trace(s) in a guarded "
+                f"steady-state region (budget {self.max_traces}) — the "
+                "jit cache is missing (possibly masked by the persistent "
+                "compile cache); rerun with JAX_LOG_COMPILES=1"
+            )
+        self.reset()
+
+    # -- context manager -------------------------------------------------
+    def __enter__(self) -> "RetraceGuard":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            try:
+                self.check()
+            finally:
+                self.stop()
+        else:
+            self.stop()

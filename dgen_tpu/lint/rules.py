@@ -1,0 +1,444 @@
+"""dgenlint rules L1-L8: JAX/TPU anti-patterns for the dgen-tpu stack.
+
+Every rule is a generator ``rule(module, index) -> (line, message)``;
+:func:`run_rules` applies suppressions and wraps results in
+:class:`~dgen_tpu.lint.core.Finding`. The rule ids, what they catch and
+why each matters on TPU are documented operator-facing in
+``docs/lint.md`` — keep the two in sync.
+
+Scope notes:
+
+  * L1/L2/L4/L8 only fire inside jit-REACHABLE functions (see
+    core.ProjectIndex): the same ``np.asarray`` that silently syncs a
+    traced value is correct in the host-side tariff compiler.
+  * ``int()`` is deliberately NOT a host-sync trigger: trace-time shape
+    arithmetic (``int(mesh.devices.size)``) is pervasive and legal.
+  * L5/L6/L7 are structural and fire anywhere in the file.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+from dgen_tpu.lint.core import (
+    Finding,
+    FuncInfo,
+    ModuleInfo,
+    ProjectIndex,
+    dotted,
+    jit_decorator_call,
+    is_jit_decorator,
+    walk_own_body,
+)
+
+RuleHit = Tuple[int, str]
+
+_JNP = "jax.numpy."
+_NP = "numpy."
+
+#: jnp constructors whose shape argument must be trace-static
+_SHAPE_CTORS = {
+    "zeros": (0,), "ones": (0,), "empty": (0,), "full": (0,),
+    "arange": (0, 1, 2), "linspace": (0, 1, 2), "eye": (0, 1),
+}
+
+#: reductions whose result is a traced scalar/array — a shape built
+#: from one of these is data-dependent
+_REDUCTION_METHODS = {
+    "sum", "max", "min", "prod", "mean", "count_nonzero", "item",
+    "argmax", "argmin", "nonzero",
+}
+
+
+def _resolve(m: ModuleInfo, d: Optional[str]) -> Optional[str]:
+    """Expand the leading import alias of a dotted name
+    (``np.asarray`` -> ``numpy.asarray``)."""
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    base = m.imports.get(head)
+    if base is None:
+        return d
+    return f"{base}.{rest}" if rest else base
+
+
+def _reachable_nodes(
+    m: ModuleInfo, index: ProjectIndex
+) -> Iterator[Tuple[FuncInfo, ast.AST]]:
+    for fn in index.reachable_in(m):
+        for node in walk_own_body(fn):
+            yield fn, node
+
+
+# ---------------------------------------------------------------------------
+# L1 — host syncs on traced values
+# ---------------------------------------------------------------------------
+
+_L1_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.any", "numpy.all",
+    "jax.device_get",
+}
+
+
+def rule_l1(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """Host-sync calls in jit-reachable code: ``float()/bool()`` on
+    non-literals, ``.item()/.tolist()``, ``np.asarray/np.array``,
+    ``jax.device_get``."""
+    for _fn, node in _reachable_nodes(m, index):
+        if not isinstance(node, ast.Call):
+            continue
+        r = _resolve(m, dotted(node.func))
+        if r in _L1_CALLS:
+            yield node.lineno, (
+                f"`{dotted(node.func)}` in jit-reachable code forces a "
+                "device sync / host round-trip on traced values"
+            )
+            continue
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "bool")
+            and len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            yield node.lineno, (
+                f"`{node.func.id}()` on a non-literal in jit-reachable "
+                "code blocks on the device value (ConcretizationTypeError "
+                "under trace, silent sync outside)"
+            )
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("item", "tolist")
+            and not node.args
+        ):
+            yield node.lineno, (
+                f"`.{node.func.attr}()` in jit-reachable code transfers "
+                "device values to host"
+            )
+
+
+# ---------------------------------------------------------------------------
+# L2 — Python control flow on array values
+# ---------------------------------------------------------------------------
+
+def _arrayish_test(m: ModuleInfo, expr: ast.AST) -> Optional[ast.AST]:
+    """A subexpression that evaluates to a traced array in boolean
+    position: jnp/lax calls, ``.any()``/``.all()`` method calls."""
+    for n in ast.walk(expr):
+        if not isinstance(n, ast.Call):
+            continue
+        r = _resolve(m, dotted(n.func))
+        if r and (r.startswith(_JNP) or r.startswith("jax.lax.")):
+            return n
+        if (
+            isinstance(n.func, ast.Attribute)
+            and n.func.attr in ("any", "all")
+            and not n.args
+        ):
+            return n
+    return None
+
+
+def rule_l2(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """``if``/``while``/``assert`` on array values in jit-reachable
+    code — needs ``lax.cond``/``lax.select``/``jnp.where``."""
+    for _fn, node in _reachable_nodes(m, index):
+        if isinstance(node, (ast.If, ast.While)):
+            hit = _arrayish_test(m, node.test)
+            kind = "if" if isinstance(node, ast.If) else "while"
+            if hit is not None:
+                yield node.lineno, (
+                    f"Python `{kind}` on an array value retraces or "
+                    "fails under jit; use lax.cond/lax.select/jnp.where"
+                )
+        elif isinstance(node, ast.Assert):
+            hit = _arrayish_test(m, node.test)
+            if hit is not None:
+                yield node.lineno, (
+                    "`assert` on an array value syncs (or breaks) under "
+                    "jit; use checkify or a host-side invariant check"
+                )
+
+
+# ---------------------------------------------------------------------------
+# L3 — dtype hygiene (float64 must not reach the device)
+# ---------------------------------------------------------------------------
+
+_F64 = ("numpy.float64", "jax.numpy.float64")
+
+
+def _is_f64_expr(m: ModuleInfo, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    if isinstance(node, ast.Name) and node.id == "float":
+        return True  # python float == f64 as a dtype
+    return _resolve(m, dotted(node)) in _F64
+
+
+def rule_l3(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """float64 in the device path: any f64 mention in jit-reachable
+    code, or an explicit f64 dtype on a jnp array constructor anywhere
+    (doubles the HBM agent-table footprint and falls off the VPU fast
+    path)."""
+    reported = set()   # lines already flagged (one finding per line)
+    for _fn, node in _reachable_nodes(m, index):
+        if _is_f64_expr(m, node) and not isinstance(node, ast.Name):
+            if node.lineno not in reported:
+                reported.add(node.lineno)
+                yield node.lineno, (
+                    "float64 in jit-reachable code widens traced values "
+                    "(f64 is unsupported/slow on TPU; keep the device "
+                    "path f32)"
+                )
+        elif isinstance(node, ast.keyword) and node.arg == "dtype" \
+                and _is_f64_expr(m, node.value):
+            if node.value.lineno not in reported:
+                reported.add(node.value.lineno)
+                spelled = (
+                    "python `float` as a dtype means f64"
+                    if isinstance(node.value, ast.Name)
+                    else "keep the device path f32"
+                )
+                yield node.value.lineno, (
+                    f"dtype=float64 in jit-reachable code ({spelled})"
+                )
+    # anywhere: an explicitly-f64 jnp array is f64 *on device*
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        r = _resolve(m, dotted(node.func))
+        if not (r and r.startswith(_JNP)):
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "dtype" and _is_f64_expr(m, kw.value)
+                and node.lineno not in reported
+            ):
+                reported.add(node.lineno)
+                yield node.lineno, (
+                    "explicit float64 dtype on a jnp array doubles HBM "
+                    "for that buffer and breaks the f32 agent-table "
+                    "contract"
+                )
+
+
+# ---------------------------------------------------------------------------
+# L4 — data-dependent array construction inside jitted bodies
+# ---------------------------------------------------------------------------
+
+def _data_dependent(m: ModuleInfo, expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            r = _resolve(m, dotted(n.func))
+            if r and (r.startswith(_JNP) or r.startswith("jax.lax.")):
+                return True
+            if (
+                isinstance(n.func, ast.Attribute)
+                and n.func.attr in _REDUCTION_METHODS
+            ):
+                return True
+    return False
+
+
+def rule_l4(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """Array constructors whose shape derives from traced values inside
+    jit-reachable code — shapes must be static under XLA."""
+    for _fn, node in _reachable_nodes(m, index):
+        if not isinstance(node, ast.Call):
+            continue
+        r = _resolve(m, dotted(node.func))
+        if not (r and r.startswith(_JNP)):
+            continue
+        member = r[len(_JNP):]
+        arg_idx = _SHAPE_CTORS.get(member)
+        if arg_idx is None:
+            continue
+        for i in arg_idx:
+            if i < len(node.args) and _data_dependent(m, node.args[i]):
+                yield node.lineno, (
+                    f"`jnp.{member}` with a data-dependent shape cannot "
+                    "be traced (shapes are static under jit); compute a "
+                    "static bound and mask instead"
+                )
+                break
+
+
+# ---------------------------------------------------------------------------
+# L5 — layering
+# ---------------------------------------------------------------------------
+
+#: (package prefix of the module, forbidden import prefixes, why)
+_LAYERS = (
+    ("dgen_tpu.ops.", ("dgen_tpu.models", "dgen_tpu.io"),
+     "ops/ is the kernel layer; it must stay importable without the "
+     "model or IO stack"),
+    ("dgen_tpu.models.", ("dgen_tpu.io.store",),
+     "models/ must not bind to the columnar store backend"),
+    ("dgen_tpu.utils.", ("dgen_tpu.ops", "dgen_tpu.models", "dgen_tpu.io",
+                         "dgen_tpu.parallel"),
+     "utils/ is the leaf layer"),
+)
+
+
+def rule_l5(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """Layering: ops/ must not import models/ or io/; models/ must not
+    import io/store; utils/ imports nothing above it."""
+    for pkg, forbidden, why in _LAYERS:
+        # the package __init__ itself (modname == pkg minus the dot)
+        # is part of the layer too
+        if not (m.modname.startswith(pkg) or m.modname == pkg[:-1]):
+            continue
+        for line, target in m.import_nodes:
+            for f in forbidden:
+                if target == f or target.startswith(f + "."):
+                    yield line, (
+                        f"`{m.modname}` imports `{target}`: {why}"
+                    )
+
+
+# ---------------------------------------------------------------------------
+# L6 — Pallas block-shape / dtype rules
+# ---------------------------------------------------------------------------
+
+def _imports_pallas(m: ModuleInfo) -> bool:
+    return any(
+        target.startswith("jax.experimental.pallas")
+        for _line, target in m.import_nodes
+    )
+
+
+def rule_l6(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """In Pallas modules: BlockSpec trailing dims must be lane/sublane
+    aligned (multiples of (8, 128), singletons allowed) and no f64
+    anywhere (the TPU vector unit has no f64 path)."""
+    if not _imports_pallas(m):
+        return
+    for node in ast.walk(m.tree):
+        if _is_f64_expr(m, node) and not isinstance(node, ast.Name):
+            yield node.lineno, (
+                "float64 in a Pallas module: Mosaic kernels have no f64 "
+                "path"
+            )
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if not (d and (d == "BlockSpec" or d.endswith(".BlockSpec"))):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Tuple):
+            continue
+        dims = [m.const_value(e) for e in node.args[0].elts]
+        if len(dims) >= 1 and dims[-1] is not None:
+            if dims[-1] != 1 and dims[-1] % 128 != 0:
+                yield node.lineno, (
+                    f"BlockSpec last (lane) dim {dims[-1]} is not a "
+                    "multiple of 128 — Mosaic pads every block to the "
+                    "8x128 tile, wasting VMEM and bandwidth"
+                )
+        if len(dims) >= 2 and dims[-2] is not None:
+            if dims[-2] != 1 and dims[-2] % 8 != 0:
+                yield node.lineno, (
+                    f"BlockSpec sublane dim {dims[-2]} is not a multiple "
+                    "of 8 — the f32 tile is (8, 128); unaligned blocks "
+                    "pad and copy"
+                )
+
+
+# ---------------------------------------------------------------------------
+# L7 — year-step entry points must donate the carry
+# ---------------------------------------------------------------------------
+
+def rule_l7(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """A jitted function threading a cross-step ``carry`` must donate
+    it (``donate_argnames=('carry',)``): without donation every year
+    holds two copies of the carry in HBM and XLA cannot alias the
+    update in place."""
+    for fn in m.functions:
+        node = fn.node
+        if not any(is_jit_decorator(d) for d in node.decorator_list):
+            continue
+        params = [a.arg for a in (
+            node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+        )]
+        if "carry" not in params:
+            continue
+        call = jit_decorator_call(node)
+        kwargs = {kw.arg for kw in call.keywords} if call is not None else set()
+        if not kwargs & {"donate_argnums", "donate_argnames"}:
+            yield node.lineno, (
+                f"jitted `{fn.qualname}` threads a `carry` but does not "
+                "donate it; add donate_argnames=('carry',) so XLA "
+                "aliases the cross-step state in place"
+            )
+
+
+# ---------------------------------------------------------------------------
+# L8 — debug leftovers in hot paths
+# ---------------------------------------------------------------------------
+
+_L8_CALLS = {"jax.debug.print", "jax.debug.breakpoint", "pdb.set_trace",
+             "pdb.post_mortem"}
+
+
+def rule_l8(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """Debug leftovers: ``jax.debug.print``/``breakpoint``/``print``/
+    ``pdb`` in jit-reachable code (each inserts a host callback that
+    serializes the device pipeline), and ``import pdb`` anywhere."""
+    for _fn, node in _reachable_nodes(m, index):
+        if not isinstance(node, ast.Call):
+            continue
+        r = _resolve(m, dotted(node.func))
+        if r in _L8_CALLS:
+            yield node.lineno, (
+                f"`{dotted(node.func)}` left in jit-reachable code "
+                "stalls the device pipeline on a host callback"
+            )
+        elif isinstance(node.func, ast.Name) and node.func.id in (
+            "print", "breakpoint"
+        ):
+            yield node.lineno, (
+                f"`{node.func.id}()` left in jit-reachable code (fires "
+                "at trace time only, or stalls the pipeline)"
+            )
+    for line, target in m.import_nodes:
+        if target == "pdb" or target.startswith("pdb."):
+            yield line, "`import pdb` left in library code"
+
+
+# ---------------------------------------------------------------------------
+# Registry / driver
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, Tuple[str, object]] = {
+    "L1": ("host-sync calls in jit-reachable code", rule_l1),
+    "L2": ("Python control flow on array values", rule_l2),
+    "L3": ("float64 leaking into the device path", rule_l3),
+    "L4": ("data-dependent array shapes under jit", rule_l4),
+    "L5": ("layering violations (ops/models/io/utils)", rule_l5),
+    "L6": ("Pallas block-shape / dtype alignment", rule_l6),
+    "L7": ("missing carry donation on year-step entry points", rule_l7),
+    "L8": ("debug leftovers in hot paths", rule_l8),
+}
+
+
+def run_rules(
+    index: ProjectIndex,
+    modules: Optional[Iterable[ModuleInfo]] = None,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run (selected) rules over ``modules`` (default: every indexed
+    module), honoring suppression comments; sorted by path/line."""
+    mods = list(modules) if modules is not None else index.modules
+    chosen = list(select) if select is not None else list(RULES)
+    unknown = [r for r in chosen if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {', '.join(unknown)}")
+    findings: List[Finding] = []
+    for m in mods:
+        for rule_id in chosen:
+            _summary, impl = RULES[rule_id]
+            for line, msg in impl(m, index):
+                if not m.is_suppressed(rule_id, line):
+                    findings.append(Finding(rule_id, m.path, line, msg))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -74,8 +74,14 @@ class MarketState:
 
     @staticmethod
     def zeros(n: int) -> "MarketState":
-        z = jnp.zeros(n, dtype=jnp.float32)
-        return MarketState(z, z, z, z, z, z, z, z, z)
+        # one buffer PER FIELD: the year step donates the carry, and
+        # XLA rejects donating the same buffer through two parameters —
+        # a single aliased zeros array would fail any first_year=False
+        # step on a fresh carry
+        n_fields = len(dataclasses.fields(MarketState))
+        return MarketState(
+            *(jnp.zeros(n, dtype=jnp.float32) for _ in range(n_fields))
+        )
 
 
 @jax.tree_util.register_dataclass

@@ -464,6 +464,10 @@ def _constrain_chunked(mesh: Mesh, a: jax.Array) -> jax.Array:
         "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
         "rate_switch", "mesh", "agent_chunk", "net_billing",
     ),
+    # the cross-year carry is threaded linearly (every caller rebinds
+    # it), so XLA may alias the update in place instead of holding two
+    # copies of the [N]-leaf market state per year (dgenlint L7)
+    donate_argnames=("carry",),
 )
 def year_step(
     table: AgentTable,
@@ -1123,6 +1127,13 @@ class Simulation:
         # cap that at ~2 GB; at small populations this never triggers.
         sync_every: Optional[int] = None
 
+        # steady-state retrace guard (lint.guard): the first two
+        # executed years compile the first_year=True/False program
+        # pair; from the third on, a fresh XLA compile means a static
+        # argument or shape is churning and the one-program-per-year
+        # contract is broken — fail the run there, with the year named
+        guard = None
+
         # the deferred-callback flush lives in a finally: year N's
         # results exist on device once its step ran, and a failure while
         # dispatching year N+1 must not lose year N's export
@@ -1133,6 +1144,15 @@ class Simulation:
             for yi, year in enumerate(self.years):
                 if yi < start_idx:
                     continue
+                if (
+                    self.run_config.guard_retrace and guard is None
+                    and yi - start_idx >= 2
+                ):
+                    from dgen_tpu.lint.guard import RetraceGuard
+
+                    guard = RetraceGuard(
+                        context="steady-state retrace guard"
+                    ).start()
                 t0 = time.time()
                 # trace the second executed step (post-compile) — or the
                 # only step when the run has just one
@@ -1220,11 +1240,15 @@ class Simulation:
                         collected[k].append(host[k])
                     if self.with_hourly:
                         hourly.append(host["_hourly"])
+                if guard is not None:
+                    guard.check(f"year {year}")
 
         except BaseException:
             loop_failed = True
             raise
         finally:
+            if guard is not None:
+                guard.stop()
             if pending_cb is not None:
                 # flush the deferred trailing callback (the final year
                 # on success; the last completed year on failure)

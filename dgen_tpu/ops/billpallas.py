@@ -541,11 +541,13 @@ def _maybe_shard_agents(fn, mesh, n_out: int, n_in: int = 5):
     """
     if mesh is None or mesh.devices.size <= 1:
         return fn
+    from dgen_tpu.utils import compat
+
     spec = PartitionSpec(AGENT_AXIS)
     # check_vma=False: pallas_call's out_shape ShapeDtypeStructs carry no
     # varying-manual-axes info, so the default vma check rejects the
     # kernel at trace time
-    return jax.shard_map(
+    return compat.shard_map(
         fn, mesh=mesh, in_specs=(spec,) * n_in, out_specs=(spec,) * n_out,
         check_vma=False,
     )

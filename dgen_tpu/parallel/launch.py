@@ -150,11 +150,9 @@ def main() -> None:
 
         jax.config.update("jax_platforms", plat)
     if os.environ.get("DGEN_CPU_DEVICES"):
-        import jax
+        from dgen_tpu.utils import compat
 
-        jax.config.update(
-            "jax_num_cpu_devices", int(os.environ["DGEN_CPU_DEVICES"])
-        )
+        compat.set_cpu_device_count(int(os.environ["DGEN_CPU_DEVICES"]))
     distributed = initialize_multihost()
 
     from dgen_tpu.utils import compilecache

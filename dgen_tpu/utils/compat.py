@@ -1,0 +1,64 @@
+"""Version-tolerant wrappers for jax APIs that moved between releases.
+
+The codebase targets the current jax API surface (``jax.shard_map``,
+the ``jax_num_cpu_devices`` config); older toolchains (0.4.x) spell
+these ``jax.experimental.shard_map.shard_map(check_rep=...)`` and
+``XLA_FLAGS=--xla_force_host_platform_device_count``. Everything that
+needs one of these goes through here so the fallback logic lives in
+exactly one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` when available, else the 0.4.x
+    ``jax.experimental.shard_map.shard_map`` (where ``check_vma`` is
+    spelled ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` when available; older
+    toolchains expose the same fact via the distributed global state."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:  # noqa: BLE001 — absent module = not initialized
+        return False
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Request ``n`` virtual CPU devices.
+
+    Uses the ``jax_num_cpu_devices`` config when this jax has it;
+    otherwise falls back to ``--xla_force_host_platform_device_count``,
+    which only takes effect if the CPU backend has not been initialized
+    yet (callers run this at process start, before any computation).
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()

@@ -61,8 +61,10 @@ def enable() -> Optional[str]:
         return _enabled_dir
     import jax
 
+    from dgen_tpu.utils import compat
+
     if (
-        jax.distributed.is_initialized()
+        compat.distributed_is_initialized()
         and jax.process_count() > 1
         and jax.default_backend() == "cpu"
     ):
@@ -101,9 +103,11 @@ def ensure_safe_for_backend() -> None:
     multi-process CPU (gloo) backend."""
     import jax
 
+    from dgen_tpu.utils import compat
+
     if (
         _enabled_dir is not None
-        and jax.distributed.is_initialized()
+        and compat.distributed_is_initialized()
         and jax.process_count() > 1
         and jax.default_backend() == "cpu"
     ):

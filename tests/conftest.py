@@ -19,8 +19,10 @@ import jax  # noqa: E402
 # the default run forces the virtual 8-CPU platform for sharding tests.
 _TPU_HW_RUN = os.environ.get("DGEN_TPU_TESTS", "") not in ("", "0", "false")
 if not _TPU_HW_RUN:
+    from dgen_tpu.utils import compat
+
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    compat.set_cpu_device_count(8)
 
 # persistent compile cache: entries are keyed by backend so CPU test
 # programs coexist with the TPU entries; repeat suite runs skip the

@@ -1,0 +1,13 @@
+"""dgenlint L1 fixture: host syncs on traced values in jitted code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def leaky_year_step(x):
+    host_copy = np.asarray(x)              # L1: np.asarray on a tracer
+    total = float(jnp.sum(x))              # L1: float() on a non-literal
+    first = x[0].item()                    # L1: .item() syncs
+    return host_copy, total, first

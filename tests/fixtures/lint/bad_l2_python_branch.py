@@ -1,0 +1,13 @@
+"""dgenlint L2 fixture: Python branching on array values under jit."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branchy(x):
+    if jnp.any(x > 0):                     # L2: needs lax.cond/select
+        return x
+    while (x < 0).all():                   # L2: while on an array value
+        x = x + 1
+    return -x

@@ -1,0 +1,12 @@
+"""dgenlint L3 fixture: float64 reaching the device path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WIDE_TABLE = jnp.zeros((8, 8), dtype=jnp.float64)   # L3: f64 device array
+
+
+@jax.jit
+def widen(x):
+    return x.astype(np.float64)            # L3: f64 in jitted code

@@ -1,0 +1,10 @@
+"""dgenlint L4 fixture: data-dependent array shapes under jit."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def gather_adopters(mask):
+    n_adopters = jnp.sum(mask)
+    return jnp.zeros(jnp.sum(mask)), jnp.arange(n_adopters.item())  # L4 (+L1)

@@ -1,0 +1,15 @@
+"""dgenlint L6 fixture: misaligned Pallas block shapes."""
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+HOURS = 8760   # NOT lane-aligned — the padded layout exists for a reason
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+SPEC_BAD_LANE = pl.BlockSpec((8, HOURS), lambda i: (i, 0))       # L6
+SPEC_BAD_SUBLANE = pl.BlockSpec((12, 128), lambda i: (i, 0))     # L6
+SPEC_OK = pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0))

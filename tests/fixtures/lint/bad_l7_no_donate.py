@@ -1,0 +1,10 @@
+"""dgenlint L7 fixture: year-step entry point without carry donation."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("first_year",))
+def year_step(table, carry, year_idx, *, first_year):   # L7: no donate
+    return carry, table

@@ -1,0 +1,13 @@
+"""dgenlint L8 fixture: debug leftovers in the hot path."""
+
+import pdb  # L8: debugger import in library code
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def hot_loop(x):
+    jax.debug.print("x = {}", x)           # L8: host callback per step
+    print("tracing hot_loop")              # L8: trace-time print
+    return jnp.sum(x)

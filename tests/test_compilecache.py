@@ -53,7 +53,9 @@ def test_refuses_multiprocess_cpu(monkeypatch):
     d = cc.enable()
     assert d is not None
 
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    from dgen_tpu.utils import compat
+
+    monkeypatch.setattr(compat, "distributed_is_initialized", lambda: True)
     monkeypatch.setattr(jax, "process_count", lambda: 2)
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
 

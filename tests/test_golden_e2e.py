@@ -71,8 +71,12 @@ def golden_run(tmp_path_factory):
         },
         n_states=pop.table.n_states,
     )
+    # guard_retrace: the golden run doubles as a recompilation
+    # regression test — a steady-state year that triggers a fresh XLA
+    # compile fails here (dgenlint's runtime half, lint.guard)
     sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
-                     RunConfig(sizing_iters=8), with_hourly=True)
+                     RunConfig(sizing_iters=8, guard_retrace=True),
+                     with_hourly=True)
     res = sim.run()
     assert len(res.years) == 19
     mask = np.asarray(pop.table.mask)

@@ -1,0 +1,100 @@
+"""utils.invariants coverage: the allow_nonfinite allowlist path and
+the dtype-drift branch of check_transform (previously untested), plus
+the shape/leaf-set branches and the clean path."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.utils.invariants import (
+    InvariantViolation,
+    check_finite,
+    check_transform,
+)
+
+
+def _tree(**overrides):
+    base = {
+        "market_share": jnp.zeros(8, jnp.float32),
+        "system_kw_cum": jnp.ones(8, jnp.float32),
+        "adopters": jnp.zeros(8, jnp.int32),
+    }
+    base.update(overrides)
+    return base
+
+
+def test_clean_transform_passes():
+    check_transform(_tree(), _tree(), context="clean")
+
+
+def test_dtype_drift_is_caught():
+    # numpy leaf: jnp would silently clamp f64 to f32 under the x64
+    # default, which is exactly the widening the harness must SEE when
+    # a host-fetched carry drifts
+    drifted = _tree(system_kw_cum=np.ones(8, np.float64))
+    with pytest.raises(InvariantViolation, match="dtype"):
+        check_transform(_tree(), drifted, context="year 2020")
+
+
+def test_dtype_drift_message_names_the_leaf():
+    drifted = _tree(adopters=jnp.zeros(8, jnp.float32))
+    with pytest.raises(InvariantViolation, match="adopters"):
+        check_transform(_tree(), drifted)
+
+
+def test_shape_change_is_caught():
+    grown = _tree(market_share=jnp.zeros(16, jnp.float32))
+    with pytest.raises(InvariantViolation, match="shape"):
+        check_transform(_tree(), grown)
+
+
+def test_leaf_set_change_is_caught():
+    after = _tree()
+    after["new_column"] = jnp.zeros(8, jnp.float32)
+    with pytest.raises(InvariantViolation, match="leaf set"):
+        check_transform(_tree(), after)
+    before = _tree()
+    missing = _tree()
+    del missing["adopters"]
+    with pytest.raises(InvariantViolation, match="leaf set"):
+        check_transform(before, missing)
+
+
+def test_check_finite_flags_nan_and_counts():
+    bad = _tree(market_share=jnp.array(
+        [0.0, jnp.nan, jnp.inf, 0.0, 0.0, 0.0, 0.0, 0.0], jnp.float32))
+    with pytest.raises(InvariantViolation, match="2 non-finite"):
+        check_finite(bad, context="year 2020")
+
+
+def test_allow_nonfinite_substring_allowlist():
+    """The allowlist matches by leaf-path SUBSTRING (mirroring the
+    reference's column exception list) and exempts only those leaves."""
+    bad = _tree(
+        market_share=jnp.full(8, jnp.nan, jnp.float32),
+        system_kw_cum=jnp.full(8, jnp.inf, jnp.float32),
+    )
+    # both leaves allowlisted -> clean
+    check_finite(bad, allow_nonfinite=("market_share", "system_kw"),
+                 context="allowlisted")
+    # only one allowlisted -> the other still raises, and the message
+    # names the non-exempt leaf
+    with pytest.raises(InvariantViolation, match="system_kw_cum"):
+        check_finite(bad, allow_nonfinite=("market_share",))
+
+
+def test_allow_nonfinite_ignores_int_leaves():
+    """Integer leaves have no non-finite values; the float check must
+    not trip on them regardless of the allowlist."""
+    t = _tree(adopters=jnp.full(8, 2**31 - 1, jnp.int32))
+    check_finite(t, allow_nonfinite=())
+
+
+def test_check_transform_accepts_numpy_and_mixed_trees():
+    """The harness runs host-side on fetched carries: numpy leaves are
+    first-class."""
+    before = {"a": np.zeros(4, np.float32)}
+    after = {"a": np.zeros(4, np.float32)}
+    check_transform(before, after)
+    with pytest.raises(InvariantViolation, match="dtype"):
+        check_transform(before, {"a": np.zeros(4, np.float64)})

@@ -19,6 +19,7 @@ covered by tests/test_bill.py against hand-computed cases.
 """
 
 import importlib.util
+import os
 import sys
 import types
 
@@ -36,6 +37,13 @@ from dgen_tpu.ops.tariff import (
 )
 
 REF_TF = "/root/reference/dgen_os/python/tariff_functions.py"
+
+# environment-bound: needs the reference repo mounted at /root/reference
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(REF_TF),
+    reason="reference mount not present (oracle parity needs "
+           "/root/reference)",
+)
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,106 @@
+"""RetraceGuard (dgenlint's runtime half): fresh-compile counting,
+cache-hit cleanliness, per-year check/reset composition, and the
+Simulation.run wiring — a steady-state year that recompiles must fail
+the run, and a clean run must pass with the guard armed."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dgen_tpu.config import RunConfig
+from dgen_tpu.lint.guard import RetraceError, RetraceGuard
+
+from test_simulation import make_sim
+
+
+def test_cache_hit_is_clean_and_fresh_compile_fails():
+    @jax.jit
+    def f(x):
+        return x * 3.0
+
+    f(jnp.ones(16)).block_until_ready()           # warm the cache
+    with RetraceGuard():
+        f(jnp.ones(16)).block_until_ready()       # cache hit: clean
+
+    with pytest.raises(RetraceError, match="steadyish"):
+        with RetraceGuard(context="steadyish"):
+            # new shape -> fresh trace + compile inside the guard
+            f(jnp.ones(32)).block_until_ready()
+
+
+def test_counts_and_check_reset_compose():
+    guard = RetraceGuard(max_compiles=10, max_traces=None).start()
+    try:
+        @jax.jit
+        def g(x):
+            return x - 0.5
+
+        g(jnp.ones(8)).block_until_ready()
+        assert guard.n_compiles >= 1
+        assert guard.n_traces >= 1
+        guard.check("warmup")        # within budget: resets counters
+        assert guard.n_compiles == 0
+        g(jnp.ones(8)).block_until_ready()   # cache hit
+        assert guard.n_compiles == 0
+        guard.check("steady")
+    finally:
+        guard.stop()
+
+
+def test_stop_detaches_counting():
+    guard = RetraceGuard().start()
+    guard.stop()
+
+    @jax.jit
+    def h(x):
+        return x + 2.0
+
+    h(jnp.ones(8)).block_until_ready()
+    assert guard.n_compiles == 0
+
+
+def test_simulation_steady_state_years_do_not_retrace():
+    """The design contract behind the <10-min national run: after the
+    first_year=True/False pair compiles, every later year is a cache
+    hit. guard_retrace=True turns any violation into a run failure."""
+    sim, pop = make_sim(
+        n_agents=64, states=("DE",), end_year=2022,
+        run_config=RunConfig(sizing_iters=6, guard_retrace=True),
+    )
+    res = sim.run()
+    assert len(res.years) == 5   # 2014..2022 step 2, none rejected
+
+
+def test_fresh_carry_step_is_donation_safe():
+    """year_step donates the carry, so a FRESH SimCarry.zeros carry
+    stepped with first_year=False must not trip XLA's 'donate the same
+    buffer twice' — MarketState.zeros allocates one buffer per field
+    for exactly this reason."""
+    sim, pop = make_sim(
+        n_agents=64, states=("DE",), end_year=2022,
+        run_config=RunConfig(sizing_iters=6),
+    )
+    carry = sim.init_carry()
+    carry, outs = sim.step(carry, 1, first_year=False)
+    assert outs.system_kw.shape[0] == pop.table.n_agents
+
+
+def test_simulation_guard_catches_churning_static_arg():
+    """Inject the classic retrace storm — a float static argument that
+    drifts every call — and assert the guard names the year."""
+    sim, pop = make_sim(
+        n_agents=64, states=("DE",), end_year=2020,
+        run_config=RunConfig(sizing_iters=6, guard_retrace=True),
+    )
+    orig = sim._step_kwargs
+    state = {"n": 0}
+
+    def churning(first_year):
+        kw = orig(first_year)
+        state["n"] += 1
+        kw["year_step_len"] = kw["year_step_len"] + state["n"] * 1e-6
+        return kw
+
+    sim._step_kwargs = churning
+    with pytest.raises(RetraceError, match="year 2018"):
+        sim.run()

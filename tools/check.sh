@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Repo gate: dgenlint + the tier-1 test line from ROADMAP.md.
+#
+# Usage: tools/check.sh [--lint-only|--test-only]
+#
+# Exit non-zero when the linter finds anything or the tier-1 suite
+# fails. Run from anywhere; paths resolve against the repo root.
+
+set -u -o pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+
+mode="${1:-all}"
+rc=0
+
+if [ "$mode" != "--test-only" ]; then
+    echo "== dgenlint (python -m dgen_tpu.lint) =="
+    python -m dgen_tpu.lint || rc=1
+fi
+
+if [ "$mode" != "--lint-only" ]; then
+    # optional style baseline: pyflakes + import order only (see
+    # [tool.ruff] in pyproject.toml); advisory if ruff is absent
+    if command -v ruff >/dev/null 2>&1; then
+        echo "== ruff (pyflakes + import order) =="
+        ruff check dgen_tpu tests || rc=1
+    fi
+
+    echo "== tier-1 tests (ROADMAP.md) =="
+    rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+    t1=${PIPESTATUS[0]}
+    echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+    [ "$t1" -ne 0 ] && rc=1
+fi
+
+exit $rc

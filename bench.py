@@ -47,6 +47,11 @@ Knobs (env):
                           the process (default 1680)
   DGEN_TPU_BENCH_FULL_AGENTS  full-run population ("auto" = largest that
                           fits the remaining budget; "" disables)
+  DGEN_TPU_BENCH_DAYLIGHT run with RunConfig.daylight_compact=1 (the
+                          daylight-compacted candidate kernels); the
+                          flag is stamped into the payload
+  DGEN_TPU_BENCH_BF16     run with RunConfig.bf16_banks=1 (bf16 profile
+                          banks; larger auto chunks at fixed HBM)
 """
 
 from __future__ import annotations
@@ -69,6 +74,16 @@ FALLBACK_BASELINE_AGENT_YEARS_PER_SEC = 25.0
 
 #: v5e peak bf16 FLOP/s (public spec); the MFU denominator
 V5E_PEAK_FLOPS = 197e12
+
+#: A/B knobs for the two config-gated perf paths (docs/perf.md): a
+#: daylight-compacted candidate kernel and bf16 profile banks. Both
+#: default off so the headline stays comparable across rounds; set
+#: DGEN_TPU_BENCH_DAYLIGHT=1 / DGEN_TPU_BENCH_BF16=1 to measure them
+#: (the flags are stamped into the payload either way).
+_BENCH_DAYLIGHT = os.environ.get(
+    "DGEN_TPU_BENCH_DAYLIGHT", "") not in ("", "0", "false")
+_BENCH_BF16 = os.environ.get(
+    "DGEN_TPU_BENCH_BF16", "") not in ("", "0", "false")
 
 
 def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
@@ -98,7 +113,10 @@ def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
     )
     sim = Simulation(
         pop.table, pop.profiles, pop.tariffs, inputs, cfg,
-        RunConfig(sizing_iters=sizing_iters, agent_chunk=agent_chunk),
+        RunConfig(
+            sizing_iters=sizing_iters, agent_chunk=agent_chunk,
+            daylight_compact=_BENCH_DAYLIGHT, bf16_banks=_BENCH_BF16,
+        ),
         with_hourly=with_hourly,
     )
     return sim, pop
@@ -395,7 +413,11 @@ def main() -> None:
     # emit whatever is complete if a stage overruns the budget (the
     # driver records only rc and the LAST output line; an externally
     # killed process yields neither)
-    payload: dict = {"full_run": None}
+    payload: dict = {
+        "full_run": None,
+        "daylight_compact": _BENCH_DAYLIGHT,
+        "bf16_banks": _BENCH_BF16,
+    }
     cleanup_dirs: list = []   # tempdirs the backstop must not leak
 
     import shutil

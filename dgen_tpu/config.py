@@ -102,6 +102,22 @@ class RunConfig:
     #: run the invariant harness every year step (utils.invariants —
     #: the reference's run_with_runtime_tests analogue; host sync cost)
     debug_invariants: bool = False
+    #: daylight-compacted bill kernels (ops.billpallas.DaylightLayout):
+    #: the sizing search's candidate kernels run only over the union
+    #: daylight lanes of the generation bank (~half the hour axis for
+    #: rooftop solar); night-hour bucket sums are candidate-independent
+    #: and added back exactly. Off by default — the full-hour path is
+    #: the parity oracle; results agree to ~1e-5 relative (f32
+    #: re-association only). Env: DGEN_TPU_DAYLIGHT.
+    daylight_compact: bool = False
+    #: store the hourly load/gen/wholesale profile banks in bfloat16
+    #: (f32 upcast inside the kernels): halves the O(N*8760) HBM
+    #: traffic and footprint of the sizing hot loop, so
+    #: auto_agent_chunk picks ~1.7x larger streaming chunks. Inputs are
+    #: rounded to ~3 significant digits — bills shift ~0.1-1%; see
+    #: docs/perf.md for the measured golden-run envelope. Off by
+    #: default. Env: DGEN_TPU_BF16_BANKS.
+    bf16_banks: bool = False
     #: arm the steady-state retrace guard (lint.guard.RetraceGuard):
     #: once the first two executed years have compiled the
     #: first_year=True/False program pair, any FRESH XLA compile or
@@ -131,4 +147,8 @@ class RunConfig:
             overrides["debug_invariants"] = True
         if "guard_retrace" not in overrides and flag("DGEN_TPU_GUARD"):
             overrides["guard_retrace"] = True
+        if "daylight_compact" not in overrides and flag("DGEN_TPU_DAYLIGHT"):
+            overrides["daylight_compact"] = True
+        if "bf16_banks" not in overrides and flag("DGEN_TPU_BF16_BANKS"):
+            overrides["bf16_banks"] = True
         return cls(**overrides)

@@ -52,15 +52,24 @@ def _quantize_i16(xs):
     right shape for the downstream aggregates (sums over agents).
     Jitted once per pytree structure; arrays are ARGUMENTS, never
     closed over (a captured device array bakes into the HLO).
+
+    Non-finite elements are zeroed (a single inf/NaN would otherwise
+    poison the whole column's scale), and the zeroed count per array
+    rides back with the transfer: RunExporter accumulates it and
+    stamps the per-run total into meta.json as ``nonfinite_zeroed``,
+    so silently-repaired data is visible in the run's provenance
+    instead of only in a debug run's invariant failure.
     """
     import jax.numpy as jnp
 
-    qs, scales = [], []
+    qs, scales, nonfinite = [], [], []
     for x in xs:
         # a single non-finite element must not poison the whole column
         # (scale would become inf/NaN); zero it like the reference's
-        # own _norm25 rule for malformed cells
-        x = jnp.where(jnp.isfinite(x), x, 0.0)
+        # own _norm25 rule for malformed cells — counted, see above
+        bad = ~jnp.isfinite(x)
+        nonfinite.append(jnp.sum(bad, dtype=jnp.int32))
+        x = jnp.where(bad, 0.0, x)
         # 2-D series ([n_agents, n_years]) get PER-COLUMN scales: the
         # year-0 capex column is orders of magnitude larger than the
         # out-year cash flows and a global max would waste the range
@@ -73,7 +82,7 @@ def _quantize_i16(xs):
             jnp.clip(jnp.round(x / scale), -32766, 32766).astype(jnp.int16)
         )
         scales.append(scale)
-    return qs, scales
+    return qs, scales, nonfinite
 
 
 _quantize_i16_jit = jax.jit(_quantize_i16)
@@ -173,6 +182,13 @@ class RunExporter:
             ).lower() not in ("0", "off", "false")
         self.compact = bool(compact)
         self._prepared: Dict[int, dict] = {}   # year_idx -> dispatched
+        # compact quantization zeroes non-finite elements before
+        # scaling (see _quantize_i16); the running count is stamped
+        # into meta.json after every year so a run that silently
+        # repaired data says so in its provenance (0 = clean run;
+        # counts cover the quantized surfaces, which are the only
+        # place the zeroing happens)
+        self._nonfinite_zeroed = 0
         os.makedirs(run_dir, exist_ok=True)
         # provenance stamp: ``meta`` (notably market_curves:
         # synthetic_default vs ingested, from scenario ingest) is written
@@ -185,6 +201,7 @@ class RunExporter:
                      # energy_value column)
                      "export_quantized": bool(
                          self.compact and jax.process_count() == 1),
+                     "nonfinite_zeroed": 0,
                      **(meta or {})}
         if jax.process_index() == 0:
             with open(os.path.join(run_dir, "meta.json"), "w") as f:
@@ -212,16 +229,17 @@ class RunExporter:
     @staticmethod
     def _quant_dispatch(arrs, quant):
         """Enqueue the on-device quantization of the True-masked fields;
-        returns (qs, scales, rest) device arrays WITHOUT fetching.  Used
-        at prepare() time so the ops land on the device queue right
-        behind the step that produced ``arrs`` — dispatching them at
-        callback time instead would queue them behind the NEXT year's
-        step and serialize the export pipeline against device compute
-        (measured: 1M-agent exports 1492 s vs ~130 s prepared)."""
+        returns (qs, scales, rest, nonfinite) device arrays WITHOUT
+        fetching.  Used at prepare() time so the ops land on the device
+        queue right behind the step that produced ``arrs`` —
+        dispatching them at callback time instead would queue them
+        behind the NEXT year's step and serialize the export pipeline
+        against device compute (measured: 1M-agent exports 1492 s vs
+        ~130 s prepared)."""
         q_in = [a for a, q in zip(arrs, quant) if q]
-        qs, scales = _quantize_i16_jit(q_in)
+        qs, scales, nonfinite = _quantize_i16_jit(q_in)
         rest = [a for a, q in zip(arrs, quant) if not q]
-        return qs, scales, rest
+        return qs, scales, rest, nonfinite
 
     def _local_fields(self, arrs, quant=None, prepared=None
                       ) -> tuple[list, np.ndarray]:
@@ -245,8 +263,10 @@ class RunExporter:
                     and any(quant)):
                 prepared = self._quant_dispatch(arrs, quant)
             if prepared is not None:
-                qs, scales, rest = prepared
-                h_q, h_s, h_rest = jax.device_get([qs, scales, rest])
+                qs, scales, rest, nonfinite = prepared
+                h_q, h_s, h_rest, h_nf = jax.device_get(
+                    [qs, scales, rest, nonfinite])
+                self._nonfinite_zeroed += int(sum(int(c) for c in h_nf))
                 qi = iter(zip(h_q, h_s))
                 ri = iter(h_rest)
                 host = [
@@ -345,6 +365,19 @@ class RunExporter:
             self.write_state_hourly(
                 year, np.asarray(outs.state_hourly_net_mw)
             )
+        self._flush_meta()
+
+    def _flush_meta(self) -> None:
+        """Re-stamp meta.json when the running non-finite-zeroed count
+        has grown (per-run provenance; process 0 owns the file)."""
+        if (
+            jax.process_index() != 0
+            or self.meta.get("nonfinite_zeroed") == self._nonfinite_zeroed
+        ):
+            return
+        self.meta["nonfinite_zeroed"] = int(self._nonfinite_zeroed)
+        with open(os.path.join(self.run_dir, "meta.json"), "w") as f:
+            json.dump(self.meta, f, indent=2, default=str)
 
     # --- agent_outputs (reference dgen_model.py:460-462) ---
     def write_agent_outputs(self, year: int, outs, prepared=None) -> None:

@@ -21,7 +21,14 @@ import os
 import subprocess
 from typing import Optional, Tuple
 
+import ml_dtypes  # ships with jax; bf16 <-> numpy bridge
 import numpy as np
+
+#: DGPB1 dtype codes (header bytes [6:8)); bf16 banks halve the disk
+#: and mmap footprint of the 8760-hour profile banks
+#: (RunConfig.bf16_banks consumes them natively on device)
+_CODE_TO_DTYPE = {0: np.dtype(np.float32), 1: np.dtype(ml_dtypes.bfloat16)}
+_DTYPE_TO_CODE = {v: k for k, v in _CODE_TO_DTYPE.items()}
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
                     "profile_store.cpp")
@@ -74,6 +81,13 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
         ctypes.c_uint64, ctypes.c_uint64,
     ]
+    lib.dg_store_write2.restype = ctypes.c_int
+    lib.dg_store_write2.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.dg_store_dtype.restype = ctypes.c_int
+    lib.dg_store_dtype.argtypes = [ctypes.c_void_p]
     lib.dg_store_open.restype = ctypes.c_void_p
     lib.dg_store_open.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
@@ -102,31 +116,52 @@ def _err(lib) -> str:
     return lib.dg_last_error().decode()
 
 
-def write_bank(path: str, data: np.ndarray) -> None:
-    """Persist a row-major f32 matrix as a DGPB1 bank file."""
-    data = np.ascontiguousarray(data, dtype=np.float32)
+def _resolve_dtype(data: np.ndarray, dtype: Optional[str]) -> np.dtype:
+    if dtype is None:
+        d = np.dtype(data.dtype)
+        return d if d in _DTYPE_TO_CODE else np.dtype(np.float32)
+    if dtype in ("f32", "float32"):
+        return np.dtype(np.float32)
+    if dtype in ("bf16", "bfloat16"):
+        return np.dtype(ml_dtypes.bfloat16)
+    raise ValueError(f"unsupported bank dtype {dtype!r} (f32 | bf16)")
+
+
+def write_bank(path: str, data: np.ndarray,
+               dtype: Optional[str] = None) -> None:
+    """Persist a row-major matrix as a DGPB1 bank file.
+
+    ``dtype``: None keeps the array's own dtype (f32 unless it is
+    already bf16); "bf16" converts on write — half the disk/mmap bytes
+    at ~3 significant digits, the at-rest companion of
+    ``RunConfig.bf16_banks``; "f32" forces full precision.
+    """
+    target = _resolve_dtype(np.asarray(data), dtype)
+    data = np.ascontiguousarray(data, dtype=target)
     if data.ndim != 2:
         raise ValueError("bank must be 2-D [rows, cols]")
+    code = _DTYPE_TO_CODE[target]
     lib = _load()
     if lib is not None:
-        rc = lib.dg_store_write(
-            path.encode(), data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            data.shape[0], data.shape[1],
+        rc = lib.dg_store_write2(
+            path.encode(), data.ctypes.data_as(ctypes.c_void_p),
+            data.shape[0], data.shape[1], code,
         )
         if rc != 0:
             raise IOError(f"native write failed: {_err(lib)}")
         return
     with open(path, "wb") as f:
         f.write(_MAGIC)
-        f.write((0).to_bytes(2, "little"))
+        f.write(code.to_bytes(2, "little"))
         f.write(int(data.shape[0]).to_bytes(8, "little"))
         f.write(int(data.shape[1]).to_bytes(8, "little"))
         f.write(data.tobytes())
 
 
 def read_bank(path: str) -> np.ndarray:
-    """Load a DGPB1 bank. Native path: one mmap + zero-copy view
-    (copied into an owned array before the handle closes)."""
+    """Load a DGPB1 bank in its stored dtype (f32 or bf16). Native
+    path: one mmap + zero-copy view (copied into an owned array before
+    the handle closes)."""
     lib = _load()
     if lib is not None:
         rows = ctypes.c_uint64()
@@ -136,10 +171,16 @@ def read_bank(path: str) -> np.ndarray:
         if not h:
             raise IOError(f"native open failed: {_err(lib)}")
         try:
+            dt = _CODE_TO_DTYPE[int(lib.dg_store_dtype(ctypes.c_void_p(h)))]
             ptr = lib.dg_store_data(ctypes.c_void_p(h))
-            arr = np.ctypeslib.as_array(
-                ptr, shape=(rows.value, cols.value)
-            ).copy()
+            n = rows.value * cols.value
+            buf = ctypes.cast(
+                ptr, ctypes.POINTER(ctypes.c_uint8 * (n * dt.itemsize))
+            ).contents
+            arr = (
+                np.frombuffer(buf, dtype=dt)
+                .reshape(rows.value, cols.value).copy()
+            )
         finally:
             lib.dg_store_close(ctypes.c_void_p(h))
         return arr
@@ -147,9 +188,13 @@ def read_bank(path: str) -> np.ndarray:
         head = f.read(_HEADER)
         if head[:6] != _MAGIC:
             raise IOError("bad magic (not a DGPB1 file)")
+        code = int.from_bytes(head[6:8], "little")
+        if code not in _CODE_TO_DTYPE:
+            raise IOError(f"unsupported dtype code {code}")
+        dt = _CODE_TO_DTYPE[code]
         rows = int.from_bytes(head[8:16], "little")
         cols = int.from_bytes(head[16:24], "little")
-        data = np.frombuffer(f.read(rows * cols * 4), dtype=np.float32)
+        data = np.frombuffer(f.read(rows * cols * dt.itemsize), dtype=dt)
     return data.reshape(rows, cols).copy()
 
 

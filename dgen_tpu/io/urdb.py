@@ -63,7 +63,10 @@ def _schedule(record: dict, key: str, n_periods: int) -> Optional[np.ndarray]:
     sched = record.get(key)
     if sched is None:
         return None
-    m = np.asarray(sched, np.int64)
+    # np.array (copy), NOT np.asarray: when the record already holds an
+    # int64 ndarray, asarray aliases it and the in-place remap below
+    # would silently mutate the caller's data
+    m = np.array(sched, np.int64)
     m[m >= n_periods] = 0
     return m
 
@@ -107,9 +110,16 @@ def urdb_rate_to_specs(
     fd = record.get("flatdemandstructure")
     if fd:
         prices, levels = _rate_matrix(fd)          # [T, n_constructs]
-        # .get default does not cover an explicit JSON null
-        months = np.asarray(
-            record.get("flatdemandmonths") or [0] * 12, np.int64)
+        # .get default does not cover an explicit JSON null; np.array
+        # copies so the in-place remap never mutates the record's own
+        # ndarray (same aliasing hazard as _schedule), and the explicit
+        # None/empty check replaces a truthiness test that raised on
+        # ndarray-valued records
+        fdm = record.get("flatdemandmonths")
+        months = (
+            np.array(fdm, np.int64) if fdm is not None and len(fdm)
+            else np.zeros(12, np.int64)
+        )
         months[months >= prices.shape[1]] = 0
         # per-month columns, the d_flat_* layout (tariff_functions.py:250)
         demand["d_flat_prices"] = prices[:, months].tolist()

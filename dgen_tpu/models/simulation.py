@@ -178,14 +178,22 @@ def build_econ_inputs(
         if rate_switch else None
     )
 
-    load = profiles.load[table.load_idx] * ya.load_kwh_per_customer[:, None]
+    # multipliers are cast to the bank dtype BEFORE the product so bf16
+    # profile banks (RunConfig.bf16_banks) stay bf16 through the
+    # gathered [N, 8760] streams — a f32 multiplier would silently
+    # promote them and forfeit the halved HBM footprint (no-op for the
+    # default f32 banks)
+    bdt = profiles.load.dtype
+    load = profiles.load[table.load_idx] * \
+        ya.load_kwh_per_customer[:, None].astype(bdt)
     gen_per_kw = profiles.solar_cf[table.cf_idx]
     # Net-billing sell rate = this year's wholesale price x retail
     # multiplier (reference financial_functions.py:182; wholesale
     # itself is merged per year, elec.py:608)
     ts_sell = (
         profiles.wholesale[table.region_idx]
-        * (mult * ya.wholesale_multiplier)[:, None]
+        * (mult * ya.wholesale_multiplier)[:, None].astype(
+            profiles.wholesale.dtype)
     )
 
     # NEM system-size limit caps the sizing bracket while NEM is active;
@@ -331,6 +339,11 @@ _LIVE_HOUR_ARRAYS_RATE_SWITCH = 2
 #: (linear identity only): load/gen/sell/period for linear_sums plus
 #: dispatch traces
 _LIVE_HOUR_ARRAYS_ALL_NEM = 6
+#: under bf16 profile banks, the bank-derived streams (load/gen/sell +
+#: their month-padded repacks) ride at 2 bytes/hour; this many of the
+#: envelope's hour arrays stay 4-byte — the int32 period stream plus
+#: the f32 dispatch trace (the SOC recursion upcasts; ops.sizing)
+_LIVE_HOUR_ARRAYS_F32 = 2
 _HBM_RESERVE_FRAC = 0.2        # compiler scratch / fragmentation
 
 
@@ -356,10 +369,20 @@ def _per_agent_step_bytes(
     with_hourly: bool,
     net_billing: bool = True,
     rate_switch: bool = False,
+    bank_bf16: bool = False,
 ) -> int:
     """Modeled peak HBM bytes per agent of one streaming-chunk step —
     the single footprint model shared by the chunk chooser and the
-    end-of-run modeled-vs-actual validation log."""
+    end-of-run modeled-vs-actual validation log.
+
+    ``bank_bf16`` (RunConfig.bf16_banks): the bank-derived hour streams
+    ride at 2 bytes, an f32 floor (:data:`_LIVE_HOUR_ARRAYS_F32`, plus
+    the keep_hourly net profiles, which downstream state aggregation
+    consumes in f32) stays at 4, and the [r_pad, B_PAD] candidate sums
+    are stored at bank precision too (billpallas._sums_out_dtype:
+    bf16 in -> bf16 out) — the default configuration models ~1.8x
+    fewer bytes per agent, and the auto chunk grows to match.
+    """
     from dgen_tpu.ops.billpallas import B_PAD, H_PAD, _round8
 
     r_pad = _round8(max(sizing_iters, 4) * econ_years)
@@ -372,9 +395,18 @@ def _per_agent_step_bytes(
         if rate_switch:
             hour_arrays += _LIVE_HOUR_ARRAYS_RATE_SWITCH
             kernel_outs += 1     # second tariff's [r_pad, B_PAD] sums
+    f32_floor = _LIVE_HOUR_ARRAYS_F32
     if with_hourly:
         hour_arrays += _LIVE_HOUR_ARRAYS_HOURLY
-    return 4 * (hour_arrays * H_PAD + kernel_outs * r_pad * B_PAD)
+        f32_floor += _LIVE_HOUR_ARRAYS_HOURLY
+    if bank_bf16:
+        f32_floor = min(f32_floor, hour_arrays)
+        hour_bytes = 4 * f32_floor + 2 * (hour_arrays - f32_floor)
+        out_bytes = 2
+    else:
+        hour_bytes = 4 * hour_arrays
+        out_bytes = 4
+    return hour_bytes * H_PAD + out_bytes * kernel_outs * r_pad * B_PAD
 
 
 def auto_agent_chunk(
@@ -386,6 +418,7 @@ def auto_agent_chunk(
     hbm_bytes: Optional[int],
     net_billing: bool = True,
     rate_switch: bool = False,
+    bank_bf16: bool = False,
 ) -> int:
     """Derive the per-device streaming chunk from the HBM budget.
 
@@ -401,7 +434,7 @@ def auto_agent_chunk(
     per_agent = _per_agent_step_bytes(
         sizing_iters=sizing_iters, econ_years=econ_years,
         with_hourly=with_hourly, net_billing=net_billing,
-        rate_switch=rate_switch,
+        rate_switch=rate_switch, bank_bf16=bank_bf16,
     )
     budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
     # persistent whole-table state ([N] outputs/carry, ~50 f32 fields)
@@ -462,7 +495,7 @@ def _constrain_chunked(mesh: Mesh, a: jax.Array) -> jax.Array:
     static_argnames=(
         "n_periods", "econ_years", "sizing_iters", "first_year",
         "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
-        "rate_switch", "mesh", "agent_chunk", "net_billing",
+        "rate_switch", "mesh", "agent_chunk", "net_billing", "daylight",
     ),
     # the cross-year carry is threaded linearly (every caller rebinds
     # it), so XLA may alias the update in place instead of holding two
@@ -489,8 +522,14 @@ def year_step(
     mesh: Optional[Mesh] = None,
     agent_chunk: int = 0,
     net_billing: bool = True,
+    daylight=None,
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
+
+    ``daylight``: optional billpallas.DaylightLayout (a hashable STATIC
+    host constant, like the month layout it compacts) — the sizing
+    search's import kernels run daylight-compacted; None keeps the
+    full-hour oracle path.
 
     Mirrors the reference's per-year sequence (dgen_model.py:242-438):
     trajectory application -> sizing -> max market share -> (initial
@@ -540,7 +579,7 @@ def year_step(
             res_c = sizing_ops.size_agents(
                 envs_c, n_periods=n_periods, n_years=econ_years,
                 n_iters=sizing_iters, keep_hourly=False, impl=sizing_impl,
-                mesh=mesh, net_billing=net_billing,
+                mesh=mesh, net_billing=net_billing, daylight=daylight,
             )
             return None, res_c
 
@@ -558,7 +597,7 @@ def year_step(
         res = sizing_ops.size_agents(
             envs, n_periods=n_periods, n_years=econ_years,
             n_iters=sizing_iters, keep_hourly=with_hourly, impl=sizing_impl,
-            mesh=mesh, net_billing=net_billing,
+            mesh=mesh, net_billing=net_billing, daylight=daylight,
         )
 
     # --- market step ---
@@ -810,6 +849,44 @@ class Simulation:
             self.years,
         )
 
+        # daylight-compacted candidate kernels (config-gated; the
+        # full-hour path stays the default parity oracle): the layout
+        # is built host-side from the f32 generation bank BEFORE any
+        # bf16 conversion — bf16 rounding can only send tiny positives
+        # to zero, so the f32 union mask over-covers, never under-covers
+        self._daylight = None
+        if self.run_config.daylight_compact and self._net_billing:
+            from dgen_tpu.ops import billpallas
+
+            self._daylight = billpallas.daylight_layout(
+                np.asarray(profiles.solar_cf)
+            )
+            if self._daylight is None:
+                logger.info(
+                    "daylight_compact requested but the generation bank "
+                    "has no compactable night hours; full-hour kernels"
+                )
+            else:
+                logger.info(
+                    "daylight-compacted kernels: %d of %d month-padded "
+                    "lanes (%.2fx fewer candidate lane-ops)",
+                    self._daylight.n_lanes, billpallas.H_MONTHS,
+                    billpallas.H_MONTHS / self._daylight.n_lanes,
+                )
+
+        # bf16 profile banks (config-gated): halve the HBM-resident
+        # banks AND the gathered O(N*8760) per-agent streams; kernels
+        # upcast to f32 on read (ops.billpallas)
+        if self.run_config.bf16_banks:
+            profiles = jax.tree.map(
+                lambda x: (
+                    x.astype(jnp.bfloat16)
+                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                    else jnp.asarray(x)
+                ),
+                profiles,
+            )
+
         # state-local shard layout (the reference's per-state task
         # binning, SURVEY.md §2.6); results are keyed by agent_id and
         # invariant under the reordering
@@ -826,6 +903,7 @@ class Simulation:
                 hbm_bytes=default_hbm_bytes(),
                 net_billing=self._net_billing,
                 rate_switch=self._rate_switch,
+                bank_bf16=self.run_config.bf16_banks,
             )
             if chunk:
                 logger.info(
@@ -872,6 +950,10 @@ class Simulation:
         # a globally-sharded table would fail under true multi-host
         self.host_agent_id = np.asarray(table.agent_id)
         self.host_mask = np.asarray(table.mask)
+        # state_idx too: the end-of-run STATE_KW_BOUND check maps each
+        # process's addressable carry rows back to states by GLOBAL row
+        # index, which only the host copy can serve under multi-host
+        self.host_state_idx = np.asarray(table.state_idx)
         # _rate_switch (skip the second tariff gather + bill structure
         # when no agent's post-adoption DG rate differs) and
         # _net_billing (whether net-billing bills can EVER price: any
@@ -944,6 +1026,7 @@ class Simulation:
             mesh=self.mesh,
             agent_chunk=self._agent_chunk,
             net_billing=self._net_billing,
+            daylight=self._daylight,
         )
 
     def _hbm_check(self) -> Optional[dict]:
@@ -972,6 +1055,7 @@ class Simulation:
             with_hourly=self.with_hourly,
             net_billing=self._net_billing,
             rate_switch=self._rate_switch,
+            bank_bf16=self.run_config.bf16_banks,
         )
         modeled = rows * per_agent + n_local * 50 * 4
         rec = {
@@ -1007,10 +1091,35 @@ class Simulation:
         """Raise if any state's cumulative capacity reaches
         STATE_KW_BOUND — the value at which the static all-NEM proof
         (the compile-time skip of the net-billing bill path) would stop
-        being sound.  Host-side check on fetched carry data."""
-        kw = np.asarray(jax.device_get(carry.market.system_kw_cum))
+        being sound.  Host-side check on fetched carry data.
+
+        Multi-process runs check each process's ADDRESSABLE shard rows:
+        per-agent kW is nonnegative, so any shard's per-state partial
+        sums lower-bound the global totals — a partial that reaches the
+        bound proves the global total has too, and every row is covered
+        by whichever process holds it (no cross-host gather needed).
+        """
+        arr = carry.market.system_kw_cum
+        if getattr(arr, "is_fully_addressable", True) is not False:
+            kw = np.asarray(jax.device_get(arr))
+            sidx = self.host_state_idx
+        else:
+            rows, starts = [], []
+            seen = set()
+            for s in arr.addressable_shards:
+                sl = s.index[0] if s.index else slice(None)
+                start = sl.start or 0
+                if start in seen:   # in-host replication: one copy
+                    continue
+                seen.add(start)
+                data = np.asarray(s.data)
+                rows.append(data)
+                stop = sl.stop if sl.stop is not None else arr.shape[0]
+                starts.append(np.arange(start, stop))
+            kw = np.concatenate(rows)
+            sidx = self.host_state_idx[np.concatenate(starts)]
         state_kw = np.zeros(self.table.n_states, np.float64)
-        np.add.at(state_kw, np.asarray(self.table.state_idx), kw)
+        np.add.at(state_kw, sidx, kw)
         if not np.all(state_kw < STATE_KW_BOUND):
             raise AssertionError(
                 f"{context}: state capacity exceeds STATE_KW_BOUND; "
@@ -1272,15 +1381,15 @@ class Simulation:
                 jax.block_until_ready(carry.market.market_share)
                 float(jnp.sum(carry.batt_adopters_cum))
         self._hbm_check()
-        if (not self._net_billing and not debug
-                and jax.process_count() == 1):
+        if not self._net_billing and not debug:
             # always-on soundness check for the static all-NEM skip:
             # system_kw_cum is monotone, so one end-of-run bound check
             # covers every year's gate evaluation at the cost of a
-            # single host fetch (the per-year variant runs under debug;
-            # multi-process runs skip it — device_get on an array
-            # spanning non-addressable devices raises, and the bound is
-            # still enforced by any shard run under debug)
+            # single host fetch (the per-year variant runs under
+            # debug). Multi-process runs check their own addressable
+            # shard rows — nonnegative per-agent kW makes the per-shard
+            # partials a sound lower bound on the global state totals,
+            # and the shards jointly cover every row.
             self._check_state_kw_bound(carry, "end of run")
         if ckpt_writer is not None:
             ckpt_writer.close()

@@ -56,11 +56,32 @@ microbench point, tools/kernel_microbench.py):
   The kernel's 38.6G lane-ops of fma+relu at the v5e VPU's ~1G
   lane-op/s/lane-group rate bound the call at ~75-80 ms; 89.5 ms is
   ~97% of that bound with the masked reductions riding along.
+
+**Past the floor: remove lanes, don't reschedule them.** The 38.6G
+lane-op count assumes every candidate touches the full hour axis, but
+rooftop-solar generation is structurally zero at night: wherever
+``gen == 0``, ``relu(load - s*gen) == relu(load)`` for EVERY candidate
+``s``, so roughly half the hours contribute candidate-INDEPENDENT
+sums. The daylight-compacted layout (:func:`daylight_layout`) exploits
+this: the union daylight mask per calendar month (over the whole
+generation bank) defines per-month compacted segments (each padded to
+a 128-lane multiple), the nonlinear kernels run only over those lanes,
+and the night hours' bucket sums — signed, import, and sell-weighted,
+all linear in nothing — are precomputed once per call
+(:func:`_night_sums`) and added back. On the synthetic diurnal banks
+the compacted layout is 4608 lanes vs the 9216 full month-padded
+lanes: 2.0x fewer lane-ops against a ~97%-of-floor kernel
+(tools/kernel_microbench.py ``compact``; real solar banks land at
+1.5-2x depending on the longest summer month). Config-gated
+(``RunConfig.daylight_compact``); the full-hour path stays the
+default-on parity oracle.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +108,10 @@ H_MONTHS = 12 * MONTH_SLOT
 _HOUR_MONTH = hour_month_map()
 
 
+#: full-hour month segment lengths (every month gets the 768-lane slot)
+FULL_SEG_LENS = (MONTH_SLOT,) * MONTHS
+
+
 def _month_layout() -> tuple[np.ndarray, np.ndarray]:
     """(gather idx [H_MONTHS] int32, valid [H_MONTHS] f32) for the
     month-padded repack; cached numpy (no backend touch at import)."""
@@ -101,6 +126,141 @@ def _month_layout() -> tuple[np.ndarray, np.ndarray]:
 
 
 _MONTH_IDX, _MONTH_VALID = _month_layout()
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class DaylightLayout:
+    """Compacted month-padded hour layout for the candidate kernels.
+
+    Built host-side once per scenario (:func:`daylight_layout`) from
+    the generation shape bank: month m's DAYLIGHT hours (union over the
+    whole bank — any agent's gen can be nonzero there) occupy the
+    static lane segment ``[offs[m], offs[m] + seg_lens[m])``, padded to
+    a 128-lane multiple and zero-filled beyond. Night hours never enter
+    the kernels: ``relu(load - s*gen) == relu(load)`` wherever
+    ``gen == 0``, so their bucket sums are candidate-independent and
+    are added back from :func:`_night_sums`.
+
+    Deliberately NOT a pytree: the hour maps are HOST numpy constants
+    (hashable — the object rides ``static_argnames`` like the layout
+    tuple it is), so the engines fold them into the executable exactly
+    like the full-hour ``_MONTH_IDX`` — a traced index operand would
+    instead lower the repack to the pathologically slow TPU runtime
+    gather the bill engine goes out of its way to avoid (see
+    ``bill.select_by_period``).
+    """
+
+    idx: np.ndarray    # [sum(seg_lens)] int32 gather into the 8760 axis
+    valid: np.ndarray  # [sum(seg_lens)] f32, 1 = real daylight lane
+    night: np.ndarray  # [8760] f32, 1 = structurally-zero-gen hour
+    seg_lens: tuple
+
+    def __post_init__(self):
+        for a in (self.idx, self.valid, self.night):
+            a.setflags(write=False)
+        object.__setattr__(
+            self, "_key",
+            (self.seg_lens, self.idx.tobytes(), self.night.tobytes()),
+        )
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DaylightLayout) and self._key == other._key
+
+    def __hash__(self) -> int:
+        return hash(self._key)
+
+    @property
+    def n_lanes(self) -> int:
+        return int(sum(self.seg_lens))
+
+
+def daylight_layout(gen_bank: np.ndarray) -> Optional[DaylightLayout]:
+    """Union-daylight compacted layout from a [*, 8760] generation
+    bank (host numpy; no backend touch). Returns None when compaction
+    cannot drop at least one 128-lane block from any month (a bank
+    with no structurally-zero hours)."""
+    day = np.any(np.asarray(gen_bank) > 0.0, axis=0)
+    if day.shape != (HOURS,):
+        raise ValueError(f"gen bank must have a trailing {HOURS} axis")
+    hm = np.asarray(_HOUR_MONTH)
+    seg_lens = []
+    for m in range(MONTHS):
+        count = int(np.sum(day[hm == m]))
+        seg_lens.append(max(128, -(-count // 128) * 128))
+    if sum(seg_lens) >= H_MONTHS:
+        return None
+    n_lanes = sum(seg_lens)
+    idx = np.zeros(n_lanes, np.int32)
+    valid = np.zeros(n_lanes, np.float32)
+    off = 0
+    for m, seg in enumerate(seg_lens):
+        hrs = np.nonzero((hm == m) & day)[0]
+        idx[off:off + len(hrs)] = hrs
+        valid[off:off + len(hrs)] = 1.0
+        off += seg
+    return DaylightLayout(
+        idx=idx,
+        valid=valid,
+        night=(~day).astype(np.float32),
+        seg_lens=tuple(seg_lens),
+    )
+
+
+def _seg_offsets(seg_lens) -> tuple:
+    offs = []
+    off = 0
+    for s in seg_lens:
+        offs.append(off)
+        off += s
+    return tuple(offs)
+
+
+def _sums_out_dtype(load, gen):
+    """Engine output dtype rule: bf16 banks in -> bf16 bucket sums out.
+
+    The [N, R, B_PAD] candidate sums are the other O(N*R) HBM term of
+    the streaming chunk (comparable to the hour streams at national
+    scale), and monthly-kWh sums at bank precision add the same ~0.4%
+    relative rounding the bf16 inputs already carry — accumulation
+    stays f32 in VMEM; only the stored result is bank-precision. The
+    battery forward pass mixes a f32 dispatch trace into ``gen`` and
+    therefore keeps f32 sums automatically.
+    """
+    if load.dtype == jnp.bfloat16 and gen.dtype == jnp.bfloat16:
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def _night_sums(load, sell, bucket_id, night, n_periods, with_signed):
+    """Candidate-independent night bucket sums in the kernel's
+    [N, B_PAD] output layout: wherever ``gen == 0``,
+    ``relu(load - s*gen) == relu(load)`` and the signed net is just
+    ``load`` — for EVERY candidate scale. Computed once per engine
+    call (O(N*H), pure XLA) and broadcast-added over the candidate
+    axis; returns (imports, signed-or-None)."""
+    from dgen_tpu.ops.bill import monthly_period_sums
+
+    n = load.shape[0]
+    nb = MONTHS * n_periods
+    hour_period = (bucket_id % n_periods).astype(jnp.int32)
+    sell_f = sell.astype(jnp.float32)
+
+    def bucketize(x):  # [N, H] -> [N, nb] month-major
+        mp = jax.vmap(
+            lambda row, hp: monthly_period_sums(row, hp, n_periods)
+        )(x, hour_period)
+        return mp.reshape(n, nb)
+
+    def pack(x):  # [N, H] night stream -> [N, B_PAD] layout row
+        out = jnp.zeros((n, B_PAD), jnp.float32)
+        out = out.at[:, :nb].set(bucketize(x))
+        return out.at[:, SELL_COL].set(jnp.sum(x * sell_f, axis=1))
+
+    load_n = load.astype(jnp.float32) * night[None, :]
+    imp = pack(jnp.maximum(load_n, 0.0))
+    if not with_signed:
+        return imp, None
+    return imp, pack(load_n)
 
 
 def _kernel(scales_ref, load_ref, gen_ref, sell_ref, bucket_ref,
@@ -125,9 +285,10 @@ def _kernel(scales_ref, load_ref, gen_ref, sell_ref, bucket_ref,
     mm_dtype = jnp.bfloat16 if bf16 else jnp.float32
 
     for h0 in range(0, H_PAD, h_chunk):
-        load = load_ref[0, 0, h0:h0 + h_chunk]             # [Hc]
-        gen = gen_ref[0, 0, h0:h0 + h_chunk]
-        sell = sell_ref[0, 0, h0:h0 + h_chunk]
+        # upcast on read: inputs may arrive bf16 (bf16 profile banks)
+        load = load_ref[0, 0, h0:h0 + h_chunk].astype(jnp.float32)  # [Hc]
+        gen = gen_ref[0, 0, h0:h0 + h_chunk].astype(jnp.float32)
+        sell = sell_ref[0, 0, h0:h0 + h_chunk].astype(jnp.float32)
         bucket = bucket_ref[0, 0, h0:h0 + h_chunk]
 
         col = jax.lax.broadcasted_iota(jnp.int32, (h_chunk, B_PAD), 1)
@@ -150,7 +311,8 @@ def _kernel(scales_ref, load_ref, gen_ref, sell_ref, bucket_ref,
 
 
 def _kernel_month(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
-                  *out_refs, r_pad, r_chunk, n_periods, with_signed):
+                  *out_refs, r_pad, r_chunk, n_periods, with_signed,
+                  seg_lens=FULL_SEG_LENS):
     """One agent per program: month-blocked masked reductions.
 
     The round-3 kernel built a per-agent [H, 128] bucket one-hot in VMEM
@@ -175,9 +337,16 @@ def _kernel_month(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
     within ~20% of the irreducible net-build floor (net+relu alone:
     73 ms). Outputs keep the dot kernel's layout ([r_pad, B_PAD],
     bucket cols month-major, sell sums in SELL_COL).
+
+    ``seg_lens`` are the static per-month lane lengths: the full
+    layout's (768,)*12 or a :class:`DaylightLayout`'s compacted
+    segments (same positional-month contract, just fewer lanes).
+    Input refs may be bf16 (bf16 profile banks); the kernel upcasts on
+    read and accumulates in f32.
     """
     scales_all = scales_ref[0, 0, :]                        # [r_pad]
     nb = MONTHS * n_periods
+    offs = _seg_offsets(seg_lens)
 
     for r0 in range(0, r_pad, r_chunk):
         scales = scales_all[r0:r0 + r_chunk]
@@ -186,11 +355,11 @@ def _kernel_month(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
         sell_i = jnp.zeros((r_chunk,), jnp.float32)
         sell_s = jnp.zeros((r_chunk,), jnp.float32)
         for m in range(MONTHS):
-            lo = m * MONTH_SLOT
-            load = load_ref[0, 0, lo:lo + MONTH_SLOT]
-            gen = gen_ref[0, 0, lo:lo + MONTH_SLOT]
-            sell = sell_ref[0, 0, lo:lo + MONTH_SLOT]
-            period = period_ref[0, 0, lo:lo + MONTH_SLOT]
+            lo, ln = offs[m], seg_lens[m]
+            load = load_ref[0, 0, lo:lo + ln].astype(jnp.float32)
+            gen = gen_ref[0, 0, lo:lo + ln].astype(jnp.float32)
+            sell = sell_ref[0, 0, lo:lo + ln].astype(jnp.float32)
+            period = period_ref[0, 0, lo:lo + ln]
 
             net = load[None, :] - scales[:, None] * gen[None, :]
             pos = jnp.maximum(net, 0.0)                 # [r_chunk, 768]
@@ -215,16 +384,20 @@ def _kernel_month(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
         fill = jnp.zeros((r_chunk, B_PAD - nb - 1), jnp.float32)
         out_i = jnp.concatenate(
             [jnp.stack(cols_i, axis=1), fill, sell_i[:, None]], axis=1)
-        out_refs[0][0, r0:r0 + r_chunk, :] = out_i
+        # accumulate f32, store at the output ref's dtype (bf16 under
+        # bf16 profile banks — sums at bank precision, half the HBM)
+        out_refs[0][0, r0:r0 + r_chunk, :] = out_i.astype(out_refs[0].dtype)
         if with_signed:
             out_s = jnp.concatenate(
                 [jnp.stack(cols_s, axis=1), fill, sell_s[:, None]], axis=1)
-            out_refs[1][0, r0:r0 + r_chunk, :] = out_s
+            out_refs[1][0, r0:r0 + r_chunk, :] = \
+                out_s.astype(out_refs[1].dtype)
 
 
 def _kernel_month_pair(scales_ref, load_ref, gen_ref,
                        sell_a_ref, period_a_ref, sell_b_ref, period_b_ref,
-                       out_a_ref, out_b_ref, *, r_pad, r_chunk, n_periods):
+                       out_a_ref, out_b_ref, *, r_pad, r_chunk, n_periods,
+                       seg_lens=FULL_SEG_LENS):
     """Imports bucket sums for TWO tariff structures over ONE net grid.
 
     Rate-switch populations (reference apply_rate_switch,
@@ -237,6 +410,7 @@ def _kernel_month_pair(scales_ref, load_ref, gen_ref,
     """
     scales_all = scales_ref[0, 0, :]
     nb = MONTHS * n_periods
+    offs = _seg_offsets(seg_lens)
 
     for r0 in range(0, r_pad, r_chunk):
         scales = scales_all[r0:r0 + r_chunk]
@@ -245,13 +419,13 @@ def _kernel_month_pair(scales_ref, load_ref, gen_ref,
         sell_acc_a = jnp.zeros((r_chunk,), jnp.float32)
         sell_acc_b = jnp.zeros((r_chunk,), jnp.float32)
         for m in range(MONTHS):
-            lo = m * MONTH_SLOT
-            load = load_ref[0, 0, lo:lo + MONTH_SLOT]
-            gen = gen_ref[0, 0, lo:lo + MONTH_SLOT]
-            sell_a = sell_a_ref[0, 0, lo:lo + MONTH_SLOT]
-            period_a = period_a_ref[0, 0, lo:lo + MONTH_SLOT]
-            sell_b = sell_b_ref[0, 0, lo:lo + MONTH_SLOT]
-            period_b = period_b_ref[0, 0, lo:lo + MONTH_SLOT]
+            lo, ln = offs[m], seg_lens[m]
+            load = load_ref[0, 0, lo:lo + ln].astype(jnp.float32)
+            gen = gen_ref[0, 0, lo:lo + ln].astype(jnp.float32)
+            sell_a = sell_a_ref[0, 0, lo:lo + ln].astype(jnp.float32)
+            period_a = period_a_ref[0, 0, lo:lo + ln]
+            sell_b = sell_b_ref[0, 0, lo:lo + ln].astype(jnp.float32)
+            period_b = period_b_ref[0, 0, lo:lo + ln]
 
             net = load[None, :] - scales[:, None] * gen[None, :]
             pos = jnp.maximum(net, 0.0)                 # shared
@@ -274,19 +448,24 @@ def _kernel_month_pair(scales_ref, load_ref, gen_ref,
 
         fill = jnp.zeros((r_chunk, B_PAD - nb - 1), jnp.float32)
         out_a_ref[0, r0:r0 + r_chunk, :] = jnp.concatenate(
-            [jnp.stack(cols_a, axis=1), fill, sell_acc_a[:, None]], axis=1)
+            [jnp.stack(cols_a, axis=1), fill, sell_acc_a[:, None]], axis=1
+        ).astype(out_a_ref.dtype)
         out_b_ref[0, r0:r0 + r_chunk, :] = jnp.concatenate(
-            [jnp.stack(cols_b, axis=1), fill, sell_acc_b[:, None]], axis=1)
+            [jnp.stack(cols_b, axis=1), fill, sell_acc_b[:, None]], axis=1
+        ).astype(out_b_ref.dtype)
 
 
-def _pick_r_chunk(r_pad: int, with_signed: bool) -> int:
-    """Largest multiple-of-8 scales chunk whose [r_chunk, 768] working
-    set (net + pos + masked temporaries; signed keeps both live) stays
-    well under the 16 MB VMEM."""
+def _pick_r_chunk(r_pad: int, with_signed: bool,
+                  max_seg: int = MONTH_SLOT) -> int:
+    """Largest multiple-of-8 scales chunk whose [r_chunk, max_seg]
+    working set (net + pos + masked temporaries; signed keeps both
+    live) stays well under the 16 MB VMEM. ``max_seg`` is the longest
+    month segment (768 full-hour; less under a DaylightLayout, which
+    buys proportionally larger scale chunks)."""
     live = 4 if with_signed else 3
     budget = 10_000_000
     r_chunk = min(r_pad, 1024)
-    while r_chunk > 8 and live * 4 * r_chunk * MONTH_SLOT > budget:
+    while r_chunk > 8 and live * 4 * r_chunk * max_seg > budget:
         r_chunk //= 2
     r_chunk = _round8(r_chunk)
     while r_pad % r_chunk:   # chunks must tile the padded scales axis
@@ -322,31 +501,44 @@ def _pick_h_chunk(r_pad: int, with_signed: bool) -> int:
     return 552
 
 
-def _month_repack(*arrays):
-    """Host-side month-padded repack shared by every pallas engine:
-    gather each [N, 8760] array into the [N, 12*768] month-positional
-    layout (zero-filled pad lanes — downstream sums see exact zeros) and
-    add the kernel's singleton block dim. The layout contract lives
-    HERE only; _kernel_month/_kernel_month_pair consume it."""
-    idx = jnp.asarray(_MONTH_IDX)
-    valid = jnp.asarray(_MONTH_VALID)
+def _month_repack(arrays, idx=None, valid=None):
+    """Month-positional repack shared by every pallas engine: gather
+    each [N, 8760] array into the month-padded lane layout (zero-filled
+    pad lanes — downstream sums see exact zeros) and add the kernel's
+    singleton block dim. ``idx``/``valid`` default to the full-hour
+    layout; a :class:`DaylightLayout` supplies compacted ones — both
+    are HOST numpy constants, so XLA folds the gather (a traced index
+    operand would hit the slow TPU runtime-gather path). The layout
+    contract lives HERE only; _kernel_month/_kernel_month_pair consume
+    it. Float streams keep their dtype (bf16 banks stay bf16 through
+    VMEM; 0/1 valid is exact in bf16)."""
+    if idx is None:
+        idx = _MONTH_IDX
+        valid = _MONTH_VALID
     out = []
     for a in arrays:
         if a.dtype == jnp.int32:
             out.append(a[:, idx][:, None, :])   # pad lanes harmless:
             # their VALUES are zeroed in the float streams
         else:
-            out.append((a[:, idx] * valid[None, :])[:, None, :])
+            out.append((a[:, idx] * valid.astype(a.dtype)[None, :])[:, None, :])
     return out
 
 
-def _sums_pallas(load, gen, sell, bucket_id, scales, with_signed,
-                 n_periods=None, bf16=False):
+def _sums_pallas(load, gen, sell, bucket_id, scales, *, with_signed,
+                 n_periods=None, bf16=False, layout=None):
     """Month-blocked masked-reduction engine (see _kernel_month).
 
     ``bucket_id`` must be the canonical month-major layout
     (hourly_bucket_ids: month * n_periods + period), from which the
     per-hour TOU period is recovered as ``bucket_id % n_periods``.
+
+    ``layout``: optional :class:`DaylightLayout` (a static host-side
+    constant) — the kernel then runs only over the compacted daylight
+    lanes and the candidate-independent night bucket sums are added
+    back (exact wherever the layout's premise — gen == 0 off-daylight
+    — holds, which :func:`daylight_layout` guarantees by construction
+    for bank-derived gen).
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -354,59 +546,83 @@ def _sums_pallas(load, gen, sell, bucket_id, scales, with_signed,
     n = load.shape[0]
     r = scales.shape[1]
     r_pad = _round8(r)
-    r_chunk = _pick_r_chunk(r_pad, with_signed)
+    segs = FULL_SEG_LENS if layout is None else layout.seg_lens
+    h_lanes = sum(segs)
+    r_chunk = _pick_r_chunk(r_pad, with_signed, max(segs))
+    out_dtype = _sums_out_dtype(load, gen)
 
     period = (bucket_id % n_periods).astype(jnp.int32)
     load_p, gen_p, sell_p, period_p = _month_repack(
-        load, gen, sell, period)
+        (load, gen, sell, period),
+        None if layout is None else layout.idx,
+        None if layout is None else layout.valid,
+    )
     scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
 
     out3 = lambda i: (i, 0, 0)
     n_out = 2 if with_signed else 1
     outs = pl.pallas_call(
         partial(_kernel_month, r_pad=r_pad, r_chunk=r_chunk,
-                n_periods=n_periods, with_signed=with_signed),
+                n_periods=n_periods, with_signed=with_signed,
+                seg_lens=segs),
         grid=(n,),
         in_specs=[
             pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, h_lanes), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, h_lanes), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, h_lanes), out3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, h_lanes), out3, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, r_pad, B_PAD), out3, memory_space=pltpu.VMEM)
         ] * n_out,
         out_shape=[
-            jax.ShapeDtypeStruct((n, r_pad, B_PAD), jnp.float32)
+            jax.ShapeDtypeStruct((n, r_pad, B_PAD), out_dtype)
         ] * n_out,
         cost_estimate=pl.CostEstimate(
-            flops=(4 + 2 * n_periods) * n_out * n * r_pad * H_MONTHS,
-            bytes_accessed=5 * n * H_MONTHS * 4,
+            flops=(4 + 2 * n_periods) * n_out * n * r_pad * h_lanes,
+            bytes_accessed=5 * n * h_lanes * 4,
             transcendentals=0,
         ),
     )(scales_p, load_p, gen_p, sell_p, period_p)
     # imports first to match the dot engine's historical output order
-    return tuple(o[:, :r] for o in outs)
+    outs = tuple(o[:, :r] for o in outs)
+    if layout is None:
+        return outs
+    night_i, night_s = _night_sums(
+        load, sell, bucket_id, layout.night, n_periods, with_signed)
+    add = lambda o, nn: (
+        o.astype(jnp.float32) + nn[:, None, :]).astype(out_dtype)
+    if with_signed:
+        return (add(outs[0], night_i), add(outs[1], night_s))
+    return (add(outs[0], night_i),)
 
 
 def _sums_pallas_pair(load, gen, sell_a, bucket_a, sell_b, bucket_b,
-                      scales, n_periods):
+                      scales, *, n_periods, layout=None):
     """Fused two-tariff imports engine (see _kernel_month_pair):
-    (imports_a, imports_b), each [N, R, B_PAD]."""
+    (imports_a, imports_b), each [N, R, B_PAD]. Accepts the same
+    optional static DaylightLayout as :func:`_sums_pallas` (night sums
+    are added per tariff structure)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n = load.shape[0]
     r = scales.shape[1]
     r_pad = _round8(r)
-    r_chunk = _pick_r_chunk(r_pad, with_signed=True)  # 2 mask sets live
+    segs = FULL_SEG_LENS if layout is None else layout.seg_lens
+    h_lanes = sum(segs)
+    r_chunk = _pick_r_chunk(r_pad, with_signed=True,
+                            max_seg=max(segs))  # 2 mask sets live
+    out_dtype = _sums_out_dtype(load, gen)
 
     load_p, gen_p, sell_a_p, period_a_p, sell_b_p, period_b_p = (
         _month_repack(
-            load, gen,
-            sell_a, (bucket_a % n_periods).astype(jnp.int32),
-            sell_b, (bucket_b % n_periods).astype(jnp.int32),
+            (load, gen,
+             sell_a, (bucket_a % n_periods).astype(jnp.int32),
+             sell_b, (bucket_b % n_periods).astype(jnp.int32)),
+            None if layout is None else layout.idx,
+            None if layout is None else layout.valid,
         )
     )
     scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
@@ -414,26 +630,35 @@ def _sums_pallas_pair(load, gen, sell_a, bucket_a, sell_b, bucket_b,
     out3 = lambda i: (i, 0, 0)
     outs = pl.pallas_call(
         partial(_kernel_month_pair, r_pad=r_pad, r_chunk=r_chunk,
-                n_periods=n_periods),
+                n_periods=n_periods, seg_lens=segs),
         grid=(n,),
         in_specs=[
             pl.BlockSpec((1, 1, r_pad), out3, memory_space=pltpu.VMEM),
         ] + [
-            pl.BlockSpec((1, 1, H_MONTHS), out3, memory_space=pltpu.VMEM)
+            pl.BlockSpec((1, 1, h_lanes), out3, memory_space=pltpu.VMEM)
         ] * 6,
         out_specs=[
             pl.BlockSpec((1, r_pad, B_PAD), out3, memory_space=pltpu.VMEM)
         ] * 2,
         out_shape=[
-            jax.ShapeDtypeStruct((n, r_pad, B_PAD), jnp.float32)
+            jax.ShapeDtypeStruct((n, r_pad, B_PAD), out_dtype)
         ] * 2,
         cost_estimate=pl.CostEstimate(
-            flops=(5 + 4 * n_periods) * n * r_pad * H_MONTHS,
-            bytes_accessed=7 * n * H_MONTHS * 4,
+            flops=(5 + 4 * n_periods) * n * r_pad * h_lanes,
+            bytes_accessed=7 * n * h_lanes * 4,
             transcendentals=0,
         ),
     )(scales_p, load_p, gen_p, sell_a_p, period_a_p, sell_b_p, period_b_p)
-    return tuple(o[:, :r] for o in outs)
+    outs = tuple(o[:, :r] for o in outs)
+    if layout is None:
+        return outs
+    night_a, _ = _night_sums(
+        load, sell_a, bucket_a, layout.night, n_periods, False)
+    night_b, _ = _night_sums(
+        load, sell_b, bucket_b, layout.night, n_periods, False)
+    add = lambda o, nn: (
+        o.astype(jnp.float32) + nn[:, None, :]).astype(out_dtype)
+    return (add(outs[0], night_a), add(outs[1], night_b))
 
 
 def _sums_pallas_dot(load, gen, sell, bucket_id, scales, with_signed,
@@ -482,7 +707,8 @@ def _sums_pallas_dot(load, gen, sell, bucket_id, scales, with_signed,
     return tuple(o[:, :r] for o in outs)
 
 
-def _sums_xla(load, gen, sell, bucket_id, scales, n_buckets, with_signed):
+def _sums_xla(load, gen, sell, bucket_id, scales, *, n_buckets,
+              with_signed, layout=None):
     """Pure-XLA twin (CPU tests, sharded runs): one [N, H] pass per
     scale via lax.map, bucketed with per-period masked matmuls against
     the SHARED month one-hot — no per-agent [H, B] one-hot is ever
@@ -491,6 +717,12 @@ def _sums_xla(load, gen, sell, bucket_id, scales, n_buckets, with_signed):
     ``bucket_id = month * P + period`` implies
     ``period = bucket_id mod P`` (P = n_buckets // 12), so the period
     mask is recovered without needing the tariff here.
+
+    With a static :class:`DaylightLayout` the per-scale pass runs over
+    the compacted daylight lanes only — the same gather/positional-
+    month algebra as the pallas kernel, so CPU parity tests exercise
+    the compacted path's math, not just its results — and the night
+    sums are added back exactly as on TPU.
     """
     from dgen_tpu.ops.bill import monthly_period_sums
 
@@ -498,29 +730,58 @@ def _sums_xla(load, gen, sell, bucket_id, scales, n_buckets, with_signed):
     hour_period = (bucket_id % n_periods).astype(jnp.int32)
     n = load.shape[0]
 
-    def bucketize(x):  # [N, H] -> [N, B] month-major
-        mp = jax.vmap(
-            lambda row, hp: monthly_period_sums(row, hp, n_periods)
-        )(x, hour_period)                                    # [N, 12, P]
-        return mp.reshape(n, n_buckets)
+    if layout is None:
+        def bucketize(x):  # [N, H] -> [N, B] month-major
+            mp = jax.vmap(
+                lambda row, hp: monthly_period_sums(row, hp, n_periods)
+            )(x, hour_period)                                # [N, 12, P]
+            return mp.reshape(n, n_buckets)
+
+        load_c, gen_c, sell_c = load, gen, sell
+    else:
+        # compact gather (static numpy indices — constant-folded);
+        # float lanes zeroed beyond each month's daylight count, the
+        # hour->month map positional
+        month_of_lane = np.repeat(
+            np.arange(MONTHS), layout.seg_lens)              # [Hc] static
+        onehot_c = np.eye(MONTHS, dtype=np.float32)[month_of_lane]
+        idx, valid = layout.idx, layout.valid
+        vf = lambda a: a[:, idx].astype(jnp.float32) * valid[None, :]
+        load_c, gen_c, sell_c = vf(load), vf(gen), vf(sell)
+        period_c = hour_period[:, idx]
+
+        def bucketize(x):  # [N, Hc] -> [N, B] month-major
+            cols = [
+                (x * (period_c == p).astype(x.dtype)) @ onehot_c
+                for p in range(n_periods)
+            ]
+            return jnp.stack(cols, axis=-1).reshape(n, n_buckets)
 
     def per_scale(s_r):
-        net = load - s_r[:, None] * gen                      # [N, H]
+        net = load_c - s_r[:, None] * gen_c                  # [N, Hc]
         pos = jnp.maximum(net, 0.0)
         imports = bucketize(pos)
-        imp_sell = jnp.sum(pos * sell, axis=1)
+        imp_sell = jnp.sum(pos * sell_c, axis=1)
         if with_signed:
             return (imports, imp_sell), (bucketize(net),
-                                         jnp.sum(net * sell, axis=1))
+                                         jnp.sum(net * sell_c, axis=1))
         return ((imports, imp_sell),)
 
     outs = jax.lax.map(per_scale, jnp.swapaxes(scales, 0, 1))
+    if layout is None:
+        nights = (None, None)
+    else:
+        nights = _night_sums(
+            load, sell, bucket_id, layout.night, n_periods, with_signed)
+    out_dtype = _sums_out_dtype(load, gen)
     result = []
-    for buckets, sell_sum in outs:
+    for (buckets, sell_sum), night_o in zip(outs, nights):
         o = jnp.swapaxes(buckets, 0, 1)                      # [N, R, B]
         o = jnp.pad(o, ((0, 0), (0, 0), (0, B_PAD - n_buckets)))
         o = o.at[:, :, SELL_COL].set(jnp.swapaxes(sell_sum, 0, 1))
-        result.append(o)
+        if night_o is not None:
+            o = o + night_o[:, None, :]
+        result.append(o.astype(out_dtype))
     return tuple(result)
 
 
@@ -537,7 +798,9 @@ def _maybe_shard_agents(fn, mesh, n_out: int, n_in: int = 5):
     is fully per-agent (grid=(n,)), so under a >1-device mesh the engine
     runs unchanged on each shard — this is what lets the Pallas kernel
     (not partition-aware by itself) live inside the sharded year step
-    instead of downgrading to the XLA twin.
+    instead of downgrading to the XLA twin. (A DaylightLayout rides in
+    as closed-over host constants — shared hour-axis maps, identical on
+    every shard.)
     """
     if mesh is None or mesh.devices.size <= 1:
         return fn
@@ -564,7 +827,8 @@ def _check_buckets(n_buckets: int) -> None:
         )
 
 
-@partial(jax.jit, static_argnames=("n_buckets", "impl", "bf16", "mesh"))
+@partial(jax.jit,
+         static_argnames=("n_buckets", "impl", "bf16", "mesh", "layout"))
 def import_sums(
     load: jax.Array,      # [N, 8760]
     gen: jax.Array,       # [N, 8760]
@@ -575,25 +839,37 @@ def import_sums(
     impl: str = "auto",
     bf16: bool = False,
     mesh=None,
+    layout: Optional[DaylightLayout] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(imports [N,R,B], imp_sell [N,R]): positive-part bucket sums and
-    the sell-weighted positive-part sum for R net-load scales."""
+    the sell-weighted positive-part sum for R net-load scales.
+
+    ``layout``: optional :class:`DaylightLayout` (STATIC — hashable
+    host constant) — the candidate kernel then touches only the
+    compacted daylight lanes and the night hours' candidate-independent
+    sums are added back; totals cover ALL hours either way. Valid only
+    when ``gen`` is zero off-daylight (true for any bank-derived
+    generation the layout was built from); the legacy ``pallas_dot``
+    engine ignores it (full-hour A/B reference)."""
     _check_buckets(n_buckets)
     resolved = _resolve_impl(impl)
     if resolved == "pallas":
         fn = partial(_sums_pallas, with_signed=False,
-                     n_periods=n_buckets // MONTHS, bf16=bf16)
+                     n_periods=n_buckets // MONTHS, bf16=bf16,
+                     layout=layout)
     elif resolved == "pallas_dot":
+        # full-hour engine; results are identical totals either way
         fn = partial(_sums_pallas_dot, with_signed=False, bf16=bf16)
     else:
-        fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=False)
+        fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=False,
+                     layout=layout)
     (imp,) = _maybe_shard_agents(fn, mesh, 1)(
         load, gen, sell, bucket_id, scales
     )
     return imp[:, :, :n_buckets], imp[:, :, SELL_COL]
 
 
-@partial(jax.jit, static_argnames=("n_buckets", "impl", "mesh"))
+@partial(jax.jit, static_argnames=("n_buckets", "impl", "mesh", "layout"))
 def import_sums_pair(
     load: jax.Array,       # [N, 8760]
     gen: jax.Array,        # [N, 8760]
@@ -605,25 +881,31 @@ def import_sums_pair(
     n_buckets: int,
     impl: str = "auto",
     mesh=None,
+    layout: Optional[DaylightLayout] = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """(imports_a [N,R,B], imp_sell_a [N,R], imports_b, imp_sell_b):
     the rate-switch search's two tariff structures priced over ONE
     shared ``relu(load - s*gen)`` grid (reference apply_rate_switch,
     agent_mutation/elec.py:838-845) — ~40% faster than two
-    :func:`import_sums` calls on TPU because the net build dominates."""
+    :func:`import_sums` calls on TPU because the net build dominates.
+    ``layout`` as in :func:`import_sums` (night sums are added per
+    tariff structure)."""
     _check_buckets(n_buckets)
     resolved = _resolve_impl(impl)
     if resolved == "pallas":
-        fn = partial(_sums_pallas_pair, n_periods=n_buckets // MONTHS)
+        fn = partial(_sums_pallas_pair, n_periods=n_buckets // MONTHS,
+                     layout=layout)
         imp_a, imp_b = _maybe_shard_agents(fn, mesh, 2, n_in=7)(
             load, gen, sell_a, bucket_a, sell_b, bucket_b, scales
         )
     else:
         # XLA twin / dot engine: two independent single-tariff passes
         # (the fusion is a TPU-kernel optimization, not a semantic one)
-        engine = (_sums_pallas_dot if resolved == "pallas_dot"
-                  else partial(_sums_xla, n_buckets=n_buckets))
-        fa = partial(engine, with_signed=False)
+        if resolved == "pallas_dot":
+            fa = partial(_sums_pallas_dot, with_signed=False)
+        else:
+            fa = partial(_sums_xla, n_buckets=n_buckets,
+                         with_signed=False, layout=layout)
         (imp_a,) = _maybe_shard_agents(fa, mesh, 1)(
             load, gen, sell_a, bucket_a, scales)
         (imp_b,) = _maybe_shard_agents(fa, mesh, 1)(
@@ -679,9 +961,16 @@ def linear_sums(
     Pure XLA: per TOU period, one [N, 8760] x [8760, 12] matmul against
     the SHARED month one-hot — full MXU row tiles over the agent axis,
     no per-agent kernel program needed.
+
+    Inputs are upcast to f32 first: this runs ONCE per year step, and
+    under bf16 profile banks an 8760-term bf16 accumulation would lose
+    the linear identity's precision for no meaningful HBM saving.
     """
     from dgen_tpu.ops.bill import monthly_period_sums
 
+    load = load.astype(jnp.float32)
+    gen = gen.astype(jnp.float32)
+    sell = sell.astype(jnp.float32)
     n = load.shape[0]
 
     def bucketize(x):  # vmapped shared-month-one-hot bucketing
@@ -712,7 +1001,9 @@ def sell_rate_hourly(tariff, ts_sell: jax.Array) -> jax.Array:
 
     tou = select_by_period(tariff.hour_period, tariff.sell_price, ts_sell)
     has_tou = jnp.any(tariff.sell_price > 0.0, axis=1, keepdims=True)
-    return jnp.where(has_tou, tou, ts_sell)
+    # keep the bank dtype: under bf16 profile banks the sell stream
+    # rides VMEM at 2 bytes/lane like load/gen (no-op for f32)
+    return jnp.where(has_tou, tou, ts_sell).astype(ts_sell.dtype)
 
 
 def _tier_charge_batched(sums_mp, tariff):

@@ -255,7 +255,7 @@ def size_one_agent(
     3. One forward run with a battery at the fixed PV ratio
        (reference financial_functions.py:479).
     """
-    naep = jnp.sum(env.gen_per_kw)
+    naep = jnp.sum(env.gen_per_kw.astype(jnp.float32))
     max_system = env.load_kwh_per_customer / jnp.maximum(naep, 1e-9)
     lo = max_system * SIZE_LO_FRAC
     hi = max_system * SIZE_HI_FRAC
@@ -286,8 +286,11 @@ def size_one_agent(
         dispatch_ops.DEFAULT_RT_EFF if env.batt_rt_eff is None
         else env.batt_rt_eff
     )
+    # f32 dispatch even under bf16 banks (same rule as the fast path:
+    # the SOC recursion compounds rounding over 8760 steps)
+    load_f32 = env.load.astype(jnp.float32)
     dr = dispatch_ops.dispatch_battery(
-        env.load, gen_n, batt_kw, batt_kwh, rt_eff
+        load_f32, gen_n, batt_kw, batt_kwh, rt_eff
     )
     # Battery capex enters the cost basis at 0.7x for the ITC treatment
     # (reference financial_functions.py:219).
@@ -305,10 +308,10 @@ def size_one_agent(
 
     if keep_hourly:
         baseline_net, net_pvonly, net_with_batt = net_hourly_profiles(
-            env.load, gen_n, dr.system_out
+            load_f32, gen_n, dr.system_out
         )
     else:
-        empty = jnp.zeros((0,), dtype=env.load.dtype)
+        empty = jnp.zeros((0,), dtype=jnp.float32)
         baseline_net = net_pvonly = net_with_batt = empty
 
     return SizingResult(
@@ -335,7 +338,7 @@ def size_one_agent(
 @partial(
     jax.jit,
     static_argnames=("n_periods", "n_years", "n_iters", "keep_hourly", "impl",
-                     "mesh", "net_billing"),
+                     "mesh", "net_billing", "daylight"),
 )
 def _size_agents_fast(
     envs: AgentEconInputs,
@@ -346,6 +349,7 @@ def _size_agents_fast(
     impl: str,
     mesh=None,
     net_billing: bool = True,
+    daylight=None,
 ) -> SizingResult:
     """Table-level sizing via two refining candidate-grid rounds.
 
@@ -363,7 +367,8 @@ def _size_agents_fast(
     f32 = jnp.float32
     k = max(int(n_iters), 4)
 
-    naep = jnp.sum(envs.gen_per_kw, axis=1)                       # [N]
+    # f32 accumulation even under bf16 profile banks (8760-term sum)
+    naep = jnp.sum(envs.gen_per_kw.astype(f32), axis=1)           # [N]
     max_system = envs.load_kwh_per_customer / jnp.maximum(naep, 1e-9)
     lo = max_system * SIZE_LO_FRAC
     hi = max_system * SIZE_HI_FRAC
@@ -465,7 +470,7 @@ def _size_agents_fast(
         if not has_switch:
             imports, imp_sell = billpallas.import_sums(
                 envs.load, gen_shape, sell, bucket, scales, n_buckets,
-                impl, mesh=mesh,
+                impl, mesh=mesh, layout=daylight,
             )
             return billpallas.bills_linear_nb(
                 lin, imports, imp_sell, scales, tw, n_periods
@@ -476,7 +481,7 @@ def _size_agents_fast(
         imports, imp_sell, imports_o, imp_sell_o = (
             billpallas.import_sums_pair(
                 envs.load, gen_shape, sell, bucket, sell_wo, bucket_wo,
-                scales, n_buckets, impl, mesh=mesh,
+                scales, n_buckets, impl, mesh=mesh, layout=daylight,
             )
         )
         bills_sw = billpallas.bills_linear_nb(
@@ -549,8 +554,11 @@ def _size_agents_fast(
         jnp.full(n, dispatch_ops.DEFAULT_RT_EFF, f32)
         if envs.batt_rt_eff is None else envs.batt_rt_eff
     )
+    # f32 dispatch even under bf16 banks: the SOC recursion compounds
+    # rounding over 8760 steps
+    load_f32 = envs.load.astype(f32)
     dr = jax.vmap(dispatch_ops.dispatch_battery)(
-        envs.load, gen_n, batt_kw, batt_kwh, rt_eff
+        load_f32, gen_n, batt_kw, batt_kwh, rt_eff
     )
     batt_cost = envs.batt_capex_per_kwh_combined * batt_kwh * 0.7
     sw_star = _switch_active(envs, kw_star)                       # [N]
@@ -587,10 +595,10 @@ def _size_agents_fast(
 
     if keep_hourly:
         baseline_net, net_pvonly, net_with_batt = net_hourly_profiles(
-            envs.load, gen_n, dr.system_out
+            load_f32, gen_n, dr.system_out
         )
     else:
-        empty = jnp.zeros((n, 0), dtype=envs.load.dtype)
+        empty = jnp.zeros((n, 0), dtype=f32)
         baseline_net = net_pvonly = net_with_batt = empty
 
     bills_wo_y1 = bills_wo[:, 0]
@@ -625,6 +633,7 @@ def size_agents(
     impl: str = "auto",
     mesh=None,
     net_billing: bool = True,
+    daylight=None,
 ) -> SizingResult:
     """Sizing over the whole agent table (leading axis).
 
@@ -638,6 +647,11 @@ def size_agents(
     prices on a net-billing tariff, so search-round bills reduce to the
     linear NEM identity and skip the hourly kernel — the driver derives
     this from the tariffs the population actually references.
+    ``daylight``: optional :class:`billpallas.DaylightLayout` — the
+    search-round import kernels run over the compacted daylight lanes
+    only (night sums added back; the battery forward run always prices
+    full-hour, since a discharging battery breaks the night-zero
+    premise).
     """
     if (envs.nem_kw_cap is None or envs.switch_min_kw is None
             or envs.switch_max_kw is None):
@@ -660,7 +674,7 @@ def size_agents(
         return _size_agents_fast(
             envs, n_periods=n_periods, n_years=n_years, n_iters=n_iters,
             keep_hourly=keep_hourly, impl=impl, mesh=mesh,
-            net_billing=net_billing,
+            net_billing=net_billing, daylight=daylight,
         )
     fn = partial(
         size_one_agent,

@@ -57,7 +57,12 @@ def enable() -> Optional[str]:
     so import-time callers don't trigger backend bring-up."""
     global _enabled_dir
     d = cache_dir()
-    if d is None or _enabled_dir == d:
+    if d is None:
+        # flipping DGEN_TPU_CACHE_DIR off mid-process must actually
+        # disarm a previously-enabled cache, not report it as active
+        disable()
+        return None
+    if _enabled_dir == d:
         return _enabled_dir
     import jax
 

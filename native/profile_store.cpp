@@ -13,10 +13,15 @@
 //
 // File format "DGPB1\0":
 //   [0:6)   magic "DGPB1\0"
-//   [6:8)   dtype code (u16 little-endian): 0 = f32
+//   [6:8)   dtype code (u16 little-endian): 0 = f32, 1 = bf16
 //   [8:16)  rows (u64 LE)
 //   [16:24) cols (u64 LE)
 //   [24:..) row-major payload
+//
+// bf16 banks (dtype 1) halve the on-disk and mmap footprint of the
+// 8760-hour profile banks; the Python face converts to/from
+// ml_dtypes.bfloat16 and the TPU runtime consumes them natively
+// (RunConfig.bf16_banks).
 //
 // C ABI only (consumed via ctypes; no pybind11 in this image).
 
@@ -43,11 +48,14 @@ struct Handle {
   size_t map_len = 0;
   uint64_t rows = 0;
   uint64_t cols = 0;
+  uint16_t dtype = 0;  // 0 = f32, 1 = bf16
 };
 
 thread_local std::string g_err;
 
 void set_err(const std::string& e) { g_err = e; }
+
+size_t elem_size(uint16_t dtype) { return dtype == 1 ? 2 : 4; }
 
 }  // namespace
 
@@ -55,26 +63,39 @@ extern "C" {
 
 const char* dg_last_error() { return g_err.c_str(); }
 
-// Write a row-major f32 matrix as a DGPB1 file. Returns 0 on success.
-int dg_store_write(const char* path, const float* data, uint64_t rows,
-                   uint64_t cols) {
+// Write a row-major matrix as a DGPB1 file; dtype 0 = f32 payload,
+// 1 = bf16 payload (caller supplies already-converted bytes).
+// Returns 0 on success.
+int dg_store_write2(const char* path, const void* data, uint64_t rows,
+                    uint64_t cols, int dtype) {
+  if (dtype != 0 && dtype != 1) {
+    set_err("unsupported dtype code");
+    return -1;
+  }
   FILE* f = std::fopen(path, "wb");
   if (!f) {
     set_err(std::string("open for write failed: ") + std::strerror(errno));
     return -1;
   }
-  uint16_t dtype = 0;
+  uint16_t dt = static_cast<uint16_t>(dtype);
+  size_t es = elem_size(dt);
   bool ok = std::fwrite(kMagic, 1, 6, f) == 6 &&
-            std::fwrite(&dtype, 2, 1, f) == 1 &&
+            std::fwrite(&dt, 2, 1, f) == 1 &&
             std::fwrite(&rows, 8, 1, f) == 1 &&
             std::fwrite(&cols, 8, 1, f) == 1 &&
-            std::fwrite(data, sizeof(float), rows * cols, f) == rows * cols;
+            std::fwrite(data, es, rows * cols, f) == rows * cols;
   if (std::fclose(f) != 0) ok = false;
   if (!ok) {
     set_err("short write");
     return -1;
   }
   return 0;
+}
+
+// Legacy f32 entry point (kept for ABI stability).
+int dg_store_write(const char* path, const float* data, uint64_t rows,
+                   uint64_t cols) {
+  return dg_store_write2(path, data, rows, cols, 0);
 }
 
 // mmap a DGPB1 file; fills rows/cols; returns an opaque handle or null.
@@ -105,9 +126,16 @@ void* dg_store_open(const char* path, uint64_t* rows, uint64_t* cols) {
   auto* h = new Handle();
   h->map = map;
   h->map_len = st.st_size;
+  std::memcpy(&h->dtype, base + 6, 2);
   std::memcpy(&h->rows, base + 8, 8);
   std::memcpy(&h->cols, base + 16, 8);
-  if (kHeader + h->rows * h->cols * sizeof(float) > h->map_len) {
+  if (h->dtype != 0 && h->dtype != 1) {
+    set_err("unsupported dtype code");
+    munmap(map, st.st_size);
+    delete h;
+    return nullptr;
+  }
+  if (kHeader + h->rows * h->cols * elem_size(h->dtype) > h->map_len) {
     set_err("truncated payload");
     munmap(map, st.st_size);
     delete h;
@@ -116,6 +144,11 @@ void* dg_store_open(const char* path, uint64_t* rows, uint64_t* cols) {
   *rows = h->rows;
   *cols = h->cols;
   return h;
+}
+
+// Element dtype code of an open bank (0 = f32, 1 = bf16).
+int dg_store_dtype(void* handle) {
+  return static_cast<Handle*>(handle)->dtype;
 }
 
 const float* dg_store_data(void* handle) {

@@ -78,3 +78,17 @@ def test_stats_counts_entries(tmp_path):
         f.write(b"x" * 10)
     s = cc.stats()
     assert s["entries"] == 1 and s["bytes"] == 10
+
+
+def test_env_off_mid_process_disarms(tmp_path, monkeypatch):
+    """Regression (ADVICE r5): enable() with the env flipped to "off"
+    must DISABLE a previously-armed cache, not keep reporting the stale
+    directory as active."""
+    import jax
+
+    d = cc.enable()
+    assert d is not None and jax.config.jax_compilation_cache_dir == d
+    monkeypatch.setenv("DGEN_TPU_CACHE_DIR", "off")
+    assert cc.enable() is None
+    assert cc._enabled_dir is None
+    assert jax.config.jax_compilation_cache_dir is None

@@ -181,3 +181,102 @@ def test_golden_fixture_exercises_converter_surface(golden_run):
     kw = (res.agent["system_kw_cum"] * m[None, :]).sum(axis=1)
     assert kw[-1] > 0
     assert np.all(np.diff(kw) >= -1e-3)
+
+
+def _rerun_golden(pop, run_config):
+    """Re-run the golden scenario on an already-converted population
+    with a different RunConfig (the config-gated perf paths)."""
+    cfg = ScenarioConfig(name="golden", start_year=2014, end_year=2050,
+                         anchor_years=())
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups,
+        n_regions=np.asarray(pop.profiles.wholesale).shape[0],
+        overrides={
+            "attachment_rate": np.full((pop.table.n_groups,), 0.35,
+                                       np.float32),
+        },
+        n_states=pop.table.n_states,
+    )
+    sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+                     run_config, with_hourly=True)
+    return sim, sim.run()
+
+
+def test_golden_daylight_compact_parity(golden_run):
+    """ISSUE 2 acceptance: the daylight-compacted kernels reproduce the
+    full-hour golden e2e to <= 1e-5 relative on the bill engine's
+    outputs — the compaction only re-associates f32 sums, so the
+    full-hour path remains a true parity oracle.
+
+    The parity surface is the PRE-argmax economics (bills): the sizing
+    search's discrete candidate grid can flip an agent between two
+    near-tied sizes on a ~1e-7 bill difference, moving that agent's kW
+    by < 1% of its bracket — so the post-argmax national curves get a
+    1e-4 envelope (observed: one tie-flip agent, 3.4e-5) while the
+    bills themselves must hold 1e-5."""
+    pop, res_f, _ = golden_run
+    sim, res_d = _rerun_golden(
+        pop, RunConfig(sizing_iters=8, daylight_compact=True))
+    assert sim._daylight is not None, \
+        "golden solar profiles should have compactable night hours"
+    mask = np.asarray(pop.table.mask)
+
+    # pre-argmax kernel surface on the GOLDEN population: compacted
+    # XLA twin vs full-hour, <= 1e-5 relative (the acceptance bound)
+    import jax
+    import jax.numpy as jnp
+
+    from dgen_tpu.ops import bill as bill_ops
+    from dgen_tpu.ops import billpallas as bp
+    from dgen_tpu.ops import sizing as sizing_ops
+
+    t = pop.table
+    load = pop.profiles.load[t.load_idx] * \
+        t.load_kwh_per_customer_in_bin[:, None]
+    gen = pop.profiles.solar_cf[t.cf_idx] * sizing_ops.INV_EFF
+    ts = pop.profiles.wholesale[t.region_idx]
+    at = jax.vmap(lambda k: bill_ops.gather_tariff(pop.tariffs, k))(
+        t.tariff_idx)
+    p = pop.tariffs.max_periods
+    bucket = bp.hourly_bucket_ids(at.hour_period, p)
+    sell = bp.sell_rate_hourly(at, ts)
+    scales = jnp.asarray(
+        np.abs(np.random.default_rng(0).normal(
+            2.0, 1.5, (load.shape[0], 8))).astype(np.float32))
+    full = bp.import_sums(load, gen, sell, bucket, scales, 12 * p,
+                          impl="xla")
+    comp = bp.import_sums(load, gen, sell, bucket, scales, 12 * p,
+                          impl="xla", layout=sim._daylight)
+    for a, c in zip(full, comp):
+        a, c = np.asarray(a), np.asarray(c)
+        scale = max(float(np.max(np.abs(a))), 1.0)
+        assert float(np.max(np.abs(a - c))) / scale < 1e-5
+
+    s_f = res_f.summary(mask)
+    s_d = res_d.summary(mask)
+    for k in ("adopters", "system_kw_cum", "batt_kwh_cum"):
+        np.testing.assert_allclose(s_d[k], s_f[k], rtol=1e-4, err_msg=k)
+    np.testing.assert_allclose(
+        (res_d.agent["npv"] * mask), (res_f.agent["npv"] * mask),
+        rtol=1e-3, atol=25.0,
+    )
+
+
+def test_golden_bf16_banks_within_tolerance(golden_run):
+    """bf16 profile banks against the f32 golden run: the documented
+    envelope is 2% on national adoption curves (inputs carry ~0.4%
+    rounding; the sizing search and diffusion amplify mildly). A
+    violation means the bf16 path's precision story changed — retune
+    or re-document, don't just bump the bound."""
+    pop, res_f, _ = golden_run
+    _, res_b = _rerun_golden(
+        pop, RunConfig(sizing_iters=8, bf16_banks=True))
+    mask = np.asarray(pop.table.mask)
+    s_f = res_f.summary(mask)
+    s_b = res_b.summary(mask)
+    for k in ("adopters", "system_kw_cum", "batt_kwh_cum"):
+        ref = np.maximum(np.abs(np.asarray(s_f[k], np.float64)), 1e-6)
+        rel = np.max(np.abs(s_b[k] - s_f[k]) / ref)
+        assert rel < 2e-2, f"{k}: bf16 drift {rel:.3e} exceeds envelope"
+    for v in res_b.agent.values():
+        assert np.all(np.isfinite(v))

@@ -334,3 +334,28 @@ def test_exporter_surfaces(tmp_path):
     sh = exp.load_surface(str(tmp_path / "run"), "state_hourly")
     assert len(sh) == pop.table.n_states * len(sim.years)
     assert len(sh["net_load_mw"].iloc[0]) == 8760
+
+
+def test_exporter_stamps_nonfinite_zeroed_count(tmp_path):
+    """Compact quantization zeroes non-finite elements; the per-run
+    count must land in meta.json so repaired data is visible in the
+    run's provenance."""
+    import json
+
+    n = 6
+    ex = exp.RunExporter(str(tmp_path / "run"), agent_id=np.arange(n),
+                         mask=np.ones(n, np.float32), compact=True)
+    meta0 = json.load(open(tmp_path / "run" / "meta.json"))
+    assert meta0["nonfinite_zeroed"] == 0
+
+    dirty = jnp.asarray([1.0, np.nan, 2.0, np.inf, -np.inf, 3.0],
+                        jnp.float32)
+    clean = jnp.arange(n, dtype=jnp.float32)
+    (rows_d, rows_c), _ = ex._local_fields([dirty, clean],
+                                           quant=(True, True))
+    # the three non-finite elements came back as exact zeros
+    np.testing.assert_allclose(rows_d[[1, 3, 4]], 0.0)
+    np.testing.assert_allclose(rows_c, np.arange(n), atol=1e-3)
+    ex._flush_meta()
+    meta = json.load(open(tmp_path / "run" / "meta.json"))
+    assert meta["nonfinite_zeroed"] == 3

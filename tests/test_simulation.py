@@ -260,6 +260,22 @@ def test_auto_agent_chunk_budget():
         10**6, sizing_iters=10, econ_years=25, with_hourly=False,
         hbm_bytes=None) == 0
 
+    # bf16 profile banks halve the bank-derived hour streams: at a
+    # fixed HBM budget the auto chunk must grow >= 1.5x (ISSUE 2
+    # acceptance), and the footprint model must reflect the cut
+    c_bf = sm.auto_agent_chunk(65536, bank_bf16=True, **kw)
+    assert c_bf >= 1.5 * c, (c_bf, c)
+    per_f32 = sm._per_agent_step_bytes(
+        sizing_iters=10, econ_years=25, with_hourly=False)
+    per_bf = sm._per_agent_step_bytes(
+        sizing_iters=10, econ_years=25, with_hourly=False, bank_bf16=True)
+    assert per_bf < per_f32
+    # pinned: f32 floor stays, bank streams drop to 2 bytes/hour, and
+    # the candidate-sums outputs store at bank precision (2 bytes)
+    hour_bf = (4 * sm._LIVE_HOUR_ARRAYS_F32
+               + 2 * (sm._LIVE_HOUR_ARRAYS - sm._LIVE_HOUR_ARRAYS_F32))
+    assert per_bf == hour_bf * 8832 + 2 * 2 * 256 * 128
+
     # a Simulation built on the CPU backend keeps whole-table semantics
     sim, _ = make_sim(end_year=2016)
     assert sim._agent_chunk == 0
@@ -512,3 +528,86 @@ def test_avoided_co2_outputs():
     np.testing.assert_allclose(
         naep_v, np.broadcast_to(naep_v[0], naep_v.shape), rtol=5e-3)
     assert np.all((naep_v[0] > 500.0) & (naep_v[0] < 3000.0))
+
+
+def test_chunked_matches_whole_table_fast():
+    """Push-gated (fast-tier) representative of the equivalence family:
+    a cheap 2-year chunked-vs-whole-table check, so a core streaming
+    regression fails on push instead of waiting for the nightly slow
+    tier (the thorough hourly/sharded variants above stay slow)."""
+    end = 2016
+    sim_u, pop = make_sim(end_year=end)
+    sim_c, _ = make_sim(
+        end_year=end,
+        run_config=RunConfig(sizing_iters=8, agent_chunk=64),
+    )
+    assert sim_c._agent_chunk == 64, "chunked path should engage"
+    res_u = sim_u.run()
+    res_c = sim_c.run()
+    m = np.asarray(sim_u.table.mask)
+    n = len(m)
+    for k in ("system_kw_cum", "number_of_adopters", "npv"):
+        np.testing.assert_allclose(
+            res_u.agent[k] * m, res_c.agent[k][:, :n] * m,
+            rtol=2e-5, atol=1e-4, err_msg=k,
+        )
+
+
+def test_daylight_compact_run_matches_oracle():
+    """RunConfig.daylight_compact end to end: same adoption, sizing and
+    economics as the full-hour oracle path (<= 1e-5 relative; the
+    compacted kernels only re-associate f32 sums)."""
+    sim_o, pop = make_sim(end_year=2016)
+    sim_d, _ = make_sim(
+        end_year=2016,
+        run_config=RunConfig(sizing_iters=8, daylight_compact=True),
+    )
+    assert sim_d._daylight is not None, "synth bank should compact"
+    assert sim_d._daylight.n_lanes < 9216
+    res_o = sim_o.run()
+    res_d = sim_d.run()
+    m = np.asarray(pop.table.mask)
+    for k in ("system_kw_cum", "number_of_adopters", "npv",
+              "payback_period"):
+        a, b = res_o.agent[k] * m, res_d.agent[k] * m
+        scale = max(float(np.max(np.abs(a))), 1.0)
+        assert float(np.max(np.abs(a - b))) / scale < 1e-5, k
+
+    # the layout rides the streaming scan too (closed over per chunk)
+    sim_dc, _ = make_sim(
+        end_year=2016,
+        run_config=RunConfig(sizing_iters=8, daylight_compact=True,
+                             agent_chunk=64),
+    )
+    assert sim_dc._agent_chunk == 64 and sim_dc._daylight is not None
+    res_dc = sim_dc.run()
+    n = len(m)
+    for k in ("system_kw_cum", "npv"):
+        a, b = res_o.agent[k] * m, res_dc.agent[k][:, :n] * m
+        scale = max(float(np.max(np.abs(a))), 1.0)
+        assert float(np.max(np.abs(a - b))) / scale < 2e-5, k
+
+
+def test_bf16_banks_run_within_tolerance():
+    """RunConfig.bf16_banks end to end: banks convert to bf16 (kernels
+    upcast on read), the run stays finite, and national curves land
+    within the documented ~1% of the f32 run."""
+    import jax.numpy as jnp
+
+    sim_f, pop = make_sim(end_year=2016)
+    sim_b, _ = make_sim(
+        end_year=2016,
+        run_config=RunConfig(sizing_iters=8, bf16_banks=True),
+    )
+    assert sim_b.profiles.load.dtype == jnp.bfloat16
+    assert sim_b.profiles.solar_cf.dtype == jnp.bfloat16
+    res_f = sim_f.run()
+    res_b = sim_b.run()
+    m = np.asarray(pop.table.mask)
+    for v in res_b.agent.values():
+        assert np.all(np.isfinite(v))
+    s_f = res_f.summary(m)
+    s_b = res_b.summary(m)
+    for k in ("adopters", "system_kw_cum"):
+        scale = max(float(np.max(np.abs(s_f[k]))), 1.0)
+        assert float(np.max(np.abs(s_f[k] - s_b[k]))) / scale < 1e-2, k

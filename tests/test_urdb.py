@@ -143,3 +143,25 @@ def test_design_tariff_extracts_target_revenue():
         for i in take
     ])
     np.testing.assert_allclose(bills, expect, rtol=1e-4)
+
+
+def test_schedule_remap_never_mutates_caller_arrays():
+    """Regression (ADVICE r5): the out-of-range period remap must copy
+    before writing — callers handing ndarrays in the record must get
+    them back untouched."""
+    sched = np.full((12, 24), 7, np.int64)   # all out of range for P=2
+    months = np.asarray([5] * 12, np.int64)  # out of range constructs
+    record = {
+        "energyratestructure": [[{"rate": 0.1}], [{"rate": 0.2}]],
+        "energyweekdayschedule": sched,
+        "energyweekendschedule": sched,
+        "flatdemandstructure": [[{"rate": 3.0}], [{"rate": 5.0}]],
+        "flatdemandmonths": months,
+    }
+    energy, demand = urdb.urdb_rate_to_specs(record)
+    # the specs saw the remapped-to-0 values...
+    assert np.all(np.asarray(energy["e_wkday_12by24"]) == 0)
+    assert demand is not None
+    # ...but the caller's arrays are untouched
+    np.testing.assert_array_equal(sched, np.full((12, 24), 7, np.int64))
+    np.testing.assert_array_equal(months, np.asarray([5] * 12, np.int64))

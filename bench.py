@@ -52,6 +52,10 @@ Knobs (env):
                           flag is stamped into the payload
   DGEN_TPU_BENCH_BF16     run with RunConfig.bf16_banks=1 (bf16 profile
                           banks; larger auto chunks at fixed HBM)
+  DGEN_TPU_BENCH_SWEEP    <S>: also run an S-way identical-scenario
+                          sweep (dgen_tpu.sweep) vs one single run and
+                          stamp S, per-scenario wall, bank-bytes-shared
+                          and the amortization ratio into the payload
 """
 
 from __future__ import annotations
@@ -687,6 +691,51 @@ def main() -> None:
                 del sim_c, pop_c
             except Exception as e:  # noqa: BLE001
                 config_points[key] = {"failed": str(e)[:200]}
+
+    # --- S-way identical-scenario sweep A/B (DGEN_TPU_BENCH_SWEEP=<S>):
+    # captures the amortization win of one bank upload + one compile
+    # shared across scenarios, vs S independent full runs ---
+    sweep_env = os.environ.get("DGEN_TPU_BENCH_SWEEP", "").strip()
+    if sweep_env:
+        s_way = int(sweep_env)
+        if not spendable(point_est * 3):
+            skipped["sweep"] = "budget"
+        else:
+            try:
+                from dgen_tpu.sweep import SweepSimulation
+
+                sim_sw, pop_sw = _build(n_agents, 2022)
+                t0 = time.time()
+                sim_sw.run(collect=False)
+                single_s = time.time() - t0
+                # S references to ONE ScenarioInputs: an identical-
+                # scenario sweep, so per-scenario wall isolates the
+                # engine overhead rather than scenario divergence
+                sweep = SweepSimulation(
+                    pop_sw.table, pop_sw.profiles, pop_sw.tariffs,
+                    [sim_sw.inputs] * s_way, sim_sw.scenario,
+                    sim_sw.run_config,
+                )
+                t0 = time.time()
+                sweep.run(collect=False)
+                wall = time.time() - t0
+                payload["sweep"] = {
+                    "s": s_way,
+                    "modes": [g.mode for g in sweep.plan.groups],
+                    "wall_s": round(wall, 2),
+                    "per_scenario_wall_s": round(wall / s_way, 3),
+                    "single_run_wall_s": round(single_s, 2),
+                    "amortization_x": round(
+                        single_s * s_way / max(wall, 1e-9), 2),
+                    "bank_bytes_shared": int(sweep.bank_bytes_shared),
+                }
+                del sim_sw, pop_sw, sweep
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["sweep"] = {
+                    "s": s_way,
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
 
     if os.environ.get("DGEN_TPU_BENCH_SKIP_CPU") or not spendable(120.0):
         if not os.environ.get("DGEN_TPU_BENCH_SKIP_CPU"):

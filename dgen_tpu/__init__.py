@@ -36,5 +36,6 @@ from dgen_tpu import (  # noqa: F401
     models,
     ops,
     parallel,
+    sweep,
     utils,
 )

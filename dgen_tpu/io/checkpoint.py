@@ -30,6 +30,17 @@ import orbax.checkpoint as ocp
 from dgen_tpu.models.simulation import SimCarry
 
 
+def scenario_dir(directory: str, scenario: Optional[str]) -> str:
+    """Per-scenario checkpoint subdirectory of a sweep run: scenario
+    ``s`` of a sweep under ``directory`` checkpoints into
+    ``directory/scn=<s>/``, so a killed sweep resumes at (scenario,
+    year) rather than restarting every scenario. ``None`` keeps the
+    flat single-run layout."""
+    if scenario is None:
+        return directory
+    return os.path.join(directory, f"scn={scenario}")
+
+
 def _mgr(directory: str) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(
         os.path.abspath(directory),
@@ -42,10 +53,14 @@ class Writer:
     manager per save re-scans the directory and restarts worker threads
     every year). ``force=True`` overwrites an existing step — without
     it orbax silently skips the save and a later resume would restore
-    stale carries from a previous run into the same directory."""
+    stale carries from a previous run into the same directory.
 
-    def __init__(self, directory: str) -> None:
-        self._mgr = _mgr(directory)
+    ``scenario`` selects the per-scenario subdirectory layout
+    (:func:`scenario_dir`) used by sweep runs."""
+
+    def __init__(self, directory: str, scenario: Optional[str] = None
+                 ) -> None:
+        self._mgr = _mgr(scenario_dir(directory, scenario))
 
     def save(self, year: int, carry: SimCarry) -> None:
         if year in self._mgr.all_steps():
@@ -70,13 +85,16 @@ class Writer:
         self.close()
 
 
-def save_year(directory: str, year: int, carry: SimCarry) -> None:
+def save_year(directory: str, year: int, carry: SimCarry,
+              scenario: Optional[str] = None) -> None:
     """One-shot save (prefer :class:`Writer` inside run loops)."""
-    with Writer(directory) as w:
+    with Writer(directory, scenario=scenario) as w:
         w.save(year, carry)
 
 
-def latest_year(directory: str) -> Optional[int]:
+def latest_year(directory: str, scenario: Optional[str] = None
+                ) -> Optional[int]:
+    directory = scenario_dir(directory, scenario)
     if not os.path.isdir(directory):
         return None
     with _mgr(directory) as mgr:
@@ -89,22 +107,52 @@ def restore_year(
     n_agents: int,
     year: Optional[int] = None,
     sharding=None,
+    scenario: Optional[str] = None,
+    n_scenarios: Optional[int] = None,
 ) -> Tuple[int, SimCarry]:
     """(year, carry) for ``year`` (default: latest checkpointed year).
 
     ``sharding``: a jax Sharding to restore each leaf onto (pass the
     run's agent-axis NamedSharding for mesh/multi-host runs — shards
     are read straight to their devices, no full-array host copy).
+    ``scenario`` reads a sweep's per-scenario subdirectory;
+    ``n_scenarios`` restores a STACKED carry (every leaf ``[S, ...]``
+    — the sweep engine's vmapped lockstep checkpoint).
     """
+    directory = scenario_dir(directory, scenario)
     with _mgr(directory) as mgr:
         step = year if year is not None else mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
         zeros = SimCarry.zeros(n_agents)
+        if n_scenarios is not None:
+            zeros = jax.tree.map(
+                lambda x: jax.numpy.broadcast_to(
+                    x, (n_scenarios,) + x.shape
+                ),
+                zeros,
+            )
         if sharding is not None:
+            leaf_sharding = sharding
+            if n_scenarios is not None:
+                # a stacked carry prepends the scenario axis, so the
+                # caller's agent-axis spec must shift one dim right
+                # (scenario axis replicated) or it would partition
+                # scenarios across the agent mesh axis
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                if not isinstance(sharding, NamedSharding):
+                    raise TypeError(
+                        "restore_year(n_scenarios=..., sharding=...) "
+                        "requires a NamedSharding so the agent-axis "
+                        "spec can shift past the leading scenario axis"
+                    )
+                leaf_sharding = NamedSharding(
+                    sharding.mesh, PartitionSpec(None, *sharding.spec)
+                )
             template = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(
-                    x.shape, x.dtype, sharding=sharding
+                    x.shape, x.dtype, sharding=leaf_sharding
                 ),
                 zeros,
             )

@@ -107,6 +107,82 @@ class ScenarioInputs:
         return self.pv_capex_per_kw.shape[0]
 
 
+class ScenarioStackError(ValueError):
+    """Scenarios cannot share one device program: a static field (a
+    leaf's shape or dtype) differs between members. The message names
+    the offending field."""
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ScenarioStack:
+    """S :class:`ScenarioInputs` stacked along a leading scenario axis.
+
+    ``inputs`` holds the same pytree structure as one scenario but with
+    every leaf shaped ``[S, ...]`` — scenarios differ only in these
+    small trajectory arrays, never in the multi-GB profile banks, so a
+    whole policy sweep adds O(S x Y x G) bytes to a run, not O(S x
+    N x 8760). Built with :func:`stack_scenarios`, which validates that
+    the static configuration (every leaf's shape and dtype — year grid,
+    group/region/state counts) agrees across members.
+    """
+
+    inputs: ScenarioInputs   # every leaf [S, ...]
+
+    @property
+    def n_scenarios(self) -> int:
+        return self.inputs.pv_capex_per_kw.shape[0]
+
+    @property
+    def n_years(self) -> int:
+        return self.inputs.pv_capex_per_kw.shape[1]
+
+    def scenario(self, i: int) -> ScenarioInputs:
+        """Unstack member ``i`` (host-side convenience; the sweep
+        engine slices on device instead)."""
+        return jax.tree.map(lambda leaf: leaf[i], self.inputs)
+
+
+def validate_scenario_statics(members: Sequence[ScenarioInputs]) -> None:
+    """Check that S scenarios share one static configuration: every
+    leaf must agree in shape and dtype across members (scenarios in a
+    stack share a compiled program, so the year grid and the
+    group/region/state axis sizes must match exactly). Raises
+    :class:`ScenarioStackError` naming the offending field. Shared by
+    :func:`stack_scenarios` and the sweep planner
+    (dgen_tpu.sweep.plan)."""
+    members = list(members)
+    if not members:
+        raise ScenarioStackError("cannot stack zero scenarios")
+    ref = members[0]
+    for f in dataclasses.fields(ScenarioInputs):
+        ref_leaf = jnp.asarray(getattr(ref, f.name))
+        for i, m in enumerate(members[1:], start=1):
+            leaf = jnp.asarray(getattr(m, f.name))
+            if leaf.shape != ref_leaf.shape:
+                raise ScenarioStackError(
+                    f"scenario {i} field '{f.name}' has shape "
+                    f"{leaf.shape} but scenario 0 has {ref_leaf.shape}; "
+                    "scenarios in one stack must share the static grid "
+                    "(years / groups / regions / states)"
+                )
+            if leaf.dtype != ref_leaf.dtype:
+                raise ScenarioStackError(
+                    f"scenario {i} field '{f.name}' has dtype "
+                    f"{leaf.dtype} but scenario 0 has {ref_leaf.dtype}"
+                )
+
+
+def stack_scenarios(members: Sequence[ScenarioInputs]) -> ScenarioStack:
+    """Stack S scenarios into one :class:`ScenarioStack` (static
+    configuration validated by :func:`validate_scenario_statics`; a
+    mismatch raises :class:`ScenarioStackError` naming the field)."""
+    members = list(members)
+    validate_scenario_statics(members)
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *members)
+    return ScenarioStack(inputs=stacked)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class YearAgentInputs:

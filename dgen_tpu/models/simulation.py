@@ -490,19 +490,7 @@ def _constrain_chunked(mesh: Mesh, a: jax.Array) -> jax.Array:
     return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "n_periods", "econ_years", "sizing_iters", "first_year",
-        "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
-        "rate_switch", "mesh", "agent_chunk", "net_billing", "daylight",
-    ),
-    # the cross-year carry is threaded linearly (every caller rebinds
-    # it), so XLA may alias the update in place instead of holding two
-    # copies of the [N]-leaf market state per year (dgenlint L7)
-    donate_argnames=("carry",),
-)
-def year_step(
+def year_step_impl(
     table: AgentTable,
     profiles: ProfileBank,
     tariffs: TariffBank,
@@ -764,6 +752,91 @@ def year_step(
     return new_carry, outputs
 
 
+#: names of year_step's compile-time arguments — shared with the sweep
+#: engine (dgen_tpu.sweep.driver), whose vmapped program jits the same
+#: impl over a scenario axis with the same static set
+YEAR_STEP_STATIC_ARGNAMES = (
+    "n_periods", "econ_years", "sizing_iters", "first_year",
+    "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
+    "rate_switch", "mesh", "agent_chunk", "net_billing", "daylight",
+)
+
+#: the jitted one-year program. The cross-year carry is threaded
+#: linearly (every caller rebinds it), so XLA may alias the update in
+#: place instead of holding two copies of the [N]-leaf market state per
+#: year (dgenlint L7). ``year_step_impl`` stays reachable un-jitted so
+#: the sweep engine can vmap it over a scenario axis inside its own jit
+#: (donation of an inner jit's argument would be ignored under that
+#: trace).
+year_step = partial(
+    jax.jit,
+    static_argnames=YEAR_STEP_STATIC_ARGNAMES,
+    donate_argnames=("carry",),
+)(year_step_impl)
+
+
+def table_static_cache(table: AgentTable, tariffs: TariffBank) -> dict:
+    """The scenario-invariant half of :func:`run_static_flags` — the
+    rate-switch predicate, the any-net-billing-tariff predicate (an
+    O(N log N) np.unique over the agent tariff indices), and the
+    keep-masked NEM columns. A sweep computes this once and reuses it
+    across its S per-scenario flag evaluations."""
+    keep0 = np.asarray(table.mask) > 0
+    rate_switch = bool(np.any(
+        np.asarray(table.tariff_switch_idx)
+        != np.asarray(table.tariff_idx)
+    ))
+    metering = np.asarray(tariffs.metering)
+    used = np.unique(np.concatenate([
+        np.asarray(table.tariff_idx)[keep0],
+        np.asarray(table.tariff_switch_idx)[keep0],
+    ]))
+    return {
+        "rate_switch": rate_switch,
+        "any_nb_tariff": bool(np.any(metering[used] == NET_BILLING)),
+        "state_idx": np.asarray(table.state_idx)[keep0],
+        "nem_first_year": np.asarray(table.nem_first_year)[keep0],
+        "nem_sunset_year": np.asarray(table.nem_sunset_year)[keep0],
+        "nem_kw_limit": np.asarray(table.nem_kw_limit)[keep0],
+    }
+
+
+def run_static_flags(
+    table: AgentTable,
+    tariffs: TariffBank,
+    inputs: ScenarioInputs,
+    years: List[int],
+    table_cache: Optional[dict] = None,
+) -> tuple[bool, bool]:
+    """(rate_switch, net_billing): the two host-decided compile-time
+    predicates of a run, computed from the UNPADDED semantics (padding
+    only adds masked rows and partitioning only reorders, so the
+    predicates are invariant).
+
+    ``rate_switch``: any agent's post-adoption DG rate differs from its
+    base tariff (skips the second tariff gather + bill structure when
+    False). ``net_billing``: whether net-billing bills can EVER price —
+    any referenced net-billing tariff, or a NEM gate that can close
+    (build_econ_inputs forces NET_BILLING at runtime when it does);
+    False statically skips the hourly bucket-sums kernel and prices
+    bills by the linear NEM identity. Shared by Simulation.__init__ and
+    the sweep planner (scenarios whose flags differ cannot share one
+    compiled program); the planner passes a precomputed
+    :func:`table_static_cache` so only the per-scenario NEM-gate proof
+    reruns per member.
+    """
+    tc = table_cache or table_static_cache(table, tariffs)
+    net_billing = tc["any_nb_tariff"] or not nem_gate_never_closes(
+        tc["state_idx"],
+        np.asarray(inputs.nem_cap_kw),
+        tc["nem_first_year"],
+        tc["nem_sunset_year"],
+        tc["nem_kw_limit"],
+        years,
+    )
+    return tc["rate_switch"], net_billing
+
+
 # ---------------------------------------------------------------------------
 # Host-side driver
 # ---------------------------------------------------------------------------
@@ -826,36 +899,27 @@ class Simulation:
                 f"{len(self.years)}"
             )
 
-        # static flags, computed BEFORE chunking/partitioning (padding
-        # only adds masked rows and partitioning only reorders, so the
-        # predicates are invariant — and the HBM chunk model needs them)
-        keep0 = np.asarray(table.mask) > 0
-        self._rate_switch = bool(np.any(
-            np.asarray(table.tariff_switch_idx)
-            != np.asarray(table.tariff_idx)
-        ))
-        metering = np.asarray(tariffs.metering)
-        used = np.unique(np.concatenate([
-            np.asarray(table.tariff_idx)[keep0],
-            np.asarray(table.tariff_switch_idx)[keep0],
-        ]))
-        any_nb_tariff = bool(np.any(metering[used] == NET_BILLING))
-        self._net_billing = any_nb_tariff or not nem_gate_never_closes(
-            np.asarray(table.state_idx)[keep0],
-            np.asarray(inputs.nem_cap_kw),
-            np.asarray(table.nem_first_year)[keep0],
-            np.asarray(table.nem_sunset_year)[keep0],
-            np.asarray(table.nem_kw_limit)[keep0],
-            self.years,
+        # static flags, computed BEFORE chunking/partitioning (the HBM
+        # chunk model needs them); see run_static_flags
+        self._rate_switch, self._net_billing = run_static_flags(
+            table, tariffs, inputs, self.years
         )
+        #: optional label prefixed to this run's timer names (utils.
+        #: timing ctx) — the sweep engine sets it per scenario so S
+        #: scenarios' year_step timings report separately
+        self.timing_ctx: Optional[str] = None
 
         # daylight-compacted candidate kernels (config-gated; the
         # full-hour path stays the default parity oracle): the layout
         # is built host-side from the f32 generation bank BEFORE any
         # bf16 conversion — bf16 rounding can only send tiny positives
-        # to zero, so the f32 union mask over-covers, never under-covers
+        # to zero, so the f32 union mask over-covers, never under-covers.
+        # Built whenever the config asks (not gated on _net_billing): an
+        # all-NEM program simply ignores it, and with_inputs siblings
+        # whose NEM gate CAN close (sweep groups) inherit a live layout
+        # instead of silently running full-hour kernels.
         self._daylight = None
-        if self.run_config.daylight_compact and self._net_billing:
+        if self.run_config.daylight_compact:
             from dgen_tpu.ops import billpallas
 
             self._daylight = billpallas.daylight_layout(
@@ -1126,6 +1190,46 @@ class Simulation:
                 "the static all-NEM kernel skip is unsound for this run"
             )
 
+    def with_inputs(
+        self,
+        inputs: ScenarioInputs,
+        net_billing: Optional[bool] = None,
+        timing_ctx: Optional[str] = None,
+    ) -> "Simulation":
+        """A sibling runner driving different ScenarioInputs over THIS
+        simulation's already-placed table, profile banks, tariffs and
+        chunk/partition layout — the sweep engine's scenario-major
+        loop: every sibling shares the same static year_step arguments,
+        so S scenarios execute the one compiled program pair and the
+        multi-GB banks are uploaded exactly once.
+
+        ``inputs`` must cover the same year grid. ``net_billing``
+        overrides the recomputed flag (the sweep planner pins it per
+        scenario group so a mixed group cannot split the executable);
+        passing True for an all-NEM scenario is numerically exact —
+        False is only ever a compile-time skip of the bucket-sums
+        kernel. The daylight layout is inherited as-is (it depends only
+        on the shared generation bank)."""
+        import copy
+
+        if len(self.years) != inputs.n_years:
+            raise ValueError(
+                f"inputs cover {inputs.n_years} years but this "
+                f"simulation has {len(self.years)}"
+            )
+        if net_billing is None:
+            _, net_billing = run_static_flags(
+                self.table, self.tariffs, inputs, self.years
+            )
+        if self.mesh is not None:
+            repl = NamedSharding(self.mesh, P())
+            inputs = jax.tree.map(lambda x: self._put(x, repl), inputs)
+        sib = copy.copy(self)
+        sib.inputs = inputs
+        sib._net_billing = net_billing
+        sib.timing_ctx = timing_ctx
+        return sib
+
     def init_carry(self) -> SimCarry:
         carry = SimCarry.zeros(self.table.n_agents)
         if self._shard is not None:
@@ -1272,7 +1376,7 @@ class Simulation:
                 if trace_now:
                     jax.profiler.start_trace(profile_dir)
                 try:
-                    with timing.timer("year_step"):
+                    with timing.timer("year_step", ctx=self.timing_ctx):
                         prev_carry = carry
                         carry, outs = self.step(carry, yi, first_year=(yi == 0))
                         if sync_per_year:
@@ -1377,7 +1481,7 @@ class Simulation:
             # scalar fetch (not just block_until_ready) guarantees the
             # chain really executed even on remote-tunnel platforms
             # with lazy readiness semantics
-            with timing.timer("device_drain"):
+            with timing.timer("device_drain", ctx=self.timing_ctx):
                 jax.block_until_ready(carry.market.market_share)
                 float(jnp.sum(carry.batt_adopters_cum))
         self._hbm_check()

@@ -1,0 +1,24 @@
+"""Batched multi-scenario sweep engine.
+
+Runs S scenarios (policy variants: ITC schedules, retail-price
+escalators, storage-cost curves, NEM caps...) in one process against
+ONE HBM-resident copy of the agent table and profile banks — the
+scenario axis rides the small [Y, ...] trajectory arrays, never the
+multi-GB hourly banks. See :mod:`dgen_tpu.sweep.driver` for the
+execution modes, :mod:`dgen_tpu.sweep.plan` for the grouping/HBM
+planner, and ``python -m dgen_tpu.sweep --help`` for the CLI.
+"""
+
+from dgen_tpu.sweep.driver import (  # noqa: F401
+    SweepSimulation,
+    bank_nbytes,
+    sweep_year_step,
+)
+from dgen_tpu.sweep.plan import (  # noqa: F401
+    MODE_LOOP,
+    MODE_VMAP,
+    ScenarioGroup,
+    SweepPlan,
+    plan_sweep,
+)
+from dgen_tpu.sweep.results import SweepResults  # noqa: F401

@@ -1,0 +1,453 @@
+"""The sweep driver: S scenarios in one program against one
+HBM-resident copy of the agent table and profile banks.
+
+A policy sweep in the reference is S independent invocations of the
+whole pipeline — S re-ingests of the same population and S re-uploads
+of the identical [N, 8760] profile banks. Here the banks and the agent
+table are placed ONCE (the :class:`~dgen_tpu.models.simulation.
+Simulation` placement path, reused as-is) and only the small
+[Y, ...]-shaped :class:`~dgen_tpu.models.scenario.ScenarioInputs`
+leaves carry a scenario axis. Per planner group
+(:mod:`dgen_tpu.sweep.plan`) execution is either:
+
+* **vmap mode** — one jitted program per model year vmapping
+  :func:`~dgen_tpu.models.simulation.year_step_impl` over the scenario
+  axis (:func:`sweep_year_step`); the per-year economics batch S-wide
+  on device, sharing every gathered bank read's upstream state; or
+* **loop mode** — scenario-major: each scenario runs through the SAME
+  compiled single-scenario ``year_step`` executable (identical static
+  arguments by construction —
+  :meth:`~dgen_tpu.models.simulation.Simulation.with_inputs` siblings),
+  so S scenarios pay one compile and HBM stays bounded by the
+  single-scenario ``auto_agent_chunk``. Mesh runs always take this
+  path: scenario groups ride the existing shard_map layout unchanged.
+
+Steady-state years never retrace in either mode (RetraceGuard-armed
+when ``RunConfig.guard_retrace`` is set: in vmap mode from the third
+executed year, in loop mode additionally across scenarios — scenario
+1..S-1 must compile NOTHING).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.models.scenario import (
+    ScenarioInputs,
+    ScenarioStack,
+    stack_scenarios,
+)
+from dgen_tpu.models.simulation import (
+    YEAR_STEP_STATIC_ARGNAMES,
+    SimCarry,
+    SimResults,
+    Simulation,
+    YearOutputs,
+    year_step_impl,
+)
+from dgen_tpu.sweep.plan import (
+    MODE_VMAP,
+    ScenarioGroup,
+    SweepPlan,
+    plan_sweep,
+)
+from dgen_tpu.sweep.results import SweepResults
+from dgen_tpu.utils import timing
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+@partial(
+    jax.jit,
+    static_argnames=YEAR_STEP_STATIC_ARGNAMES,
+    # the stacked cross-year carry is threaded linearly, exactly like
+    # the single-scenario program's (dgenlint L7)
+    donate_argnames=("carry",),
+)
+def sweep_year_step(
+    table,
+    profiles,
+    tariffs,
+    inputs_s,           # ScenarioInputs with [S, ...] leaves
+    carry,              # SimCarry with [S, N] leaves
+    year_idx,
+    *,
+    n_periods: int,
+    econ_years: int,
+    sizing_iters: int,
+    first_year: bool,
+    with_hourly: bool,
+    storage_enabled: bool,
+    year_step_len: float,
+    sizing_impl: str = "auto",
+    rate_switch: bool = False,
+    mesh=None,
+    agent_chunk: int = 0,
+    net_billing: bool = True,
+    daylight=None,
+):
+    """One model year for S scenarios as a single device program: the
+    un-jitted :func:`year_step_impl` vmapped over the scenario axis of
+    (inputs, carry), with the table and the banks closed over UNMAPPED
+    — XLA sees one copy of every [N, 8760] gather source. Static
+    arguments mirror ``year_step`` exactly, so the two programs share
+    the compile-flag vocabulary."""
+
+    def one(inputs, c):
+        return year_step_impl(
+            table, profiles, tariffs, inputs, c, year_idx,
+            n_periods=n_periods, econ_years=econ_years,
+            sizing_iters=sizing_iters, first_year=first_year,
+            with_hourly=with_hourly, storage_enabled=storage_enabled,
+            year_step_len=year_step_len, sizing_impl=sizing_impl,
+            rate_switch=rate_switch, mesh=mesh, agent_chunk=agent_chunk,
+            net_billing=net_billing, daylight=daylight,
+        )
+
+    return jax.vmap(one)(inputs_s, carry)
+
+
+def bank_nbytes(profiles) -> int:
+    """Total bytes of the HBM-resident profile banks — the quantity a
+    sweep uploads once instead of S times (stamped into bench payloads
+    and sweep metadata as ``bank_bytes_shared``)."""
+    return int(sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(profiles)
+    ))
+
+
+class SweepSimulation:
+    """Run S scenarios against one shared population (the sweep
+    analogue of :class:`~dgen_tpu.models.simulation.Simulation`).
+
+    Parameters
+    ----------
+    table, profiles, tariffs : the shared population and banks, placed
+        once (Simulation's placement path).
+    scenarios : S ScenarioInputs (or a prebuilt ScenarioStack); all
+        must share the scenario's static grid — a mismatch raises
+        ScenarioStackError naming the field.
+    scenario : ScenarioConfig common to every member (the sweep axis is
+        the trajectory arrays, not the year grid).
+    labels : per-scenario names (default ``scn0..scnS-1``); stamped
+        into exports and checkpoint subdirectories.
+    baseline : index of the delta-report reference scenario.
+    plan : optional precomputed SweepPlan (default: plan_sweep on the
+        live device budget).
+    max_vmap_scenarios : forwarded to the planner.
+    Other parameters match Simulation.
+    """
+
+    def __init__(
+        self,
+        table,
+        profiles,
+        tariffs,
+        scenarios: Union[Sequence[ScenarioInputs], ScenarioStack],
+        scenario: ScenarioConfig,
+        run_config: Optional[RunConfig] = None,
+        mesh=None,
+        with_hourly: bool = False,
+        econ_years: int = 25,
+        labels: Optional[Sequence[str]] = None,
+        baseline: int = 0,
+        plan: Optional[SweepPlan] = None,
+        max_vmap_scenarios: Optional[int] = None,
+    ) -> None:
+        if isinstance(scenarios, ScenarioStack):
+            members = [
+                scenarios.scenario(i) for i in range(scenarios.n_scenarios)
+            ]
+        else:
+            members = list(scenarios)
+        if not members:
+            raise ValueError("sweep needs at least one scenario")
+        self.members = members
+        self.scenario = scenario
+        self.run_config = run_config or RunConfig()
+        self.mesh = mesh
+        self.with_hourly = with_hourly
+        self.labels = (
+            list(labels) if labels is not None
+            else [f"scn{i}" for i in range(len(members))]
+        )
+        if len(self.labels) != len(members):
+            raise ValueError(
+                f"{len(self.labels)} labels for {len(members)} scenarios"
+            )
+        if not 0 <= baseline < len(members):
+            raise ValueError(f"baseline index {baseline} out of range")
+        self.baseline = baseline
+        years = list(scenario.model_years)
+
+        self.plan = plan if plan is not None else plan_sweep(
+            members, years,
+            table=table, tariffs=tariffs,
+            with_hourly=with_hourly, econ_years=econ_years,
+            sizing_iters=self.run_config.sizing_iters,
+            bank_bf16=self.run_config.bf16_banks,
+            mesh=mesh,
+            max_vmap_scenarios=max_vmap_scenarios,
+        )
+
+        # the base Simulation does all the one-time work — static
+        # flags, daylight layout, chunk derivation, padding/partition,
+        # device placement of the table and the multi-GB banks — with
+        # the planner's S-aware chunk substituted so a vmapped group's
+        # working set fits
+        rc = self.run_config
+        if self.plan.agent_chunk is not None and rc.agent_chunk is None:
+            rc = dataclasses.replace(rc, agent_chunk=self.plan.agent_chunk)
+        self.base = Simulation(
+            table, profiles, tariffs, members[self.baseline], scenario,
+            rc, mesh=mesh, with_hourly=with_hourly, econ_years=econ_years,
+        )
+        self.years = self.base.years
+
+        #: bytes of profile bank resident in HBM — uploaded once for
+        #: the whole sweep (the S-way amortization the engine exists
+        #: for); per-scenario siblings share the SAME placed arrays
+        self.bank_bytes_shared = bank_nbytes(self.base.profiles)
+
+        # per-scenario sibling runners (loop mode executes these; vmap
+        # mode uses them only for init/resume conveniences). Every
+        # sibling shares the base's placed table/banks and compiled
+        # executables; net_billing is pinned per planner group so a
+        # group cannot split the executable.
+        nb_of = {
+            i: g.net_billing for g in self.plan.groups for i in g.indices
+        }
+        self.sims: List[Simulation] = [
+            self.base.with_inputs(
+                m, net_billing=nb_of[i], timing_ctx=self.labels[i],
+            )
+            for i, m in enumerate(members)
+        ]
+
+        for g in self.plan.groups:
+            logger.info(
+                "sweep group (%d scenario(s), net_billing=%s): %s mode",
+                g.n_scenarios, g.net_billing, g.mode,
+            )
+
+    @property
+    def n_scenarios(self) -> int:
+        return len(self.members)
+
+    # -- vmap mode ------------------------------------------------------
+
+    def _init_stacked_carry(self, s: int) -> SimCarry:
+        n = self.base.table.n_agents
+        zeros = SimCarry.zeros(n)
+        # one buffer per (field, scenario-stack): broadcast_to would
+        # alias, and the step donates the carry
+        return jax.tree.map(
+            lambda x: jnp.zeros((s,) + x.shape, x.dtype), zeros
+        )
+
+    def _run_group_vmap(
+        self,
+        group: ScenarioGroup,
+        collect: bool,
+        checkpoint_dir: Optional[str],
+        resume: bool,
+        guard_label: str,
+    ) -> Dict[int, SimResults]:
+        from dgen_tpu.io import checkpoint as ckpt
+
+        s = group.n_scenarios
+        stack = stack_scenarios([self.members[i] for i in group.indices])
+        inputs_s = stack.inputs
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            inputs_s = jax.tree.map(
+                lambda x: self.base._put(x, repl), inputs_s
+            )
+
+        kwargs = self.base._step_kwargs(first_year=True)
+        kwargs["net_billing"] = group.net_billing
+        # a 1-device mesh adds nothing inside a vmapped body (the
+        # planner sends >1-device meshes to loop mode); dropping it
+        # keeps sharding constraints out of the batched trace
+        kwargs["mesh"] = None
+
+        carry = self._init_stacked_carry(s)
+        start_idx = 0
+        writer = None
+        scn_key = guard_label        # per-group stacked checkpoint dir
+        if resume:
+            if not checkpoint_dir:
+                raise ValueError("resume=True requires checkpoint_dir")
+            last = ckpt.latest_year(checkpoint_dir, scenario=scn_key)
+            if last is not None and last not in self.years:
+                raise ValueError(
+                    f"checkpointed year {last} is not on this sweep's "
+                    f"year grid {self.years}; refusing to resume"
+                )
+            if last is not None:
+                _, carry = ckpt.restore_year(
+                    checkpoint_dir, self.base.table.n_agents, last,
+                    scenario=scn_key, n_scenarios=s,
+                )
+                start_idx = self.years.index(last) + 1
+                logger.info(
+                    "sweep %s: resuming after year %d (index %d)",
+                    scn_key, last, start_idx,
+                )
+        if checkpoint_dir is not None:
+            writer = ckpt.Writer(checkpoint_dir, scenario=scn_key)
+
+        agent_fields = [
+            f.name for f in dataclasses.fields(YearOutputs)
+            if f.name != "state_hourly_net_mw"
+        ]
+        collected: Dict[str, list] = {k: [] for k in agent_fields}
+        hourly: List[np.ndarray] = []
+
+        guard = None
+        try:
+            for yi, year in enumerate(self.years):
+                if yi < start_idx:
+                    continue
+                if (
+                    self.run_config.guard_retrace and guard is None
+                    and yi - start_idx >= 2
+                ):
+                    from dgen_tpu.lint.guard import RetraceGuard
+
+                    guard = RetraceGuard(
+                        context=f"sweep {guard_label} steady state"
+                    ).start()
+                kwargs["first_year"] = (yi == 0)
+                with timing.timer("sweep_year_step", ctx=guard_label):
+                    carry, outs = sweep_year_step(
+                        self.base.table, self.base.profiles,
+                        self.base.tariffs, inputs_s, carry,
+                        jnp.asarray(yi, dtype=jnp.int32), **kwargs,
+                    )
+                    jax.block_until_ready(carry.market.market_share)
+                if writer is not None:
+                    writer.save(year, carry)
+                if collect:
+                    to_fetch = {k: getattr(outs, k) for k in agent_fields}
+                    if self.with_hourly:
+                        to_fetch["_hourly"] = outs.state_hourly_net_mw
+                    host = jax.device_get(to_fetch)
+                    for k in agent_fields:
+                        collected[k].append(host[k])
+                    if self.with_hourly:
+                        hourly.append(host["_hourly"])
+                if guard is not None:
+                    guard.check(f"year {year}")
+        finally:
+            if guard is not None:
+                guard.stop()
+            if writer is not None:
+                writer.close()
+
+        run_years = self.years[start_idx:]
+        out: Dict[int, SimResults] = {}
+        for j, idx in enumerate(group.indices):
+            agent = (
+                {k: np.stack([v[j] for v in vs])
+                 for k, vs in collected.items()}
+                if collect and collected[agent_fields[0]] else {}
+            )
+            out[idx] = SimResults(
+                years=list(run_years),
+                agent=agent,
+                state_hourly_net_mw=(
+                    np.stack([h[j] for h in hourly]) if hourly else None
+                ),
+            )
+        return out
+
+    # -- loop mode ------------------------------------------------------
+
+    def _run_group_loop(
+        self,
+        group: ScenarioGroup,
+        collect: bool,
+        checkpoint_dir: Optional[str],
+        resume: bool,
+    ) -> Dict[int, SimResults]:
+        from dgen_tpu.io import checkpoint as ckpt
+
+        out: Dict[int, SimResults] = {}
+        guard = None
+        try:
+            for k, idx in enumerate(group.indices):
+                sim = self.sims[idx]
+                scn_ckpt = (
+                    ckpt.scenario_dir(checkpoint_dir, self.labels[idx])
+                    if checkpoint_dir else None
+                )
+                out[idx] = sim.run(
+                    collect=collect, checkpoint_dir=scn_ckpt,
+                    resume=resume,
+                )
+                if (
+                    self.run_config.guard_retrace and guard is None
+                    and k == 0 and len(group.indices) > 1
+                ):
+                    # scenario 0 compiled the program pair; every later
+                    # scenario in the group must compile NOTHING — the
+                    # whole point of grouping by static config
+                    from dgen_tpu.lint.guard import RetraceGuard
+
+                    guard = RetraceGuard(
+                        context="sweep cross-scenario"
+                    ).start()
+                elif guard is not None:
+                    guard.check(f"scenario {self.labels[idx]}")
+        finally:
+            if guard is not None:
+                guard.stop()
+        return out
+
+    # -- the sweep ------------------------------------------------------
+
+    def run(
+        self,
+        collect: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        resume: bool = False,
+    ) -> SweepResults:
+        """Run every scenario of every planner group.
+
+        ``checkpoint_dir`` lays out per-scenario subdirectories
+        (``scn=<label>/`` in loop mode, one stacked ``scn=<group>/``
+        per vmapped group), so ``resume=True`` continues a killed sweep
+        at (scenario, year) instead of restarting it.
+        """
+        results: Dict[int, SimResults] = {}
+        for gi, group in enumerate(self.plan.groups):
+            if group.mode == MODE_VMAP:
+                results.update(self._run_group_vmap(
+                    group, collect, checkpoint_dir, resume,
+                    guard_label=f"group{gi}",
+                ))
+            else:
+                results.update(self._run_group_loop(
+                    group, collect, checkpoint_dir, resume,
+                ))
+        return SweepResults(
+            labels=list(self.labels),
+            baseline=self.baseline,
+            runs=[results[i] for i in range(self.n_scenarios)],
+            plan=self.plan,
+            bank_bytes_shared=self.bank_bytes_shared,
+            host_mask=self.base.host_mask,
+            host_agent_id=self.base.host_agent_id,
+        )

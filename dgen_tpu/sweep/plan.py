@@ -1,0 +1,207 @@
+"""Sweep planner: group scenarios so each group shares ONE compiled
+executable, and budget the batched scenario axis against the HBM
+footprint model.
+
+Scenarios in a sweep run against one shared agent table and one
+HBM-resident copy of the profile banks; the only thing that may vary
+is the small [Y, ...]-shaped trajectory arrays in
+:class:`~dgen_tpu.models.scenario.ScenarioInputs`. Two things can still
+split the compiled program:
+
+* a **static-shape mismatch** (different year grid / group / region /
+  state axis sizes) — rejected outright with an error naming the field
+  (:func:`~dgen_tpu.models.scenario.validate_scenario_statics`), since
+  such scenarios cannot share the table either;
+* the **net-billing compile flag**
+  (:func:`~dgen_tpu.models.simulation.run_static_flags`): an all-NEM
+  scenario statically drops the bucket-sums kernel. Scenarios are
+  grouped by this flag, so each group compiles once and shares the
+  compilecache entry.
+
+Per group the planner also picks the execution mode against the
+per-agent HBM model (:func:`_per_agent_step_bytes`): ``vmap`` batches
+the per-year economics over the scenario axis in one program (the
+cheap-parameter-axis observation of the columnar-ABM literature);
+``loop`` runs scenario-major over the SAME compiled single-scenario
+executable when S would blow the vmapped working set — HBM stays
+bounded by ``auto_agent_chunk`` either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from dgen_tpu.models.scenario import ScenarioInputs, validate_scenario_statics
+from dgen_tpu.models.simulation import (
+    _HBM_RESERVE_FRAC,
+    _per_agent_step_bytes,
+    auto_agent_chunk,
+    default_hbm_bytes,
+    run_static_flags,
+    table_static_cache,
+)
+
+#: vmap-width cap when the device exposes no HBM budget (CPU/virtual
+#: backends, where the byte model is not calibrated): small sweeps
+#: batch, large sweeps fall back to the scenario-major loop
+DEFAULT_MAX_VMAP_SCENARIOS = 8
+
+MODE_VMAP = "vmap"
+MODE_LOOP = "loop"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioGroup:
+    """Scenarios that share one compiled executable."""
+
+    indices: Tuple[int, ...]     # positions in the sweep's scenario list
+    net_billing: bool            # the group's compile-time bill flag
+    mode: str                    # MODE_VMAP | MODE_LOOP
+
+    @property
+    def n_scenarios(self) -> int:
+        return len(self.indices)
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepPlan:
+    """Execution plan for an S-scenario sweep."""
+
+    groups: Tuple[ScenarioGroup, ...]
+    n_scenarios: int
+    #: agent-axis streaming chunk the sweep should run with (value for
+    #: RunConfig.agent_chunk): None = keep the operator's setting (no
+    #: HBM information); 0 = whole-table; >0 = budgeted for the widest
+    #: vmapped group, so every group's working set fits
+    agent_chunk: Optional[int]
+    #: per-device HBM bytes the budget used (None = unknown backend)
+    hbm_bytes: Optional[int]
+    #: modeled peak step bytes per (agent x scenario) row
+    per_agent_bytes: int
+
+    @property
+    def max_vmap_width(self) -> int:
+        widths = [g.n_scenarios for g in self.groups if g.mode == MODE_VMAP]
+        return max(widths) if widths else 1
+
+
+def plan_sweep(
+    scenarios: Sequence[ScenarioInputs],
+    years: List[int],
+    *,
+    table,
+    tariffs,
+    with_hourly: bool = False,
+    econ_years: int = 25,
+    sizing_iters: int = 12,
+    bank_bf16: bool = False,
+    mesh=None,
+    hbm_bytes: Optional[int] = -1,
+    max_vmap_scenarios: Optional[int] = None,
+) -> SweepPlan:
+    """Plan an S-scenario sweep over one shared population.
+
+    ``hbm_bytes``: per-device accelerator memory; the default sentinel
+    ``-1`` reads the live device (:func:`default_hbm_bytes`), ``None``
+    means explicitly unknown (mode decisions then fall back to the
+    :data:`DEFAULT_MAX_VMAP_SCENARIOS` width cap).
+
+    Raises :class:`~dgen_tpu.models.scenario.ScenarioStackError` when
+    scenarios disagree on a static field (the error names it).
+    """
+    scenarios = list(scenarios)
+    validate_scenario_statics(scenarios)
+    if hbm_bytes == -1:
+        hbm_bytes = default_hbm_bytes()
+    max_vmap = (
+        max_vmap_scenarios if max_vmap_scenarios is not None
+        else DEFAULT_MAX_VMAP_SCENARIOS
+    )
+
+    # group by the compile-time flags (rate_switch is table-only and
+    # identical across scenarios; net_billing depends on each
+    # scenario's NEM caps) — first-seen order keeps group 0 anchored on
+    # scenario 0, the conventional sweep baseline. The table-derived
+    # half is computed once (table_static_cache); only the NEM-gate
+    # proof reruns per member.
+    tcache = table_static_cache(table, tariffs)
+    rate_switch = tcache["rate_switch"]
+    by_flag: dict = {}
+    for i, inputs in enumerate(scenarios):
+        _, nb = run_static_flags(
+            table, tariffs, inputs, years, table_cache=tcache)
+        by_flag.setdefault(nb, []).append(i)
+
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    n_local = max(table.n_agents // n_dev, 1)
+
+    # worst-case per-row footprint across the sweep's flag groups (a
+    # single chunk choice must hold for every group)
+    per_agent = max(
+        _per_agent_step_bytes(
+            sizing_iters=sizing_iters, econ_years=econ_years,
+            with_hourly=with_hourly, net_billing=nb,
+            rate_switch=rate_switch, bank_bf16=bank_bf16,
+        )
+        for nb in by_flag
+    )
+
+    groups: List[ScenarioGroup] = []
+    chunk: Optional[int] = None
+    for nb, idxs in by_flag.items():
+        s = len(idxs)
+        if mesh is not None and mesh.devices.size > 1:
+            # multi-chip: scenario groups ride the existing shard_map
+            # layout unchanged — the scenario-major loop reuses the
+            # single-scenario executable and its mesh placement as-is,
+            # including its per-device streaming chunk
+            mode = MODE_LOOP
+            if hbm_bytes is not None:
+                c = auto_agent_chunk(
+                    n_local, sizing_iters=sizing_iters,
+                    econ_years=econ_years, with_hourly=with_hourly,
+                    hbm_bytes=hbm_bytes, net_billing=nb,
+                    rate_switch=rate_switch, bank_bf16=bank_bf16,
+                )
+                if c:
+                    chunk = c if chunk is None else min(chunk, c)
+        elif hbm_bytes is None:
+            mode = MODE_VMAP if s <= max_vmap else MODE_LOOP
+        else:
+            # budget S x N rows against the device (the same model
+            # auto_agent_chunk uses, with the persistent [S, N] carry
+            # counted S-wide)
+            budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
+            budget -= s * n_local * 50 * 4
+            rows_fit = max(budget, 0) // per_agent
+            if s <= max_vmap and s * n_local <= rows_fit:
+                mode = MODE_VMAP            # whole table, S-way batched
+            elif s <= max_vmap and rows_fit // s >= 128:
+                mode = MODE_VMAP            # chunked, S-way batched
+                c = int(rows_fit // s) // 128 * 128
+                chunk = c if chunk is None else min(chunk, c)
+            else:
+                mode = MODE_LOOP
+                c = auto_agent_chunk(
+                    n_local, sizing_iters=sizing_iters,
+                    econ_years=econ_years, with_hourly=with_hourly,
+                    hbm_bytes=hbm_bytes, net_billing=nb,
+                    rate_switch=rate_switch, bank_bf16=bank_bf16,
+                )
+                if c:
+                    chunk = c if chunk is None else min(chunk, c)
+        groups.append(ScenarioGroup(
+            indices=tuple(idxs), net_billing=nb, mode=mode,
+        ))
+
+    if hbm_bytes is not None and chunk is None:
+        chunk = 0   # everything fits whole-table
+
+    return SweepPlan(
+        groups=tuple(groups),
+        n_scenarios=len(scenarios),
+        agent_chunk=chunk,
+        hbm_bytes=hbm_bytes,
+        per_agent_bytes=per_agent,
+    )

@@ -1,0 +1,208 @@
+"""Sweep outputs: per-scenario :class:`SimResults` plus the
+cross-scenario delta report, and parquet export with the scenario id
+stamped into each run directory's ``meta.json``.
+
+The reference answers "what did the ITC step-down change?" by diffing
+two separately-exported Postgres schemas by hand; here the sweep knows
+its own baseline and emits the deltas as a first-class surface
+(``sweep.json`` + per-scenario export directories).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dgen_tpu.models.simulation import SimResults
+
+
+class _YearView:
+    """Adapter presenting one (scenario, year) slice of collected host
+    results with the YearOutputs attribute surface RunExporter reads —
+    so the export path is the single-run exporter, unchanged."""
+
+    def __init__(self, res: SimResults, yi: int) -> None:
+        self._res = res
+        self._yi = yi
+
+    def __getattr__(self, name: str):
+        if name == "state_hourly_net_mw":
+            h = self._res.state_hourly_net_mw
+            if h is None:
+                return np.zeros((0, 0), dtype=np.float32)
+            return h[self._yi]
+        try:
+            return self._res.agent[name][self._yi]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+
+@dataclasses.dataclass
+class SweepResults:
+    """Host-side results of an S-scenario sweep."""
+
+    labels: List[str]
+    baseline: int                 # index of the delta reference
+    runs: List[SimResults]        # one per scenario, label-aligned
+    plan: object                  # the SweepPlan that executed
+    bank_bytes_shared: int        # profile-bank bytes uploaded ONCE
+    host_mask: np.ndarray
+    host_agent_id: np.ndarray
+
+    @property
+    def n_scenarios(self) -> int:
+        return len(self.runs)
+
+    def __getitem__(self, label_or_idx) -> SimResults:
+        if isinstance(label_or_idx, str):
+            return self.runs[self.labels.index(label_or_idx)]
+        return self.runs[label_or_idx]
+
+    def summaries(self) -> List[Dict[str, np.ndarray]]:
+        """Per-scenario national per-year aggregates
+        (:meth:`SimResults.summary`)."""
+        return [r.summary(self.host_mask) for r in self.runs]
+
+    def delta_report(self) -> Dict[str, object]:
+        """Cross-scenario deltas vs the designated baseline scenario:
+        per-year national adoption / capacity / storage deltas plus
+        fleet NPV, and final-year scalars for quick reading.
+
+        Resume-safe: a resumed sweep's members cover (possibly
+        different, possibly empty) suffixes of the year grid —
+        checkpoints hold only the cross-year carry, so already-run
+        years have no collected outputs. Deltas are computed on the
+        years every non-empty run covers; members with no new years
+        are reported as ``no_new_years`` entries. Raises ValueError
+        when the baseline itself has no collected years (nothing to
+        delta against — rerun without resume, or read the exported
+        surfaces of the original run)."""
+        m = self.host_mask
+        base_run = self.runs[self.baseline]
+        if not base_run.agent:
+            raise ValueError(
+                f"baseline scenario '{self.labels[self.baseline]}' has "
+                "no collected years (fully resumed, or collect=False); "
+                "no delta report is possible"
+            )
+        nonempty = [i for i, r in enumerate(self.runs) if r.agent]
+        years = [
+            y for y in base_run.years
+            if all(y in self.runs[i].years for i in nonempty)
+        ]
+        if not years:
+            raise ValueError(
+                "no common collected years across scenarios; rerun the "
+                "sweep without resume for a full delta report"
+            )
+
+        def curves(i):
+            r = self.runs[i]
+            sel = np.asarray([r.years.index(y) for y in years])
+            s = r.summary(m)
+            npv = (r.agent["npv"] * m[None, :]).sum(axis=1)
+            return {k: np.asarray(v)[sel] for k, v in s.items()}, npv[sel]
+
+        base, base_npv = curves(self.baseline)
+        scenarios = []
+        for i, label in enumerate(self.labels):
+            if i not in nonempty:
+                scenarios.append({
+                    "scenario": label,
+                    "is_baseline": i == self.baseline,
+                    "no_new_years": True,
+                })
+                continue
+            s, npv = curves(i)
+            d_adopt = np.asarray(s["adopters"] - base["adopters"])
+            d_kw = np.asarray(s["system_kw_cum"] - base["system_kw_cum"])
+            d_kwh = np.asarray(s["batt_kwh_cum"] - base["batt_kwh_cum"])
+            d_npv = np.asarray(npv - base_npv)
+            scenarios.append({
+                "scenario": label,
+                "is_baseline": i == self.baseline,
+                "adopters_delta": [float(v) for v in d_adopt],
+                "system_kw_cum_delta": [float(v) for v in d_kw],
+                "batt_kwh_cum_delta": [float(v) for v in d_kwh],
+                "npv_total_delta": [float(v) for v in d_npv],
+                "final": {
+                    "adopters": float(s["adopters"][-1]),
+                    "adopters_delta": float(d_adopt[-1]),
+                    "system_kw_cum": float(s["system_kw_cum"][-1]),
+                    "system_kw_cum_delta": float(d_kw[-1]),
+                    "batt_kwh_cum_delta": float(d_kwh[-1]),
+                    "npv_total_delta": float(d_npv[-1]),
+                },
+            })
+        return {
+            "baseline": self.labels[self.baseline],
+            "years": [int(y) for y in years],
+            "scenarios": scenarios,
+        }
+
+    def export(
+        self,
+        run_dir: str,
+        state_names: Optional[Sequence[str]] = None,
+        meta: Optional[Dict[str, object]] = None,
+        finance_series: bool = True,
+    ) -> str:
+        """Write every scenario's three parquet surfaces under
+        ``<run_dir>/scenario=<label>/`` (the single-run
+        :class:`~dgen_tpu.io.export.RunExporter`, with the scenario id
+        stamped into each meta.json) plus the cross-scenario
+        ``sweep.json`` delta report at the top. Returns ``run_dir``."""
+        from dgen_tpu.io.export import RunExporter
+        from dgen_tpu.utils.logging import get_logger
+
+        if all(not r.agent for r in self.runs):
+            raise ValueError(
+                "no scenario has collected results (collect=False, or a "
+                "fully resumed sweep); nothing to export"
+            )
+        for i, (label, res) in enumerate(zip(self.labels, self.runs)):
+            if not res.agent:
+                # a resumed member with no NEW years: its surfaces were
+                # written by the original run — skip, don't fail the
+                # members that do have fresh data
+                get_logger().warning(
+                    "sweep export: scenario %s has no collected years "
+                    "(resumed); skipping", label,
+                )
+                continue
+            exporter = RunExporter(
+                os.path.join(run_dir, f"scenario={label}"),
+                agent_id=self.host_agent_id,
+                mask=self.host_mask,
+                state_names=list(state_names) if state_names else None,
+                finance_series=finance_series,
+                meta={
+                    "scenario": label,
+                    "scenario_index": i,
+                    "sweep_baseline": self.labels[self.baseline],
+                    "sweep_n_scenarios": self.n_scenarios,
+                    **(meta or {}),
+                },
+            )
+            for yi, year in enumerate(res.years):
+                exporter(int(year), yi, _YearView(res, yi))
+        try:
+            report = self.delta_report()
+        except ValueError as e:
+            # partial resume without a usable baseline: still leave a
+            # sweep.json breadcrumb saying why the deltas are absent
+            report = {"delta_report_unavailable": str(e),
+                      "baseline": self.labels[self.baseline]}
+        report["bank_bytes_shared"] = int(self.bank_bytes_shared)
+        report["groups"] = [
+            {"mode": g.mode, "net_billing": bool(g.net_billing),
+             "scenarios": [self.labels[i] for i in g.indices]}
+            for g in self.plan.groups
+        ]
+        with open(os.path.join(run_dir, "sweep.json"), "w") as f:
+            json.dump(report, f, indent=1)
+        return run_dir

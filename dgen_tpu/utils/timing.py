@@ -34,20 +34,39 @@ def fn_timer(tab_level: int = 0) -> Callable:
     return deco
 
 
+def _key(name: str, ctx: str | None) -> str:
+    """Timer accumulation key: ``ctx:name`` when a context label is
+    given, else the bare name. Sweeps label per-scenario phases (e.g.
+    ``timer("year_step", ctx="scn3")``) so S scenarios' year steps do
+    not collide in one global bucket."""
+    return f"{ctx}:{name}" if ctx else name
+
+
 @contextmanager
-def timer(name: str):
+def timer(name: str, ctx: str | None = None):
     t0 = time.perf_counter()
     yield
     dt = time.perf_counter() - t0
-    _TIMINGS.setdefault(name, []).append(dt)
-    get_logger().debug("%s took: %.3fs", name, dt)
+    key = _key(name, ctx)
+    _TIMINGS.setdefault(key, []).append(dt)
+    get_logger().debug("%s took: %.3fs", key, dt)
 
 
-def timing_report() -> Dict[str, Dict[str, float]]:
-    """Per-name {count, total, mean} summary."""
+def timing_report(ctx: str | None = None) -> Dict[str, Dict[str, float]]:
+    """Per-name {count, total, mean} summary. ``ctx`` filters to one
+    context's timers (keys come back with the ``ctx:`` prefix
+    stripped, i.e. as the bare phase names recorded under it)."""
+    if ctx is None:
+        items = _TIMINGS.items()
+    else:
+        prefix = f"{ctx}:"
+        items = (
+            (k[len(prefix):], v) for k, v in _TIMINGS.items()
+            if k.startswith(prefix)
+        )
     return {
         k: {"count": len(v), "total": sum(v), "mean": sum(v) / len(v)}
-        for k, v in _TIMINGS.items()
+        for k, v in items
         if v
     }
 

@@ -1,0 +1,492 @@
+"""Sweep engine tests: S-way parity against single runs, planner
+grouping/HBM budgeting, static-mismatch errors, cross-scenario retrace
+guarantees, per-(scenario, year) checkpoint resume, bank-sharing
+accounting, and per-scenario timing contexts."""
+
+import dataclasses as dc
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+from dgen_tpu.sweep import (
+    MODE_LOOP,
+    MODE_VMAP,
+    SweepSimulation,
+    plan_sweep,
+)
+
+#: golden-e2e tolerance (tests/test_golden_e2e.py RTOL) — the sweep
+#: acceptance bound; the identical-scenario paths actually reproduce
+#: the single run exactly and are pinned tighter below
+GOLDEN_RTOL = 1e-3
+
+CFG = ScenarioConfig(name="sweep-t", start_year=2014, end_year=2016,
+                     anchor_years=())
+RC = RunConfig(sizing_iters=6)
+
+
+@pytest.fixture(scope="module")
+def pop():
+    return synth.generate_population(
+        96, states=["DE", "CA"], seed=11, pad_multiple=32
+    )
+
+
+def make_inputs(pop, itc=0.30, **overrides):
+    Y = len(CFG.model_years)
+    ov = {"itc_fraction": jnp.full((Y, 3), itc, jnp.float32)}
+    ov.update(overrides)
+    return scen.uniform_inputs(
+        CFG, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+        overrides=ov,
+    )
+
+
+@pytest.fixture(scope="module")
+def single_run(pop):
+    inputs = make_inputs(pop)
+    sim = Simulation(pop.table, pop.profiles, pop.tariffs, inputs, CFG, RC)
+    return inputs, sim.run()
+
+
+# ---------------------------------------------------------------------------
+# ScenarioStack
+# ---------------------------------------------------------------------------
+
+def test_stack_validation_names_offending_field(pop):
+    from dgen_tpu.models.scenario import (
+        ScenarioStackError,
+        stack_scenarios,
+        validate_scenario_statics,
+    )
+
+    a = make_inputs(pop)
+    # a different static grid (extra state column in the NEM caps)
+    bad_shape = dc.replace(
+        a, nem_cap_kw=jnp.concatenate(
+            [a.nem_cap_kw, a.nem_cap_kw[:, :1]], axis=1)
+    )
+    with pytest.raises(ScenarioStackError, match="nem_cap_kw"):
+        stack_scenarios([a, bad_shape])
+    # a dtype drift is a static mismatch too
+    bad_dtype = dc.replace(
+        a, itc_fraction=a.itc_fraction.astype(jnp.bfloat16)
+    )
+    with pytest.raises(ScenarioStackError, match="itc_fraction"):
+        validate_scenario_statics([a, bad_dtype])
+    with pytest.raises(ScenarioStackError):
+        stack_scenarios([])
+
+    stack = stack_scenarios([a, make_inputs(pop, itc=0.0)])
+    assert stack.n_scenarios == 2
+    assert stack.n_years == len(CFG.model_years)
+    # round trip: member 1 comes back leaf-for-leaf
+    b1 = stack.scenario(1)
+    np.testing.assert_array_equal(
+        np.asarray(b1.itc_fraction), 0.0
+    )
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+def test_planner_groups_budget_and_errors(pop):
+    from dgen_tpu.models.scenario import ScenarioStackError
+
+    years = list(CFG.model_years)
+    members = [make_inputs(pop, itc=v) for v in (0.3, 0.1, 0.0)]
+    kw = dict(table=pop.table, tariffs=pop.tariffs, econ_years=25,
+              sizing_iters=6)
+
+    # ample budget: one vmap group, whole table
+    plan = plan_sweep(members, years, hbm_bytes=256 * 1024**3, **kw)
+    assert len(plan.groups) == 1
+    assert plan.groups[0].mode == MODE_VMAP
+    assert plan.groups[0].indices == (0, 1, 2)
+    assert plan.agent_chunk == 0
+
+    # starved budget: the vmapped working set cannot fit -> loop mode
+    plan_small = plan_sweep(members, years, hbm_bytes=8 * 1024**2, **kw)
+    assert plan_small.groups[0].mode == MODE_LOOP
+
+    # mid budget: vmap survives but chunked (S x chunk rows bounded).
+    # Needs a table larger than the 128-row chunk floor; planning is
+    # host-side only, so a bigger population costs nothing here.
+    pop_big = synth.generate_population(
+        512, states=["DE", "CA"], seed=11, pad_multiple=32)
+    members_big = [
+        scen.uniform_inputs(
+            CFG, n_groups=pop_big.table.n_groups,
+            n_regions=pop_big.n_regions)
+        for _ in range(3)
+    ]
+    n = pop_big.table.n_agents
+    # budget sized so rows_fit // 3 lands in [128, n): chunked vmap
+    mid = plan.per_agent_bytes * 3 * 256
+    plan_mid = plan_sweep(
+        members_big, years, hbm_bytes=int(mid / 0.8 * 1.05),
+        table=pop_big.table, tariffs=pop_big.tariffs,
+        econ_years=25, sizing_iters=6)
+    assert plan_mid.groups[0].mode == MODE_VMAP
+    assert plan_mid.agent_chunk and plan_mid.agent_chunk % 128 == 0
+    assert plan_mid.agent_chunk < n
+
+    # unknown budget: width cap decides
+    assert plan_sweep(members, years, hbm_bytes=None,
+                      max_vmap_scenarios=2, **kw).groups[0].mode == MODE_LOOP
+    assert plan_sweep(members, years, hbm_bytes=None,
+                      **kw).groups[0].mode == MODE_VMAP
+
+    # multi-device mesh: scenario groups ride the existing shard_map
+    # layout unchanged -> loop
+    from dgen_tpu.parallel.mesh import make_mesh
+
+    plan_mesh = plan_sweep(members, years, mesh=make_mesh(),
+                           hbm_bytes=256 * 1024**3, **kw)
+    assert plan_mesh.groups[0].mode == MODE_LOOP
+    assert plan_mesh.agent_chunk == 0   # ample budget: whole table
+
+    # a starved mesh budget must still derive a streaming chunk (the
+    # loop reuses the single-scenario executable, chunk included) —
+    # not pin agent_chunk=0 and OOM where a lone Simulation would not
+    from dgen_tpu.models.simulation import auto_agent_chunk
+
+    mesh = make_mesh()
+    small = 8 * 1024**2
+    plan_mesh_small = plan_sweep(members, years, mesh=mesh,
+                                 hbm_bytes=small, **kw)
+    n_local = max(pop.table.n_agents // int(mesh.devices.size), 1)
+    expect = auto_agent_chunk(
+        n_local, sizing_iters=6, econ_years=25, with_hourly=False,
+        hbm_bytes=small)
+    assert plan_mesh_small.agent_chunk == expect
+
+    # scenarios whose compile-time net-billing flag differs split into
+    # their own group (needs an all-NEM tariff population: the synth
+    # default mix references net-billing tariffs, forcing True for all)
+    rng = np.random.default_rng(0)
+    nem_ids = np.asarray([0, 2, 5], np.int32)
+    tidx = jnp.asarray(nem_ids[rng.integers(0, 3, pop.table.n_agents)])
+    t_nem = dc.replace(pop.table, tariff_idx=tidx, tariff_switch_idx=tidx)
+    years_n = len(years)
+    caps = np.full((years_n, pop.table.n_states), 1e30, np.float32)
+    caps[1:] = 1e3
+    closing = make_inputs(pop, nem_cap_kw=jnp.asarray(caps))
+    plan2 = plan_sweep(
+        members + [closing], years, table=t_nem, tariffs=pop.tariffs,
+        econ_years=25, sizing_iters=6, hbm_bytes=256 * 1024**3)
+    assert len(plan2.groups) == 2
+    assert {g.net_billing for g in plan2.groups} == {True, False}
+    by_flag = {g.net_billing: g.indices for g in plan2.groups}
+    assert by_flag[False] == (0, 1, 2)   # open caps: all-NEM skip
+    assert by_flag[True] == (3,)         # the closing-cap scenario
+
+    # static mismatch is rejected with the field named
+    bad = dc.replace(
+        members[0], nem_cap_kw=jnp.concatenate(
+            [members[0].nem_cap_kw, members[0].nem_cap_kw[:, :1]], axis=1)
+    )
+    with pytest.raises(ScenarioStackError, match="nem_cap_kw"):
+        plan_sweep(members + [bad], years, hbm_bytes=None, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Parity: sweep vs single runs (the acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def test_identical_scenario_sweep_matches_single_run(pop, single_run):
+    """S-way sweep of IDENTICAL scenarios == one Simulation.run(),
+    within the golden-e2e tolerance (observed: exact), with the banks
+    shared rather than re-uploaded per scenario."""
+    inputs, res_single = single_run
+    sweep = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, [inputs] * 3, CFG, RC,
+    )
+    assert sweep.plan.groups[0].mode == MODE_VMAP
+    res = sweep.run()
+
+    # bank accounting: every per-scenario runner holds the SAME placed
+    # bank arrays (one upload for the whole sweep), and the stamped
+    # byte count is the banks' real footprint
+    for sim in sweep.sims:
+        assert sim.profiles is sweep.base.profiles
+        assert sim.table is sweep.base.table
+        assert sim.tariffs is sweep.base.tariffs
+    expected = sum(
+        np.asarray(x).nbytes
+        for x in (pop.profiles.load, pop.profiles.solar_cf,
+                  pop.profiles.wholesale)
+    )
+    assert res.bank_bytes_shared == expected
+
+    m = np.asarray(pop.table.mask)
+    for s in range(3):
+        for k in ("system_kw_cum", "number_of_adopters", "npv",
+                  "batt_kwh_cum", "payback_period"):
+            a = res_single.agent[k] * m
+            b = res.runs[s].agent[k] * m
+            np.testing.assert_allclose(
+                b, a, rtol=GOLDEN_RTOL, atol=1e-4,
+                err_msg=f"scenario {s} field {k}",
+            )
+            # the vmapped program shares every upstream value with the
+            # single-scenario program; drift beyond f32 noise means the
+            # scenario axis leaked into the economics
+            scale = max(float(np.max(np.abs(a))), 1.0)
+            assert float(np.max(np.abs(a - b))) / scale < 1e-5, k
+
+    # deltas vs baseline are all ~zero for identical scenarios
+    rep = res.delta_report()
+    for s_rep in rep["scenarios"]:
+        assert abs(s_rep["final"]["system_kw_cum_delta"]) < 1e-3
+
+
+def test_differing_itc_sweep_matches_independent_runs(pop):
+    """A sweep of differing ITC schedules == a Python loop of
+    independent Simulation runs, in both execution modes."""
+    members = [make_inputs(pop, itc=v) for v in (0.3, 0.0)]
+    expected = []
+    for inputs in members:
+        sim = Simulation(
+            pop.table, pop.profiles, pop.tariffs, inputs, CFG, RC)
+        expected.append(sim.run())
+
+    for max_vmap in (8, 1):   # vmap mode, then the scenario-major loop
+        sweep = SweepSimulation(
+            pop.table, pop.profiles, pop.tariffs, members, CFG, RC,
+            max_vmap_scenarios=max_vmap,
+        )
+        want = MODE_VMAP if max_vmap == 8 else MODE_LOOP
+        assert sweep.plan.groups[0].mode == want
+        res = sweep.run()
+        m = np.asarray(pop.table.mask)
+        for s in range(2):
+            for k in ("system_kw_cum", "number_of_adopters", "npv"):
+                a = expected[s].agent[k] * m
+                b = res.runs[s].agent[k] * m
+                scale = max(float(np.max(np.abs(a))), 1.0)
+                assert float(np.max(np.abs(a - b))) / scale < 1e-5, \
+                    f"{want} scenario {s} field {k}"
+        # the ITC axis actually moved the answer
+        s0 = res.runs[0].summary(m)["system_kw_cum"][-1]
+        s1 = res.runs[1].summary(m)["system_kw_cum"][-1]
+        assert s1 < s0
+
+
+def test_sweep_steady_state_compiles_once_per_group(pop):
+    """RetraceGuard-backed acceptance: with guard_retrace armed, the
+    vmapped program may compile only in the first two executed years
+    (the first_year True/False pair) and the loop mode may compile
+    NOTHING after scenario 0 — a retrace anywhere raises RetraceError
+    and fails this test."""
+    members = [make_inputs(pop, itc=v) for v in (0.3, 0.1, 0.0)]
+    rc = dc.replace(RC, guard_retrace=True)
+    for max_vmap in (8, 1):
+        sweep = SweepSimulation(
+            pop.table, pop.profiles, pop.tariffs, members, CFG, rc,
+            max_vmap_scenarios=max_vmap,
+        )
+        res = sweep.run()
+        assert len(res.runs) == 3
+
+    # and explicitly: scenarios after the first share the executable
+    from dgen_tpu.lint.guard import RetraceGuard
+
+    sweep = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG, RC,
+        max_vmap_scenarios=1,
+    )
+    sweep.sims[0].run()   # compiles the program pair
+    with RetraceGuard(context="cross-scenario"):
+        sweep.sims[1].run()
+        sweep.sims[2].run()
+
+
+def test_vmap_sweep_composes_with_agent_chunk(pop):
+    """The vmapped program streams the agent axis through the sizing
+    scan exactly like the single-scenario path: a chunked S-way sweep
+    matches unchunked independent runs (HBM stays bounded by one
+    chunk's [S, C, 8760] working set)."""
+    members = [make_inputs(pop, itc=v) for v in (0.3, 0.0)]
+    rc_chunk = dc.replace(RC, agent_chunk=64)
+    sweep = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG, rc_chunk,
+    )
+    assert sweep.base._agent_chunk == 64
+    assert sweep.plan.groups[0].mode == MODE_VMAP
+    res = sweep.run()
+    m = np.asarray(pop.table.mask)
+    n = len(m)
+    for s, inputs in enumerate(members):
+        ref = Simulation(
+            pop.table, pop.profiles, pop.tariffs, inputs, CFG, RC
+        ).run()
+        for k in ("system_kw_cum", "npv"):
+            a = ref.agent[k] * m
+            b = res.runs[s].agent[k][:, :n] * m
+            scale = max(float(np.max(np.abs(a))), 1.0)
+            assert float(np.max(np.abs(a - b))) / scale < 2e-5, (s, k)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume at (scenario, year)
+# ---------------------------------------------------------------------------
+
+def test_sweep_resumes_at_scenario_year(pop, tmp_path):
+    members = [make_inputs(pop, itc=v) for v in (0.3, 0.0)]
+    d = str(tmp_path / "ckpt")
+
+    # loop mode: pre-complete scenario 0 only (a sweep killed between
+    # scenarios); the resumed sweep must skip scenario 0's years and
+    # still produce full results for scenario 1
+    from dgen_tpu.io import checkpoint as ckpt
+
+    sweep = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG, RC,
+        max_vmap_scenarios=1,
+    )
+    sweep.sims[0].run(
+        checkpoint_dir=ckpt.scenario_dir(d, sweep.labels[0]))
+    assert sorted(os.listdir(d)) == [f"scn={sweep.labels[0]}"]
+    res = sweep.run(checkpoint_dir=d, resume=True)
+    assert res.runs[0].years == []            # fully resumed
+    assert len(res.runs[1].years) == len(CFG.model_years)
+    m = np.asarray(pop.table.mask)
+    assert res.runs[1].summary(m)["system_kw_cum"][-1] > 0
+
+    # vmap mode: the group checkpoints one stacked carry per year and
+    # resumes in lockstep
+    d2 = str(tmp_path / "ckpt-vmap")
+    sweep_v = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG, RC,
+    )
+    assert sweep_v.plan.groups[0].mode == MODE_VMAP
+    sweep_v.run(checkpoint_dir=d2)
+    assert sorted(os.listdir(d2)) == ["scn=group0"]
+    res_v = sweep_v.run(checkpoint_dir=d2, resume=True)
+    assert res_v.runs[0].years == [] and res_v.runs[1].years == []
+
+
+def test_checkpoint_scenario_layout_isolated(pop, tmp_path):
+    """Per-scenario checkpoint trees don't collide: the same years
+    saved under two scenario keys restore independently."""
+    from dgen_tpu.io import checkpoint as ckpt
+    from dgen_tpu.models.simulation import SimCarry
+
+    d = str(tmp_path)
+    c = SimCarry.zeros(8)
+    a = dc.replace(c, batt_adopters_cum=c.batt_adopters_cum + 1.0)
+    b = dc.replace(c, batt_adopters_cum=c.batt_adopters_cum + 2.0)
+    ckpt.save_year(d, 2014, a, scenario="s0")
+    ckpt.save_year(d, 2014, b, scenario="s1")
+    assert ckpt.latest_year(d, scenario="s0") == 2014
+    assert ckpt.latest_year(d) is None        # flat layout untouched
+    _, ra = ckpt.restore_year(d, 8, scenario="s0")
+    _, rb = ckpt.restore_year(d, 8, scenario="s1")
+    assert float(ra.batt_adopters_cum[0]) == 1.0
+    assert float(rb.batt_adopters_cum[0]) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Timing contexts + exports
+# ---------------------------------------------------------------------------
+
+def test_timing_ctx_separates_scenario_phases(pop):
+    from dgen_tpu.utils import timing
+
+    timing.reset_timings()
+    members = [make_inputs(pop, itc=v) for v in (0.3, 0.0)]
+    sweep = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG, RC,
+        max_vmap_scenarios=1, labels=["hi", "lo"],
+    )
+    sweep.run()
+    rep = timing.timing_report()
+    assert rep["hi:year_step"]["count"] == len(CFG.model_years)
+    assert rep["lo:year_step"]["count"] == len(CFG.model_years)
+    # ctx filter strips the prefix
+    assert timing.timing_report(ctx="hi")["year_step"]["count"] == \
+        len(CFG.model_years)
+    # unlabeled timers still work
+    with timing.timer("bare"):
+        pass
+    assert "bare" in timing.timing_report()
+
+
+def test_sweep_export_stamps_scenario_ids(pop, tmp_path):
+    members = [make_inputs(pop, itc=v) for v in (0.3, 0.0)]
+    sweep = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG, RC,
+        labels=["itc30", "itc0"], baseline=0,
+    )
+    res = sweep.run()
+    out = str(tmp_path / "sweep-out")
+    res.export(out)
+
+    import json
+
+    for i, label in enumerate(["itc30", "itc0"]):
+        scn_dir = os.path.join(out, f"scenario={label}")
+        with open(os.path.join(scn_dir, "meta.json")) as f:
+            meta = json.load(f)
+        assert meta["scenario"] == label
+        assert meta["scenario_index"] == i
+        assert meta["sweep_baseline"] == "itc30"
+        assert os.path.isdir(os.path.join(scn_dir, "agent_outputs"))
+    with open(os.path.join(out, "sweep.json")) as f:
+        rep = json.load(f)
+    assert rep["baseline"] == "itc30"
+    assert rep["bank_bytes_shared"] == res.bank_bytes_shared
+    deltas = {s["scenario"]: s["final"] for s in rep["scenarios"]}
+    assert deltas["itc30"]["system_kw_cum_delta"] == 0.0
+    assert deltas["itc0"]["system_kw_cum_delta"] < 0.0
+
+    # the exported surface round-trips through the standard loader
+    from dgen_tpu.io.export import load_surface
+
+    df = load_surface(os.path.join(out, "scenario=itc0"), "agent_outputs")
+    assert set(df["year"]) == set(CFG.model_years)
+
+
+# ---------------------------------------------------------------------------
+# Mesh (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sweep_on_mesh_matches_unmeshed(pop):
+    """Scenario groups ride the existing shard_map layout unchanged:
+    a sweep over the 8-device CPU mesh (scenario-major loop by plan)
+    reproduces the unmeshed sweep per agent_id."""
+    from dgen_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    members = [make_inputs(pop, itc=v) for v in (0.3, 0.0)]
+    sweep_m = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG, RC,
+        mesh=mesh,
+    )
+    assert all(g.mode == MODE_LOOP for g in sweep_m.plan.groups)
+    sweep_u = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG, RC,
+    )
+    res_m = sweep_m.run()
+    res_u = sweep_u.run()
+
+    def by_id(sweep, res, s):
+        keep = np.asarray(sweep.base.table.mask) > 0
+        ids = np.asarray(sweep.base.table.agent_id)[keep]
+        order = np.argsort(ids)
+        return res.runs[s].agent["system_kw_cum"][:, keep][:, order]
+
+    for s in range(2):
+        np.testing.assert_allclose(
+            by_id(sweep_m, res_m, s), by_id(sweep_u, res_u, s),
+            rtol=5e-4, atol=1e-3,
+        )

@@ -17,6 +17,10 @@ rc=0
 if [ "$mode" != "--test-only" ]; then
     echo "== dgenlint (python -m dgen_tpu.lint) =="
     python -m dgen_tpu.lint || rc=1
+    # the sweep subsystem is inside the default lint root already; an
+    # explicit pass keeps it gated even if the default root narrows
+    echo "== dgenlint (dgen_tpu/sweep) =="
+    python -m dgen_tpu.lint dgen_tpu/sweep || rc=1
 fi
 
 if [ "$mode" != "--lint-only" ]; then
@@ -27,6 +31,8 @@ if [ "$mode" != "--lint-only" ]; then
         ruff check dgen_tpu tests || rc=1
     fi
 
+    # tier-1 ('not slow') includes the fast sweep tests
+    # (tests/test_sweep.py) — the push gate covers the sweep engine
     echo "== tier-1 tests (ROADMAP.md) =="
     rm -f /tmp/_t1.log
     timeout -k 10 870 env JAX_PLATFORMS=cpu \

@@ -56,6 +56,14 @@ Knobs (env):
                           sweep (dgen_tpu.sweep) vs one single run and
                           stamp S, per-scenario wall, bank-bytes-shared
                           and the amortization ratio into the payload
+  DGEN_TPU_BENCH_ASYNC    1: A/B the background host-IO pipeline
+                          (io.hostio) — the SAME export+checkpoint run
+                          with the pipeline on vs the serialized
+                          oracle (DGEN_TPU_ASYNC_IO=0), plus the
+                          no-consumer pipelined floor the ~1.15x
+                          overlap target is measured against; stamps
+                          walls, host_blocked_wall and
+                          overlap_efficiency into the payload
 """
 
 from __future__ import annotations
@@ -88,6 +96,8 @@ _BENCH_DAYLIGHT = os.environ.get(
     "DGEN_TPU_BENCH_DAYLIGHT", "") not in ("", "0", "false")
 _BENCH_BF16 = os.environ.get(
     "DGEN_TPU_BENCH_BF16", "") not in ("", "0", "false")
+_BENCH_ASYNC = os.environ.get(
+    "DGEN_TPU_BENCH_ASYNC", "") not in ("", "0", "false")
 
 
 def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
@@ -375,6 +385,64 @@ def _cpu_baseline(sim, pop) -> float:
     return 8.0 / dt  # 8 workers, 1 agent-year per sizing call
 
 
+def _async_io_ab(n_agents: int) -> dict:
+    """A/B the background host-IO pipeline (io.hostio): one export- and
+    checkpoint-enabled run with the pipeline ON vs the serialized
+    parity oracle (the DGEN_TPU_ASYNC_IO kill switch), plus the
+    no-consumer pipelined floor — the async path's wall is supposed to
+    land within ~1.15x of that floor while the serialized path pays
+    the full host-IO tax on the dispatch critical path.  All three
+    runs share one compiled executable (the floor run warms it)."""
+    import shutil
+    import tempfile
+
+    from dgen_tpu.io.export import RunExporter
+
+    sim, pop = _build(n_agents, 2022, with_hourly=True)
+    ids = np.asarray(pop.table.agent_id)
+    mask = np.asarray(pop.table.mask)
+
+    def _consumer_run(async_on: bool) -> tuple[float, dict | None]:
+        rd = tempfile.mkdtemp(prefix="dgen_bench_async_")
+        prev = os.environ.get("DGEN_TPU_ASYNC_IO")
+        os.environ["DGEN_TPU_ASYNC_IO"] = "1" if async_on else "0"
+        try:
+            exp = RunExporter(os.path.join(rd, "run"), ids, mask)
+            t0 = time.time()
+            sim.run(callback=exp, collect=False,
+                    checkpoint_dir=os.path.join(rd, "ckpt"))
+            return time.time() - t0, sim.hostio_stats
+        finally:
+            if prev is None:
+                os.environ.pop("DGEN_TPU_ASYNC_IO", None)
+            else:
+                os.environ["DGEN_TPU_ASYNC_IO"] = prev
+            shutil.rmtree(rd, ignore_errors=True)
+
+    # no-consumer pipelined floor (also pays the compile, so the two
+    # consumer runs measure steady-state walls)
+    t0 = time.time()
+    sim.run(collect=False)
+    floor_s = time.time() - t0
+    sync_s, _ = _consumer_run(async_on=False)
+    async_s, stats = _consumer_run(async_on=True)
+    out = {
+        "agents": n_agents,
+        "no_consumer_wall_s": round(floor_s, 2),
+        "serialized_wall_s": round(sync_s, 2),
+        "async_wall_s": round(async_s, 2),
+        "serialized_vs_no_consumer_x": round(sync_s / max(floor_s, 1e-9), 3),
+        "async_vs_no_consumer_x": round(async_s / max(floor_s, 1e-9), 3),
+        "speedup_x": round(sync_s / max(async_s, 1e-9), 3),
+    }
+    if stats:
+        out["host_io_s"] = stats.get("host_io_s")
+        out["host_blocked_wall"] = stats.get("host_blocked_s")
+        out["overlap_efficiency"] = stats.get("overlap_efficiency")
+        out["pipeline_depth"] = stats.get("depth_bound")
+    return out
+
+
 #: process start — the budget clock (module import pays the jax/backend
 #: bring-up, which belongs inside the budget)
 _T0 = time.time()
@@ -417,10 +485,18 @@ def main() -> None:
     # emit whatever is complete if a stage overruns the budget (the
     # driver records only rc and the LAST output line; an externally
     # killed process yields neither)
+    from dgen_tpu.config import RunConfig as _RC
+
     payload: dict = {
         "full_run": None,
         "daylight_compact": _BENCH_DAYLIGHT,
         "bf16_banks": _BENCH_BF16,
+        # the session's resolved async host-IO default (the kill
+        # switch DGEN_TPU_ASYNC_IO applies to every run below); the
+        # dedicated A/B block lands under "async_io" when
+        # DGEN_TPU_BENCH_ASYNC is set
+        "async_host_io": _RC().async_io_enabled,
+        "async_io": None if _BENCH_ASYNC else {"skipped": "knob off"},
     }
     cleanup_dirs: list = []   # tempdirs the backstop must not leak
 
@@ -733,6 +809,21 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["sweep"] = {
                     "s": s_way,
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- async host-IO A/B (DGEN_TPU_BENCH_ASYNC=1): pipeline on vs
+    # the serialized oracle vs the no-consumer floor, with overlap
+    # stats (docs/perf.md "Host-IO overlap") ---
+    if _BENCH_ASYNC:
+        if not spendable(point_est * 3):
+            skipped["async_io"] = "budget"
+        else:
+            try:
+                payload["async_io"] = _async_io_ab(n_agents)
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["async_io"] = {
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

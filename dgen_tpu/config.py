@@ -118,6 +118,17 @@ class RunConfig:
     #: docs/perf.md for the measured golden-run envelope. Off by
     #: default. Env: DGEN_TPU_BF16_BANKS.
     bf16_banks: bool = False
+    #: background host-IO pipeline (io.hostio.HostPipeline): per-year
+    #: result collection, RunExporter parquet writes and orbax
+    #: checkpoint saves run on worker threads against one batched
+    #: device fetch per year, so the driver keeps dispatching year
+    #: steps back to back instead of serializing on every host
+    #: consumer. None (default) = on unless the DGEN_TPU_ASYNC_IO env
+    #: kill switch says 0; False restores the serialized per-year path
+    #: (the bit-exact parity oracle); True forces it on. debug runs
+    #: (debug_invariants) and DGEN_TPU_PROFILE always serialize — they
+    #: need per-year host sync regardless.
+    async_host_io: Optional[bool] = None
     #: arm the steady-state retrace guard (lint.guard.RetraceGuard):
     #: once the first two executed years have compiled the
     #: first_year=True/False program pair, any FRESH XLA compile or
@@ -131,6 +142,18 @@ class RunConfig:
         _check(4 <= self.sizing_iters <= 64, "sizing_iters out of range")
         _check(self.agent_chunk is None or self.agent_chunk >= 0,
                "agent_chunk must be None (auto) or >= 0")
+
+    @property
+    def async_io_enabled(self) -> bool:
+        """The resolved async host-IO decision: the explicit field when
+        set, else on unless the ``DGEN_TPU_ASYNC_IO`` kill switch says
+        0/false/off (read at run time, so an operator can flip an
+        already-built config back to the serialized oracle)."""
+        if self.async_host_io is not None:
+            return self.async_host_io
+        return os.environ.get("DGEN_TPU_ASYNC_IO", "") not in (
+            "0", "false", "off"
+        )
 
     @classmethod
     def from_env(cls, **overrides) -> "RunConfig":
@@ -151,4 +174,9 @@ class RunConfig:
             overrides["daylight_compact"] = True
         if "bf16_banks" not in overrides and flag("DGEN_TPU_BF16_BANKS"):
             overrides["bf16_banks"] = True
+        # async_host_io deliberately NOT baked from the env here: the
+        # field stays None so async_io_enabled re-reads the
+        # DGEN_TPU_ASYNC_IO kill switch at run time — baking it would
+        # freeze the value at config-build time and silently ignore an
+        # operator flipping the switch on an already-built config
         return cls(**overrides)

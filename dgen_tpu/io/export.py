@@ -202,10 +202,14 @@ class RunExporter:
                      "export_quantized": bool(
                          self.compact and jax.process_count() == 1),
                      "nonfinite_zeroed": 0,
+                     # flipped (with per-year host_io_wall stamps) by
+                     # stamp_hostio when the async pipeline drives this
+                     # exporter (io.hostio)
+                     "async_io": False,
                      **(meta or {})}
+        self._meta_dirty = False
         if jax.process_index() == 0:
-            with open(os.path.join(run_dir, "meta.json"), "w") as f:
-                json.dump(self.meta, f, indent=2, default=str)
+            self._write_meta()
             if static_frame is not None:
                 # once per run: the static join keys refschema needs
                 static_frame.to_parquet(
@@ -230,16 +234,46 @@ class RunExporter:
     def _quant_dispatch(arrs, quant):
         """Enqueue the on-device quantization of the True-masked fields;
         returns (qs, scales, rest, nonfinite) device arrays WITHOUT
-        fetching.  Used at prepare() time so the ops land on the device
-        queue right behind the step that produced ``arrs`` —
-        dispatching them at callback time instead would queue them
-        behind the NEXT year's step and serialize the export pipeline
-        against device compute (measured: 1M-agent exports 1492 s vs
-        ~130 s prepared)."""
+        fetching.  Used at prepare()/device_payload() time so the ops
+        land on the device queue right behind the step that produced
+        ``arrs`` — dispatching them at callback time instead would
+        queue them behind the NEXT year's step and serialize the export
+        pipeline against device compute (measured: 1M-agent exports
+        1492 s vs ~130 s prepared).  With no True fields (full-
+        precision mode) this is the identity bundle — the fields ride
+        ``rest`` untouched."""
         q_in = [a for a, q in zip(arrs, quant) if q]
-        qs, scales, nonfinite = _quantize_i16_jit(q_in)
+        if q_in:
+            qs, scales, nonfinite = _quantize_i16_jit(q_in)
+        else:
+            qs, scales, nonfinite = [], [], []
         rest = [a for a, q in zip(arrs, quant) if not q]
         return qs, scales, rest, nonfinite
+
+    def _host_reconstruct(self, host_prepared, quant) -> list:
+        """Host-side tail of the transfer: reassemble per-field host
+        arrays in original order from a FETCHED (qs, scales, rest,
+        nonfinite) bundle, f32-reconstructing the quantized fields and
+        accumulating the nonfinite-zeroed provenance count."""
+        h_q, h_s, h_rest, h_nf = host_prepared
+        self._nonfinite_zeroed += int(sum(int(c) for c in h_nf))
+        qi = iter(zip(h_q, h_s))
+        ri = iter(h_rest)
+        out = []
+        for q in quant:
+            if q:
+                qv, s = next(qi)
+                out.append(qv.astype(np.float32) * s)
+            else:
+                out.append(next(ri))
+        return out
+
+    def _ao_quant(self) -> tuple:
+        return (_AGENT_OUTPUT_QUANT if self.compact
+                else (False,) * len(AGENT_OUTPUT_FIELDS))
+
+    def _fin_quant(self) -> tuple:
+        return (True,) if self.compact else (False, False)
 
     def _local_fields(self, arrs, quant=None, prepared=None
                       ) -> tuple[list, np.ndarray]:
@@ -259,24 +293,12 @@ class RunExporter:
         ):
             # single-controller: ONE batched transfer for all fields
             # (per-leaf np.asarray costs a host round trip each)
-            if (prepared is None and self.compact and quant is not None
-                    and any(quant)):
+            if prepared is None:
+                if not (self.compact and quant is not None):
+                    quant = (False,) * len(arrs)   # identity bundle
                 prepared = self._quant_dispatch(arrs, quant)
-            if prepared is not None:
-                qs, scales, rest, nonfinite = prepared
-                h_q, h_s, h_rest, h_nf = jax.device_get(
-                    [qs, scales, rest, nonfinite])
-                self._nonfinite_zeroed += int(sum(int(c) for c in h_nf))
-                qi = iter(zip(h_q, h_s))
-                ri = iter(h_rest)
-                host = [
-                    (lambda qv_s: qv_s[0].astype(np.float32) * qv_s[1])(
-                        next(qi)
-                    ) if q else next(ri)
-                    for q in quant
-                ]
-            else:
-                host = jax.device_get(list(arrs))
+            host = self._host_reconstruct(
+                jax.device_get(list(prepared)), quant)
             return [h[self.keep] for h in host], self.agent_id
         first, idx = _host_rows(arrs[0])
         if idx is None:
@@ -367,25 +389,105 @@ class RunExporter:
             )
         self._flush_meta()
 
+    # --- the async host-IO pipeline's split fetch/write protocol ------
+    # (io.hostio.ExportConsumer; __call__ above stays the serialized
+    # parity oracle and the multi-host path)
+
+    def device_payload(self, year: int, year_idx: int, outs):
+        """Device-side export bundle for one year: quantization (or the
+        full-precision identity bundle) is DISPATCHED here on the main
+        thread — right behind the step that produced ``outs`` — and
+        the single batched ``jax.device_get`` happens on the pipeline's
+        fetch thread.  Returns None when any leaf is not fully
+        addressable: multi-host shard writes keep the synchronous
+        per-shard path."""
+        ao = [getattr(outs, f) for f in AGENT_OUTPUT_FIELDS]
+        fin = (
+            ([outs.cash_flow] if self.compact
+             else [outs.cash_flow, outs.energy_value_pv_only])
+            if self.finance_series else []
+        )
+        if any(
+            getattr(a, "is_fully_addressable", True) is False
+            for a in ao + fin
+        ):
+            return None
+        pre = self._prepared.pop(int(year_idx), {})
+        payload = {
+            "ao": pre.get("agent_outputs")
+            or self._quant_dispatch(ao, self._ao_quant()),
+        }
+        if self.finance_series:
+            payload["fin"] = (
+                pre.get("finance")
+                or self._quant_dispatch(fin, self._fin_quant())
+            )
+        if getattr(outs.state_hourly_net_mw, "size", 0):
+            payload["hourly"] = outs.state_hourly_net_mw
+        return payload
+
+    def write_host(self, year: int, year_idx: int, host) -> None:
+        """Write stage of the pipeline: the host-array tail of
+        write_agent_outputs / write_finance_series / write_state_hourly
+        over a fetched :meth:`device_payload` bundle.  Byte-identical
+        parquet to the serialized path (same reconstruction, masking
+        and frame layout)."""
+        rows = [
+            h[self.keep]
+            for h in self._host_reconstruct(host["ao"], self._ao_quant())
+        ]
+        self._write_ao_frame(year, rows, self.agent_id)
+        if self.finance_series:
+            f_rows = [
+                h[self.keep]
+                for h in self._host_reconstruct(
+                    host["fin"], self._fin_quant())
+            ]
+            ev = None if self.compact else f_rows[1]
+            self._write_fin_frame(year, f_rows[0], ev, self.agent_id)
+        if host.get("hourly") is not None and jax.process_index() == 0:
+            self.write_state_hourly(year, np.asarray(host["hourly"]))
+        self._flush_meta()
+
+    def stamp_hostio(self, stats: Dict[str, object]) -> None:
+        """Stamp the async pipeline's provenance into meta.json:
+        ``async_io`` plus the per-year ``host_io_wall`` (d2h fetch +
+        write seconds) and overlap stats the pipeline measured
+        (io.hostio.HostPipeline.stats)."""
+        self.meta["async_io"] = True
+        self.meta["host_io_wall"] = {
+            str(y): w for y, w in stats.get("years", {}).items()
+        }
+        for k in ("host_io_s", "host_blocked_s", "overlap_efficiency"):
+            if k in stats:
+                self.meta[k] = stats[k]
+        self._meta_dirty = True
+        self._flush_meta()
+
+    def _write_meta(self) -> None:
+        """meta.json write via temp file + os.replace: atomic, so a
+        killed async writer can never leave truncated JSON behind."""
+        path = os.path.join(self.run_dir, "meta.json")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.meta, f, indent=2, default=str)
+        os.replace(tmp, path)
+
     def _flush_meta(self) -> None:
-        """Re-stamp meta.json when the running non-finite-zeroed count
-        has grown (per-run provenance; process 0 owns the file)."""
+        """Re-stamp meta.json when the provenance counters changed
+        (per-run provenance; process 0 owns the file)."""
         if (
             jax.process_index() != 0
-            or self.meta.get("nonfinite_zeroed") == self._nonfinite_zeroed
+            or (self.meta.get("nonfinite_zeroed") == self._nonfinite_zeroed
+                and not self._meta_dirty)
         ):
             return
         self.meta["nonfinite_zeroed"] = int(self._nonfinite_zeroed)
-        with open(os.path.join(self.run_dir, "meta.json"), "w") as f:
-            json.dump(self.meta, f, indent=2, default=str)
+        self._meta_dirty = False
+        self._write_meta()
 
     # --- agent_outputs (reference dgen_model.py:460-462) ---
-    def write_agent_outputs(self, year: int, outs, prepared=None) -> None:
-        rows, ids = self._local_fields(
-            [getattr(outs, f) for f in AGENT_OUTPUT_FIELDS],
-            quant=_AGENT_OUTPUT_QUANT,
-            prepared=prepared,
-        )
+    def _write_ao_frame(self, year: int, rows, ids) -> None:
         cols = dict(zip(AGENT_OUTPUT_FIELDS, rows))
         df = pd.DataFrame({"agent_id": ids, "year": year, **cols})
         df.to_parquet(
@@ -394,7 +496,30 @@ class RunExporter:
             compression=_PARQUET_COMPRESSION,
         )
 
+    def write_agent_outputs(self, year: int, outs, prepared=None) -> None:
+        rows, ids = self._local_fields(
+            [getattr(outs, f) for f in AGENT_OUTPUT_FIELDS],
+            quant=_AGENT_OUTPUT_QUANT,
+            prepared=prepared,
+        )
+        self._write_ao_frame(year, rows, ids)
+
     # --- agent_finance_series (reference finance_series_export.py:22) ---
+    def _write_fin_frame(self, year: int, cf, ev, ids) -> None:
+        data = {
+            "agent_id": ids,
+            "year": year,
+            "cash_flow": list(cf),
+        }
+        if ev is not None:
+            data["energy_value"] = list(ev)
+        df = pd.DataFrame(data)
+        df.to_parquet(
+            os.path.join(_dir(self.run_dir, "finance_series"),
+                         self._part_name(year)),
+            compression=_PARQUET_COMPRESSION,
+        )
+
     def write_finance_series(self, year: int, outs, prepared=None) -> None:
         if self.compact:
             # energy_value is the detail column analysts rarely read and
@@ -409,19 +534,7 @@ class RunExporter:
             (cf, ev), ids = self._local_fields(
                 [outs.cash_flow, outs.energy_value_pv_only]  # [n,Y+1],[n,Y]
             )
-        data = {
-            "agent_id": ids,
-            "year": year,
-            "cash_flow": list(cf),
-        }
-        if ev is not None:
-            data["energy_value"] = list(ev)
-        df = pd.DataFrame(data)
-        df.to_parquet(
-            os.path.join(_dir(self.run_dir, "finance_series"),
-                         self._part_name(year)),
-            compression=_PARQUET_COMPRESSION,
-        )
+        self._write_fin_frame(year, cf, ev, ids)
 
     # --- state_hourly_agg (reference attachment_rate_functions.py:151) ---
     def write_state_hourly(self, year: int, hourly: np.ndarray) -> None:

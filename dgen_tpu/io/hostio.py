@@ -1,0 +1,546 @@
+"""Background host-IO pipeline: overlap exports, checkpoints and result
+collection with device compute.
+
+The year loop already pipelines device steps back to back — but only
+when nothing on the host consumes the per-year outputs. Every
+production path (``collect=True``, a :class:`~dgen_tpu.io.export.
+RunExporter` callback, orbax checkpoints) used to flip the driver into
+a fully serialized mode: block on year N, synchronous ``device_get``,
+parquet writes, orbax save, then dispatch year N+1 — the per-step
+host/dispatch overhead (~40% of wall through a remote tunnel) paid
+every year, and exports ~half the full-run wall at 1M agents.
+
+:class:`HostPipeline` takes every host consumer off the device critical
+path, the async-checkpoint/prefetch shape of serious training stacks:
+
+.. code-block:: text
+
+    main thread   step N ── step N+1 ── step N+2 ── …   (dispatch only)
+                     │ submit(N)
+    fetch thread     └─> device_get(N)  ─> device_get(N+1) ─> …
+                            │ (one batched D2H; GIL released)
+    io thread               └─> collect ─ parquet ─ orbax   (ordered)
+
+* The driver dispatches year N+1 immediately, then :meth:`HostPipeline.
+  submit`\\ s year N.  ``submit`` runs each consumer's
+  :meth:`~HostConsumer.device_payload` on the MAIN thread (dispatch-only
+  device work — e.g. the exporter's int16 quantization — lands on the
+  device queue right behind the step that produced the year) and never
+  fetches.
+* A **fetch stage** runs the single batched :func:`jax.device_get` of
+  the year's payloads on a worker thread: the GIL is released during
+  the D2H copy, so the main thread keeps dispatching.
+* Ordered **downstream stages** consume the host arrays on a second
+  worker thread: result collection, parquet writes, orbax saves.  Both
+  stages are single-threaded executors, so years complete strictly in
+  submission order.
+* **Depth is bounded** (:func:`depth_for_bytes`, the same ~2 GB
+  in-flight-``YearOutputs`` envelope the no-consumer pipelined path
+  drains at): ``submit`` blocks when ``max_in_flight`` years are
+  queued, which bounds both the live device buffers and the fetched
+  host copies.
+* **Worker exceptions surface** on the next ``submit`` or at
+  :meth:`~HostPipeline.drain`, never silently.  A ``finally`` drain
+  preserves the serialized path's crash semantics: the last completed
+  year's export is flushed exactly once, and a year whose write failed
+  partway is not re-written.
+
+Donation/snapshot rule: the jitted year step donates the cross-year
+carry, so its buffers die the moment year N+1 is dispatched.  Anything
+the pipeline must read from the carry (checkpoint saves) is snapshotted
+by the driver — a device-side ``jnp.copy`` tree, queued behind the
+producing step — BEFORE the next dispatch, and the snapshot rides the
+batched fetch.  ``YearOutputs`` leaves are not donated and need no
+snapshot.
+
+The serialized per-year path survives as the bit-exact parity oracle
+behind ``RunConfig.async_host_io=False`` (env kill switch
+``DGEN_TPU_ASYNC_IO=0``) and is still forced by ``debug_invariants``
+and ``DGEN_TPU_PROFILE`` runs, which need per-year host sync anyway.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.utils import timing
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: in-flight per-year device/host bytes the pipeline depth is derived
+#: from — the same envelope the no-consumer pipelined path's
+#: ``sync_every`` drain model uses (models.simulation)
+QUEUE_HBM_BYTES = int(2e9)
+
+
+def depth_for_bytes(per_year_bytes: int,
+                    budget: int = QUEUE_HBM_BYTES) -> int:
+    """Max in-flight years for the pipeline: every queued year keeps its
+    device ``YearOutputs`` buffers (until its fetch completes) and its
+    fetched host copy (until its consumers finish) live, so depth x
+    per-year bytes rides the same ~2 GB envelope the no-consumer path
+    drains at.  Depth 1 still overlaps one full year: the driver
+    dispatches year N+1 before submitting year N."""
+    return max(1, int(budget // max(per_year_bytes, 1)))
+
+
+def tree_bytes(tree) -> int:
+    """Total leaf bytes of a pytree — the per-year unit both in-flight
+    models (:func:`depth_for_bytes` here, the no-consumer path's
+    ``sync_every`` in models.simulation) budget against."""
+    return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(tree))
+
+
+def pipeline_for(consumers, outs, carry=None, *,
+                 timing_ctx: Optional[str] = None,
+                 pool: Optional["HostIOPool"] = None) -> "HostPipeline":
+    """Build a :class:`HostPipeline` sized from the first executed
+    year's outputs (every year is the same shape).  Pass ``carry`` when
+    checkpointing: each queued year then also pins its carry snapshot
+    (device copy + fetched host copy) until the save completes, so the
+    depth budget must count it or checkpointed runs ride ~2x the
+    documented in-flight envelope."""
+    per_year = tree_bytes(outs)
+    if carry is not None:
+        per_year += tree_bytes(carry)
+    return HostPipeline(
+        consumers, max_in_flight=depth_for_bytes(per_year),
+        timing_ctx=timing_ctx, pool=pool,
+    )
+
+
+def snapshot_carry(carry):
+    """Device-side copy of the cross-year carry, queued behind the step
+    that produced it — taken BEFORE the next dispatch, because the
+    jitted year step donates the live carry's buffers (see the
+    donation/snapshot rule in the module docstring)."""
+    return jax.tree.map(jnp.copy, carry)
+
+
+class HostIOPool:
+    """The pipeline's two single-thread stages (fetch, io), shareable
+    across pipelines: a sweep's per-scenario pipelines reuse one pair
+    instead of spawning two threads per scenario."""
+
+    def __init__(self) -> None:
+        self.fetch = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dgen-hostio-fetch")
+        self.io = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dgen-hostio-io")
+
+    def close(self) -> None:
+        self.fetch.shutdown(wait=True)
+        self.io.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------------------
+# Consumers
+# ---------------------------------------------------------------------------
+#
+# A consumer implements:
+#   name            payload key in the batched fetch
+#   timer_name      utils.timing bucket its consume stage records under
+#   needs_device    True -> consume() also receives the year's device
+#                   ``outs`` (the pipeline then holds the device refs
+#                   until the consume stage finishes)
+#   device_payload(year, year_idx, outs, carry) -> pytree | None
+#                   MAIN thread, dispatch-only: device arrays to ride
+#                   the batched fetch (None = nothing to fetch)
+#   consume(year, year_idx, host, outs)
+#                   io thread, strictly ordered by submission
+#   finalize(stats, failed)
+#                   at drain (main thread), success or failure
+
+
+class CollectConsumer:
+    """Result collection: the async analogue of the serialized loop's
+    per-year batched ``device_get`` + append."""
+
+    name = "collect"
+    timer_name = "collect_host"
+    needs_device = False
+
+    def __init__(self, agent_fields: Sequence[str],
+                 with_hourly: bool) -> None:
+        self.agent_fields = list(agent_fields)
+        self.with_hourly = with_hourly
+        self.collected: Dict[str, list] = {k: [] for k in self.agent_fields}
+        self.hourly: List[Any] = []
+
+    def device_payload(self, year, year_idx, outs, carry):
+        payload = {k: getattr(outs, k) for k in self.agent_fields}
+        if self.with_hourly:
+            payload["_hourly"] = outs.state_hourly_net_mw
+        return payload
+
+    def consume(self, year, year_idx, host, outs) -> None:
+        for k in self.agent_fields:
+            self.collected[k].append(host[k])
+        if self.with_hourly:
+            self.hourly.append(host["_hourly"])
+
+    def finalize(self, stats, failed) -> None:
+        pass
+
+
+class ExportConsumer:
+    """A :class:`~dgen_tpu.io.export.RunExporter` stage: quantization is
+    dispatched at submit time (main thread, right behind the producing
+    step — the old ``prepare()`` pre-dispatch contract), the batched
+    fetch rides the pipeline's fetch stage, and only the parquet writes
+    run here."""
+
+    name = "export"
+    timer_name = "export_write"
+    needs_device = False
+
+    def __init__(self, exporter) -> None:
+        self.exporter = exporter
+
+    def device_payload(self, year, year_idx, outs, carry):
+        return self.exporter.device_payload(year, year_idx, outs)
+
+    def consume(self, year, year_idx, host, outs) -> None:
+        self.exporter.write_host(year, year_idx, host)
+
+    def finalize(self, stats, failed) -> None:
+        # per-year host-IO walls + async provenance into meta.json —
+        # runs on the failure path too, so a crashed run still stamps
+        # the years it completed
+        self.exporter.stamp_hostio(stats)
+
+
+class CheckpointConsumer:
+    """An orbax :class:`~dgen_tpu.io.checkpoint.Writer` stage.  The
+    driver hands ``submit`` a device-side carry SNAPSHOT (taken before
+    the next step donates the live carry's buffers); the batched fetch
+    brings it to host and the save runs here.  ``Writer.close`` stays
+    with the driver's ``finally`` — after the drain, so every queued
+    save has been issued."""
+
+    name = "ckpt"
+    timer_name = "ckpt_save"
+    needs_device = False
+
+    def __init__(self, writer) -> None:
+        self.writer = writer
+
+    def device_payload(self, year, year_idx, outs, carry):
+        return carry
+
+    def consume(self, year, year_idx, host, outs) -> None:
+        self.writer.save(year, host)
+
+    def finalize(self, stats, failed) -> None:
+        pass
+
+
+class CallbackConsumer:
+    """An arbitrary user callback, run unchanged on the io thread: its
+    own device fetches overlap device compute, just not batched with
+    the other consumers.  The ``prepare(year, yi, outs)`` pre-dispatch
+    hook (if the callback has one) fires at submit time on the main
+    thread, preserving the old deferred-callback contract."""
+
+    name = "callback"
+    timer_name = "callback_host"
+    needs_device = True
+
+    def __init__(self, cb) -> None:
+        self.cb = cb
+
+    def device_payload(self, year, year_idx, outs, carry):
+        prep = getattr(self.cb, "prepare", None)
+        if prep is not None:
+            prep(year, year_idx, outs)
+        return None
+
+    def consume(self, year, year_idx, host, outs) -> None:
+        self.cb(year, year_idx, outs)
+
+    def finalize(self, stats, failed) -> None:
+        pass
+
+
+def consumer_for_callback(cb):
+    """The pipeline stage for a run callback: exporters implementing the
+    split fetch/write protocol (``device_payload`` + ``write_host``)
+    get the batched-fetch fast path; anything else runs as-is on the io
+    thread."""
+    if hasattr(cb, "device_payload") and hasattr(cb, "write_host"):
+        return ExportConsumer(cb)
+    return CallbackConsumer(cb)
+
+
+# ---------------------------------------------------------------------------
+# The pipeline
+# ---------------------------------------------------------------------------
+
+class _Item:
+    __slots__ = ("year", "year_idx", "payloads", "outs", "done",
+                 "fetch_s", "consume_s")
+
+    def __init__(self, year, year_idx, payloads, outs) -> None:
+        self.year = year
+        self.year_idx = year_idx
+        self.payloads = payloads
+        self.outs = outs
+        self.done: Future = Future()
+        self.fetch_s = 0.0
+        self.consume_s = 0.0
+
+
+class HostPipeline:
+    """Bounded FIFO pipeline of per-year host-IO work (module
+    docstring has the full contract).
+
+    Parameters
+    ----------
+    consumers : ordered stage list (Collect/Export/Checkpoint/Callback
+        consumers, or anything implementing the same protocol).
+    max_in_flight : queue depth bound (:func:`depth_for_bytes`).
+    timing_ctx : utils.timing context label for the stage timers
+        (``d2h_fetch`` / ``export_write`` / ``ckpt_save`` / …).
+    pool : optional shared :class:`HostIOPool`; the pipeline owns (and
+        closes at drain) a private pool when None.
+    """
+
+    def __init__(
+        self,
+        consumers: Sequence[Any],
+        *,
+        max_in_flight: int = 1,
+        timing_ctx: Optional[str] = None,
+        pool: Optional[HostIOPool] = None,
+    ) -> None:
+        self.consumers = list(consumers)
+        self.timing_ctx = timing_ctx
+        self.max_in_flight = max(1, int(max_in_flight))
+        self._own_pool = pool is None
+        self.pool = pool if pool is not None else HostIOPool()
+        self._slots = threading.BoundedSemaphore(self.max_in_flight)
+        self._lock = threading.Lock()
+        self._items: List[_Item] = []
+        self._error: Optional[BaseException] = None
+        self._error_year: Optional[int] = None
+        self._error_year_idx: Optional[int] = None
+        self._in_flight = 0
+        self.max_observed_depth = 0
+        self.host_blocked_s = 0.0
+        self._fetch_s = 0.0
+        self._consume_s = 0.0
+        self._needs_device = any(
+            getattr(c, "needs_device", False) for c in self.consumers
+        )
+        self._drained = False
+
+    # -- error plumbing -------------------------------------------------
+    def _record_error(self, year, exc: BaseException,
+                      year_idx: Optional[int] = None) -> None:
+        """Keep the error of the EARLIEST failed year — the one the
+        crash semantics are defined against.  The fetch stage runs
+        ahead of the io stage, so a year-7 fetch error can be recorded
+        while year 5's write is still in flight; if that write then
+        fails, year 5's error must win (and gate years >= 5), not be
+        dropped.  A superseded error is logged, never swallowed."""
+        with self._lock:
+            if self._error is None or (
+                year_idx is not None
+                and self._error_year_idx is not None
+                and year_idx < self._error_year_idx
+            ):
+                dropped, dropped_year = self._error, self._error_year
+                self._error = exc
+                self._error_year = year
+                self._error_year_idx = year_idx
+            else:
+                dropped, dropped_year = exc, year
+        if dropped is not None:
+            logger.error(
+                "host-IO pipeline error for year %s: %r (year %s's "
+                "error wins)", dropped_year, dropped, self._error_year)
+
+    def _raise_if_failed(self) -> None:
+        if self._error is not None:
+            raise self._error
+
+    def _should_run(self, item: "_Item") -> bool:
+        """Years strictly BEFORE the errored year still run their
+        stages: the serialized oracle would have completed them before
+        any failed-year work started, and the documented crash
+        semantics promise the last completed year's export.  The
+        errored year itself and everything after it are skipped."""
+        with self._lock:
+            if self._error is None:
+                return True
+            if self._error_year_idx is None:
+                return False
+            return item.year_idx < self._error_year_idx
+
+    # -- submit (main thread) -------------------------------------------
+    def submit(self, year: int, year_idx: int, outs,
+               carry=None) -> None:
+        """Queue year ``year``'s host consumers.  Blocks while
+        ``max_in_flight`` years are already queued (the HBM bound);
+        raises any earlier worker exception instead of queueing more
+        work on top of a dead pipeline."""
+        self._raise_if_failed()
+        # acquire the slot BEFORE materializing device payloads: the
+        # copies device_payload dispatches (quantized outputs, pinned
+        # snapshots) count against the same ~2 GB envelope the depth
+        # was budgeted for — building them first would put up to
+        # (depth + 1) years' bytes in flight on HBM-tight configs.
+        # Blocking here dispatches nothing, so the payload ops still
+        # land right behind this year's step in the device queue.
+        t0 = time.perf_counter()
+        self._slots.acquire()
+        self.host_blocked_s += time.perf_counter() - t0
+        payloads = {}
+        try:
+            for c in self.consumers:
+                p = c.device_payload(year, year_idx, outs, carry)
+                if p is not None:
+                    payloads[c.name] = p
+        except BaseException:
+            self._slots.release()
+            raise
+        with self._lock:
+            self._in_flight += 1
+            self.max_observed_depth = max(
+                self.max_observed_depth, self._in_flight)
+        item = _Item(year, year_idx, payloads,
+                     outs if self._needs_device else None)
+        self._items.append(item)
+        try:
+            self.pool.fetch.submit(self._fetch_job, item)
+        except BaseException as e:  # pool torn down under us
+            self._record_error(year, e, year_idx)
+            self._finish(item)
+            raise
+
+    # -- fetch stage (fetch thread) -------------------------------------
+    def _fetch_job(self, item: _Item) -> None:
+        host = None
+        try:
+            if item.payloads and self._should_run(item):
+                t0 = time.perf_counter()
+                with timing.timer("d2h_fetch", ctx=self.timing_ctx):
+                    host = jax.device_get(item.payloads)
+                item.fetch_s = time.perf_counter() - t0
+                with self._lock:
+                    self._fetch_s += item.fetch_s
+        except BaseException as e:  # noqa: BLE001 — surfaced at submit/drain
+            self._record_error(item.year, e, item.year_idx)
+            host = None
+        item.payloads = None   # device buffers release here
+        try:
+            self.pool.io.submit(self._io_job, item, host)
+        except BaseException as e:
+            self._record_error(item.year, e, item.year_idx)
+            self._finish(item)
+
+    # -- consume stage (io thread) --------------------------------------
+    def _io_job(self, item: _Item, host) -> None:
+        try:
+            if self._should_run(item):
+                t0 = time.perf_counter()
+                for c in self.consumers:
+                    payload = None if host is None else host.get(c.name)
+                    if payload is None and not c.needs_device:
+                        continue
+                    with timing.timer(c.timer_name, ctx=self.timing_ctx):
+                        c.consume(item.year, item.year_idx, payload,
+                                  item.outs)
+                item.consume_s = time.perf_counter() - t0
+                with self._lock:
+                    self._consume_s += item.consume_s
+        except BaseException as e:  # noqa: BLE001 — surfaced at submit/drain
+            self._record_error(item.year, e, item.year_idx)
+        finally:
+            self._finish(item)
+
+    def _finish(self, item: _Item) -> None:
+        item.outs = None
+        with self._lock:
+            self._in_flight -= 1
+        self._slots.release()
+        if not item.done.done():
+            item.done.set_result(None)
+
+    # -- drain (main thread, from a finally) ----------------------------
+    def drain(self, failed: bool = False) -> Dict[str, Any]:
+        """Wait for every queued year and finalize the consumers.  On
+        the success path the earliest failed year's worker exception
+        re-raises here (or at an earlier ``submit``); with
+        ``failed=True`` (the driver's loop already raised) it is
+        logged instead, so the original error is not masked.  Closes
+        an owned pool.  Returns :meth:`stats`."""
+        if self._drained:
+            return self.stats()
+        self._drained = True
+        t0 = time.perf_counter()
+        for item in self._items:
+            item.done.result()
+        self.host_blocked_s += time.perf_counter() - t0
+        if self._own_pool:
+            self.pool.close()
+        finalize_err: Optional[BaseException] = None
+        for c in self.consumers:
+            try:
+                c.finalize(self.stats(), failed or self._error is not None)
+            except BaseException as e:  # noqa: BLE001
+                if finalize_err is None:
+                    finalize_err = e
+        if self._error is not None:
+            if failed:
+                logger.error(
+                    "host-IO pipeline failed for year %s: %r (original "
+                    "loop error wins)", self._error_year, self._error)
+            else:
+                if finalize_err is not None:
+                    # the worker error wins the raise; don't drop the
+                    # finalize failure silently
+                    logger.error(
+                        "host-IO finalize failed: %r", finalize_err)
+                raise self._error
+        if finalize_err is not None:
+            if failed:
+                logger.error("host-IO finalize failed: %r", finalize_err)
+            else:
+                raise finalize_err
+        return self.stats()
+
+    # -- observability --------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """Pipeline observability record: per-year host-IO wall (fetch +
+        consume seconds), stage totals, the wall the MAIN thread spent
+        blocked on the pipeline (full submits + drain), and
+        ``overlap_efficiency`` = the fraction of host-IO wall hidden
+        behind device compute (1 - blocked/host_io)."""
+        years = {
+            int(i.year): round(i.fetch_s + i.consume_s, 4)
+            for i in self._items
+            if i.done.done() and (i.fetch_s or i.consume_s)
+        }
+        host_io = self._fetch_s + self._consume_s
+        if host_io > 0:
+            overlap = 1.0 - min(self.host_blocked_s, host_io) / host_io
+        else:
+            overlap = 1.0
+        return {
+            "years": years,
+            "d2h_fetch_s": round(self._fetch_s, 4),
+            "consume_s": round(self._consume_s, 4),
+            "host_io_s": round(host_io, 4),
+            "host_blocked_s": round(self.host_blocked_s, 4),
+            "overlap_efficiency": round(overlap, 4),
+            "max_depth": self.max_observed_depth,
+            "depth_bound": self.max_in_flight,
+        }

@@ -1,4 +1,4 @@
-"""dgenlint rules L1-L8: JAX/TPU anti-patterns for the dgen-tpu stack.
+"""dgenlint rules L1-L9: JAX/TPU anti-patterns for the dgen-tpu stack.
 
 Every rule is a generator ``rule(module, index) -> (line, message)``;
 :func:`run_rules` applies suppressions and wraps results in
@@ -14,6 +14,9 @@ Scope notes:
   * ``int()`` is deliberately NOT a host-sync trigger: trace-time shape
     arithmetic (``int(mesh.devices.size)``) is pervasive and legal.
   * L5/L6/L7 are structural and fire anywhere in the file.
+  * L9 is the inverse scope: a HOST-driver rule (per-year run loops),
+    with the async pipeline module itself exempt — its fetch stage is
+    where the device_get belongs.
 """
 
 from __future__ import annotations
@@ -406,6 +409,76 @@ def rule_l8(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
 
 
 # ---------------------------------------------------------------------------
+# L9 — synchronous host fetches inside per-year driver loops
+# ---------------------------------------------------------------------------
+
+#: the async pipeline itself: its fetch stage IS the sanctioned
+#: device_get (it runs on a worker thread, off the dispatch path)
+_L9_EXEMPT_MODULES = ("dgen_tpu.io.hostio",)
+
+_L9_FETCHES = {"jax.device_get"}
+#: np constructors that force a D2H copy when handed a device array;
+#: only flagged when the argument is rooted at a per-year output/carry
+#: binding, where it is certainly a device array
+_L9_NP_CTORS = {"numpy.asarray", "numpy.array"}
+_L9_DEVICE_ROOTS = {"outs", "out", "outputs", "carry", "snap"}
+
+
+def _is_year_loop(node: ast.For) -> bool:
+    """A per-year driver loop: binds a loop variable named
+    ``year``/``yi``/``year_idx``, or iterates (an ``enumerate`` of)
+    something whose name ends in ``years``."""
+    names = {
+        t.id for t in ast.walk(node.target) if isinstance(t, ast.Name)
+    }
+    if names & {"year", "yi", "year_idx"}:
+        return True
+    it = node.iter
+    if (
+        isinstance(it, ast.Call)
+        and dotted(it.func) == "enumerate"
+        and it.args
+    ):
+        it = it.args[0]
+    d = dotted(it)
+    return bool(d) and d.split(".")[-1].endswith("years")
+
+
+def rule_l9(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """Synchronous ``jax.device_get`` / ``np.asarray(<device array>)``
+    inside per-year loop bodies outside :mod:`dgen_tpu.io.hostio`: each
+    one serializes the driver against the device every year — route
+    the consumer through the host-IO pipeline instead."""
+    if m.modname in _L9_EXEMPT_MODULES:
+        return
+    reported = set()
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.For) or not _is_year_loop(node):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or sub.lineno in reported:
+                continue
+            r = _resolve(m, dotted(sub.func))
+            if r in _L9_FETCHES:
+                reported.add(sub.lineno)
+                yield sub.lineno, (
+                    f"synchronous `{dotted(sub.func)}` in a per-year "
+                    "loop blocks dispatch on the D2H copy every year; "
+                    "route the consumer through io.hostio.HostPipeline "
+                    "(or suppress if this IS the serialized oracle)"
+                )
+            elif r in _L9_NP_CTORS and sub.args:
+                arg = dotted(sub.args[0])
+                if arg and arg.split(".")[0] in _L9_DEVICE_ROOTS:
+                    reported.add(sub.lineno)
+                    yield sub.lineno, (
+                        f"`{dotted(sub.func)}({arg})` in a per-year "
+                        "loop fetches a device array synchronously; "
+                        "route it through io.hostio.HostPipeline"
+                    )
+
+
+# ---------------------------------------------------------------------------
 # Registry / driver
 # ---------------------------------------------------------------------------
 
@@ -418,6 +491,7 @@ RULES: Dict[str, Tuple[str, object]] = {
     "L6": ("Pallas block-shape / dtype alignment", rule_l6),
     "L7": ("missing carry donation on year-step entry points", rule_l7),
     "L8": ("debug leftovers in hot paths", rule_l8),
+    "L9": ("synchronous host fetches in per-year driver loops", rule_l9),
 }
 
 

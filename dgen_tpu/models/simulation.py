@@ -908,6 +908,12 @@ class Simulation:
         #: timing ctx) — the sweep engine sets it per scenario so S
         #: scenarios' year_step timings report separately
         self.timing_ctx: Optional[str] = None
+        #: optional shared io.hostio.HostIOPool — the sweep engine sets
+        #: it so S per-scenario pipelines reuse one thread pair
+        self._hostio_pool = None
+        #: io.hostio.HostPipeline.stats() of the last run's async
+        #: host-IO pipeline (None when the run serialized)
+        self.hostio_stats: Optional[dict] = None
 
         # daylight-compacted candidate kernels (config-gated; the
         # full-hour path stays the default parity oracle): the layout
@@ -1265,6 +1271,17 @@ class Simulation:
         (orbax); with ``resume=True`` the run restarts after the last
         checkpointed year — the working version of the reference's
         vestigial ``resume_year`` stub (SURVEY.md §5).
+
+        Host consumers (collection, export callbacks, checkpoint saves)
+        run on the background host-IO pipeline by default
+        (``dgen_tpu.io.hostio``): the driver keeps dispatching year
+        steps back to back while a worker thread fetches each finished
+        year and ordered stages write it out — bit-identical results,
+        with the host IO overlapped against device compute.
+        ``RunConfig.async_host_io=False`` (env ``DGEN_TPU_ASYNC_IO=0``)
+        restores the serialized per-year path, which also remains in
+        force for ``debug_invariants``, profiling, and multi-process
+        runs (whose shard writes must stay with their own process).
         """
         start_idx = 0
         carry = self.init_carry()
@@ -1296,8 +1313,6 @@ class Simulation:
             f.name for f in dataclasses.fields(YearOutputs)
             if f.name != "state_hourly_net_mw"
         ]
-        collected: Dict[str, list] = {k: [] for k in agent_fields}
-        hourly: List[np.ndarray] = []
 
         ckpt_writer = None
         if checkpoint_dir is not None:
@@ -1306,13 +1321,170 @@ class Simulation:
             ckpt_writer = ckpt.Writer(checkpoint_dir)
 
         debug = self.run_config.debug_invariants
-        if debug:
-            from dgen_tpu.utils import invariants
 
         # opt-in device trace (xprof/tensorboard-consumable), the
         # device-level analogue of the reference's cProfile prof.dat
         # (SURVEY.md §5): traces the first post-compile year step
         profile_dir = os.environ.get("DGEN_TPU_PROFILE")
+
+        # background host-IO pipeline (io.hostio): the default for any
+        # single-process run with a host consumer. debug_invariants and
+        # profiling need per-year host sync; multi-process runs keep
+        # the synchronous per-shard writes with their own process.
+        async_io = (
+            self.run_config.async_io_enabled
+            and not debug and not profile_dir
+            and jax.process_count() == 1
+            and (collect or callback is not None or ckpt_writer is not None)
+        )
+        self.hostio_stats = None
+        try:
+            if async_io:
+                carry, collected, hourly = self._run_years_async(
+                    carry, start_idx, callback, collect, ckpt_writer,
+                    agent_fields,
+                )
+            else:
+                carry, collected, hourly = self._run_years_sync(
+                    carry, start_idx, callback, collect, ckpt_writer,
+                    agent_fields, debug, profile_dir,
+                )
+        finally:
+            # in the finally: a mid-run exception must not abandon
+            # orbax's background save threads without
+            # wait_until_finished (io.checkpoint.Writer.close)
+            if ckpt_writer is not None:
+                ckpt_writer.close()
+        self._hbm_check()
+        if not self._net_billing and not debug:
+            # always-on soundness check for the static all-NEM skip:
+            # system_kw_cum is monotone, so one end-of-run bound check
+            # covers every year's gate evaluation at the cost of a
+            # single host fetch (the per-year variant runs under
+            # debug). Multi-process runs check their own addressable
+            # shard rows — nonnegative per-agent kW makes the per-shard
+            # partials a sound lower bound on the global state totals,
+            # and the shards jointly cover every row.
+            self._check_state_kw_bound(carry, "end of run")
+        agent = (
+            {k: np.stack(v) for k, v in collected.items()}
+            if collect and collected[agent_fields[0]] else {}
+        )
+        return SimResults(
+            years=self.years[start_idx:],
+            agent=agent,
+            state_hourly_net_mw=np.stack(hourly) if hourly else None,
+        )
+
+    def _run_years_async(
+        self,
+        carry: SimCarry,
+        start_idx: int,
+        callback,
+        collect: bool,
+        ckpt_writer,
+        agent_fields: List[str],
+    ) -> tuple[SimCarry, Dict[str, list], List[np.ndarray]]:
+        """The async host-IO year loop (io.hostio.HostPipeline): years
+        are dispatched back to back exactly like the no-consumer
+        pipelined path, and every host consumer — result collection,
+        export callbacks, checkpoint saves — runs on the pipeline's
+        worker threads against one batched device fetch per year.
+
+        The cross-year carry is snapshotted (a device-side copy queued
+        right behind the step that produced it) BEFORE the next
+        iteration's step donates its buffers, so checkpoint saves read
+        stable data.  Pipeline depth is bounded by the same ~2 GB
+        in-flight-outputs envelope as the no-consumer drain model, and
+        the ``finally`` drain preserves the serialized path's crash
+        semantics: the last completed year's export is flushed exactly
+        once, worker errors surface instead of masking (or being
+        masked by) the loop's own failure."""
+        from dgen_tpu.io import hostio
+
+        consumers: list = []
+        collector = None
+        if collect:
+            collector = hostio.CollectConsumer(
+                agent_fields, self.with_hourly)
+            consumers.append(collector)
+        if callback is not None:
+            consumers.append(hostio.consumer_for_callback(callback))
+        if ckpt_writer is not None:
+            consumers.append(hostio.CheckpointConsumer(ckpt_writer))
+
+        pipeline = None
+        guard = None
+        loop_failed = False
+        try:
+            for yi, year in enumerate(self.years):
+                if yi < start_idx:
+                    continue
+                if (
+                    self.run_config.guard_retrace and guard is None
+                    and yi - start_idx >= 2
+                ):
+                    from dgen_tpu.lint.guard import RetraceGuard
+
+                    guard = RetraceGuard(
+                        context="steady-state retrace guard"
+                    ).start()
+                t0 = time.time()
+                with timing.timer("year_step", ctx=self.timing_ctx):
+                    carry, outs = self.step(carry, yi, first_year=(yi == 0))
+                if pipeline is None:
+                    pipeline = hostio.pipeline_for(
+                        consumers, outs,
+                        carry=carry if ckpt_writer is not None else None,
+                        timing_ctx=self.timing_ctx,
+                        pool=self._hostio_pool,
+                    )
+                snap = (hostio.snapshot_carry(carry)
+                        if ckpt_writer is not None else None)
+                pipeline.submit(year, yi, outs, carry=snap)
+                logger.info(
+                    "year %d (%d/%d) %.2fs (queued)", year, yi + 1,
+                    len(self.years), time.time() - t0,
+                )
+                if guard is not None:
+                    guard.check(f"year {year}")
+        except BaseException:
+            loop_failed = True
+            raise
+        finally:
+            if guard is not None:
+                guard.stop()
+            if pipeline is not None:
+                # flush every queued year (the last completed year's
+                # export included) without masking a loop failure
+                self.hostio_stats = pipeline.drain(failed=loop_failed)
+        with timing.timer("device_drain", ctx=self.timing_ctx):
+            jax.block_until_ready(carry.market.market_share)
+            float(jnp.sum(carry.batt_adopters_cum))
+        if collector is not None:
+            return carry, collector.collected, collector.hourly
+        return carry, {k: [] for k in agent_fields}, []
+
+    def _run_years_sync(
+        self,
+        carry: SimCarry,
+        start_idx: int,
+        callback,
+        collect: bool,
+        ckpt_writer,
+        agent_fields: List[str],
+        debug: bool,
+        profile_dir: Optional[str],
+    ) -> tuple[SimCarry, Dict[str, list], List[np.ndarray]]:
+        """The serialized year loop: the no-consumer pipelined path,
+        plus the per-year host-sync parity oracle for the async
+        pipeline (``RunConfig.async_host_io=False``, debug runs,
+        profiling, multi-process shard writes)."""
+        collected: Dict[str, list] = {k: [] for k in agent_fields}
+        hourly: List[np.ndarray] = []
+        if debug:
+            from dgen_tpu.utils import invariants
+
         profiled = False
 
         # per-year host sync is only needed when something consumes the
@@ -1337,7 +1509,9 @@ class Simulation:
         # step's YearOutputs buffers stay live until it executes, so an
         # unthrottled queue holds queue-depth x per-year-outputs of
         # extra HBM (~380 MB/year at 1M agents). Drain often enough to
-        # cap that at ~2 GB; at small populations this never triggers.
+        # cap that at hostio.QUEUE_HBM_BYTES (~2 GB) — the SAME envelope
+        # the async pipeline bounds its queue depth with; at small
+        # populations this never triggers.
         sync_every: Optional[int] = None
 
         # steady-state retrace guard (lint.guard): the first two
@@ -1383,12 +1557,10 @@ class Simulation:
                             jax.block_until_ready(carry.market.market_share)
                         else:
                             if sync_every is None:
-                                per_year = sum(
-                                    l.size * l.dtype.itemsize
-                                    for l in jax.tree.leaves(outs)
-                                )
-                                sync_every = max(
-                                    1, int(2e9 // max(per_year, 1))
+                                from dgen_tpu.io import hostio
+
+                                sync_every = hostio.depth_for_bytes(
+                                    hostio.tree_bytes(outs)
                                 )
                             if (yi - start_idx) % sync_every == sync_every - 1:
                                 jax.block_until_ready(carry.market.market_share)
@@ -1448,7 +1620,9 @@ class Simulation:
                     to_fetch = {k: getattr(outs, k) for k in agent_fields}
                     if self.with_hourly:
                         to_fetch["_hourly"] = outs.state_hourly_net_mw
-                    host = jax.device_get(to_fetch)
+                    # serialized parity-oracle path: the sync fetch IS
+                    # the point here (async runs route through hostio)
+                    host = jax.device_get(to_fetch)  # dgenlint: disable=L9
                     for k in agent_fields:
                         collected[k].append(host[k])
                     if self.with_hourly:
@@ -1484,25 +1658,4 @@ class Simulation:
             with timing.timer("device_drain", ctx=self.timing_ctx):
                 jax.block_until_ready(carry.market.market_share)
                 float(jnp.sum(carry.batt_adopters_cum))
-        self._hbm_check()
-        if not self._net_billing and not debug:
-            # always-on soundness check for the static all-NEM skip:
-            # system_kw_cum is monotone, so one end-of-run bound check
-            # covers every year's gate evaluation at the cost of a
-            # single host fetch (the per-year variant runs under
-            # debug). Multi-process runs check their own addressable
-            # shard rows — nonnegative per-agent kW makes the per-shard
-            # partials a sound lower bound on the global state totals,
-            # and the shards jointly cover every row.
-            self._check_state_kw_bound(carry, "end of run")
-        if ckpt_writer is not None:
-            ckpt_writer.close()
-        agent = (
-            {k: np.stack(v) for k, v in collected.items()}
-            if collect and collected[agent_fields[0]] else {}
-        )
-        return SimResults(
-            years=self.years[start_idx:],
-            agent=agent,
-            state_hourly_net_mw=np.stack(hourly) if hourly else None,
-        )
+        return carry, collected, hourly

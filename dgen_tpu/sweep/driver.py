@@ -239,6 +239,14 @@ class SweepSimulation:
                 g.n_scenarios, g.net_billing, g.mode,
             )
 
+        #: shared io.hostio.HostIOPool for the duration of run(): S
+        #: per-scenario pipelines reuse ONE fetch/io thread pair
+        #: instead of spawning two threads per scenario
+        self._pool = None
+        #: per-group/per-scenario HostPipeline.stats() of the last run
+        #: (empty when the run serialized)
+        self.hostio_stats: Dict[str, dict] = {}
+
     @property
     def n_scenarios(self) -> int:
         return len(self.members)
@@ -315,7 +323,30 @@ class SweepSimulation:
         collected: Dict[str, list] = {k: [] for k in agent_fields}
         hourly: List[np.ndarray] = []
 
+        # background host-IO pipeline (io.hostio): the stacked year
+        # steps dispatch back to back while collection and the stacked
+        # checkpoint saves drain on the sweep's shared worker pair
+        async_io = (
+            self.run_config.async_io_enabled
+            and not self.run_config.debug_invariants
+            and jax.process_count() == 1
+            and (collect or writer is not None)
+        )
+        pipeline = None
+        collector = None
+        consumers: list = []
+        if async_io:
+            from dgen_tpu.io import hostio
+
+            if collect:
+                collector = hostio.CollectConsumer(
+                    agent_fields, self.with_hourly)
+                consumers.append(collector)
+            if writer is not None:
+                consumers.append(hostio.CheckpointConsumer(writer))
+
         guard = None
+        loop_failed = False
         try:
             for yi, year in enumerate(self.years):
                 if yi < start_idx:
@@ -336,25 +367,66 @@ class SweepSimulation:
                         self.base.tariffs, inputs_s, carry,
                         jnp.asarray(yi, dtype=jnp.int32), **kwargs,
                     )
-                    jax.block_until_ready(carry.market.market_share)
-                if writer is not None:
-                    writer.save(year, carry)
-                if collect:
-                    to_fetch = {k: getattr(outs, k) for k in agent_fields}
-                    if self.with_hourly:
-                        to_fetch["_hourly"] = outs.state_hourly_net_mw
-                    host = jax.device_get(to_fetch)
-                    for k in agent_fields:
-                        collected[k].append(host[k])
-                    if self.with_hourly:
-                        hourly.append(host["_hourly"])
+                    if not async_io:
+                        jax.block_until_ready(carry.market.market_share)
+                if async_io:
+                    if pipeline is None:
+                        pipeline = hostio.pipeline_for(
+                            consumers, outs,
+                            carry=carry if writer is not None else None,
+                            timing_ctx=guard_label,
+                            pool=self._pool,
+                        )
+                    # stacked-carry snapshot BEFORE the next
+                    # iteration's sweep_year_step donates it
+                    snap = (hostio.snapshot_carry(carry)
+                            if writer is not None else None)
+                    pipeline.submit(year, yi, outs, carry=snap)
+                else:
+                    if writer is not None:
+                        writer.save(year, carry)
+                    if collect:
+                        to_fetch = {
+                            k: getattr(outs, k) for k in agent_fields
+                        }
+                        if self.with_hourly:
+                            to_fetch["_hourly"] = outs.state_hourly_net_mw
+                        # serialized parity-oracle path (async sweeps
+                        # route through hostio)
+                        host = jax.device_get(to_fetch)  # dgenlint: disable=L9
+                        for k in agent_fields:
+                            collected[k].append(host[k])
+                        if self.with_hourly:
+                            hourly.append(host["_hourly"])
                 if guard is not None:
                     guard.check(f"year {year}")
+        except BaseException:
+            loop_failed = True
+            raise
         finally:
             if guard is not None:
                 guard.stop()
-            if writer is not None:
-                writer.close()
+            try:
+                if pipeline is not None:
+                    # flush queued years before the writer closes,
+                    # without masking a loop failure
+                    self.hostio_stats[guard_label] = pipeline.drain(
+                        failed=loop_failed)
+            finally:
+                # nested finally: drain() re-raises a worker error on
+                # the success path, and even then a mid-run exception
+                # must not abandon orbax's background save threads
+                # without wait_until_finished (io.checkpoint.Writer)
+                if writer is not None:
+                    writer.close()
+        if async_io:
+            # drain the dispatched year chain (scalar fetch: readiness
+            # alone is unreliable through remote-tunnel transports)
+            with timing.timer("device_drain", ctx=guard_label):
+                jax.block_until_ready(carry.market.market_share)
+                float(jnp.sum(carry.batt_adopters_cum))
+        if collector is not None:
+            collected, hourly = collector.collected, collector.hourly
 
         run_years = self.years[start_idx:]
         out: Dict[int, SimResults] = {}
@@ -397,6 +469,8 @@ class SweepSimulation:
                     collect=collect, checkpoint_dir=scn_ckpt,
                     resume=resume,
                 )
+                if sim.hostio_stats is not None:
+                    self.hostio_stats[self.labels[idx]] = sim.hostio_stats
                 if (
                     self.run_config.guard_retrace and guard is None
                     and k == 0 and len(group.indices) > 1
@@ -430,18 +504,50 @@ class SweepSimulation:
         (``scn=<label>/`` in loop mode, one stacked ``scn=<group>/``
         per vmapped group), so ``resume=True`` continues a killed sweep
         at (scenario, year) instead of restarting it.
+
+        Host consumers ride the background host-IO pipeline
+        (:mod:`dgen_tpu.io.hostio`) exactly like single runs — with
+        ONE shared worker pair across every per-scenario pipeline, not
+        two threads per scenario. ``RunConfig.async_host_io=False``
+        (env ``DGEN_TPU_ASYNC_IO=0``) serializes, and
+        :attr:`hostio_stats` carries the per-group/per-scenario
+        pipeline stats afterwards.
         """
+        self.hostio_stats = {}
+        pool = None
+        # same gate as the per-scenario pipelines (_run_group_vmap /
+        # Simulation.run): no consumer or a debug/multi-process run
+        # never builds a pipeline, so don't spawn the worker pair
+        if (
+            self.run_config.async_io_enabled
+            and not self.run_config.debug_invariants
+            and jax.process_count() == 1
+            and (collect or checkpoint_dir is not None)
+        ):
+            from dgen_tpu.io import hostio
+
+            pool = hostio.HostIOPool()
+        self._pool = pool
+        for sim in self.sims:
+            sim._hostio_pool = pool
         results: Dict[int, SimResults] = {}
-        for gi, group in enumerate(self.plan.groups):
-            if group.mode == MODE_VMAP:
-                results.update(self._run_group_vmap(
-                    group, collect, checkpoint_dir, resume,
-                    guard_label=f"group{gi}",
-                ))
-            else:
-                results.update(self._run_group_loop(
-                    group, collect, checkpoint_dir, resume,
-                ))
+        try:
+            for gi, group in enumerate(self.plan.groups):
+                if group.mode == MODE_VMAP:
+                    results.update(self._run_group_vmap(
+                        group, collect, checkpoint_dir, resume,
+                        guard_label=f"group{gi}",
+                    ))
+                else:
+                    results.update(self._run_group_loop(
+                        group, collect, checkpoint_dir, resume,
+                    ))
+        finally:
+            self._pool = None
+            for sim in self.sims:
+                sim._hostio_pool = None
+            if pool is not None:
+                pool.close()
         return SweepResults(
             labels=list(self.labels),
             baseline=self.baseline,

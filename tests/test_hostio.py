@@ -1,0 +1,501 @@
+"""Async host-IO pipeline tests (dgen_tpu.io.hostio): bit-exact parity
+of async vs serialized runs (collection, parquet bytes, checkpoint
+restore), bounded queue depth under a slow writer, worker-exception
+propagation, failure-path drain semantics, sweep integration, the
+DGEN_TPU_ASYNC_IO kill switch, and the L9 lint rule guarding the
+per-year driver loops."""
+
+import json
+import os
+import pathlib
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import checkpoint as ckpt
+from dgen_tpu.io import hostio, synth
+from dgen_tpu.io.export import RunExporter
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+
+CFG = ScenarioConfig(name="hostio-t", start_year=2014, end_year=2018,
+                     anchor_years=())          # model years 2014/16/18
+
+
+@pytest.fixture(scope="module")
+def pop():
+    return synth.generate_population(
+        96, states=["DE", "CA"], seed=7, pad_multiple=32
+    )
+
+
+def make_sim(pop, async_io, **kw):
+    inputs = scen.uniform_inputs(
+        CFG, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+        overrides={"attachment_rate": jnp.full((pop.table.n_groups,), 0.4)},
+    )
+    return Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, CFG,
+        RunConfig(sizing_iters=6, async_host_io=async_io),
+        with_hourly=True, **kw,
+    )
+
+
+def make_exporter(pop, run_dir):
+    return RunExporter(
+        str(run_dir), np.asarray(pop.table.agent_id),
+        np.asarray(pop.table.mask),
+    )
+
+
+@pytest.fixture(scope="module")
+def ab_runs(pop, tmp_path_factory):
+    """One async and one serialized run with every consumer attached
+    (collect + exporter + checkpoints); the parity tests below compare
+    the two."""
+    td = tmp_path_factory.mktemp("hostio-ab")
+    out = {}
+    for tag, async_io in (("async", True), ("sync", False)):
+        sim = make_sim(pop, async_io)
+        exp = make_exporter(pop, td / tag)
+        res = sim.run(callback=exp, collect=True,
+                      checkpoint_dir=str(td / f"ckpt-{tag}"))
+        out[tag] = (sim, res)
+    return td, out
+
+
+# ---------------------------------------------------------------------------
+# Parity: async vs serialized oracle
+# ---------------------------------------------------------------------------
+
+def test_async_collect_bit_exact(ab_runs):
+    _, runs = ab_runs
+    (sim_a, res_a), (sim_s, res_s) = runs["async"], runs["sync"]
+    assert res_a.years == res_s.years
+    assert set(res_a.agent) == set(res_s.agent)
+    for k in res_a.agent:
+        assert np.array_equal(res_a.agent[k], res_s.agent[k]), k
+    assert np.array_equal(res_a.state_hourly_net_mw,
+                          res_s.state_hourly_net_mw)
+    # the pipeline actually ran (and only on the async side)
+    assert sim_a.hostio_stats is not None
+    assert sim_s.hostio_stats is None
+    assert len(sim_a.hostio_stats["years"]) == len(res_a.years)
+    assert sim_a.hostio_stats["max_depth"] >= 1
+
+
+def test_async_export_parquet_byte_identical(ab_runs):
+    td, _ = ab_runs
+    for sub in ("agent_outputs", "finance_series", "state_hourly"):
+        fa = sorted((pathlib.Path(td) / "async" / sub).glob("*.parquet"))
+        fs = sorted((pathlib.Path(td) / "sync" / sub).glob("*.parquet"))
+        assert [f.name for f in fa] == [f.name for f in fs] != []
+        for a, s in zip(fa, fs):
+            assert a.read_bytes() == s.read_bytes(), f"{sub}/{a.name}"
+
+
+def test_async_checkpoint_restore_bit_exact(ab_runs, pop):
+    td, _ = ab_runs
+    ya, ca = ckpt.restore_year(str(td / "ckpt-async"), pop.table.n_agents)
+    ys, cs = ckpt.restore_year(str(td / "ckpt-sync"), pop.table.n_agents)
+    assert ya == ys == CFG.model_years[-1]
+    for a, s in zip(jax.tree.leaves(ca), jax.tree.leaves(cs)):
+        assert np.array_equal(np.asarray(a), np.asarray(s))
+
+
+def test_meta_stamps_async_provenance(ab_runs):
+    td, _ = ab_runs
+    meta_a = json.loads((pathlib.Path(td) / "async" / "meta.json").read_text())
+    meta_s = json.loads((pathlib.Path(td) / "sync" / "meta.json").read_text())
+    assert meta_a["async_io"] is True
+    assert meta_s["async_io"] is False
+    assert sorted(meta_a["host_io_wall"]) == [str(y) for y in CFG.model_years]
+    assert 0.0 <= meta_a["overlap_efficiency"] <= 1.0
+    assert "host_blocked_s" in meta_a
+    # atomic meta writes never leave the temp file behind
+    assert not (pathlib.Path(td) / "async" / "meta.json.tmp").exists()
+
+
+def test_timer_buckets_recorded(ab_runs):
+    from dgen_tpu.utils import timing
+
+    report = timing.timing_report()
+    for bucket in ("d2h_fetch", "export_write", "ckpt_save"):
+        assert report.get(bucket, {}).get("count", 0) >= 1, bucket
+
+
+def test_env_kill_switch_forces_serialized(pop, monkeypatch):
+    monkeypatch.setenv("DGEN_TPU_ASYNC_IO", "0")
+    assert RunConfig().async_io_enabled is False       # run-time read
+    # from_env must NOT bake the env into the field: the kill switch
+    # is read at run time, so it keeps working on a prebuilt config
+    assert RunConfig.from_env().async_host_io is None
+    assert RunConfig.from_env().async_io_enabled is False
+    sim = make_sim(pop, async_io=None)
+    sim.run(collect=True)
+    assert sim.hostio_stats is None
+    # explicit field beats the env default
+    assert RunConfig(async_host_io=True).async_io_enabled is True
+
+
+# ---------------------------------------------------------------------------
+# Pipeline mechanics (no simulation)
+# ---------------------------------------------------------------------------
+
+class Recorder:
+    """Minimal consumer: records (year, payload) in consume order."""
+
+    name = "rec"
+    timer_name = "export_write"
+    needs_device = False
+
+    def __init__(self, delay=0.0, fail_on=None):
+        self.delay = delay
+        self.fail_on = fail_on
+        self.years = []
+        self.finalized = None
+
+    def device_payload(self, year, year_idx, outs, carry):
+        return {"x": outs}
+
+    def consume(self, year, year_idx, host, outs):
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail_on is not None and year == self.fail_on:
+            raise RuntimeError(f"writer died at year {year}")
+        self.years.append(int(year))
+
+    def finalize(self, stats, failed):
+        self.finalized = bool(failed)
+
+
+def test_depth_for_bytes_bounds():
+    assert hostio.depth_for_bytes(1, budget=10) == 10
+    assert hostio.depth_for_bytes(4, budget=10) == 2
+    # never zero, even when one year exceeds the whole budget
+    assert hostio.depth_for_bytes(10**12) == 1
+
+
+def test_bounded_depth_under_slow_writer():
+    rec = Recorder(delay=0.05)
+    p = hostio.HostPipeline([rec], max_in_flight=2)
+    t0 = time.perf_counter()
+    for y in range(6):
+        p.submit(y, y, jnp.float32(y))
+    submit_wall = time.perf_counter() - t0
+    stats = p.drain()
+    # strictly ordered, exactly once each
+    assert rec.years == list(range(6))
+    assert stats["max_depth"] <= 2
+    # 6 submits against a 2-deep queue with a 50 ms writer MUST have
+    # blocked the main thread (the HBM bound working as intended)
+    assert submit_wall > 0.05
+    assert stats["host_blocked_s"] > 0.0
+    assert rec.finalized is False
+
+
+def test_worker_exception_surfaces_never_silently():
+    rec = Recorder(fail_on=1)
+    p = hostio.HostPipeline([rec], max_in_flight=1)
+    # the driver shape: submits in a try, drain in the finally — the
+    # worker error surfaces at a later submit or at the drain, and the
+    # drain still finalizes the consumers
+    with pytest.raises(RuntimeError, match="writer died at year 1"):
+        try:
+            for y in range(5):
+                p.submit(y, y, jnp.float32(y))
+        finally:
+            p.drain()
+    # years after the failure are NOT consumed (a dead writer must not
+    # keep appending partitions), and finalize still ran, failure-aware
+    assert rec.years == [0]
+    assert rec.finalized is True
+
+
+def test_late_year_error_does_not_suppress_earlier_years():
+    """A fetch-stage error for year N must not skip already-fetched
+    EARLIER years still queued on the io thread — the serialized oracle
+    would have completed their writes before any year-N work started."""
+    gate = threading.Event()
+
+    class Gated(Recorder):
+        def consume(self, year, year_idx, host, outs):
+            gate.wait(5.0)
+            super().consume(year, year_idx, host, outs)
+
+    rec = Gated()
+    p = hostio.HostPipeline([rec], max_in_flight=4)
+    for y in range(4):
+        p.submit(y, y, jnp.float32(y))
+    # year 3 fails while years 0-2 sit queued behind the gated writer
+    p._record_error(3, RuntimeError("boom"), 3)
+    gate.set()
+    with pytest.raises(RuntimeError, match="boom"):
+        p.drain()
+    assert rec.years == [0, 1, 2]
+    assert rec.finalized is True
+
+
+def test_earliest_year_error_wins_and_gates_later_years():
+    """The fetch stage runs ahead of the io stage: a later year's fetch
+    error must not suppress an EARLIER year's write failure — the
+    earliest failed year's error wins the raise and gates everything
+    after it (a dead writer must not keep appending partitions)."""
+    gate = threading.Event()
+
+    class Gated(Recorder):
+        def consume(self, year, year_idx, host, outs):
+            gate.wait(5.0)
+            super().consume(year, year_idx, host, outs)
+
+    rec = Gated(fail_on=1)
+    p = hostio.HostPipeline([rec], max_in_flight=4)
+    for y in range(4):
+        p.submit(y, y, jnp.float32(y))
+    # year 3's fetch has already failed while years 0-2 sit queued
+    p._record_error(3, RuntimeError("late fetch died"), 3)
+    gate.set()
+    with pytest.raises(RuntimeError, match="writer died at year 1"):
+        p.drain()
+    # year 1's own failure superseded year 3's and gated year 2
+    assert rec.years == [0]
+    assert rec.finalized is True
+
+
+def test_failed_drain_preserves_original_error():
+    """drain(failed=True) — the driver's loop already raised — logs a
+    worker error instead of masking the original exception."""
+    rec = Recorder(fail_on=0)
+    p = hostio.HostPipeline([rec], max_in_flight=1)
+    p.submit(0, 0, jnp.float32(0))
+    stats = p.drain(failed=True)           # must not raise
+    assert stats["max_depth"] == 1
+    assert rec.finalized is True
+
+
+def test_drain_flushes_all_queued_years_exactly_once():
+    rec = Recorder()
+    p = hostio.HostPipeline([rec], max_in_flight=4)
+    for y in range(3):
+        p.submit(y, y, jnp.float32(y))
+    p.drain(failed=True)                   # failure path still flushes
+    assert rec.years == [0, 1, 2]
+    # drain is idempotent
+    p.drain()
+    assert rec.years == [0, 1, 2]
+
+
+def test_shared_pool_not_closed_by_pipeline():
+    pool = hostio.HostIOPool()
+    try:
+        r1, r2 = Recorder(), Recorder()
+        p1 = hostio.HostPipeline([r1], max_in_flight=1, pool=pool)
+        p1.submit(0, 0, jnp.float32(0))
+        p1.drain()
+        # pool survives the first pipeline's drain and serves a second
+        p2 = hostio.HostPipeline([r2], max_in_flight=1, pool=pool)
+        p2.submit(1, 1, jnp.float32(1))
+        p2.drain()
+        assert r1.years == [0] and r2.years == [1]
+    finally:
+        pool.close()
+
+
+def test_plain_callback_runs_ordered_on_io_thread():
+    seen = []
+    main = threading.get_ident()
+
+    def cb(year, year_idx, outs):
+        seen.append((int(year), threading.get_ident()))
+
+    c = hostio.consumer_for_callback(cb)
+    assert isinstance(c, hostio.CallbackConsumer)
+    p = hostio.HostPipeline([c], max_in_flight=2)
+    for y in range(4):
+        p.submit(y, y, jnp.float32(y))
+    p.drain()
+    assert [y for y, _ in seen] == list(range(4))
+    assert all(tid != main for _, tid in seen)
+
+
+def test_exporter_gets_split_fetch_protocol(pop, tmp_path):
+    exp = make_exporter(pop, tmp_path / "r")
+    assert isinstance(
+        hostio.consumer_for_callback(exp), hostio.ExportConsumer
+    )
+
+
+# ---------------------------------------------------------------------------
+# Failure-path crash semantics through Simulation.run
+# ---------------------------------------------------------------------------
+
+def test_loop_failure_flushes_completed_years_once(pop, monkeypatch):
+    """A step failure at year N surfaces as-is, and every COMPLETED
+    year's callback ran exactly once (the finally drain)."""
+    calls = []
+
+    def cb(year, year_idx, outs):
+        calls.append(int(year))
+
+    sim = make_sim(pop, async_io=True)
+    orig = Simulation.step
+
+    def bad_step(self, carry, year_idx, first_year):
+        if year_idx == 2:
+            raise RuntimeError("device fell over")
+        return orig(self, carry, year_idx, first_year)
+
+    monkeypatch.setattr(Simulation, "step", bad_step)
+    with pytest.raises(RuntimeError, match="device fell over"):
+        sim.run(callback=cb, collect=False)
+    assert calls == CFG.model_years[:2]
+
+
+def test_worker_error_fails_the_run(pop):
+    def cb(year, year_idx, outs):
+        raise OSError("disk full")
+
+    sim = make_sim(pop, async_io=True)
+    with pytest.raises(OSError, match="disk full"):
+        sim.run(callback=cb, collect=False)
+
+
+# ---------------------------------------------------------------------------
+# Sweep integration
+# ---------------------------------------------------------------------------
+
+def test_sweep_vmap_async_checkpoints_and_resumes(pop, tmp_path):
+    """A vmapped sweep group checkpoints through the pipeline and
+    resumes at (scenario, year); hostio stats are recorded per group
+    under ONE shared worker pool."""
+    from dgen_tpu.sweep import MODE_VMAP, SweepSimulation
+
+    Y = len(CFG.model_years)
+    members = [
+        scen.uniform_inputs(
+            CFG, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+            overrides={"itc_fraction": jnp.full((Y, 3), v, jnp.float32)},
+        )
+        for v in (0.3, 0.0)
+    ]
+    d = str(tmp_path / "ckpt")
+    sweep = SweepSimulation(
+        pop.table, pop.profiles, pop.tariffs, members, CFG,
+        RunConfig(sizing_iters=6, async_host_io=True),
+    )
+    assert sweep.plan.groups[0].mode == MODE_VMAP
+    res = sweep.run(checkpoint_dir=d)
+    assert "group0" in sweep.hostio_stats
+    assert len(sweep.hostio_stats["group0"]["years"]) == Y
+    assert sweep._pool is None                 # shared pool torn down
+    m = np.asarray(pop.table.mask)
+    assert res.runs[0].summary(m)["system_kw_cum"][-1] > 0
+
+    res_r = sweep.run(checkpoint_dir=d, resume=True)
+    assert res_r.runs[0].years == [] and res_r.runs[1].years == []
+
+
+def test_sweep_async_matches_serialized(pop):
+    from dgen_tpu.sweep import SweepSimulation
+
+    Y = len(CFG.model_years)
+    members = [
+        scen.uniform_inputs(
+            CFG, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+            overrides={"itc_fraction": jnp.full((Y, 3), v, jnp.float32)},
+        )
+        for v in (0.3, 0.0)
+    ]
+
+    def run(async_io):
+        return SweepSimulation(
+            pop.table, pop.profiles, pop.tariffs, members, CFG,
+            RunConfig(sizing_iters=6, async_host_io=async_io),
+        ).run()
+
+    ra, rs = run(True), run(False)
+    for s in range(2):
+        for k in ra.runs[s].agent:
+            assert np.array_equal(
+                ra.runs[s].agent[k], rs.runs[s].agent[k]
+            ), (s, k)
+
+
+# ---------------------------------------------------------------------------
+# L9: the lint rule guarding the per-year loops
+# ---------------------------------------------------------------------------
+
+def _lint(src, modname="dgen_tpu.models.fake"):
+    from dgen_tpu.lint.core import ProjectIndex, parse_source
+    from dgen_tpu.lint.rules import run_rules
+
+    m = parse_source(src, modname=modname)
+    return run_rules(ProjectIndex([m]), select=["L9"])
+
+
+def test_l9_flags_device_get_in_year_loop():
+    src = (
+        "import jax\n"
+        "def run(self):\n"
+        "    for yi, year in enumerate(self.years):\n"
+        "        outs = step(yi)\n"
+        "        host = jax.device_get(outs)\n"
+    )
+    fs = _lint(src)
+    assert len(fs) == 1 and fs[0].rule == "L9" and fs[0].line == 5
+
+
+def test_l9_flags_np_asarray_on_outputs():
+    src = (
+        "import numpy as np\n"
+        "def run(years):\n"
+        "    for year in years:\n"
+        "        outs = step(year)\n"
+        "        h = np.asarray(outs.state_hourly_net_mw)\n"
+    )
+    assert len(_lint(src)) == 1
+    # host-side arrays are not flagged
+    src_ok = src.replace("outs.state_hourly_net_mw", "table.mask")
+    assert _lint(src_ok) == []
+
+
+def test_l9_suppression_and_hostio_exempt():
+    src = (
+        "import jax\n"
+        "def run(self):\n"
+        "    for yi in range(3):\n"
+        "        h = jax.device_get(x)  # dgenlint: disable=L9\n"
+    )
+    assert _lint(src) == []
+    src2 = src.replace("  # dgenlint: disable=L9", "")
+    assert len(_lint(src2)) == 1
+    assert _lint(src2, modname="dgen_tpu.io.hostio") == []
+
+
+def test_l9_ignores_non_year_loops():
+    src = (
+        "import jax\n"
+        "def gather(shards):\n"
+        "    for s in shards:\n"
+        "        h = jax.device_get(s)\n"
+    )
+    assert _lint(src) == []
+
+
+def test_repo_drivers_are_l9_clean():
+    """The run drivers pass L9: every remaining sync fetch in a
+    per-year loop is an explicitly suppressed oracle path."""
+    from dgen_tpu.lint import lint_paths
+
+    root = pathlib.Path(__file__).resolve().parents[1] / "dgen_tpu"
+    findings = lint_paths(
+        [str(root / "models" / "simulation.py"),
+         str(root / "sweep"), str(root / "io")],
+        select=["L9"],
+    )
+    assert findings == []

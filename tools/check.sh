@@ -21,6 +21,13 @@ if [ "$mode" != "--test-only" ]; then
     # explicit pass keeps it gated even if the default root narrows
     echo "== dgenlint (dgen_tpu/sweep) =="
     python -m dgen_tpu.lint dgen_tpu/sweep || rc=1
+    # L9 guards the async host-IO overlap (docs/perf.md): any new sync
+    # device fetch in a per-year driver loop must be an explicit,
+    # suppressed decision — gate the drivers by name so the rule keeps
+    # firing even if the default root narrows
+    echo "== dgenlint L9 (per-year host-fetch guard) =="
+    python -m dgen_tpu.lint --select L9 \
+        dgen_tpu/models/simulation.py dgen_tpu/sweep dgen_tpu/io || rc=1
 fi
 
 if [ "$mode" != "--lint-only" ]; then

@@ -56,6 +56,12 @@ Knobs (env):
                           sweep (dgen_tpu.sweep) vs one single run and
                           stamp S, per-scenario wall, bank-bytes-shared
                           and the amortization ratio into the payload
+  DGEN_TPU_BENCH_SERVE    <QPS>: closed-loop load test of the online
+                          what-if query engine (dgen_tpu.serve) at the
+                          target aggregate QPS — stamps achieved
+                          throughput, batch occupancy and p50/p99
+                          request latency into the payload (the
+                          trajectory's first latency numbers)
   DGEN_TPU_BENCH_ASYNC    1: A/B the background host-IO pipeline
                           (io.hostio) — the SAME export+checkpoint run
                           with the pipeline on vs the serialized
@@ -98,6 +104,10 @@ _BENCH_BF16 = os.environ.get(
     "DGEN_TPU_BENCH_BF16", "") not in ("", "0", "false")
 _BENCH_ASYNC = os.environ.get(
     "DGEN_TPU_BENCH_ASYNC", "") not in ("", "0", "false")
+# "0"/"false" disable, same convention as the sibling flags above
+_BENCH_SERVE = os.environ.get("DGEN_TPU_BENCH_SERVE", "").strip()
+if _BENCH_SERVE in ("0", "false"):
+    _BENCH_SERVE = ""
 
 
 def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
@@ -441,6 +451,82 @@ def _async_io_ab(n_agents: int) -> dict:
         out["overlap_efficiency"] = stats.get("overlap_efficiency")
         out["pipeline_depth"] = stats.get("depth_bound")
     return out
+
+
+def _serve_bench(
+    n_agents: int, qps: int, duration_s: float = 5.0
+) -> dict:
+    """Closed-loop load generator against the serving engine
+    (dgen_tpu.serve): C client threads each issue single-agent what-if
+    queries through the microbatcher, pacing themselves so the
+    aggregate offered load approximates ``qps``; each client waits for
+    its answer before issuing the next (closed loop — overload shows
+    up as latency, not as an unbounded in-flight pile). Stamps the
+    trajectory's first serving-latency numbers: achieved throughput,
+    p50/p99 request latency, and mean batch occupancy."""
+    import threading
+
+    from dgen_tpu.config import ServeConfig
+    from dgen_tpu.serve import Microbatcher, ServeEngine
+
+    sim, pop = _build(min(n_agents, 8192), 2022)
+    engine = ServeEngine(sim)
+    cfg = ServeConfig(max_batch=64, max_wait_ms=2.0, max_queue=4096)
+    t0 = time.time()
+    engine.warmup(cfg.buckets)
+    warmup_s = time.time() - t0
+    bat = Microbatcher(engine, cfg)
+
+    n_real = int(np.asarray(pop.table.mask).sum())
+    years = sim.years
+    n_clients = max(1, min(64, qps // 4))
+    interval = n_clients / max(qps, 1)
+    stop = time.time() + duration_s
+    done = [0] * n_clients
+    errors = [0] * n_clients
+
+    def client(ci: int) -> None:
+        rng = np.random.default_rng(ci)
+        while time.time() < stop:
+            t_iter = time.time()
+            aid = int(rng.integers(0, n_real))
+            yr = int(years[int(rng.integers(0, len(years)))])
+            try:
+                bat.query([aid], year=yr, timeout=30.0)
+                done[ci] += 1
+            except Exception:  # noqa: BLE001 — count, keep offering load
+                errors[ci] += 1
+            dt = time.time() - t_iter
+            if dt < interval:
+                time.sleep(interval - dt)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(n_clients)
+    ]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 60.0)
+    elapsed = time.time() - t0
+    stats = bat.stats()   # latency_ms percentiles come from here — one
+    bat.close()           # formatting of the shared timing histogram
+    return {
+        "agents": n_real,
+        "qps_target": qps,
+        "clients": n_clients,
+        "duration_s": round(elapsed, 2),
+        "warmup_s": round(warmup_s, 2),
+        "buckets": list(cfg.buckets),
+        "qps_achieved": round(sum(done) / max(elapsed, 1e-9), 1),
+        "requests": sum(done),
+        "errors": sum(errors),
+        "latency_ms": stats.get("latency_ms"),
+        "batch_occupancy": stats.get("batch_occupancy"),
+        "batches": stats.get("batches"),
+        "rejected": stats.get("rejected"),
+    }
 
 
 #: process start — the budget clock (module import pays the jax/backend
@@ -824,6 +910,23 @@ def main() -> None:
                 payload["async_io"] = _async_io_ab(n_agents)
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["async_io"] = {
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- serving load A/B (DGEN_TPU_BENCH_SERVE=<QPS>): closed-loop
+    # clients through the microbatcher — the trajectory's first latency
+    # numbers (docs/serve.md) ---
+    if _BENCH_SERVE:
+        qps = int(_BENCH_SERVE)
+        if not spendable(point_est + 60.0):
+            skipped["serve"] = "budget"
+        else:
+            try:
+                payload["serve"] = _serve_bench(n_agents, qps)
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["serve"] = {
+                    "qps_target": qps,
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

@@ -180,3 +180,81 @@ class RunConfig:
         # freeze the value at config-build time and silently ignore an
         # operator flipping the switch on an already-built config
         return cls(**overrides)
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Settings for the online what-if query engine
+    (:mod:`dgen_tpu.serve`): the microbatcher's bucket/queue shape and
+    the HTTP front-end. Every compile-relevant knob is a power of two
+    so the set of program shapes a serving process can ever build is
+    fixed up front (``log2(max_batch/min_bucket)+1`` bucket programs —
+    RetraceGuard-clean steady state)."""
+
+    #: largest microbatch (rows per device program); queries coalesce
+    #: up to this many agent rows into one padded bucket
+    max_batch: int = 64
+    #: smallest padded bucket; single-agent queries compile/run at this
+    #: width (1 = a dedicated single-shot program)
+    min_bucket: int = 1
+    #: deadline flush: a queued request waits at most this long for
+    #: co-batching before its (possibly underfull) bucket dispatches
+    max_wait_ms: float = 5.0
+    #: admission control: submissions beyond this many queued requests
+    #: are rejected with ``serve.QueueFullError`` instead of growing
+    #: the queue (and the tail latency) without bound
+    max_queue: int = 256
+    #: HTTP front-end bind address (``python -m dgen_tpu.serve``);
+    #: port 0 binds an ephemeral port (tests)
+    host: str = "127.0.0.1"
+    port: int = 8178
+    #: compile every bucket program before accepting traffic, so no
+    #: request ever pays a compile (RunConfig.guard_retrace then holds
+    #: from the first query on)
+    warmup: bool = True
+
+    def __post_init__(self) -> None:
+        _check(_is_pow2(self.max_batch), "max_batch must be a power of two")
+        _check(_is_pow2(self.min_bucket) and self.min_bucket <= self.max_batch,
+               "min_bucket must be a power of two <= max_batch")
+        _check(self.max_wait_ms >= 0.0, "max_wait_ms must be >= 0")
+        _check(self.max_queue >= 1, "max_queue must be >= 1")
+        _check(0 <= self.port <= 65535, "port out of range")
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        """The fixed compile shapes, ascending (powers of two from
+        min_bucket to max_batch)."""
+        out = []
+        b = self.min_bucket
+        while b <= self.max_batch:
+            out.append(b)
+            b *= 2
+        return tuple(out)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        """Env switches, same conventions as :meth:`RunConfig.from_env`:
+        DGEN_TPU_SERVE_MAX_BATCH, DGEN_TPU_SERVE_WAIT_MS,
+        DGEN_TPU_SERVE_QUEUE, DGEN_TPU_SERVE_HOST, DGEN_TPU_SERVE_PORT,
+        DGEN_TPU_SERVE_WARMUP (0/false = off)."""
+        env = os.environ.get
+        if "max_batch" not in overrides and env("DGEN_TPU_SERVE_MAX_BATCH"):
+            overrides["max_batch"] = int(env("DGEN_TPU_SERVE_MAX_BATCH"))
+        if "max_wait_ms" not in overrides and env("DGEN_TPU_SERVE_WAIT_MS"):
+            overrides["max_wait_ms"] = float(env("DGEN_TPU_SERVE_WAIT_MS"))
+        if "max_queue" not in overrides and env("DGEN_TPU_SERVE_QUEUE"):
+            overrides["max_queue"] = int(env("DGEN_TPU_SERVE_QUEUE"))
+        if "host" not in overrides and env("DGEN_TPU_SERVE_HOST"):
+            overrides["host"] = env("DGEN_TPU_SERVE_HOST")
+        if "port" not in overrides and env("DGEN_TPU_SERVE_PORT"):
+            overrides["port"] = int(env("DGEN_TPU_SERVE_PORT"))
+        if "warmup" not in overrides and env("DGEN_TPU_SERVE_WARMUP"):
+            overrides["warmup"] = env("DGEN_TPU_SERVE_WARMUP") not in (
+                "0", "false", "off"
+            )
+        return cls(**overrides)

@@ -27,6 +27,9 @@ gets the same property from per-task Postgres writes
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+import hashlib
 import json
 import os
 from typing import Dict, Optional, Sequence
@@ -149,6 +152,67 @@ def _dir(run_dir: str, name: str) -> str:
     return d
 
 
+# ---------------------------------------------------------------------------
+# Provenance stamps
+# ---------------------------------------------------------------------------
+#
+# One definition of "which code / which configuration produced this
+# answer", shared by every surface that claims provenance: RunExporter
+# stamps it into each run's meta.json, and the serving front-end
+# (dgen_tpu.serve.server) returns the same stamp from /healthz so an
+# operator can tie a live query endpoint to the exact tree and config
+# it is answering from.
+
+@functools.lru_cache(maxsize=8)
+def git_sha(root: Optional[str] = None) -> Optional[str]:
+    """Short commit sha of the running checkout (None when the tree is
+    not a git checkout or git is unavailable). Cached: exporters and
+    health probes must not fork a subprocess per call."""
+    import subprocess
+
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=5,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def config_hash(*configs) -> Optional[str]:
+    """12-hex digest over the given config objects (dataclasses are
+    serialized field-by-field, dicts as-is) — two processes answering
+    from the same configuration produce the same hash regardless of
+    field order. None when no configs are given."""
+    if not configs:
+        return None
+    blob = json.dumps(
+        [
+            dataclasses.asdict(c) if dataclasses.is_dataclass(c) else c
+            for c in configs
+        ],
+        sort_keys=True, default=str,
+    )
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def provenance_stamp(*configs) -> Dict[str, object]:
+    """The shared provenance record: git sha, config hash (when configs
+    are given), and the live backend shape."""
+    return {
+        "git_sha": git_sha(),
+        "config_hash": config_hash(*configs),
+        "jax_backend": jax.default_backend(),
+        "n_devices": jax.device_count(),
+    }
+
+
 class RunExporter:
     """Host-side per-year writer, used as a Simulation.run callback.
 
@@ -192,8 +256,12 @@ class RunExporter:
         os.makedirs(run_dir, exist_ok=True)
         # provenance stamp: ``meta`` (notably market_curves:
         # synthetic_default vs ingested, from scenario ingest) is written
-        # up front so a run's outputs carry their own caveats
-        self.meta = {"n_agents": int(self.keep.sum()),
+        # up front so a run's outputs carry their own caveats; the
+        # git-sha/backend stamp is the same record /healthz serves
+        # (provenance_stamp), so run artifacts and live query endpoints
+        # attribute themselves identically
+        self.meta = {**provenance_stamp(),
+                     "n_agents": int(self.keep.sum()),
                      "export_compact": self.compact,
                      # quantization applies only on the single-controller
                      # fast path; multi-host shard writes stay full f32

@@ -1,4 +1,4 @@
-"""dgenlint rules L1-L9: JAX/TPU anti-patterns for the dgen-tpu stack.
+"""dgenlint rules L1-L10: JAX/TPU anti-patterns for the dgen-tpu stack.
 
 Every rule is a generator ``rule(module, index) -> (line, message)``;
 :func:`run_rules` applies suppressions and wraps results in
@@ -17,6 +17,8 @@ Scope notes:
   * L9 is the inverse scope: a HOST-driver rule (per-year run loops),
     with the async pipeline module itself exempt — its fetch stage is
     where the device_get belongs.
+  * L10 is a host-side SERVING rule: it fires in request-handling
+    functions (name/class heuristic), anywhere in the repo.
 """
 
 from __future__ import annotations
@@ -479,6 +481,67 @@ def rule_l9(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
 
 
 # ---------------------------------------------------------------------------
+# L10 — jit construction inside request-handling paths
+# ---------------------------------------------------------------------------
+#
+# The serving layer's contract is FIXED compile shapes: every device
+# program a process can run is built (and warmed) at engine
+# construction. A ``jax.jit`` reachable from a request handler breaks
+# that silently — each distinct request shape/static pays an 80-170 s
+# XLA compile ON the request path, which is a p99 catastrophe the
+# averages hide. RetraceGuard catches the fact at runtime; this rule
+# catches the code shape statically.
+
+def _is_request_fn(fn: FuncInfo) -> bool:
+    """Request-handling heuristic: http.server ``do_*`` verbs, any
+    ``handle``/``request`` in the function name, or a method of a
+    ``*Handler`` class."""
+    name = fn.node.name.lower()
+    if name.startswith("do_") or "handle" in name or "request" in name:
+        return True
+    return bool(fn.class_name and fn.class_name.lower().endswith("handler"))
+
+
+def rule_l10(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """``jax.jit`` (or ``partial(jax.jit, ...)``) constructed inside a
+    request-handling function: per-request compiles. Build jitted query
+    programs once at engine init (module level or constructor) and
+    dispatch to them from handlers."""
+    for fn in m.functions:
+        inside = fn if _is_request_fn(fn) else fn.parent
+        while inside is not None and not _is_request_fn(inside):
+            inside = inside.parent
+        if inside is None:
+            continue
+        # a function's OWN decorators evaluate once at def time, not
+        # per request: skip them in the Call scan (a jit-DECORATED
+        # handler is fine; a jit-decorated def NESTED in a handler is
+        # reported once, by the FunctionDef branch of the parent scan)
+        own_decorators = {id(d) for d in fn.node.decorator_list}
+        for node in walk_own_body(fn):
+            if id(node) in own_decorators:
+                continue
+            if isinstance(node, ast.Call) and is_jit_decorator(node):
+                yield node.lineno, (
+                    "`jax.jit` constructed inside request-handling "
+                    f"path `{fn.qualname}`: every request (shape) pays "
+                    "a fresh trace/compile on the serving path — build "
+                    "the jitted program once at engine init and "
+                    "dispatch to fixed bucket shapes"
+                )
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and any(is_jit_decorator(d) for d in node.decorator_list):
+                yield node.lineno, (
+                    f"jit-decorated function defined inside request-"
+                    f"handling path `{fn.qualname}`: the decorator "
+                    "builds a fresh jit wrapper (empty compile cache) "
+                    "per request — define it once at module/engine "
+                    "scope"
+                )
+
+
+# ---------------------------------------------------------------------------
 # Registry / driver
 # ---------------------------------------------------------------------------
 
@@ -492,6 +555,7 @@ RULES: Dict[str, Tuple[str, object]] = {
     "L7": ("missing carry donation on year-step entry points", rule_l7),
     "L8": ("debug leftovers in hot paths", rule_l8),
     "L9": ("synchronous host fetches in per-year driver loops", rule_l9),
+    "L10": ("jit construction inside request-handling paths", rule_l10),
 }
 
 

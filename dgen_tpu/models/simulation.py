@@ -259,6 +259,19 @@ def _nem_allowed_arrays(
     return cap_gate & window & (nem_kw_limit > 0)
 
 
+def starting_state_kw(table: AgentTable, inputs: ScenarioInputs) -> jax.Array:
+    """[n_states] installed PV kW BEFORE the first model year — the
+    base-year capacity the year-1 NEM cap gate compares against
+    (reference calc_state_capacity_by_year, agent_mutation/elec.py:788
+    seeds from the starting capacities). Derived purely from the group
+    layout (starting_kw is [G] = state x sector), so it is row-subset
+    invariant: the serving engine evaluates it for gathered agent
+    buckets against the SAME state totals as a full run's first year.
+    """
+    group_state = jnp.arange(table.n_groups, dtype=jnp.int32) // table.n_sectors
+    return jax.ops.segment_sum(inputs.starting_kw, group_state, table.n_states)
+
+
 def compute_nem_allowed(
     table: AgentTable,
     inputs: ScenarioInputs,
@@ -534,10 +547,7 @@ def year_step_impl(
     # (zeroed) carry (reference calc_state_capacity_by_year,
     # agent_mutation/elec.py:788) ---
     if first_year:
-        group_state = jnp.arange(n_groups, dtype=jnp.int32) // table.n_sectors
-        state_kw_last = jax.ops.segment_sum(
-            inputs.starting_kw, group_state, n_states
-        )
+        state_kw_last = starting_state_kw(table, inputs)
     else:
         state_kw_last = jax.ops.segment_sum(
             carry.market.system_kw_cum, table.state_idx, n_states

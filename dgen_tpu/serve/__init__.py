@@ -1,0 +1,28 @@
+"""dgen_tpu.serve: online what-if query engine.
+
+The first request/response layer of the codebase — the bridge from
+"reproduce the paper's batch runs" to the north star's "serve heavy
+traffic": a long-lived process loads a placed agent table + profile
+banks once and answers ad-hoc per-agent queries (optimal PV+storage
+size, bill savings, NPV/payback, scenario-override deltas) through
+fixed-shape, microbatched device programs.
+
+    from dgen_tpu.serve import ServeEngine, Microbatcher
+    engine = ServeEngine(sim)                # reuse a built Simulation
+    bat = Microbatcher(engine)               # pow2 buckets, deadline flush
+    bat.query([17, 203], year=2026,
+              overrides={"scale": {"itc_fraction": 0.0}})
+
+HTTP front-end: ``python -m dgen_tpu.serve`` (see docs/serve.md).
+"""
+
+from dgen_tpu.serve.batcher import Microbatcher, QueueFullError  # noqa: F401
+from dgen_tpu.serve.engine import (  # noqa: F401
+    QUERY_FIELDS,
+    OverrideError,
+    QueryOutputs,
+    ServeEngine,
+    apply_overrides,
+    override_key,
+    query_program,
+)

@@ -1,0 +1,76 @@
+"""CLI: ``python -m dgen_tpu.serve`` — stand up the what-if query
+endpoint over a synthetic population (or a preset's population when a
+reference input mount exists).
+
+    python -m dgen_tpu.serve --agents 8192 --port 8178
+    curl -s localhost:8178/healthz
+    curl -s -XPOST localhost:8178/query -d \\
+        '{"agent_ids": [17], "year": 2026,
+          "overrides": {"scale": {"itc_fraction": 0.0}}}'
+
+Serve knobs come from :class:`dgen_tpu.config.ServeConfig` (env:
+DGEN_TPU_SERVE_*); the population/scenario build mirrors the bench
+driver's synthetic path.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m dgen_tpu.serve",
+        description="online what-if query engine (docs/serve.md)",
+    )
+    ap.add_argument("--agents", type=int, default=8192)
+    ap.add_argument("--start-year", type=int, default=2014)
+    ap.add_argument("--end-year", type=int, default=2050)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--host", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args(argv)
+
+    from dgen_tpu.utils import compilecache
+
+    compilecache.enable()
+
+    from dgen_tpu.config import RunConfig, ScenarioConfig, ServeConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.simulation import Simulation
+    from dgen_tpu.serve.engine import ServeEngine
+    from dgen_tpu.serve.server import ServeApp, serve_forever
+
+    overrides = {}
+    for k, v in (
+        ("host", args.host), ("port", args.port),
+        ("max_batch", args.max_batch), ("max_wait_ms", args.max_wait_ms),
+    ):
+        if v is not None:
+            overrides[k] = v
+    if args.no_warmup:
+        overrides["warmup"] = False
+    serve_cfg = ServeConfig.from_env(**overrides)
+
+    cfg = ScenarioConfig(
+        name="serve", start_year=args.start_year, end_year=args.end_year,
+        anchor_years=(),
+    )
+    pop = synth.generate_population(args.agents, seed=args.seed)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions
+    )
+    sim = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+        RunConfig.from_env(),
+    )
+    app = ServeApp(ServeEngine(sim), serve_cfg)
+    serve_forever(app)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,399 @@
+"""The serving engine: ad-hoc per-agent what-if queries against the
+HBM-resident agent table and profile banks.
+
+Every offline subsystem so far answers "run the whole 2014->2050
+scenario"; the question a live product asks is pointwise: *what is THIS
+customer's optimal PV+storage size, bill savings and payback under THIS
+tariff/incentive tweak?* That query is exactly one row of the paper's
+hot loop (per-agent bill engine + sizing search + 25-year cashflow,
+reference financial_functions.py:291-565) — embarrassingly parallel
+once the banks are resident, the same columnar-residency argument the
+sweep engine already exploits for whole-scenario batching.
+
+Design contract (the serving analogue of the one-program-per-year
+rule):
+
+* the agent table, profile banks and tariff bank are placed ONCE at
+  engine construction (reusing :class:`~dgen_tpu.models.simulation.
+  Simulation`'s placement path) and never re-uploaded per query;
+* query programs are jitted with FIXED shapes — one compiled program
+  per power-of-two bucket size (``ServeConfig.buckets``) — so a
+  steady-state serving session compiles nothing after warmup
+  (RetraceGuard-verifiable);
+* scenario overrides ride the small ``[Y, ...]`` ScenarioInputs leaves
+  as traced ARGUMENTS (exactly like the sweep's scenario axis): a
+  what-if tweak changes data, never the program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from collections import OrderedDict
+from functools import partial
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.models.scenario import ScenarioInputs, apply_year
+from dgen_tpu.models.simulation import (
+    Simulation,
+    build_econ_inputs,
+    compute_nem_allowed,
+    starting_state_kw,
+)
+from dgen_tpu.ops import sizing as sizing_ops
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class QueryOutputs:
+    """Per-agent answers of one query bucket (all leaves [B, ...]) —
+    the pointwise slice of what a full run writes per agent per year
+    (sizing/economics columns of ``YearOutputs``)."""
+
+    agent_id: jax.Array                       # [B] int32
+    nem_allowed: jax.Array                    # [B] 1.0 = NEM available
+    system_kw: jax.Array
+    npv: jax.Array
+    payback_period: jax.Array
+    batt_kw: jax.Array
+    batt_kwh: jax.Array
+    first_year_bill_with_system: jax.Array
+    first_year_bill_without_system: jax.Array
+    bill_savings_y1: jax.Array                # without - with, year 1
+    annual_kwh: jax.Array
+    capacity_factor: jax.Array
+    cash_flow: jax.Array                      # [B, Y+1]
+
+
+#: QueryOutputs field names, in declaration order (the JSON row schema)
+QUERY_FIELDS = tuple(f.name for f in dataclasses.fields(QueryOutputs))
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_periods", "econ_years", "sizing_iters", "sizing_impl",
+        "rate_switch", "net_billing", "daylight",
+    ),
+)
+def query_program(
+    table,
+    profiles,
+    tariffs,
+    inputs: ScenarioInputs,
+    idx: jax.Array,          # [B] int32 row indices into the table
+    year_idx: jax.Array,     # scalar int32 model-year index
+    *,
+    n_periods: int,
+    econ_years: int,
+    sizing_iters: int,
+    sizing_impl: str = "auto",
+    rate_switch: bool = False,
+    net_billing: bool = True,
+    daylight=None,
+) -> QueryOutputs:
+    """One query bucket as a single device program: gather the B
+    requested rows from the resident table, rebuild their one-year
+    economics environment, and size them through the same
+    :func:`~dgen_tpu.ops.sizing.size_agents` engine the year step runs.
+
+    The bucket is evaluated at FIRST-YEAR market state (state capacity
+    = the scenario's starting capacities, :func:`starting_state_kw`):
+    a what-if point query answers "this customer, were they deciding in
+    model year ``year_idx``", not "row N of a particular diffusion
+    trajectory" — so the program is a pure function of (banks, inputs,
+    idx, year), independent of any run's carry, and one answer is
+    bit-identical whether it was computed alone or inside a coalesced
+    bucket (per-row math only; the one cross-agent term, the NEM state
+    cap, depends on inputs alone).
+    """
+    sub = jax.tree.map(lambda a: a[idx], table)
+    ya = apply_year(sub, inputs, year_idx)
+    state_kw = starting_state_kw(table, inputs)
+    nem_allowed = compute_nem_allowed(sub, inputs, year_idx, state_kw)
+    envs = build_econ_inputs(
+        sub, profiles, tariffs, ya, nem_allowed, sub.incentives,
+        rate_switch=rate_switch,
+    )
+    res = sizing_ops.size_agents(
+        envs, n_periods=n_periods, n_years=econ_years,
+        n_iters=sizing_iters, keep_hourly=False, impl=sizing_impl,
+        net_billing=net_billing, daylight=daylight,
+    )
+    return QueryOutputs(
+        agent_id=sub.agent_id,
+        nem_allowed=nem_allowed,
+        system_kw=res.system_kw,
+        npv=res.npv,
+        payback_period=res.payback_period,
+        batt_kw=res.batt_kw,
+        batt_kwh=res.batt_kwh,
+        first_year_bill_with_system=res.first_year_bill_with_system,
+        first_year_bill_without_system=res.first_year_bill_without_system,
+        bill_savings_y1=(
+            res.first_year_bill_without_system
+            - res.first_year_bill_with_system
+        ),
+        annual_kwh=res.annual_energy_production_kwh,
+        capacity_factor=res.capacity_factor,
+        cash_flow=res.cash_flow,
+    )
+
+
+class OverrideError(ValueError):
+    """A scenario override names an unknown field or cannot broadcast
+    to the field's static shape."""
+
+
+def apply_overrides(
+    inputs: ScenarioInputs, overrides: Optional[dict]
+) -> ScenarioInputs:
+    """Build a what-if variant of ``inputs``: ``{"set": {field: v},
+    "scale": {field: f}}`` replaces / scales named trajectory fields.
+
+    Values broadcast to the field's existing shape and KEEP its dtype,
+    so the override variant is pytree-compatible with the base inputs —
+    the compiled query programs see new data, never a new program
+    (exactly the sweep engine's scenario-axis contract). Unchanged
+    leaves are the base's already-placed arrays; only touched fields
+    re-upload. The arithmetic runs in NUMPY on purpose: building a
+    variant must upload small arrays, not compile tiny XLA programs —
+    a steady-state serving process compiles nothing after warmup, new
+    override keys included.
+    """
+    if not overrides:
+        return inputs
+    valid = {f.name for f in dataclasses.fields(ScenarioInputs)}
+    unknown_ops = set(overrides) - {"set", "scale"}
+    if unknown_ops:
+        raise OverrideError(
+            f"unknown override op(s) {sorted(unknown_ops)}; expected "
+            "{'set': {field: value}, 'scale': {field: factor}}"
+        )
+    repl: Dict[str, jax.Array] = {}
+    for op in ("set", "scale"):
+        for field, value in (overrides.get(op) or {}).items():
+            if field not in valid:
+                raise OverrideError(
+                    f"unknown ScenarioInputs field '{field}'; valid "
+                    f"fields: {', '.join(sorted(valid))}"
+                )
+            leaf = repl.get(field, getattr(inputs, field))
+            host = np.asarray(leaf)
+            is_int = np.issubdtype(host.dtype, np.integer)
+            try:
+                if op == "set":
+                    v = np.asarray(value, dtype=host.dtype)
+                    if is_int and not np.array_equal(v, np.asarray(value)):
+                        raise ValueError("lossy integer conversion")
+                    new = np.broadcast_to(v, host.shape)
+                else:
+                    # scale in f64 so an integer field (loan_term_yrs)
+                    # that lands off-grid raises instead of silently
+                    # truncating the client's what-if
+                    exact = host * np.asarray(value, dtype=np.float64)
+                    new = exact.astype(host.dtype)
+                    if is_int and not np.array_equal(new, exact):
+                        raise ValueError("lossy integer conversion")
+                if new.shape != host.shape:
+                    raise ValueError("shape changed")
+            except (TypeError, ValueError) as e:
+                raise OverrideError(
+                    f"override for '{field}' does not fit its static "
+                    f"shape/dtype ({host.shape}, {host.dtype}): {e}"
+                ) from e
+            repl[field] = jnp.asarray(np.ascontiguousarray(new))
+    return dataclasses.replace(inputs, **repl)
+
+
+def override_key(overrides: Optional[dict]) -> str:
+    """Canonical string key of an override dict (the microbatcher's
+    coalescing key: requests batch together only when they share the
+    same what-if scenario)."""
+    if not overrides:
+        return ""
+    return json.dumps(overrides, sort_keys=True, default=float)
+
+
+class ServeEngine:
+    """Long-lived query engine over one placed population.
+
+    Parameters
+    ----------
+    sim : a built :class:`~dgen_tpu.models.simulation.Simulation` — the
+        engine reuses its placed table/banks, its host-decided static
+        flags (rate_switch, daylight) and its year grid. Serving pins
+        ``net_billing=True`` regardless of the run-time static proof:
+        an override can close a NEM gate the base scenario holds open,
+        and True is numerically exact either way (the False flag is
+        only ever a compile-time kernel skip).
+    max_override_cache : LRU size of resolved override->ScenarioInputs
+        variants (each is O(Y x G) host bytes + a few small uploads).
+    """
+
+    def __init__(self, sim: Simulation, max_override_cache: int = 128) -> None:
+        if sim.mesh is not None and jax.process_count() > 1:
+            raise ValueError(
+                "the serving engine is single-controller; run it on one "
+                "process (multi-host meshes serve via a router in front)"
+            )
+        self.sim = sim
+        self.years = list(sim.years)
+        self._year_to_idx = {int(y): i for i, y in enumerate(self.years)}
+        # stable-id -> padded-table row; padding rows (mask 0) reuse
+        # agent_id fill values, so only masked-in rows may claim an id
+        mask = np.asarray(sim.host_mask) > 0
+        ids = np.asarray(sim.host_agent_id)
+        self._id_to_row: Dict[int, int] = {}
+        for row in np.flatnonzero(mask):
+            self._id_to_row.setdefault(int(ids[row]), int(row))
+        self.n_agents = int(mask.sum())
+        self._static_kwargs = dict(
+            n_periods=sim.tariffs.max_periods,
+            econ_years=sim.econ_years,
+            sizing_iters=sim.run_config.sizing_iters,
+            sizing_impl="auto",
+            rate_switch=sim._rate_switch,
+            net_billing=True,
+            daylight=sim._daylight,
+        )
+        self._override_cache: "OrderedDict[str, ScenarioInputs]" = (
+            OrderedDict()
+        )
+        self._override_lock = threading.Lock()
+        self._max_override_cache = int(max_override_cache)
+        # bucket sizes whose program has executed at least once;
+        # mutated by worker threads, snapshotted under the lock (the
+        # /healthz "warm" report; a report, not a guard — RetraceGuard
+        # is the enforcement)
+        self._warm: set = set()
+
+    @property
+    def warm_buckets(self) -> tuple:
+        """Sorted program shapes executed so far — a SNAPSHOT (taken
+        under the lock), safe to iterate from probe threads while
+        worker threads warm new shapes."""
+        with self._override_lock:
+            return tuple(sorted(self._warm))
+
+    # -- request plumbing ----------------------------------------------
+
+    def rows_for(self, agent_ids: Sequence[int]) -> np.ndarray:
+        """[n] int32 table rows for stable agent ids; unknown ids raise
+        KeyError naming the id (a clean 4xx at the HTTP layer)."""
+        rows = np.empty(len(agent_ids), dtype=np.int32)
+        for i, a in enumerate(agent_ids):
+            try:
+                ai = int(a)
+                # reject non-integral ids (int(17.9) == 17 would
+                # silently answer for the WRONG agent)
+                if ai != a:
+                    raise ValueError("non-integer id")
+                rows[i] = self._id_to_row[ai]
+            except (KeyError, TypeError, ValueError):
+                raise KeyError(f"unknown agent_id {a!r}") from None
+        return rows
+
+    def year_index(self, year: Optional[int]) -> int:
+        """Model-year index for a calendar year (default: first model
+        year); off-grid years raise KeyError naming the grid."""
+        if year is None:
+            return 0
+        try:
+            yi = int(year)
+            if yi != year:   # 2016.7 must not answer as 2016
+                raise ValueError("non-integer year")
+            return self._year_to_idx[yi]
+        except (KeyError, TypeError, ValueError):
+            raise KeyError(
+                f"year {year!r} is not on the model grid {self.years}"
+            ) from None
+
+    def inputs_for(self, overrides: Optional[dict]) -> ScenarioInputs:
+        """The (cached) ScenarioInputs variant for an override dict."""
+        key = override_key(overrides)
+        if not key:
+            return self.sim.inputs
+        with self._override_lock:
+            cached = self._override_cache.get(key)
+            if cached is not None:
+                self._override_cache.move_to_end(key)
+                return cached
+        variant = apply_overrides(self.sim.inputs, overrides)
+        with self._override_lock:
+            self._override_cache[key] = variant
+            while len(self._override_cache) > self._max_override_cache:
+                self._override_cache.popitem(last=False)
+        return variant
+
+    # -- execution ------------------------------------------------------
+
+    def query_rows(
+        self,
+        rows: np.ndarray,
+        year_idx: int,
+        inputs: Optional[ScenarioInputs] = None,
+        bucket: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Run one bucket: table rows -> host result arrays [n, ...].
+
+        ``bucket=None`` runs the direct single-shot program at the
+        exact request shape (the parity oracle); ``bucket=B`` pads the
+        rows to B (repeating row 0 — per-row math, so padding rows
+        change nothing) and slices the first n answers back out. The
+        two paths are bit-identical per row.
+        """
+        rows = np.asarray(rows, dtype=np.int32)
+        n = rows.shape[0]
+        if bucket is not None:
+            if bucket < n:
+                raise ValueError(f"bucket {bucket} < {n} requested rows")
+            rows = np.concatenate(
+                [rows, np.zeros(bucket - n, dtype=np.int32)]
+            )
+        out = query_program(
+            self.sim.table, self.sim.profiles, self.sim.tariffs,
+            inputs if inputs is not None else self.sim.inputs,
+            jnp.asarray(rows), jnp.asarray(year_idx, dtype=jnp.int32),
+            **self._static_kwargs,
+        )
+        with self._override_lock:
+            self._warm.add(int(rows.shape[0]))
+        host = jax.device_get(out)
+        return {
+            f: np.asarray(getattr(host, f))[:n] for f in QUERY_FIELDS
+        }
+
+    def query(
+        self,
+        agent_ids: Sequence[int],
+        year: Optional[int] = None,
+        overrides: Optional[dict] = None,
+        bucket: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Convenience single-shot query by stable agent id (the
+        microbatcher is the production path; this is the direct one)."""
+        return self.query_rows(
+            self.rows_for(agent_ids),
+            self.year_index(year),
+            inputs=self.inputs_for(overrides),
+            bucket=bucket,
+        )
+
+    def warmup(self, buckets: Sequence[int], year_idx: int = 0) -> None:
+        """Compile (and execute once) every bucket program so no live
+        request pays a compile. Row content is irrelevant to the
+        compiled shape; row 0 repeated is enough."""
+        for b in buckets:
+            self.query_rows(
+                np.zeros(b, dtype=np.int32), year_idx, bucket=None
+            )
+            logger.info("serve warmup: bucket %d compiled", b)

@@ -1,0 +1,265 @@
+"""Threaded JSON-over-HTTP front-end for the serving engine.
+
+Stdlib-only (http.server) by design: the repo's hard dependency set
+stays jax+numpy, and the endpoint shape — one POST route, two GET
+probes — does not need a framework. One process serves:
+
+  * ``POST /query``   {"agent_ids": [...], "year": 2026,
+                       "overrides": {"scale": {"itc_fraction": 0.5}},
+                       "cash_flow": false}
+                      -> {"year": ..., "results": [{...} per agent]}
+  * ``GET  /healthz`` liveness + the shared provenance stamp
+                      (io.export.provenance_stamp: git sha, config
+                      hash, backend) + warm bucket shapes
+  * ``GET  /metricz`` lifetime serving stats: p50/p99 request latency,
+                      queue depth, batch occupancy (utils.timing
+                      histograms + Microbatcher counters)
+
+Handlers never build programs (dgenlint L10): every device program was
+compiled at engine warmup; a handler only validates, enqueues, and
+formats.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from dgen_tpu.config import ServeConfig
+from dgen_tpu.io.export import provenance_stamp
+from dgen_tpu.serve.batcher import Microbatcher, QueueFullError
+from dgen_tpu.serve.engine import QUERY_FIELDS, OverrideError, ServeEngine
+from dgen_tpu.utils import timing
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: request-body cap: a /query of max_batch agents with overrides is a
+#: few KB; anything near this is malformed or hostile
+_MAX_BODY_BYTES = 1 << 20
+
+#: per-request wait bound on the batcher future — covers a device hang
+#: without wedging every handler thread forever
+_QUERY_TIMEOUT_S = 60.0
+
+
+def _num(v) -> "float | None":
+    """JSON-safe float: non-finite values become null (json.dumps
+    would otherwise emit bare NaN/Infinity tokens, which strict JSON
+    parsers reject)."""
+    f = float(v)
+    return f if math.isfinite(f) else None
+
+
+def _rows_to_json(out: Dict[str, np.ndarray], cash_flow: bool) -> list:
+    """Columnar engine results -> per-agent JSON rows."""
+    n = out["agent_id"].shape[0]
+    rows = []
+    for i in range(n):
+        row = {}
+        for f in QUERY_FIELDS:
+            if f == "cash_flow":
+                if cash_flow:
+                    row[f] = [_num(x) for x in out[f][i]]
+                continue
+            v = out[f][i]
+            row[f] = int(v) if f == "agent_id" else _num(v)
+        rows.append(row)
+    return rows
+
+
+class ServeApp:
+    """The server's state: engine + batcher + provenance, shared by
+    every handler thread."""
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        config: Optional[ServeConfig] = None,
+        provenance: Optional[dict] = None,
+    ) -> None:
+        self.engine = engine
+        self.config = config or ServeConfig()
+        self.batcher = Microbatcher(engine, self.config)
+        self.t_start = time.time()
+        # one stamp at construction: /healthz must stay allocation-free
+        # and subprocess-free per probe
+        self.provenance = provenance if provenance is not None else (
+            provenance_stamp(
+                engine.sim.run_config, engine.sim.scenario, self.config,
+            )
+        )
+        if self.config.warmup:
+            t0 = time.time()
+            engine.warmup(self.config.buckets)
+            logger.info(
+                "serve warmup: %d bucket programs in %.1fs",
+                len(self.config.buckets), time.time() - t0,
+            )
+
+    # -- endpoint bodies (transport-independent, unit-testable) --------
+
+    def healthz(self) -> dict:
+        return {
+            "status": "ok",
+            "uptime_s": round(time.time() - self.t_start, 1),
+            "n_agents": self.engine.n_agents,
+            "years": self.engine.years,
+            "buckets": list(self.config.buckets),
+            "warm_buckets": sorted(self.engine.warm_buckets),
+            **self.provenance,
+        }
+
+    def metricz(self) -> dict:
+        rec = self.batcher.stats()
+        batch = timing.histogram("serve_batch")
+        if batch is not None:
+            snap = batch.snapshot()
+            rec["batch_wall_ms"] = {
+                "p50": round(snap["p50"] * 1e3, 3),
+                "p99": round(snap["p99"] * 1e3, 3),
+                "count": snap["count"],
+            }
+        rec["uptime_s"] = round(time.time() - self.t_start, 1)
+        return rec
+
+    def run_query(self, body: dict) -> dict:
+        agent_ids = body.get("agent_ids")
+        if not isinstance(agent_ids, list) or not agent_ids:
+            raise ValueError("'agent_ids' must be a non-empty list")
+        year = body.get("year")
+        overrides = body.get("overrides")
+        fut = self.batcher.submit(agent_ids, year, overrides)
+        try:
+            out = fut.result(_QUERY_TIMEOUT_S)
+        except FutureTimeout:
+            # the client gets a 504 either way; cancel so a request
+            # still QUEUED is dropped instead of executed after the
+            # stall clears (double work exactly at the overload point)
+            fut.cancel()
+            raise
+        return {
+            "year": self.engine.years[self.engine.year_index(year)],
+            "results": _rows_to_json(out, bool(body.get("cash_flow"))),
+        }
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes to the :class:`ServeApp` attached to the server."""
+
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def app(self) -> ServeApp:
+        return self.server.app  # type: ignore[attr-defined]
+
+    def _send(self, code: int, payload: dict, close: bool = False) -> None:
+        blob = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        if close:
+            # advertises the close AND sets self.close_connection
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet by default
+        logger.debug("serve http: " + fmt, *args)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        if self.path == "/healthz":
+            self._send(200, self.app.healthz())
+        elif self.path == "/metricz":
+            self._send(200, self.app.metricz())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server contract
+        # read (or refuse) the body BEFORE routing: any response sent
+        # with unread body bytes on a keep-alive connection desyncs the
+        # stream (the leftover bytes parse as the next request line) —
+        # refusal paths therefore close the connection explicitly
+        if self.headers.get("Transfer-Encoding"):
+            # chunked bodies are not length-delimited; refuse + close
+            # rather than leave chunk framing in the stream
+            self._send(411, {"error": "Content-Length required"},
+                       close=True)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            self._send(400, {"error": "bad Content-Length"}, close=True)
+            return
+        if length > _MAX_BODY_BYTES:
+            self._send(413, {"error": "request body too large"},
+                       close=True)
+            return
+        raw = self.rfile.read(length)
+        if self.path != "/query":
+            self._send(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            body = json.loads(raw or b"{}")
+            self._send(200, self.app.run_query(body))
+        except QueueFullError as e:
+            # admission control: tell the client to back off
+            self._send(503, {"error": str(e), "retry": True})
+        except (KeyError, ValueError, OverrideError) as e:
+            # KeyError's str() re-quotes its message; unwrap it
+            msg = e.args[0] if isinstance(e, KeyError) and e.args else str(e)
+            self._send(400, {"error": str(msg)})
+        except FutureTimeout:
+            self._send(504, {"error": "query timed out"})
+        except Exception as e:  # noqa: BLE001 — handler must answer
+            logger.exception("serve /query failed")
+            self._send(500, {"error": str(e)})
+
+
+def make_server(app: ServeApp) -> ThreadingHTTPServer:
+    """Bind a threaded HTTP server (port 0 = ephemeral, for tests)."""
+    srv = ThreadingHTTPServer(
+        (app.config.host, app.config.port), _Handler
+    )
+    srv.app = app  # type: ignore[attr-defined]
+    return srv
+
+
+def serve_forever(app: ServeApp) -> None:
+    """Run until SIGINT; closes the batcher on the way out."""
+    srv = make_server(app)
+    host, port = srv.server_address[:2]
+    logger.info(
+        "dgen-tpu serve: %d agents, years %s-%s, buckets %s on "
+        "http://%s:%d (/query /healthz /metricz)",
+        app.engine.n_agents, app.engine.years[0], app.engine.years[-1],
+        list(app.config.buckets), host, port,
+    )
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("serve: shutting down")
+    finally:
+        srv.server_close()
+        app.close()
+
+
+def start_in_thread(app: ServeApp) -> ThreadingHTTPServer:
+    """Test/embedding helper: serve on a daemon thread; returns the
+    bound server (``server_address`` carries the ephemeral port)."""
+    srv = make_server(app)
+    t = threading.Thread(
+        target=srv.serve_forever, name="dgen-serve-http", daemon=True
+    )
+    t.start()
+    return srv

@@ -1,9 +1,13 @@
 """Tracing/profiling decorators (analogue of reference decorators.py:28
-``fn_timer`` and utility_functions.py:112 ``Timer``)."""
+``fn_timer`` and utility_functions.py:112 ``Timer``) plus a fixed-bucket
+log-spaced latency histogram for long-lived processes (the serving
+engine's request-latency percentiles, ``dgen_tpu.serve``)."""
 
 from __future__ import annotations
 
 import functools
+import math
+import threading
 import time
 from contextlib import contextmanager
 from typing import Callable, Dict, List
@@ -52,24 +56,148 @@ def timer(name: str, ctx: str | None = None):
     get_logger().debug("%s took: %.3fs", key, dt)
 
 
+# ---------------------------------------------------------------------------
+# Fixed-bucket log-spaced histogram
+# ---------------------------------------------------------------------------
+#
+# The per-call duration lists above are right for run drivers (tens of
+# calls per phase) but wrong for a serving process answering millions of
+# requests: an append-per-request list grows without bound. The
+# histogram below is O(1) memory and O(1) record — 48 log-spaced
+# buckets from 100 µs, each sqrt(2) wider than the last (~1.6e3 s at
+# the top), which resolves percentiles to within ~±19% anywhere on the
+# range. `/metricz` and the bench serve section read percentiles from
+# here via :func:`timing_report`.
+
+_HIST_MIN = 1e-4          # seconds: first bucket upper bound
+_HIST_GROWTH = 2.0 ** 0.5
+_HIST_N = 48
+
+#: bucket upper bounds (seconds), shared by every histogram
+HIST_BOUNDS: tuple = tuple(
+    _HIST_MIN * _HIST_GROWTH ** i for i in range(_HIST_N)
+)
+
+
+class LogHistogram:
+    """Fixed log-spaced-bucket histogram of nonnegative durations.
+
+    ``counts[i]`` holds observations <= ``HIST_BOUNDS[i]`` (and greater
+    than the previous bound); the final slot is the overflow bucket.
+    Thread-safe: the serving batcher records from worker threads while
+    `/metricz` reads from handler threads.
+    """
+
+    __slots__ = ("counts", "n", "total", "vmax", "_lock")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (_HIST_N + 1)
+        self.n = 0
+        self.total = 0.0
+        self.vmax = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        v = max(float(seconds), 0.0)
+        # log-index without a per-record scan: bound_i = MIN*GROWTH^i
+        if v <= _HIST_MIN:
+            i = 0
+        else:
+            i = min(
+                int(math.ceil(math.log(v / _HIST_MIN) / math.log(_HIST_GROWTH))),
+                _HIST_N,
+            )
+        with self._lock:
+            self.counts[i] += 1
+            self.n += 1
+            self.total += v
+            if v > self.vmax:
+                self.vmax = v
+
+    def percentile(self, q: float) -> float:
+        """Value at quantile ``q`` in [0, 1]: the upper bound of the
+        bucket containing the q-th observation (capped at the observed
+        max, so a single-bucket histogram reports its true extreme)."""
+        with self._lock:
+            if not self.n:
+                return 0.0
+            target = q * self.n
+            cum = 0
+            for i, c in enumerate(self.counts):
+                cum += c
+                if cum >= target:
+                    bound = HIST_BOUNDS[i] if i < _HIST_N else self.vmax
+                    return min(bound, self.vmax)
+            return self.vmax
+
+    def snapshot(self) -> Dict[str, float]:
+        """{count, total, mean, p50, p90, p99, max} summary."""
+        with self._lock:
+            n, total, vmax = self.n, self.total, self.vmax
+        if not n:
+            return {"count": 0, "total": 0.0, "mean": 0.0,
+                    "p50": 0.0, "p90": 0.0, "p99": 0.0, "max": 0.0}
+        return {
+            "count": n, "total": total, "mean": total / n,
+            "p50": self.percentile(0.50),
+            "p90": self.percentile(0.90),
+            "p99": self.percentile(0.99),
+            "max": vmax,
+        }
+
+
+#: (key -> LogHistogram); same ``ctx:name`` keying as _TIMINGS
+_HISTS: Dict[str, LogHistogram] = {}
+_HISTS_LOCK = threading.Lock()
+
+
+def observe(name: str, seconds: float, ctx: str | None = None) -> None:
+    """Record one duration into the named histogram (O(1) memory —
+    safe for per-request latencies in a long-lived server, unlike
+    :func:`timer`'s per-call list)."""
+    key = _key(name, ctx)
+    h = _HISTS.get(key)
+    if h is None:
+        with _HISTS_LOCK:
+            h = _HISTS.setdefault(key, LogHistogram())
+    h.record(seconds)
+
+
+def histogram(name: str, ctx: str | None = None) -> LogHistogram | None:
+    """The named histogram, or None if nothing was observed yet."""
+    return _HISTS.get(_key(name, ctx))
+
+
 def timing_report(ctx: str | None = None) -> Dict[str, Dict[str, float]]:
-    """Per-name {count, total, mean} summary. ``ctx`` filters to one
+    """Per-name {count, total, mean} summary; histogram'd names
+    (:func:`observe`) instead carry the histogram's
+    count/total/mean/p50/p90/p99/max. A name recorded through BOTH
+    :func:`timer` and :func:`observe` reports the histogram (observe
+    shadows timer for that key) — instrument one phase under two
+    distinct names if both views are needed. ``ctx`` filters to one
     context's timers (keys come back with the ``ctx:`` prefix
     stripped, i.e. as the bare phase names recorded under it)."""
-    if ctx is None:
-        items = _TIMINGS.items()
-    else:
+    def _select(items):
+        if ctx is None:
+            return list(items)
         prefix = f"{ctx}:"
-        items = (
-            (k[len(prefix):], v) for k, v in _TIMINGS.items()
-            if k.startswith(prefix)
-        )
-    return {
+        return [
+            (k[len(prefix):], v) for k, v in items if k.startswith(prefix)
+        ]
+
+    out = {
         k: {"count": len(v), "total": sum(v), "mean": sum(v) / len(v)}
-        for k, v in items
+        for k, v in _select(_TIMINGS.items())
         if v
     }
+    for k, h in _select(list(_HISTS.items())):
+        snap = h.snapshot()
+        if snap["count"]:
+            out[k] = snap
+    return out
 
 
 def reset_timings() -> None:
     _TIMINGS.clear()
+    with _HISTS_LOCK:
+        _HISTS.clear()

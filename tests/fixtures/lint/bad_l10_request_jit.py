@@ -1,0 +1,30 @@
+"""dgenlint L10 fixture: jit construction on the request path."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+class QueryHandler:
+    def do_POST(self):                       # request path (do_* verb)
+        # L10: a fresh jit wrapper (and compile) per request
+        prog = jax.jit(lambda x: jnp.sum(x))
+        return prog(jnp.ones(8))
+
+    def handle_query(self, x):               # request path (handle*)
+        # L10: partial(jax.jit, ...) is the same per-request compile
+        prog = partial(jax.jit, static_argnames=("n",))(_impl)
+        return prog(x, n=4)
+
+    def on_request(self, x):                 # request path (*request*)
+        # L10: jit-decorated nested def — new wrapper per call
+        @jax.jit
+        def inner(y):
+            return y * 2.0
+
+        return inner(x)
+
+
+def _impl(x, n):
+    return x * n

@@ -1,0 +1,512 @@
+"""Serving engine tests (dgen_tpu.serve): bucket-coalescing parity,
+steady-state compile stability (RetraceGuard), backpressure, scenario
+overrides, the timing histogram, the L10 lint rule, and the HTTP
+front-end.
+
+The parity contract under test is the microbatcher's: an agent's
+answer is BIT-IDENTICAL whether its request ran alone or coalesced
+with strangers into the same padded bucket (per-row math; padding rows
+are inert). Across DIFFERENT bucket shapes XLA may re-associate f32
+reductions, so cross-shape answers agree to ~1e-6 relative — asserted
+separately, with the tolerance documented in docs/serve.md.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig, ServeConfig
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+from dgen_tpu.serve import (
+    Microbatcher,
+    OverrideError,
+    QueueFullError,
+    ServeEngine,
+    apply_overrides,
+    override_key,
+)
+
+CFG = ScenarioConfig(
+    name="serve-test", start_year=2014, end_year=2020, anchor_years=()
+)
+SERVE_CFG = ServeConfig(
+    max_batch=8, min_bucket=1, max_wait_ms=50.0, max_queue=32, port=0
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    pop = synth.generate_population(192, seed=3)
+    inputs = scen.uniform_inputs(
+        CFG, n_groups=pop.table.n_groups, n_regions=pop.n_regions
+    )
+    sim = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, CFG, RunConfig(),
+        econ_years=6,
+    )
+    eng = ServeEngine(sim)
+    eng.warmup(SERVE_CFG.buckets)
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# Parity: coalesced bucket vs the direct single-shot program
+# ---------------------------------------------------------------------------
+
+def test_coalesced_bucket_is_bit_exact_vs_single_shot(engine):
+    """Three concurrent single-agent requests coalesce into one padded
+    bucket; each answer must be bit-exact with the same agent run
+    alone through the direct program at that bucket shape."""
+    ids = [5, 17, 100]
+    bat = Microbatcher(
+        engine, ServeConfig(max_batch=8, min_bucket=1, max_wait_ms=200.0,
+                            max_queue=32, port=0),
+    )
+    try:
+        futs = [bat.submit([i], year=2016) for i in ids]
+        got = [f.result(60.0) for f in futs]
+    finally:
+        bat.close()
+    stats = bat.stats()
+    # the deadline flush coalesced all three into ONE padded bucket
+    assert stats["batches"] == 1
+    assert stats["rows"] == 3
+    assert stats["batch_occupancy"] == pytest.approx(3 / 4)
+    for j, i in enumerate(ids):
+        direct = engine.query([i], year=2016, bucket=4)
+        for f in ("system_kw", "npv", "payback_period", "cash_flow",
+                  "first_year_bill_with_system", "bill_savings_y1",
+                  "batt_kw", "batt_kwh"):
+            np.testing.assert_array_equal(
+                got[j][f][0], direct[f][0],
+                err_msg=f"bucket-path {f} differs for agent {i}",
+            )
+        assert int(got[j]["agent_id"][0]) == i
+
+
+def test_cross_shape_drift_is_f32_reassociation_only(engine):
+    """Across DIFFERENT compiled bucket shapes XLA may re-associate
+    f32 reductions; answers agree to ~1e-6 rel (docs/serve.md)."""
+    ids = [5, 17, 100]
+    exact = engine.query(ids, year=2016)            # direct shape [3]
+    padded = engine.query(ids, year=2016, bucket=8)
+    for f in ("system_kw", "npv", "payback_period", "bill_savings_y1"):
+        np.testing.assert_allclose(
+            exact[f], padded[f], rtol=1e-5, atol=1e-4,
+        )
+
+
+def test_padding_rows_are_inert(engine):
+    """The same request padded into different-occupancy buckets of the
+    SAME shape is bit-identical (what coalescing relies on)."""
+    a = engine.query([7], year=2014, bucket=8)
+    b = engine.query([7, 33, 64, 101], year=2014, bucket=8)
+    for f in ("system_kw", "npv", "cash_flow"):
+        np.testing.assert_array_equal(a[f][0], b[f][0])
+
+
+# ---------------------------------------------------------------------------
+# Steady-state compile stability
+# ---------------------------------------------------------------------------
+
+def test_steady_state_compiles_nothing_after_warmup(engine):
+    """One compile per bucket size, all paid at warmup: steady-state
+    traffic across agents, years, bucket sizes AND override variants
+    must compile and trace nothing (RetraceGuard budget 0)."""
+    from dgen_tpu.lint.guard import RetraceGuard
+
+    bat = Microbatcher(engine, SERVE_CFG)
+    try:
+        with RetraceGuard(context="serve steady state"):
+            for b in SERVE_CFG.buckets:
+                engine.query_rows(
+                    np.arange(b, dtype=np.int32), year_idx=1, bucket=None
+                )
+            bat.query([3], year=2018, timeout=60.0)
+            bat.query([9, 12], year=2014,
+                      overrides={"scale": {"itc_fraction": 0.0}},
+                      timeout=60.0)
+            bat.query([9, 12], year=2014,
+                      overrides={"set": {"itc_fraction": 0.26}},
+                      timeout=60.0)
+    finally:
+        bat.close()
+
+
+# ---------------------------------------------------------------------------
+# Microbatcher: backpressure, validation, lifecycle
+# ---------------------------------------------------------------------------
+
+def test_backpressure_rejects_over_limit_queue(engine):
+    bat = Microbatcher(
+        engine,
+        ServeConfig(max_batch=8, max_wait_ms=1000.0, max_queue=2, port=0),
+        start=False,   # worker never drains: deterministic queue state
+    )
+    f1 = bat.submit([1], year=2014)
+    f2 = bat.submit([2], year=2014)
+    with pytest.raises(QueueFullError, match="back off"):
+        bat.submit([3], year=2014)
+    assert bat.stats()["rejected"] == 1
+    assert bat.stats()["queue_depth"] == 2
+    bat.close()
+    # close() fails queued futures instead of leaving callers hung
+    for f in (f1, f2):
+        with pytest.raises(RuntimeError, match="closed"):
+            f.result(1.0)
+
+
+def test_submit_validates_on_caller_thread(engine):
+    bat = Microbatcher(engine, SERVE_CFG, start=False)
+    try:
+        with pytest.raises(KeyError, match="unknown agent_id"):
+            bat.submit([10**9], year=2014)
+        with pytest.raises(KeyError, match="not on the model grid"):
+            bat.submit([1], year=1999)
+        with pytest.raises(KeyError, match="not on the model grid"):
+            bat.submit([1], year=2016.7)   # no silent truncation
+        with pytest.raises(ValueError, match="max_batch"):
+            bat.submit(list(range(9)), year=2014)
+        with pytest.raises(OverrideError, match="unknown ScenarioInputs"):
+            bat.submit([1], overrides={"set": {"no_such_field": 1.0}})
+        with pytest.raises(ValueError, match="empty"):
+            bat.submit([], year=2014)
+        assert bat.stats()["queue_depth"] == 0
+    finally:
+        bat.close()
+
+
+# ---------------------------------------------------------------------------
+# Scenario overrides
+# ---------------------------------------------------------------------------
+
+def test_overrides_change_answers_not_programs(engine):
+    ids = [5, 17, 100]
+    base = engine.query(ids, year=2016, bucket=8)
+    noitc = engine.query(
+        ids, year=2016, overrides={"scale": {"itc_fraction": 0.0}},
+        bucket=8,
+    )
+    # zeroing the ITC can only hurt NPV (and strictly hurts any agent
+    # with nonzero capex)
+    assert np.all(noitc["npv"] <= base["npv"] + 1e-6)
+    assert np.any(noitc["npv"] < base["npv"] - 1.0)
+
+    # variants are pytree-compatible with the base inputs
+    v = apply_overrides(
+        engine.sim.inputs, {"set": {"itc_fraction": 0.26}}
+    )
+    leaf = v.itc_fraction
+    assert leaf.shape == engine.sim.inputs.itc_fraction.shape
+    assert leaf.dtype == engine.sim.inputs.itc_fraction.dtype
+    np.testing.assert_allclose(np.asarray(leaf), 0.26)
+
+    with pytest.raises(OverrideError, match="unknown override op"):
+        apply_overrides(engine.sim.inputs, {"replace": {"x": 1}})
+    with pytest.raises(OverrideError, match="does not fit"):
+        # itc_fraction is [Y, 3]; a length-2 vector cannot broadcast
+        apply_overrides(
+            engine.sim.inputs, {"set": {"itc_fraction": [1.0, 2.0]}}
+        )
+    # integer trajectory fields reject lossy what-ifs instead of
+    # silently truncating (loan_term_yrs is int32)
+    with pytest.raises(OverrideError, match="lossy integer"):
+        apply_overrides(
+            engine.sim.inputs, {"set": {"loan_term_yrs": 12.7}}
+        )
+    with pytest.raises(OverrideError, match="lossy integer"):
+        # loan_term_yrs is all 20s; 20 * 0.77 = 15.4 lands off-grid
+        apply_overrides(
+            engine.sim.inputs, {"scale": {"loan_term_yrs": 0.77}}
+        )
+    # an exactly-representable integer scale is accepted (20 -> 10)
+    half = apply_overrides(
+        engine.sim.inputs, {"scale": {"loan_term_yrs": 0.5}}
+    )
+    np.testing.assert_array_equal(np.asarray(half.loan_term_yrs), 10)
+    v15 = apply_overrides(
+        engine.sim.inputs, {"set": {"loan_term_yrs": 15}}
+    )
+    assert v15.loan_term_yrs.dtype == engine.sim.inputs.loan_term_yrs.dtype
+    np.testing.assert_array_equal(np.asarray(v15.loan_term_yrs), 15)
+
+    # canonical key: dict order does not split coalescing groups
+    k1 = override_key({"scale": {"a": 1.0, "b": 2.0}})
+    k2 = override_key({"scale": {"b": 2.0, "a": 1.0}})
+    assert k1 == k2
+    assert override_key(None) == override_key({}) == ""
+
+    # the resolved variant is cached (same placed arrays per key)
+    i1 = engine.inputs_for({"scale": {"itc_fraction": 0.5}})
+    i2 = engine.inputs_for({"scale": {"itc_fraction": 0.5}})
+    assert i1 is i2
+
+
+# ---------------------------------------------------------------------------
+# Timing histogram (utils.timing)
+# ---------------------------------------------------------------------------
+
+def test_log_histogram_percentiles_and_report():
+    from dgen_tpu.utils import timing
+
+    timing.reset_timings()
+    try:
+        h = timing.LogHistogram()
+        for v in [0.001] * 90 + [0.1] * 9 + [2.0]:
+            h.record(v)
+        snap = h.snapshot()
+        assert snap["count"] == 100
+        # bucket resolution is the growth factor (sqrt2 ~ ±19%)
+        assert snap["p50"] == pytest.approx(0.001, rel=0.5)
+        assert snap["p99"] == pytest.approx(0.1, rel=0.5)
+        assert snap["max"] == pytest.approx(2.0)
+        assert snap["p50"] <= snap["p90"] <= snap["p99"] <= snap["max"]
+        # empty histogram is all zeros, no division error
+        assert timing.LogHistogram().snapshot()["p99"] == 0.0
+
+        # observe() + timing_report percentiles, with ctx filtering
+        for ms in (1, 1, 1, 50):
+            timing.observe("req", ms / 1e3, ctx="serveA")
+        rep = timing.timing_report(ctx="serveA")
+        assert rep["req"]["count"] == 4
+        assert "p99" in rep["req"] and "p50" in rep["req"]
+        assert rep["req"]["p50"] <= rep["req"]["p99"]
+        assert timing.timing_report(ctx="other") == {}
+        # global report sees the prefixed key
+        assert "serveA:req" in timing.timing_report()
+    finally:
+        timing.reset_timings()
+
+
+# ---------------------------------------------------------------------------
+# dgenlint L10
+# ---------------------------------------------------------------------------
+
+def test_l10_flags_request_path_jit_and_supports_suppression():
+    from dgen_tpu.lint import lint_paths, lint_source
+
+    fixture = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "lint", "bad_l10_request_jit.py",
+    )
+    hits = [f for f in lint_paths([fixture]) if f.rule == "L10"]
+    assert len(hits) == 3   # do_POST, handle_query, on_request
+
+    src = (
+        "import jax\n"
+        "def handle_query(x):\n"
+        "    return jax.jit(lambda y: y)(x)"
+        "  # dgenlint: disable=L10\n"
+    )
+    assert [f for f in lint_source(src) if f.rule == "L10"] == []
+
+    # non-request functions building jits at init are fine
+    src_ok = (
+        "import jax\n"
+        "def build_programs():\n"
+        "    return jax.jit(lambda y: y)\n"
+    )
+    assert [f for f in lint_source(src_ok) if f.rule == "L10"] == []
+
+    # a call-form-decorated def NESTED in a handler is one defect,
+    # reported exactly once (not once per AST branch)
+    src_nested = (
+        "import jax\n"
+        "from functools import partial\n"
+        "def handle_query(x):\n"
+        "    @partial(jax.jit, static_argnames=('n',))\n"
+        "    def inner(y, n):\n"
+        "        return y * n\n"
+        "    return inner(x, n=2)\n"
+    )
+    assert len(
+        [f for f in lint_source(src_nested) if f.rule == "L10"]
+    ) == 1
+
+    # a handler DECORATED with jit evaluates the decorator once at def
+    # time, not per request — not a finding
+    src_decorated = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('n',))\n"
+        "def handle_query(x, n):\n"
+        "    return x * n\n"
+    )
+    assert [f for f in lint_source(src_decorated) if f.rule == "L10"] == []
+
+
+def test_serve_layer_is_l10_clean():
+    """The enforcement contract tools/check.sh gates on."""
+    from dgen_tpu.lint import lint_paths
+
+    root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "dgen_tpu", "serve",
+    )
+    assert lint_paths([root], select=["L10"]) == []
+
+
+# ---------------------------------------------------------------------------
+# Provenance stamps (io.export, reused by /healthz)
+# ---------------------------------------------------------------------------
+
+def test_provenance_stamp_and_config_hash():
+    from dgen_tpu.io.export import config_hash, git_sha, provenance_stamp
+
+    h1 = config_hash(RunConfig(), CFG)
+    assert isinstance(h1, str) and len(h1) == 12
+    # deterministic, config-sensitive
+    assert h1 == config_hash(RunConfig(), CFG)
+    assert h1 != config_hash(RunConfig(sizing_iters=8), CFG)
+    assert config_hash() is None
+
+    sha = git_sha()
+    assert sha is None or (isinstance(sha, str) and len(sha) == 12)
+
+    stamp = provenance_stamp(RunConfig())
+    assert set(stamp) == {"git_sha", "config_hash", "jax_backend",
+                          "n_devices"}
+
+
+def test_run_exporter_meta_carries_provenance(tmp_path):
+    from dgen_tpu.io.export import RunExporter
+
+    exp = RunExporter(
+        str(tmp_path / "run"),
+        agent_id=np.arange(4), mask=np.ones(4, np.float32),
+    )
+    meta = json.load(open(tmp_path / "run" / "meta.json"))
+    for k in ("git_sha", "jax_backend", "n_devices"):
+        assert k in meta
+    assert exp.meta["n_agents"] == 4
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def http_app(engine):
+    from dgen_tpu.serve.server import ServeApp, start_in_thread
+
+    app = ServeApp(engine, SERVE_CFG)   # warmup is a cache hit
+    srv = start_in_thread(app)
+    port = srv.server_address[1]
+    yield app, f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    srv.server_close()
+    app.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_healthz_serves_provenance(http_app):
+    _app, base = http_app
+    code, h = _get(f"{base}/healthz")
+    assert code == 200 and h["status"] == "ok"
+    for k in ("git_sha", "config_hash", "jax_backend", "n_agents",
+              "warm_buckets", "uptime_s"):
+        assert k in h
+    # every configured bucket program is warm before traffic
+    assert set(h["buckets"]) <= set(h["warm_buckets"])
+
+
+def test_query_endpoint_matches_engine(engine, http_app):
+    _app, base = http_app
+    body = {"agent_ids": [5, 17], "year": 2016,
+            "overrides": {"scale": {"itc_fraction": 0.5}},
+            "cash_flow": True}
+    code, r = _post(f"{base}/query", body)
+    assert code == 200 and r["year"] == 2016
+    direct = engine.query(
+        [5, 17], year=2016, overrides=body["overrides"], bucket=2,
+    )
+    for j, row in enumerate(r["results"]):
+        assert row["agent_id"] == body["agent_ids"][j]
+        # JSON round-trips f32 through double exactly
+        assert row["npv"] == pytest.approx(float(direct["npv"][j]))
+        assert row["system_kw"] == pytest.approx(
+            float(direct["system_kw"][j]))
+        assert len(row["cash_flow"]) == direct["cash_flow"].shape[1]
+    # cash_flow is omitted unless asked for
+    _code, r2 = _post(
+        f"{base}/query", {"agent_ids": [5], "year": 2016})
+    assert "cash_flow" not in r2["results"][0]
+
+
+def test_http_error_paths(http_app):
+    _app, base = http_app
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/query", {"agent_ids": [10**9]})
+    assert e.value.code == 400
+    assert "unknown agent_id" in json.loads(e.value.read())["error"]
+    # non-integral ids are rejected, never truncated onto a neighbor
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/query", {"agent_ids": [17.9]})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/query", {"agent_ids": []})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/nope")
+    assert e.value.code == 404
+
+
+def test_http_keepalive_survives_refusals(http_app):
+    """Refusal paths must not desync a keep-alive connection: a POST
+    to a bad route (body read then 404) and an oversize POST (413 +
+    Connection: close) both leave the next request answerable."""
+    import http.client
+
+    _app, base = http_app
+    host, port = base.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    # 404 WITH a body: body is drained, connection stays usable
+    conn.request("POST", "/queryy", body=b'{"agent_ids": [5]}')
+    r = conn.getresponse()
+    assert r.status == 404 and not r.will_close
+    r.read()
+    conn.request("POST", "/query", body=json.dumps(
+        {"agent_ids": [5], "year": 2016}).encode())
+    r = conn.getresponse()
+    assert r.status == 200
+    assert json.loads(r.read())["results"][0]["agent_id"] == 5
+    conn.close()
+    # oversize body: refused unread, connection explicitly closed
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    conn.request("POST", "/query", body=b"",
+                 headers={"Content-Length": str(2 << 20)})
+    r = conn.getresponse()
+    assert r.status == 413 and r.will_close
+    conn.close()
+
+
+def test_metricz_reports_latency_and_occupancy(http_app):
+    _app, base = http_app
+    # ensure at least one served request
+    _post(f"{base}/query", {"agent_ids": [3, 4, 5]})
+    code, m = _get(f"{base}/metricz")
+    assert code == 200
+    assert m["requests"] >= 1 and m["batches"] >= 1
+    assert 0.0 < m["batch_occupancy"] <= 1.0
+    assert m["latency_ms"]["p50"] <= m["latency_ms"]["p99"]
+    assert m["queue_depth"] == 0
+    assert m["buckets"] == list(SERVE_CFG.buckets)

@@ -28,6 +28,12 @@ if [ "$mode" != "--test-only" ]; then
     echo "== dgenlint L9 (per-year host-fetch guard) =="
     python -m dgen_tpu.lint --select L9 \
         dgen_tpu/models/simulation.py dgen_tpu/sweep dgen_tpu/io || rc=1
+    # L10 guards the serving path (docs/serve.md): a jax.jit constructed
+    # inside a request handler is a per-request compile — gate the serve
+    # layer by name so the rule keeps firing even if the default root
+    # narrows
+    echo "== dgenlint L10 (request-path compile guard) =="
+    python -m dgen_tpu.lint --select L10 dgen_tpu/serve || rc=1
 fi
 
 if [ "$mode" != "--lint-only" ]; then

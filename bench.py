@@ -56,6 +56,11 @@ Knobs (env):
                           sweep (dgen_tpu.sweep) vs one single run and
                           stamp S, per-scenario wall, bank-bytes-shared
                           and the amortization ratio into the payload
+  DGEN_TPU_BENCH_FAULTS   1: run the resilience fault drill
+                          (dgen_tpu.resilience.drill) — every run-path
+                          fault site injected mid-run and recovered by
+                          the supervisor; stamps per-site retry counts
+                          and recovery wall time into the payload
   DGEN_TPU_BENCH_SERVE    <QPS>: closed-loop load test of the online
                           what-if query engine (dgen_tpu.serve) at the
                           target aggregate QPS — stamps achieved
@@ -104,6 +109,8 @@ _BENCH_BF16 = os.environ.get(
     "DGEN_TPU_BENCH_BF16", "") not in ("", "0", "false")
 _BENCH_ASYNC = os.environ.get(
     "DGEN_TPU_BENCH_ASYNC", "") not in ("", "0", "false")
+_BENCH_FAULTS = os.environ.get(
+    "DGEN_TPU_BENCH_FAULTS", "") not in ("", "0", "false")
 # "0"/"false" disable, same convention as the sibling flags above
 _BENCH_SERVE = os.environ.get("DGEN_TPU_BENCH_SERVE", "").strip()
 if _BENCH_SERVE in ("0", "false"):
@@ -910,6 +917,44 @@ def main() -> None:
                 payload["async_io"] = _async_io_ab(n_agents)
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["async_io"] = {
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- fault drill (DGEN_TPU_BENCH_FAULTS=1): the resilience
+    # supervisor's recovery matrix on a small population — stamps
+    # per-site retry counts + recovery wall so the trajectory records
+    # what a mid-run failure actually costs (docs/resilience.md) ---
+    if _BENCH_FAULTS:
+        if not spendable(point_est * 4):
+            skipped["fault_drill"] = "budget"
+        else:
+            try:
+                import tempfile
+
+                from dgen_tpu.resilience.drill import run_drill
+
+                rec = run_drill(
+                    tempfile.mkdtemp(prefix="dgen-bench-faults-"),
+                    n_agents=min(n_agents, 2048), end_year=2020,
+                )
+                payload["fault_drill"] = {
+                    "ok": rec["ok"],
+                    "retries_total": rec["retries_total"],
+                    "recovery_wall_s_total": rec["recovery_wall_s_total"],
+                    "clean_wall_s": rec["clean_wall_s"],
+                    "sites": {
+                        k: {
+                            "retries": s["retries"],
+                            "recovery_wall_s": s["recovery_wall_s"],
+                            "degradations": s["degradations"],
+                            "ok": s["ok"],
+                        }
+                        for k, s in rec["sites"].items()
+                    },
+                }
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["fault_drill"] = {
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

@@ -135,6 +135,13 @@ class RunConfig:
     #: jaxpr trace in a later year fails the run — retrace storms
     #: surface as errors at year 3, not as a 10x wall-time report
     guard_retrace: bool = False
+    #: deterministic fault-injection spec (resilience.faults grammar,
+    #: e.g. ``"ckpt_save@2;year_step@3:oom"``) — installed by the run
+    #: supervisor / fault drills before the first attempt. None (the
+    #: production value) injects nothing; plain Simulation.run ignores
+    #: the field unless something installs the registry. Env:
+    #: DGEN_TPU_FAULTS.
+    faults: Optional[str] = None
     seed: int = 0
 
     def __post_init__(self) -> None:
@@ -174,6 +181,8 @@ class RunConfig:
             overrides["daylight_compact"] = True
         if "bf16_banks" not in overrides and flag("DGEN_TPU_BF16_BANKS"):
             overrides["bf16_banks"] = True
+        if "faults" not in overrides and os.environ.get("DGEN_TPU_FAULTS"):
+            overrides["faults"] = os.environ["DGEN_TPU_FAULTS"].strip()
         # async_host_io deliberately NOT baked from the env here: the
         # field stays None so async_io_enabled re-reads the
         # DGEN_TPU_ASYNC_IO kill switch at run time — baking it would

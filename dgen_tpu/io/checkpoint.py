@@ -28,6 +28,10 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from dgen_tpu.models.simulation import SimCarry
+from dgen_tpu.resilience.faults import fault_point
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
 
 
 def scenario_dir(directory: str, scenario: Optional[str]) -> str:
@@ -63,6 +67,10 @@ class Writer:
         self._mgr = _mgr(scenario_dir(directory, scenario))
 
     def save(self, year: int, carry: SimCarry) -> None:
+        # resilience drill hook: a ``kill`` here models a process dying
+        # mid-checkpoint — orbax's commit protocol must leave the
+        # previous steps restorable and the torn one invisible
+        fault_point("ckpt_save")
         if year in self._mgr.all_steps():
             # drop the stale step: this orbax version refuses to save
             # over an existing step (StepAlreadyExistsError) rather than
@@ -100,6 +108,55 @@ def latest_year(directory: str, scenario: Optional[str] = None
     with _mgr(directory) as mgr:
         step = mgr.latest_step()
     return int(step) if step is not None else None
+
+
+def valid_years(directory: str, scenario: Optional[str] = None
+                ) -> list[int]:
+    """Ascending committed checkpoint years of a run directory (orbax
+    lists only steps whose commit completed — a killed mid-write save
+    never appears here)."""
+    directory = scenario_dir(directory, scenario)
+    if not os.path.isdir(directory):
+        return []
+    with _mgr(directory) as mgr:
+        steps = list(mgr.all_steps())
+    return sorted(int(s) for s in steps)
+
+
+def latest_valid_year(
+    directory: str,
+    n_agents: int,
+    max_year: Optional[int] = None,
+    sharding=None,
+    scenario: Optional[str] = None,
+    n_scenarios: Optional[int] = None,
+) -> Optional[int]:
+    """The newest checkpointed year that actually RESTORES (walking
+    back past corrupt/torn steps), optionally capped at ``max_year`` —
+    the supervisor passes the manifest's export frontier there so a
+    resume never skips over years whose artifacts are missing.
+    ``None`` when nothing restorable exists.
+
+    Each candidate is validated by a full restore (a try-restore is
+    the only check orbax guarantees), and the caller's own resume then
+    restores the chosen year again — two restores of a small carry on
+    the rare recovery path, traded for zero trust in metadata."""
+    for y in reversed(valid_years(directory, scenario=scenario)):
+        if max_year is not None and y > max_year:
+            continue
+        try:
+            restore_year(
+                directory, n_agents, y, sharding=sharding,
+                scenario=scenario, n_scenarios=n_scenarios,
+            )
+        except Exception as e:  # noqa: BLE001 — any failure = not valid
+            logger.warning(
+                "checkpoint year %d under %s does not restore (%r); "
+                "walking back", y, directory, e,
+            )
+            continue
+        return y
+    return None
 
 
 def restore_year(

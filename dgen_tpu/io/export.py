@@ -38,6 +38,8 @@ import jax
 import numpy as np
 import pandas as pd
 
+from dgen_tpu.resilience.atomic import atomic_to_parquet, atomic_write_json
+
 #: parquet codec: zstd beats the pyarrow default (snappy) ~2x on these
 #: numeric tables at equal write speed
 _PARQUET_COMPRESSION = "zstd"
@@ -229,8 +231,16 @@ class RunExporter:
         meta: Optional[Dict[str, object]] = None,
         compact: Optional[bool] = None,
         static_frame: Optional[pd.DataFrame] = None,
+        manifest=None,
     ) -> None:
         self.run_dir = run_dir
+        # crash-consistent artifact ledger (resilience.manifest.
+        # RunManifest): every landed partition is content-hash
+        # recorded and each year marked complete once its surfaces are
+        # all on disk — the supervisor's resume frontier. Single-
+        # controller only: multi-host shard writes are per-process and
+        # a process-0 ledger would claim completeness it cannot see.
+        self._manifest = manifest if jax.process_count() == 1 else None
         self.keep = np.asarray(mask) > 0
         self._ids_full = np.asarray(agent_id)
         self.agent_id = self._ids_full[self.keep]
@@ -280,10 +290,13 @@ class RunExporter:
             self._write_meta()
             if static_frame is not None:
                 # once per run: the static join keys refschema needs
-                static_frame.to_parquet(
+                atomic_to_parquet(
+                    static_frame,
                     os.path.join(run_dir, "agents.parquet"),
                     compression=_PARQUET_COMPRESSION,
                 )
+                if self._manifest is not None:
+                    self._manifest.record_run_artifact("agents.parquet")
 
     def _part_name(self, year: int) -> str:
         """Per-year parquet partition name; multi-host runs write one
@@ -456,6 +469,7 @@ class RunExporter:
                 year, np.asarray(outs.state_hourly_net_mw)
             )
         self._flush_meta()
+        self._mark_year_complete(year)
 
     # --- the async host-IO pipeline's split fetch/write protocol ------
     # (io.hostio.ExportConsumer; __call__ above stays the serialized
@@ -516,6 +530,7 @@ class RunExporter:
         if host.get("hourly") is not None and jax.process_index() == 0:
             self.write_state_hourly(year, np.asarray(host["hourly"]))
         self._flush_meta()
+        self._mark_year_complete(year)
 
     def stamp_hostio(self, stats: Dict[str, object]) -> None:
         """Stamp the async pipeline's provenance into meta.json:
@@ -533,13 +548,28 @@ class RunExporter:
         self._flush_meta()
 
     def _write_meta(self) -> None:
-        """meta.json write via temp file + os.replace: atomic, so a
-        killed async writer can never leave truncated JSON behind."""
-        path = os.path.join(self.run_dir, "meta.json")
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.meta, f, indent=2, default=str)
-        os.replace(tmp, path)
+        """meta.json write via temp file + os.replace (resilience.
+        atomic): a killed async writer can never leave truncated JSON
+        behind."""
+        atomic_write_json(
+            os.path.join(self.run_dir, "meta.json"),
+            self.meta, indent=2, default=str,
+        )
+
+    def stamp_meta(self, **kv: object) -> None:
+        """Merge extra provenance into meta.json and publish it (the
+        supervisor stamps its recovery report here)."""
+        self.meta.update(kv)
+        self._meta_dirty = True
+        self._flush_meta()
+
+    def _record(self, year: int, relpath: str) -> None:
+        if self._manifest is not None:
+            self._manifest.record_artifact(year, relpath)
+
+    def _mark_year_complete(self, year: int) -> None:
+        if self._manifest is not None:
+            self._manifest.mark_year_complete(year)
 
     def _flush_meta(self) -> None:
         """Re-stamp meta.json when the provenance counters changed
@@ -558,11 +588,14 @@ class RunExporter:
     def _write_ao_frame(self, year: int, rows, ids) -> None:
         cols = dict(zip(AGENT_OUTPUT_FIELDS, rows))
         df = pd.DataFrame({"agent_id": ids, "year": year, **cols})
-        df.to_parquet(
+        rel = os.path.join("agent_outputs", self._part_name(year))
+        atomic_to_parquet(
+            df,
             os.path.join(_dir(self.run_dir, "agent_outputs"),
                          self._part_name(year)),
             compression=_PARQUET_COMPRESSION,
         )
+        self._record(year, rel)
 
     def write_agent_outputs(self, year: int, outs, prepared=None) -> None:
         rows, ids = self._local_fields(
@@ -582,11 +615,14 @@ class RunExporter:
         if ev is not None:
             data["energy_value"] = list(ev)
         df = pd.DataFrame(data)
-        df.to_parquet(
+        rel = os.path.join("finance_series", self._part_name(year))
+        atomic_to_parquet(
+            df,
             os.path.join(_dir(self.run_dir, "finance_series"),
                          self._part_name(year)),
             compression=_PARQUET_COMPRESSION,
         )
+        self._record(year, rel)
 
     def write_finance_series(self, year: int, outs, prepared=None) -> None:
         if self.compact:
@@ -618,11 +654,14 @@ class RunExporter:
             "year": year,
             "net_load_mw": list(hourly.astype(np.float32)),
         })
-        df.to_parquet(
+        rel = os.path.join("state_hourly", f"year={year}.parquet")
+        atomic_to_parquet(
+            df,
             os.path.join(_dir(self.run_dir, "state_hourly"),
                          f"year={year}.parquet"),
             compression=_PARQUET_COMPRESSION,
         )
+        self._record(year, rel)
 
 
 #: sector index -> the reference's sector_abbr vocabulary

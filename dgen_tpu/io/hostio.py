@@ -69,6 +69,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from dgen_tpu.resilience.faults import fault_point
 from dgen_tpu.utils import timing
 from dgen_tpu.utils.logging import get_logger
 
@@ -429,6 +430,10 @@ class HostPipeline:
     def _fetch_job(self, item: _Item) -> None:
         host = None
         try:
+            # resilience drill hook: a fetch worker dying mid-year must
+            # surface via _record_error at submit/drain, never hang the
+            # driver (the supervisor then retries/resumes the run)
+            fault_point("hostio_fetch")
             if item.payloads and self._should_run(item):
                 t0 = time.perf_counter()
                 with timing.timer("d2h_fetch", ctx=self.timing_ctx):
@@ -449,6 +454,9 @@ class HostPipeline:
     # -- consume stage (io thread) --------------------------------------
     def _io_job(self, item: _Item, host) -> None:
         try:
+            # resilience drill hook: the ordered consume worker
+            # (collect/parquet/orbax) dying mid-year
+            fault_point("hostio_io")
             if self._should_run(item):
                 t0 = time.perf_counter()
                 for c in self.consumers:

@@ -33,9 +33,13 @@ from dgen_tpu.config import (
     PAYBACK_GRID_STEP,
     SECTORS,
 )
+from dgen_tpu.resilience.faults import fault_point
 
 
 def _read_csv(path: str) -> List[Dict[str, str]]:
+    # resilience drill hook: a transient input-read failure (network
+    # filesystem flake) — retryable by the supervisor, never fatal
+    fault_point("ingest", path=path)
     with open(path, newline="", encoding="utf-8-sig") as f:
         return list(csv.DictReader(f))
 

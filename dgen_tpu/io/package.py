@@ -106,15 +106,21 @@ def save_population(
             out[k] = v.tolist() if isinstance(v, np.ndarray) else v
         return out
 
-    with open(os.path.join(pkg_dir, "tariffs.json"), "w") as f:
-        json.dump([jsonable(s) for s in tariff_specs], f)
-    with open(os.path.join(pkg_dir, "meta.json"), "w") as f:
-        json.dump({
+    from dgen_tpu.resilience.atomic import atomic_write_json
+
+    atomic_write_json(
+        os.path.join(pkg_dir, "tariffs.json"),
+        [jsonable(s) for s in tariff_specs],
+    )
+    atomic_write_json(
+        os.path.join(pkg_dir, "meta.json"),
+        {
             "format_version": FORMAT_VERSION,
             "states": list(states),
             "n_states": int(table.n_states),
             "n_agents": int(keep.sum()),
-        }, f)
+        },
+    )
 
 
 @fn_timer()

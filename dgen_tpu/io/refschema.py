@@ -176,10 +176,13 @@ def write_reference_tables(
         "agent_finance_series": reference_finance_series(run_dir),
         "state_hourly_agg": reference_state_hourly(run_dir),
     }
+    from dgen_tpu.resilience.atomic import atomic_write
+
     paths = {}
     for name, df in tables.items():
         path = os.path.join(out_dir, f"{name}.csv")
-        _csv_ready(df).to_csv(path, index=False)
+        ready = _csv_ready(df)
+        atomic_write(path, lambda tmp, d=ready: d.to_csv(tmp, index=False))
         paths[name] = path
     if postgres_url:
         import sqlalchemy

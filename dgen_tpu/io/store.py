@@ -142,20 +142,32 @@ def write_bank(path: str, data: np.ndarray,
         raise ValueError("bank must be 2-D [rows, cols]")
     code = _DTYPE_TO_CODE[target]
     lib = _load()
+    from dgen_tpu.resilience.atomic import atomic_write
+
+    # both branches publish via atomic_write (temp sibling + one
+    # os.replace): a bank file is a run artifact, and a killed
+    # converter must not leave a truncated DGPB at the published path
     if lib is not None:
-        rc = lib.dg_store_write2(
-            path.encode(), data.ctypes.data_as(ctypes.c_void_p),
-            data.shape[0], data.shape[1], code,
-        )
-        if rc != 0:
-            raise IOError(f"native write failed: {_err(lib)}")
+        def _write_native(tmp_path: str) -> None:
+            rc = lib.dg_store_write2(
+                tmp_path.encode(), data.ctypes.data_as(ctypes.c_void_p),
+                data.shape[0], data.shape[1], code,
+            )
+            if rc != 0:
+                raise IOError(f"native write failed: {_err(lib)}")
+
+        atomic_write(path, _write_native)
         return
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        f.write(code.to_bytes(2, "little"))
-        f.write(int(data.shape[0]).to_bytes(8, "little"))
-        f.write(int(data.shape[1]).to_bytes(8, "little"))
-        f.write(data.tobytes())
+
+    def _write(tmp_path: str) -> None:
+        with open(tmp_path, "wb") as f:
+            f.write(_MAGIC)
+            f.write(code.to_bytes(2, "little"))
+            f.write(int(data.shape[0]).to_bytes(8, "little"))
+            f.write(int(data.shape[1]).to_bytes(8, "little"))
+            f.write(data.tobytes())
+
+    atomic_write(path, _write)
 
 
 def read_bank(path: str) -> np.ndarray:

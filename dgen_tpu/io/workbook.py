@@ -417,26 +417,32 @@ def export_drop_ins(path: str, out_dir: str) -> Dict[str, str]:
     mapping as <name>.csv. Returns {artifact: path}."""
     import csv
 
+    from dgen_tpu.resilience.atomic import atomic_write, atomic_write_json
+
     os.makedirs(out_dir, exist_ok=True)
     out: Dict[str, str] = {}
     with _Workbook(path) as wb:
         ws = _read_scenario(wb, path)
 
         opt_path = os.path.join(out_dir, "scenario_options.csv")
-        with open(opt_path, "w", newline="") as f:
-            w = csv.writer(f)
-            w.writerow(["option", "value"])
-            for k, v in ws.options.items():
-                w.writerow([k, "" if v is None else v])
+
+        def _write_options(tmp: str) -> None:
+            with open(tmp, "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(["option", "value"])
+                for k, v in ws.options.items():
+                    w.writerow([k, "" if v is None else v])
+
+        atomic_write(opt_path, _write_options)
         out["scenario_options"] = opt_path
 
         sel_path = os.path.join(out_dir, "selections.json")
-        with open(sel_path, "w") as f:
-            json.dump(
-                {"selections": ws.selections, "agent_file": ws.agent_file,
-                 "workbook": os.path.basename(path)},
-                f, indent=1,
-            )
+        atomic_write_json(
+            sel_path,
+            {"selections": ws.selections, "agent_file": ws.agent_file,
+             "workbook": os.path.basename(path)},
+            indent=1,
+        )
         out["selections"] = sel_path
 
         ranges = read_named_ranges(
@@ -446,10 +452,14 @@ def export_drop_ins(path: str, out_dir: str) -> Dict[str, str]:
         for name, val in ranges.items():
             if isinstance(val, list) and name != "scenario_options_main":
                 p = os.path.join(out_dir, f"{name}.csv")
-                with open(p, "w", newline="") as f:
-                    w = csv.writer(f)
-                    for row in val:
-                        w.writerow(
-                            ["" if c is None else c for c in row])
+
+                def _write_range(tmp: str, rows=val) -> None:
+                    with open(tmp, "w", newline="") as f:
+                        w = csv.writer(f)
+                        for row in rows:
+                            w.writerow(
+                                ["" if c is None else c for c in row])
+
+                atomic_write(p, _write_range)
                 out[name] = p
     return out

@@ -1,4 +1,4 @@
-"""dgenlint rules L1-L10: JAX/TPU anti-patterns for the dgen-tpu stack.
+"""dgenlint rules L1-L11: JAX/TPU anti-patterns for the dgen-tpu stack.
 
 Every rule is a generator ``rule(module, index) -> (line, message)``;
 :func:`run_rules` applies suppressions and wraps results in
@@ -19,6 +19,9 @@ Scope notes:
     where the device_get belongs.
   * L10 is a host-side SERVING rule: it fires in request-handling
     functions (name/class heuristic), anywhere in the repo.
+  * L11 is a host-side ARTIFACT rule: write-mode opens and frame
+    writers are fine inside (or handed to) the temp+rename helpers
+    (resilience.atomic), flagged everywhere else.
 """
 
 from __future__ import annotations
@@ -542,6 +545,103 @@ def rule_l10(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
 
 
 # ---------------------------------------------------------------------------
+# L11 — bare run-artifact writes outside the temp+rename helpers
+# ---------------------------------------------------------------------------
+#
+# Run artifacts (parquet partitions, meta/manifest JSON, bank files)
+# must be crash-consistent: a killed writer may leave a *.tmp sibling,
+# never a truncated file at the published path.  The sanctioned path is
+# dgen_tpu.resilience.atomic (write to temp, one os.replace).  This
+# rule flags write-mode ``open`` and direct ``.to_parquet``/``.to_csv``
+# calls in functions that neither call an atomic_* helper nor perform
+# the rename themselves (and whose enclosing functions don't either —
+# a nested writer handed to atomic_write is fine).
+
+#: a function (or an enclosing one) calling any of these IS the
+#: temp+rename path, not a bypass of it
+_L11_SAFE_CALL_SUFFIXES = (
+    "atomic_write", "atomic_write_text", "atomic_write_json",
+    "atomic_write_bytes", "atomic_to_parquet",
+)
+_L11_RENAMES = {"os.replace", "os.rename"}
+_L11_FRAME_WRITERS = {"to_parquet", "to_csv"}
+
+
+def _l11_write_mode(node: ast.Call) -> Optional[str]:
+    """The literal write mode of an ``open`` call, or None."""
+    if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+        return None
+    mode = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if isinstance(mode, str) and mode[:1] in ("w", "a", "x"):
+        return mode
+    return None
+
+
+def _l11_fn_is_safe(m: ModuleInfo, fn: FuncInfo) -> bool:
+    """fn or an enclosing function calls an atomic_* helper or does the
+    rename itself."""
+    node: Optional[FuncInfo] = fn
+    while node is not None:
+        for sub in walk_own_body(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted(sub.func)
+            if d is None:
+                continue
+            if _resolve(m, d) in _L11_RENAMES:
+                return True
+            if d.split(".")[-1] in _L11_SAFE_CALL_SUFFIXES:
+                return True
+        node = node.parent
+    return False
+
+
+def rule_l11(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """Bare write-mode ``open``/``to_parquet``/``to_csv`` outside the
+    temp+rename helpers: a kill mid-write leaves a truncated artifact
+    at the published path.  Route the write through
+    ``dgen_tpu.resilience.atomic`` (or do the temp+``os.replace`` dance
+    in the same function)."""
+    safe_cache: Dict[int, bool] = {}
+
+    def safe(fn: FuncInfo) -> bool:
+        k = id(fn)
+        if k not in safe_cache:
+            safe_cache[k] = _l11_fn_is_safe(m, fn)
+        return safe_cache[k]
+
+    for fn in m.functions:
+        if safe(fn):
+            continue
+        for node in walk_own_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            mode = _l11_write_mode(node)
+            if mode is not None:
+                yield node.lineno, (
+                    f"bare `open(..., '{mode}')` writes an artifact "
+                    "in place — a kill mid-write leaves it truncated; "
+                    "use dgen_tpu.resilience.atomic (temp + os.replace)"
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _L11_FRAME_WRITERS
+                and (node.args or node.keywords)
+            ):
+                yield node.lineno, (
+                    f"bare `.{node.func.attr}(...)` writes a run "
+                    "artifact in place — a kill mid-write leaves it "
+                    "truncated; use resilience.atomic.atomic_to_parquet "
+                    "(temp + os.replace)"
+                )
+
+
+# ---------------------------------------------------------------------------
 # Registry / driver
 # ---------------------------------------------------------------------------
 
@@ -556,6 +656,7 @@ RULES: Dict[str, Tuple[str, object]] = {
     "L8": ("debug leftovers in hot paths", rule_l8),
     "L9": ("synchronous host fetches in per-year driver loops", rule_l9),
     "L10": ("jit construction inside request-handling paths", rule_l10),
+    "L11": ("bare run-artifact writes outside temp+rename", rule_l11),
 }
 
 

@@ -58,6 +58,7 @@ from dgen_tpu.ops import dispatch as dispatch_ops
 from dgen_tpu.ops import sizing as sizing_ops
 from dgen_tpu.ops.tariff import NET_BILLING, TariffBank
 from dgen_tpu.parallel.mesh import AGENT_AXIS
+from dgen_tpu.resilience.faults import fault_point
 from dgen_tpu.utils import timing
 from dgen_tpu.utils.logging import get_logger
 
@@ -1257,6 +1258,11 @@ class Simulation:
     def step(
         self, carry: SimCarry, year_idx: int, first_year: bool
     ) -> tuple[SimCarry, YearOutputs]:
+        # resilience drill hook: the per-year device program dispatch.
+        # An ``oom``-kind fault here raises the RESOURCE_EXHAUSTED
+        # error a real chunk-scan OOM surfaces with, so the
+        # supervisor's chunk-halving degradation is testable on CPU.
+        fault_point("year_step")
         return year_step(
             self.table, self.profiles, self.tariffs, self.inputs, carry,
             jnp.asarray(year_idx, dtype=jnp.int32),
@@ -1269,6 +1275,7 @@ class Simulation:
         collect: bool = True,
         checkpoint_dir: Optional[str] = None,
         resume: bool = False,
+        resume_year: Optional[int] = None,
     ) -> SimResults:
         """Run every model year; returns stacked host results.
 
@@ -1280,7 +1287,12 @@ class Simulation:
         ``checkpoint_dir`` saves the cross-year carry after every year
         (orbax); with ``resume=True`` the run restarts after the last
         checkpointed year — the working version of the reference's
-        vestigial ``resume_year`` stub (SURVEY.md §5).
+        vestigial ``resume_year`` stub (SURVEY.md §5).  ``resume_year``
+        pins the restart to a SPECIFIC checkpointed year instead of the
+        latest — the resilience supervisor passes the crash-consistent
+        frontier here so a resumed run re-exports exactly the years
+        whose artifacts are not durably on disk (later checkpoints are
+        overwritten as those years re-run).
 
         Host consumers (collection, export callbacks, checkpoint saves)
         run on the background host-IO pipeline by default
@@ -1300,7 +1312,10 @@ class Simulation:
                 raise ValueError("resume=True requires checkpoint_dir")
             from dgen_tpu.io import checkpoint as ckpt
 
-            last = ckpt.latest_year(checkpoint_dir)
+            last = (
+                resume_year if resume_year is not None
+                else ckpt.latest_year(checkpoint_dir)
+            )
             if last is not None and last not in self.years:
                 # silently restarting from scratch would also overwrite
                 # the existing (incompatible) checkpoints

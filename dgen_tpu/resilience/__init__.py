@@ -1,0 +1,43 @@
+"""Fault-injected, self-healing run supervision (docs/resilience.md).
+
+Layers, bottom up:
+
+* :mod:`~dgen_tpu.resilience.faults` — deterministic fault injection
+  at named production sites (``DGEN_TPU_FAULTS`` spec grammar).
+* :mod:`~dgen_tpu.resilience.atomic` — temp+rename artifact writes
+  (the PR-4 ``meta.json`` pattern, extended to every run artifact).
+* :mod:`~dgen_tpu.resilience.manifest` — content-hashed per-year
+  artifact ledger; ``verify`` audits any run directory.
+* :mod:`~dgen_tpu.resilience.supervisor` — bounded retry + checkpoint
+  resume + graceful degradation around Simulation/sweep runs.
+
+CLI: ``python -m dgen_tpu.resilience {run,verify,drill}``.
+"""
+
+from dgen_tpu.resilience.atomic import (  # noqa: F401
+    atomic_to_parquet,
+    atomic_write,
+    atomic_write_bytes,
+    atomic_write_json,
+    atomic_write_text,
+)
+from dgen_tpu.resilience.faults import (  # noqa: F401
+    FaultError,
+    FaultRegistry,
+    SimulatedOOM,
+    fault_point,
+    injected,
+    install_from_env,
+)
+from dgen_tpu.resilience.manifest import (  # noqa: F401
+    RunManifest,
+    VerifyReport,
+    verify_run_dir,
+)
+from dgen_tpu.resilience.supervisor import (  # noqa: F401
+    RetryPolicy,
+    Supervisor,
+    SupervisorReport,
+    classify_error,
+    run_supervised,
+)

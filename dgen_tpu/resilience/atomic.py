@@ -1,0 +1,79 @@
+"""Crash-consistent artifact writes: temp file + ``os.replace``.
+
+The PR-4 exporter proved the pattern on ``meta.json`` (a killed async
+writer can never leave truncated JSON behind); this module extends it
+to EVERY run artifact — parquet partitions, manifests, package
+metadata, converter outputs.  The contract:
+
+* a reader never observes a partially-written file at the final path —
+  it sees the previous complete version, or the new complete version;
+* a killed writer leaves at most a ``*.tmp`` sibling, which the next
+  write (or a ``resilience verify``) identifies as garbage;
+* dgenlint rule L11 flags bare ``open(..., 'w')`` / ``to_parquet``
+  writes that bypass this helper.
+
+Fault sites (:mod:`dgen_tpu.resilience.faults`): ``export_write``
+fires BEFORE the rename (writer died, nothing landed — retried work
+re-emits it) and ``export_torn`` AFTER it (torn storage damaged a
+landed artifact — the failure mode the content-hashed manifest
+exists to catch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+from dgen_tpu.resilience.faults import fault_point
+
+
+def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
+    """Write ``path`` crash-consistently: ``write_fn(tmp_path)``
+    produces the bytes at a temp sibling, then one ``os.replace``
+    publishes it.  The temp file is removed on failure."""
+    tmp = f"{path}.tmp"
+    ok = False
+    try:
+        write_fn(tmp)
+        fault_point("export_write", path=path)
+        os.replace(tmp, path)
+        ok = True
+    finally:
+        if not ok and os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    fault_point("export_torn", path=path)
+
+
+def atomic_write_text(path: str, text: str, **open_kw: Any) -> None:
+    def _w(tmp: str) -> None:
+        with open(tmp, "w", **open_kw) as f:
+            f.write(text)
+
+    atomic_write(path, _w)
+
+
+def atomic_write_json(path: str, obj: Any, **dump_kw: Any) -> None:
+    def _w(tmp: str) -> None:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, **dump_kw)
+
+    atomic_write(path, _w)
+
+
+def atomic_write_bytes(path: str, blob: bytes) -> None:
+    def _w(tmp: str) -> None:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+
+    atomic_write(path, _w)
+
+
+def atomic_to_parquet(df, path: str, **to_parquet_kw: Any) -> None:
+    """Parquet partition write via temp+rename — a killed exporter can
+    never leave a truncated partition at a ``year=*.parquet`` path for
+    ``load_surface`` to trip over."""
+    atomic_write(path, lambda tmp: df.to_parquet(tmp, **to_parquet_kw))

@@ -1,0 +1,232 @@
+"""The fault drill: prove, on CPU, that every registered fault site is
+retried/resumed by the supervisor and that the recovered run's
+artifacts are bit-exact against an uninterrupted run.
+
+This is the executable form of the resilience acceptance contract —
+``python -m dgen_tpu.resilience drill`` runs it (tools/check.sh wires a
+smoke invocation), the fault-drill bench (``DGEN_TPU_BENCH_FAULTS``)
+stamps its timings, and tests/test_resilience.py asserts its pieces
+individually.
+
+Per injected site the drill runs a fresh supervised run into its own
+directory and checks:
+
+* the fault actually fired (a drill that injects nothing proves
+  nothing);
+* the supervisor retried and the run succeeded;
+* every parquet partition is byte-identical to the clean baseline —
+  except under the ``oom`` drill, where the degraded (chunk-halved)
+  re-entry runs a different-but-equivalent program, so those years are
+  compared numerically (the same tolerance the chunked-vs-whole
+  equivalence suite uses);
+* ``manifest verify`` passes on the recovered directory.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from dgen_tpu.resilience import faults as faults_mod
+from dgen_tpu.resilience.manifest import verify_run_dir
+from dgen_tpu.resilience.supervisor import RetryPolicy, run_supervised
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: the drill matrix: every run-path fault site, hit mid-run.
+#: (ingest / sweep_scenario / serve_query live off the single-run path
+#: and are drilled by tests/test_resilience.py directly.)
+DRILL_SPECS = (
+    ("year_step", "year_step@2"),
+    ("year_step_oom", "year_step@2:oom"),
+    ("ckpt_save", "ckpt_save@2"),
+    ("hostio_fetch", "hostio_fetch@1"),
+    ("hostio_io", "hostio_io@2"),
+    ("export_write", "export_write@2"),
+    ("export_torn", "export_torn@2:truncate"),
+)
+
+#: parquet tolerance for degraded (chunk-halved) re-entries — the same
+#: envelope tests/test_simulation.py's chunked-vs-whole checks use
+OOM_RTOL = 2e-5
+OOM_ATOL = 1e-4
+
+
+def make_synth_runner(
+    n_agents: int = 96,
+    states=("DE", "CA"),
+    end_year: int = 2016,
+    sizing_iters: int = 8,
+) -> Callable:
+    """``make_sim(run_config) -> Simulation`` over one synthetic
+    population (built once; each attempt re-pads/places it under the
+    attempt's config — how degradations take effect)."""
+    from dgen_tpu.config import ScenarioConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.simulation import Simulation
+
+    cfg = ScenarioConfig(
+        name="drill", start_year=2014, end_year=end_year, anchor_years=(),
+    )
+    pop = synth.generate_population(
+        n_agents, states=list(states), seed=11, pad_multiple=64,
+    )
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+    )
+
+    def make_sim(rc):
+        import dataclasses
+
+        rc = dataclasses.replace(rc, sizing_iters=sizing_iters)
+        return Simulation(
+            pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc,
+        )
+
+    return make_sim
+
+
+def _parquet_files(run_dir: str) -> List[str]:
+    out = []
+    for sub in ("agent_outputs", "finance_series", "state_hourly"):
+        d = os.path.join(run_dir, sub)
+        if os.path.isdir(d):
+            out.extend(
+                os.path.join(sub, f)
+                for f in sorted(os.listdir(d)) if f.endswith(".parquet")
+            )
+    return out
+
+
+def compare_run_dirs(clean: str, recovered: str,
+                     numeric: bool = False) -> Dict[str, object]:
+    """Compare every parquet partition of two run directories.
+    ``numeric=False`` demands byte equality; ``numeric=True`` compares
+    frame values at the chunked-equivalence tolerance instead (the OOM
+    drill's degraded re-entry)."""
+    import pandas as pd
+
+    a, b = set(_parquet_files(clean)), set(_parquet_files(recovered))
+    rec: Dict[str, object] = {
+        "only_in_clean": sorted(a - b),
+        "only_in_recovered": sorted(b - a),
+        "mismatched": [],
+        "compared": len(a & b),
+    }
+    for rel in sorted(a & b):
+        pa, pb = os.path.join(clean, rel), os.path.join(recovered, rel)
+        with open(pa, "rb") as fa, open(pb, "rb") as fb:
+            if fa.read() == fb.read():
+                continue
+        if not numeric:
+            rec["mismatched"].append(rel)
+            continue
+        da, db = pd.read_parquet(pa), pd.read_parquet(pb)
+        try:
+            for col in da.columns:
+                va, vb = np.stack(da[col].values), np.stack(db[col].values)
+                if va.dtype.kind in "fc":
+                    # compact exports are int16-quantized with
+                    # per-column scales: two equivalent-but-reordered
+                    # programs can land one quantization step apart, so
+                    # the bound is the column's quant step plus the
+                    # chunked-equivalence envelope
+                    atol = max(
+                        float(np.max(np.abs(va))) / 32766.0 * 2.0,
+                        OOM_ATOL,
+                    )
+                    np.testing.assert_allclose(
+                        va, vb, rtol=OOM_RTOL * 5, atol=atol)
+                else:
+                    np.testing.assert_array_equal(va, vb)
+        except AssertionError:
+            rec["mismatched"].append(rel)
+    rec["ok"] = not (
+        rec["only_in_clean"] or rec["only_in_recovered"]
+        or rec["mismatched"]
+    )
+    return rec
+
+
+def run_drill(
+    root: str,
+    *,
+    n_agents: int = 96,
+    end_year: int = 2016,
+    specs=DRILL_SPECS,
+    policy: Optional[RetryPolicy] = None,
+    make_runner: Optional[Callable] = None,
+) -> Dict[str, object]:
+    """Run the fault matrix under ``root`` and return the drill record
+    (``ok`` plus per-site retries/recovery walls — the bench payload
+    shape)."""
+    from dgen_tpu.config import RunConfig
+
+    make_sim = make_runner or make_synth_runner(
+        n_agents=n_agents, end_year=end_year)
+    policy = policy or RetryPolicy(
+        max_retries=3, backoff_base_s=0.01, min_agent_chunk=32,
+    )
+    clean_dir = os.path.join(root, "clean")
+    t0 = time.perf_counter()
+    res_clean, rep_clean = run_supervised(
+        make_sim, RunConfig(), run_dir=clean_dir, collect=False,
+        policy=policy,
+    )
+    clean_wall = time.perf_counter() - t0
+    assert rep_clean.retries == 0, "clean baseline must not retry"
+
+    sites: Dict[str, dict] = {}
+    ok = True
+    for name, spec in specs:
+        d = os.path.join(root, name)
+        t0 = time.perf_counter()
+        with faults_mod.injected(spec) as reg:
+            _, report = run_supervised(
+                make_sim, RunConfig(), run_dir=d, collect=False,
+                policy=policy,
+            )
+        site = faults_mod.parse_spec(spec)[0].site
+        fired = reg.fired(site)
+        cmp_rec = compare_run_dirs(
+            clean_dir, d, numeric=(":oom" in spec))
+        verify_ok = all(r.ok for r in verify_run_dir(d))
+        site_ok = bool(
+            fired and report.succeeded and report.retries >= 1
+            and cmp_rec["ok"] and verify_ok
+        )
+        ok = ok and site_ok
+        sites[name] = {
+            "spec": spec,
+            "fired": fired,
+            "retries": report.retries,
+            "degradations": report.degradations,
+            "recovery_wall_s": round(report.recovery_wall_s, 3),
+            "drill_wall_s": round(time.perf_counter() - t0, 3),
+            "parquet": {
+                "compared": cmp_rec["compared"],
+                "mismatched": cmp_rec["mismatched"],
+            },
+            "verify_ok": verify_ok,
+            "ok": site_ok,
+        }
+        logger.info(
+            "fault drill %s: %s (retries=%d, recovery %.2fs)",
+            name, "ok" if site_ok else "FAILED",
+            report.retries, report.recovery_wall_s,
+        )
+    return {
+        "ok": ok,
+        "n_agents": n_agents,
+        "end_year": end_year,
+        "clean_wall_s": round(clean_wall, 3),
+        "retries_total": sum(s["retries"] for s in sites.values()),
+        "recovery_wall_s_total": round(
+            sum(s["recovery_wall_s"] for s in sites.values()), 3),
+        "sites": sites,
+    }

@@ -1,0 +1,297 @@
+"""Deterministic fault injection for the run supervisor's recovery
+drills.
+
+Every recovery path in the stack (checkpoint resume, host-IO pipeline
+error surfacing, OOM chunk degradation, export re-emission) is only
+trustworthy if it is *exercised* — a preempted TPU VM or a killed
+writer must not be the first time the code runs.  This module provides
+named **fault sites** woven through the production paths; a
+:class:`FaultRegistry` (installed from the ``DGEN_TPU_FAULTS`` env
+knob, ``RunConfig.faults``, or a test's :func:`injected` context)
+deterministically fires failures at chosen hit counts, so every
+recovery path is testable on CPU in tier-1 and reproducible run to run.
+
+Spec grammar (``DGEN_TPU_FAULTS``)::
+
+    spec    := clause (";" clause)*
+    clause  := site ["@" nth] ["x" times] [":" kind]
+    site    := a registered site name (see SITES)
+    nth     := 1-based hit index at which the clause starts firing
+               (default 1 — the first hit)
+    times   := how many consecutive hits fire (default 1)
+    kind    := "error" (default) | "oom" | "kill" | "truncate"
+
+Examples::
+
+    ckpt_save@2                 fail the 2nd checkpoint save
+    year_step@3:oom             simulate device OOM on the 3rd year step
+    hostio_io x2                fail the first two io-thread consumes
+    export_torn:truncate        damage the first landed export artifact
+    ckpt_save@2;hostio_fetch@1  two independent clauses
+
+Kinds:
+
+* ``error`` — raise :class:`FaultError` at the site (a generic
+  transient failure; the supervisor classifies it by site).
+* ``oom`` — raise :class:`SimulatedOOM`, whose message carries the
+  ``RESOURCE_EXHAUSTED`` marker real XLA device OOMs carry, so the
+  supervisor's classifier treats simulated and real OOMs identically.
+* ``kill`` — ``os._exit`` the process mid-site, with no cleanup, no
+  ``finally`` blocks, no atexit: the honest model of a preemption or
+  OOM-kill.  Only meaningful under a subprocess drill.
+* ``truncate`` — only at artifact sites (``export_torn``): truncate
+  the just-landed file to half its bytes, then raise — the model of a
+  torn write / partial flush that ``manifest verify`` exists to catch.
+
+The uninstalled fast path is one module-global ``None`` check per
+site, so production runs pay nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional
+
+#: process exit code used by the ``kill`` kind — distinct from common
+#: python/pytest codes so a subprocess drill can assert the death was
+#: the injected one
+KILL_EXIT_CODE = 77
+
+#: registered fault sites -> where they live / what failing there models
+SITES: Dict[str, str] = {
+    "year_step": (
+        "models.simulation.Simulation.step — the per-year device "
+        "program dispatch; ``oom`` here simulates a RESOURCE_EXHAUSTED "
+        "raise from the chunk scan"
+    ),
+    "ckpt_save": (
+        "io.checkpoint.Writer.save — the orbax checkpoint write; "
+        "``kill`` models a process death mid-save"
+    ),
+    "hostio_fetch": (
+        "io.hostio.HostPipeline fetch stage — the batched device_get "
+        "worker dying mid-year"
+    ),
+    "hostio_io": (
+        "io.hostio.HostPipeline io stage — the ordered consume worker "
+        "(collect/parquet/orbax) dying mid-year"
+    ),
+    "export_write": (
+        "resilience.atomic.atomic_write, before the rename — a writer "
+        "failing/killed before its artifact lands (tmp file only; the "
+        "previous artifact, if any, survives intact)"
+    ),
+    "export_torn": (
+        "resilience.atomic.atomic_write, after the rename — torn "
+        "storage damaging a landed artifact (``truncate``)"
+    ),
+    "ingest": (
+        "io.ingest._read_csv — a transient input-read failure "
+        "(network filesystem flake)"
+    ),
+    "sweep_scenario": (
+        "sweep.driver loop mode — a scenario run dying between "
+        "scenarios of a group"
+    ),
+    "serve_query": (
+        "serve.engine.ServeEngine.query_rows — a device failure on "
+        "the serving path (the batcher must fail the batch's futures, "
+        "never its worker thread)"
+    ),
+}
+
+KINDS = ("error", "oom", "kill", "truncate")
+
+
+class FaultError(RuntimeError):
+    """An injected failure.  ``site``/``kind``/``hit`` identify which
+    clause fired; the supervisor's classifier keys off them."""
+
+    def __init__(self, site: str, kind: str, hit: int) -> None:
+        super().__init__(
+            f"injected fault at site '{site}' (kind={kind}, hit #{hit})"
+        )
+        self.site = site
+        self.kind = kind
+        self.hit = hit
+
+
+class SimulatedOOM(FaultError):
+    """An injected device OOM.  The message carries the
+    ``RESOURCE_EXHAUSTED`` marker so :func:`dgen_tpu.resilience.
+    supervisor.classify_error` treats it exactly like a real XLA OOM."""
+
+    def __init__(self, site: str, hit: int) -> None:
+        FaultError.__init__(self, site, "oom", hit)
+        self.args = (
+            f"RESOURCE_EXHAUSTED: simulated device OOM injected at site "
+            f"'{site}' (hit #{hit})",
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultClause:
+    """One parsed spec clause: fire ``kind`` at hits
+    ``nth .. nth+times-1`` of ``site``."""
+
+    site: str
+    nth: int = 1
+    times: int = 1
+    kind: str = "error"
+
+    def __post_init__(self) -> None:
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site '{self.site}' (known: "
+                f"{', '.join(sorted(SITES))})"
+            )
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind '{self.kind}' (known: "
+                f"{', '.join(KINDS)})"
+            )
+        if self.nth < 1 or self.times < 1:
+            raise ValueError("nth and times must be >= 1")
+
+    def matches(self, hit: int) -> bool:
+        return self.nth <= hit < self.nth + self.times
+
+
+def parse_spec(spec: str) -> List[FaultClause]:
+    """Parse the ``DGEN_TPU_FAULTS`` grammar (module docstring).
+    Unknown sites/kinds raise — a typo'd site must fail loudly, not
+    silently never fire."""
+    clauses: List[FaultClause] = []
+    for raw in spec.split(";"):
+        tok = raw.strip()
+        if not tok:
+            continue
+        kind = "error"
+        if ":" in tok:
+            tok, kind = tok.rsplit(":", 1)
+            kind = kind.strip()
+        times = 1
+        if "x" in tok:
+            head, _, tail = tok.rpartition("x")
+            if tail.strip().isdigit():
+                tok, times = head, int(tail)
+        nth = 1
+        if "@" in tok:
+            tok, n = tok.split("@", 1)
+            nth = int(n.strip())
+        clauses.append(FaultClause(tok.strip(), nth, times, kind))
+    return clauses
+
+
+class FaultRegistry:
+    """Thread-safe hit counting + deterministic firing for a parsed
+    fault spec.  ``hits`` counts every visit to a site (fired or not),
+    so a spec like ``ckpt_save@2`` fires on exactly the second
+    checkpoint save of the process, every run."""
+
+    def __init__(self, clauses: List[FaultClause]) -> None:
+        self.clauses = list(clauses)
+        self._hits: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultRegistry":
+        return cls(parse_spec(spec))
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+    def fired(self, site: str) -> int:
+        with self._lock:
+            return self._fired.get(site, 0)
+
+    def hit(self, site: str, path: Optional[str] = None) -> None:
+        """Count a visit to ``site``; raise/kill/truncate when a clause
+        matches.  ``path`` is the landed artifact for truncate sites."""
+        if site not in SITES:
+            raise ValueError(f"unregistered fault site '{site}'")
+        with self._lock:
+            n = self._hits.get(site, 0) + 1
+            self._hits[site] = n
+            clause = next(
+                (c for c in self.clauses
+                 if c.site == site and c.matches(n)), None,
+            )
+            if clause is not None:
+                self._fired[site] = self._fired.get(site, 0) + 1
+        if clause is None:
+            return
+        if clause.kind == "kill":
+            # model a preemption/OOM-kill: no cleanup, no finally, no
+            # atexit — exactly what the crash-consistent artifact layer
+            # must survive
+            os._exit(KILL_EXIT_CODE)
+        if clause.kind == "oom":
+            raise SimulatedOOM(site, n)
+        if clause.kind == "truncate":
+            if path is not None and os.path.isfile(path):
+                size = os.path.getsize(path)
+                with open(path, "rb+") as f:
+                    f.truncate(max(size // 2, 1))
+        raise FaultError(site, clause.kind, n)
+
+
+#: the process-wide installed registry (None = fault injection off;
+#: fault_point is then a single global read)
+_active: Optional[FaultRegistry] = None
+
+
+def install(registry: Optional[FaultRegistry]) -> Optional[FaultRegistry]:
+    """Install ``registry`` process-wide; returns the previous one."""
+    global _active
+    prev, _active = _active, registry
+    return prev
+
+
+def active() -> Optional[FaultRegistry]:
+    return _active
+
+
+def install_from_env(env: str = "DGEN_TPU_FAULTS") -> Optional[FaultRegistry]:
+    """Install a registry parsed from ``env`` (no-op when unset/empty).
+    Called by the resilience CLI, the supervisor, and the fault-drill
+    bench — NOT at import, so library users opt in explicitly."""
+    spec = os.environ.get(env, "").strip()
+    if not spec:
+        return None
+    reg = FaultRegistry.parse(spec)
+    install(reg)
+    return reg
+
+
+class injected:
+    """Context manager installing a registry for the duration of a
+    drill/test::
+
+        with faults.injected("ckpt_save@2") as reg:
+            ...
+        assert reg.fired("ckpt_save") == 1
+    """
+
+    def __init__(self, spec: str) -> None:
+        self.registry = FaultRegistry.parse(spec)
+        self._prev: Optional[FaultRegistry] = None
+
+    def __enter__(self) -> FaultRegistry:
+        self._prev = install(self.registry)
+        return self.registry
+
+    def __exit__(self, *exc) -> None:
+        install(self._prev)
+
+
+def fault_point(site: str, path: Optional[str] = None) -> None:
+    """The per-site hook on the production paths: a no-op (one global
+    read) unless a registry is installed."""
+    reg = _active
+    if reg is not None:
+        reg.hit(site, path=path)

@@ -1,0 +1,335 @@
+"""Crash-consistent run manifests: content-hashed, per-year artifact
+ledger for a run directory.
+
+A run directory's parquet partitions tell you what *files* exist; they
+cannot tell you whether a file is complete, whether a year's surfaces
+all landed, or whether a resumed run must re-export anything.  The
+manifest answers exactly that:
+
+* every landed artifact gets a per-year entry ``{sha256, bytes}``,
+  recorded AFTER the atomic rename published it;
+* a year is marked **complete** only once every one of its surfaces is
+  recorded — the exporter calls :meth:`RunManifest.mark_year_complete`
+  at the end of its per-year write;
+* the manifest file itself is written via temp+rename
+  (:mod:`dgen_tpu.resilience.atomic`), so a killed run leaves either
+  the previous consistent ledger or the new one — never a torn one;
+* :meth:`RunManifest.verify` re-hashes the ledger against the
+  directory, flagging missing and corrupt (truncated/damaged) files —
+  the audit behind ``python -m dgen_tpu.resilience verify``;
+* :meth:`RunManifest.complete_through` gives the supervisor the
+  resume frontier: the latest model year through which every prior
+  year's exports are durably, verifiably on disk.  Resuming after that
+  year re-exports exactly the missing years.
+
+Checkpoint entries are recorded post-run (:meth:`record_checkpoints`),
+once orbax's own commit protocol has made the steps durable — mid-run
+the checkpoint directory's committed steps are themselves the source
+of truth, so a crash loses no recoverability by not having stamped
+them here yet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from dgen_tpu.resilience.atomic import atomic_write_json
+
+MANIFEST_NAME = "manifest.json"
+_VERSION = 1
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _hash_tree(root: str) -> tuple[str, int]:
+    """(digest, bytes) over a directory tree: per-file sha256 of
+    (relpath, size, content hash), folded in sorted order — stable
+    across filesystems and listdir orderings."""
+    h = hashlib.sha256()
+    total = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            rel = os.path.relpath(p, root)
+            size = os.path.getsize(p)
+            total += size
+            h.update(f"{rel}\0{size}\0".encode())
+            h.update(_sha256_file(p).encode())
+    return h.hexdigest(), total
+
+
+@dataclasses.dataclass
+class VerifyReport:
+    """Result of :meth:`RunManifest.verify`."""
+
+    run_dir: str
+    #: recorded artifacts whose file is gone
+    missing: List[str] = dataclasses.field(default_factory=list)
+    #: recorded artifacts whose bytes/hash no longer match (truncation,
+    #: torn writes, bit rot)
+    corrupt: List[str] = dataclasses.field(default_factory=list)
+    #: parquet files present under the known surfaces but absent from
+    #: the ledger (a writer died between rename and record — harmless:
+    #: resume re-exports the year over them)
+    unrecorded: List[str] = dataclasses.field(default_factory=list)
+    #: leftover ``*.tmp`` siblings from killed writers
+    stale_tmp: List[str] = dataclasses.field(default_factory=list)
+    #: checkpoint entries that no longer hash-match
+    bad_checkpoints: List[int] = dataclasses.field(default_factory=list)
+    years_complete: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not (self.missing or self.corrupt or self.bad_checkpoints)
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["ok"] = self.ok
+        return d
+
+
+#: surface directories the exporter writes parquet partitions into —
+#: the scan set for :meth:`RunManifest.verify`'s unrecorded check
+SURFACE_DIRS = ("agent_outputs", "finance_series", "state_hourly")
+
+
+class RunManifest:
+    """The per-run-directory artifact ledger (module docstring).
+
+    Loading an existing ``manifest.json`` resumes its ledger — a
+    re-entered run keeps the completed years' entries and overwrites
+    the years it re-exports."""
+
+    def __init__(self, run_dir: str) -> None:
+        self.run_dir = run_dir
+        self.path = os.path.join(run_dir, MANIFEST_NAME)
+        self._years: Dict[int, dict] = {}
+        self._checkpoints: Dict[int, dict] = {}
+        self._run_artifacts: Dict[str, dict] = {}
+        self.notes: List[str] = []
+        if os.path.isfile(self.path):
+            try:
+                with open(self.path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                # a torn manifest cannot happen via atomic_write; an
+                # externally-damaged one is treated as absent (the run
+                # re-exports everything — safe, just not minimal)
+                doc = {}
+            for y, rec in (doc.get("years") or {}).items():
+                self._years[int(y)] = rec
+            for y, rec in (doc.get("checkpoints") or {}).items():
+                self._checkpoints[int(y)] = rec
+            self._run_artifacts = dict(doc.get("run_artifacts") or {})
+            self.notes = list(doc.get("notes") or [])
+
+    # -- recording ------------------------------------------------------
+
+    def record_artifact(self, year: int, relpath: str) -> None:
+        """Hash + record one landed artifact (call AFTER the atomic
+        rename published it).  Re-recording a year that was previously
+        complete reopens it until :meth:`mark_year_complete`."""
+        p = os.path.join(self.run_dir, relpath)
+        rec = self._years.setdefault(
+            int(year), {"complete": False, "artifacts": {}}
+        )
+        rec["artifacts"][relpath] = {
+            "sha256": _sha256_file(p),
+            "bytes": os.path.getsize(p),
+        }
+        rec["complete"] = False
+
+    def record_run_artifact(self, relpath: str) -> None:
+        """Record a year-independent artifact (``agents.parquet``,
+        package metadata); verified alongside the per-year entries."""
+        p = os.path.join(self.run_dir, relpath)
+        self._run_artifacts[relpath] = {
+            "sha256": _sha256_file(p),
+            "bytes": os.path.getsize(p),
+        }
+
+    def mark_year_complete(self, year: int) -> None:
+        """Declare every surface of ``year`` recorded, and publish the
+        ledger (one atomic write per year)."""
+        self._years.setdefault(
+            int(year), {"complete": False, "artifacts": {}}
+        )["complete"] = True
+        self.flush()
+
+    def record_checkpoints(self, ckpt_dir: str,
+                           years: Sequence[int]) -> None:
+        """Post-run: hash each committed checkpoint step's directory
+        tree into the ledger (orbax's commit protocol is the mid-run
+        source of truth; this stamps the audit trail once saves are
+        durable)."""
+        for y in years:
+            step_dir = os.path.join(ckpt_dir, str(int(y)))
+            if not os.path.isdir(step_dir):
+                continue
+            digest, nbytes = _hash_tree(step_dir)
+            self._checkpoints[int(y)] = {
+                "dir": os.path.relpath(step_dir, self.run_dir)
+                if step_dir.startswith(self.run_dir) else step_dir,
+                "sha256": digest,
+                "bytes": nbytes,
+            }
+        self.flush()
+
+    def note(self, msg: str) -> None:
+        """Append an operational note (degradation warnings stamp
+        here) and publish."""
+        self.notes.append(msg)
+        self.flush()
+
+    def flush(self) -> None:
+        os.makedirs(self.run_dir, exist_ok=True)
+        atomic_write_json(
+            self.path,
+            {
+                "version": _VERSION,
+                "years": {
+                    str(y): self._years[y] for y in sorted(self._years)
+                },
+                "checkpoints": {
+                    str(y): self._checkpoints[y]
+                    for y in sorted(self._checkpoints)
+                },
+                "run_artifacts": {
+                    k: self._run_artifacts[k]
+                    for k in sorted(self._run_artifacts)
+                },
+                "notes": self.notes,
+            },
+            indent=2,
+        )
+
+    # -- queries --------------------------------------------------------
+
+    def complete_years(self) -> List[int]:
+        return sorted(y for y, r in self._years.items() if r["complete"])
+
+    def artifacts(self, year: int) -> Dict[str, dict]:
+        return dict(self._years.get(int(year), {}).get("artifacts", {}))
+
+    def complete_through(self, years: Sequence[int],
+                         deep: bool = True) -> Optional[int]:
+        """The resume frontier: the largest ``Y`` in ``years`` such
+        that every grid year ``<= Y`` is complete and (``deep``)
+        verifies against the directory.  ``None`` when even the first
+        year is not durably exported."""
+        frontier: Optional[int] = None
+        for y in years:
+            rec = self._years.get(int(y))
+            if not rec or not rec["complete"]:
+                break
+            if deep and not self._year_ok(int(y)):
+                break
+            frontier = int(y)
+        return frontier
+
+    def _year_ok(self, year: int) -> bool:
+        for rel, meta in self._years[year]["artifacts"].items():
+            p = os.path.join(self.run_dir, rel)
+            if not os.path.isfile(p):
+                return False
+            if os.path.getsize(p) != meta["bytes"]:
+                return False
+            if _sha256_file(p) != meta["sha256"]:
+                return False
+        return True
+
+    # -- audit ----------------------------------------------------------
+
+    def verify(self, deep: bool = True) -> VerifyReport:
+        """Audit the run directory against the ledger.  ``deep``
+        re-hashes every recorded artifact; shallow checks existence and
+        byte counts only (cheap triage on huge runs)."""
+        rep = VerifyReport(run_dir=self.run_dir)
+        recorded = set()
+        for rel, meta in self._run_artifacts.items():
+            recorded.add(rel)
+            p = os.path.join(self.run_dir, rel)
+            if not os.path.isfile(p):
+                rep.missing.append(rel)
+            elif os.path.getsize(p) != meta["bytes"] or (
+                deep and _sha256_file(p) != meta["sha256"]
+            ):
+                rep.corrupt.append(rel)
+        for y in sorted(self._years):
+            rec = self._years[y]
+            year_bad = False
+            for rel, meta in rec["artifacts"].items():
+                recorded.add(rel)
+                p = os.path.join(self.run_dir, rel)
+                if not os.path.isfile(p):
+                    rep.missing.append(rel)
+                    year_bad = True
+                    continue
+                if os.path.getsize(p) != meta["bytes"] or (
+                    deep and _sha256_file(p) != meta["sha256"]
+                ):
+                    rep.corrupt.append(rel)
+                    year_bad = True
+            if rec["complete"] and not year_bad:
+                rep.years_complete.append(y)
+        for y, meta in self._checkpoints.items():
+            step_dir = os.path.join(self.run_dir, meta["dir"]) \
+                if not os.path.isabs(meta["dir"]) else meta["dir"]
+            if not os.path.isdir(step_dir):
+                rep.bad_checkpoints.append(y)
+                continue
+            if deep:
+                digest, nbytes = _hash_tree(step_dir)
+                if digest != meta["sha256"]:
+                    rep.bad_checkpoints.append(y)
+        # sweep the surface dirs for files the ledger doesn't know and
+        # for killed writers' tmp leftovers
+        for d in SURFACE_DIRS:
+            root = os.path.join(self.run_dir, d)
+            if not os.path.isdir(root):
+                continue
+            for name in sorted(os.listdir(root)):
+                rel = os.path.join(d, name)
+                if name.endswith(".tmp"):
+                    rep.stale_tmp.append(rel)
+                elif name.endswith(".parquet") and rel not in recorded:
+                    rep.unrecorded.append(rel)
+        return rep
+
+
+def verify_run_dir(run_dir: str, deep: bool = True) -> List[VerifyReport]:
+    """Audit a run directory; recurses into per-scenario
+    subdirectories (a sweep export is one manifest per scenario
+    directory).  Raises FileNotFoundError when no manifest exists
+    anywhere under ``run_dir``."""
+    reports: List[VerifyReport] = []
+    if os.path.isfile(os.path.join(run_dir, MANIFEST_NAME)):
+        reports.append(RunManifest(run_dir).verify(deep=deep))
+    else:
+        for name in sorted(os.listdir(run_dir)):
+            sub = os.path.join(run_dir, name)
+            if os.path.isdir(sub) and os.path.isfile(
+                os.path.join(sub, MANIFEST_NAME)
+            ):
+                reports.append(RunManifest(sub).verify(deep=deep))
+    if not reports:
+        raise FileNotFoundError(
+            f"no {MANIFEST_NAME} under {run_dir} (not a manifested run "
+            "directory — re-run under the resilience supervisor or "
+            "pass an exporter a RunManifest)"
+        )
+    return reports

@@ -1,0 +1,428 @@
+"""The self-healing run supervisor: bounded retry, checkpoint resume,
+and graceful degradation around ``Simulation.run``/sweep runs.
+
+Everything below ``dgen_tpu.resilience`` assumes a process that can die
+at any instruction; this module is the layer that turns those deaths
+into bounded recovery instead of lost work:
+
+* **classify** — an escaped exception is sorted into ``oom`` /
+  ``hostio`` / ``transient`` / ``fatal`` (:func:`classify_error`).
+  Fatal errors (programming bugs: ``ValueError``, ``TypeError``,
+  assertion failures) re-raise immediately — retrying a bug is noise.
+* **retry** — everything else retries under exponential backoff with
+  deterministic jitter, bounded by :class:`RetryPolicy.max_retries`.
+* **resume** — each retry re-enters from the **crash-consistent resume
+  frontier**: the latest valid checkpoint year ``C`` such that every
+  model year ``<= C`` is durably exported per the run's
+  :class:`~dgen_tpu.resilience.manifest.RunManifest`.  Years after the
+  frontier are re-run and re-exported (atomically, over any partial
+  leftovers) — exactly the missing years, nothing else.
+* **degrade** — classified errors trigger policy responses:
+
+  - ``oom`` → halve ``RunConfig.agent_chunk`` (riding the existing
+    ``auto_agent_chunk`` streaming machinery — a smaller chunk is a
+    smaller peak working set, at more scan steps) and re-enter;
+  - repeated ``hostio`` → fall back to the serialized host-IO oracle
+    path (``async_host_io=False``) with a warning stamped into the
+    manifest and the exporter's meta.json.
+
+Use :func:`run_supervised` for the batteries-included Simulation path,
+or :class:`Supervisor` directly to wrap anything attempt-shaped (the
+sweep engine's ``run(resume=True)`` slots straight in).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from dgen_tpu.resilience import faults as faults_mod
+from dgen_tpu.resilience.manifest import RunManifest
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+# -- error classification ----------------------------------------------------
+
+OOM = "oom"
+HOSTIO = "hostio"
+TRANSIENT = "transient"
+FATAL = "fatal"
+
+#: substrings that mark a device allocation failure in XLA/runtime
+#: errors (real TPU OOMs raise XlaRuntimeError with RESOURCE_EXHAUSTED;
+#: faults.SimulatedOOM carries the same marker by construction)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory")
+
+#: fault sites whose injected errors model host-IO failures
+_HOSTIO_SITES = {
+    "hostio_fetch", "hostio_io", "ckpt_save", "export_write",
+    "export_torn",
+}
+
+#: programming errors: retrying cannot help, re-raise immediately.
+#: (AssertionError covers the invariant harness and the
+#: STATE_KW_BOUND soundness check.)
+_FATAL_TYPES = (ValueError, TypeError, KeyError, AttributeError,
+                AssertionError, NotImplementedError)
+
+
+def classify_error(exc: BaseException) -> str:
+    """Sort an escaped exception into OOM / HOSTIO / TRANSIENT / FATAL
+    (module docstring has the policy attached to each class)."""
+    msg = f"{type(exc).__name__}: {exc}"
+    if any(m in msg for m in _OOM_MARKERS):
+        return OOM
+    if isinstance(exc, faults_mod.FaultError):
+        if exc.site in _HOSTIO_SITES:
+            return HOSTIO
+        return TRANSIENT
+    # network/timeout flakes are plain-retry transient; check them
+    # BEFORE OSError (both are OSError subclasses)
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return TRANSIENT
+    if isinstance(exc, (OSError, IOError)):
+        return HOSTIO
+    if isinstance(exc, _FATAL_TYPES):
+        return FATAL
+    return TRANSIENT
+
+
+# -- policy ------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Retry/degradation budget.  ``min_agent_chunk`` floors the OOM
+    halving (128 = one TPU lane tile; tests on tiny CPU tables pass a
+    smaller floor)."""
+
+    max_retries: int = 4
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    jitter_frac: float = 0.1
+    min_agent_chunk: int = 128
+    #: consecutive-or-cumulative host-IO failures before the serialized
+    #: oracle fallback engages
+    hostio_failures_before_fallback: int = 2
+
+    def backoff_s(self, retry: int, rng: random.Random) -> float:
+        """Exponential backoff with deterministic jitter: retry ``k``
+        sleeps ``base * factor**k * (1 + U(0, jitter))`` where U comes
+        from the supervisor's seeded RNG — reproducible schedules,
+        decorrelated fleets."""
+        base = self.backoff_base_s * (self.backoff_factor ** retry)
+        return base * (1.0 + self.jitter_frac * rng.random())
+
+
+@dataclasses.dataclass
+class AttemptRecord:
+    attempt: int
+    error_class: str
+    error: str
+    backoff_s: float
+    degradation: Optional[str] = None
+    resumed_from_year: Optional[int] = None
+
+
+@dataclasses.dataclass
+class SupervisorReport:
+    """What recovery cost: stamped into bench payloads
+    (``fault_drill``) and the exporter's meta.json."""
+
+    attempts: List[AttemptRecord] = dataclasses.field(default_factory=list)
+    retries: int = 0
+    retries_by_class: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    degradations: List[str] = dataclasses.field(default_factory=list)
+    #: wall seconds from the first failure to final success (0.0 for a
+    #: clean first attempt)
+    recovery_wall_s: float = 0.0
+    succeeded: bool = False
+    final_agent_chunk: Optional[int] = None
+    final_async_host_io: Optional[bool] = None
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["recovery_wall_s"] = round(self.recovery_wall_s, 4)
+        return d
+
+
+@dataclasses.dataclass
+class AttemptContext:
+    """Handed to the attempt function each try.  ``resume`` is False
+    only on a fresh first attempt; ``effective_chunk`` may be reported
+    back by the attempt (the live ``Simulation._agent_chunk``) so the
+    OOM degradation can halve an auto-derived chunk it could not see
+    in the config."""
+
+    attempt: int
+    run_config: Any
+    resume: bool
+    effective_chunk: Optional[int] = None
+
+
+class Supervisor:
+    """Generic bounded-retry engine (module docstring).  The attempt
+    callable gets an :class:`AttemptContext` and returns the run's
+    result; escaped exceptions are classified, degraded on, and
+    retried under backoff until the policy budget is spent."""
+
+    def __init__(self, policy: Optional[RetryPolicy] = None,
+                 seed: int = 0,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
+        self.policy = policy or RetryPolicy()
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+
+    # -- degradation ----------------------------------------------------
+
+    def _degrade(self, rc, cls: str, ctx: AttemptContext,
+                 hostio_failures: int
+                 ) -> tuple[Any, Optional[str], bool]:
+        """The degraded config for the next attempt, a human
+        description of what changed (None = plain retry), and a
+        give-up flag: True means no degradation can help (e.g. OOM at
+        the chunk floor is deterministic — re-running it is noise, not
+        resilience), so the caller re-raises instead of retrying."""
+        if cls == OOM:
+            chunk = rc.agent_chunk if rc.agent_chunk else None
+            if chunk is None:
+                chunk = ctx.effective_chunk or 0
+            floor = self.policy.min_agent_chunk
+            if chunk and chunk > floor:
+                halved = max(floor, chunk // 2)
+            elif not chunk:
+                # whole-table run OOMed and the attempt reported no
+                # chunk: engage streaming at the floor — the smallest
+                # working set the policy allows
+                halved = floor
+            else:
+                logger.error(
+                    "agent_chunk already at the %d-row floor; OOM "
+                    "degradation exhausted — giving up", floor,
+                )
+                return rc, None, True
+            rc = dataclasses.replace(rc, agent_chunk=halved)
+            return rc, f"oom: agent_chunk -> {halved}", False
+        if cls == HOSTIO and (
+            hostio_failures >= self.policy.hostio_failures_before_fallback
+            and rc.async_io_enabled
+        ):
+            rc = dataclasses.replace(rc, async_host_io=False)
+            return rc, (
+                "hostio: repeated host-IO failure — falling back to the "
+                "serialized oracle path (async_host_io=False)"
+            ), False
+        return rc, None, False
+
+    # -- the loop -------------------------------------------------------
+
+    def run(
+        self,
+        attempt_fn: Callable[[AttemptContext], Any],
+        run_config,
+        *,
+        resume: bool = False,
+        on_degrade: Optional[Callable[[str], None]] = None,
+    ) -> tuple[Any, SupervisorReport]:
+        """Drive ``attempt_fn`` to success or budget exhaustion.
+        Returns ``(result, report)``; re-raises the last error when the
+        retry budget is spent or the error is fatal, with the partial
+        report attached as ``exc.supervisor_report``."""
+        report = SupervisorReport()
+        rc = run_config
+        hostio_failures = 0
+        t_first_failure: Optional[float] = None
+        attempt = 0
+        while True:
+            ctx = AttemptContext(
+                attempt=attempt, run_config=rc,
+                resume=resume or attempt > 0,
+            )
+            try:
+                result = attempt_fn(ctx)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — classified below
+                cls = classify_error(e)
+                if t_first_failure is None:
+                    t_first_failure = time.perf_counter()
+                if cls == HOSTIO:
+                    hostio_failures += 1
+                rec = AttemptRecord(
+                    attempt=attempt, error_class=cls, error=repr(e),
+                    backoff_s=0.0,
+                )
+                report.attempts.append(rec)
+                report.retries_by_class[cls] = (
+                    report.retries_by_class.get(cls, 0) + 1
+                )
+                give_up = cls == FATAL or attempt >= self.policy.max_retries
+                degradation = None
+                if not give_up:
+                    rc, degradation, give_up = self._degrade(
+                        rc, cls, ctx, hostio_failures)
+                if give_up:
+                    try:
+                        e.supervisor_report = report  # type: ignore[attr-defined]
+                    except (AttributeError, TypeError):
+                        pass  # exotic exception types without a __dict__
+                    logger.error(
+                        "supervisor giving up after attempt %d (%s): %r",
+                        attempt, cls, e,
+                    )
+                    raise
+                if degradation is not None:
+                    rec.degradation = degradation
+                    report.degradations.append(degradation)
+                    logger.warning("supervisor degradation: %s", degradation)
+                    if on_degrade is not None:
+                        on_degrade(degradation)
+                rec.backoff_s = self.policy.backoff_s(attempt, self._rng)
+                report.retries += 1
+                logger.warning(
+                    "supervisor: attempt %d failed (%s: %r); retrying in "
+                    "%.3fs", attempt, cls, e, rec.backoff_s,
+                )
+                self._sleep(rec.backoff_s)
+                attempt += 1
+                continue
+            report.succeeded = True
+            if t_first_failure is not None:
+                report.recovery_wall_s = (
+                    time.perf_counter() - t_first_failure
+                )
+            report.final_agent_chunk = getattr(rc, "agent_chunk", None)
+            report.final_async_host_io = getattr(
+                rc, "async_host_io", None)
+            return result, report
+
+
+# -- the batteries-included Simulation path ----------------------------------
+
+def run_supervised(
+    make_sim: Callable[[Any], Any],
+    run_config=None,
+    *,
+    run_dir: Optional[str] = None,
+    checkpoint_dir: Optional[str] = None,
+    export_kw: Optional[Dict[str, Any]] = None,
+    collect: bool = True,
+    policy: Optional[RetryPolicy] = None,
+    seed: int = 0,
+    resume: bool = False,
+) -> tuple[Any, SupervisorReport]:
+    """Run a Simulation under the supervisor with crash-consistent
+    exports and (scenario, year) resume.
+
+    Parameters
+    ----------
+    make_sim : ``(run_config) -> Simulation`` — rebuilt each attempt so
+        degradations (halved chunk, serialized host IO) take effect.
+    run_dir : export directory; a :class:`RunManifest` ledger and a
+        :class:`~dgen_tpu.io.export.RunExporter` are wired when given.
+    checkpoint_dir : orbax checkpoint directory (default
+        ``<run_dir>/checkpoints`` when ``run_dir`` is given; runs
+        without either retry from scratch instead of resuming).
+    export_kw : extra RunExporter kwargs (``state_names``,
+        ``with_hourly`` surfaces etc.).
+    resume : also resume a PRE-EXISTING run directory on the first
+        attempt (retries always resume).
+
+    A ``DGEN_TPU_FAULTS`` spec (or ``run_config.faults``) is installed
+    before the first attempt unless a registry is already active —
+    drills compose with programmatic :func:`faults.injected` use.
+    """
+    from dgen_tpu.config import RunConfig
+    from dgen_tpu.io import checkpoint as ckpt
+
+    rc = run_config or RunConfig()
+    installed: Optional[faults_mod.FaultRegistry] = None
+    if faults_mod.active() is None:
+        spec = getattr(rc, "faults", None) or os.environ.get(
+            "DGEN_TPU_FAULTS", "").strip()
+        if spec:
+            installed = faults_mod.FaultRegistry.parse(spec)
+            faults_mod.install(installed)
+
+    if checkpoint_dir is None and run_dir is not None:
+        checkpoint_dir = os.path.join(run_dir, "checkpoints")
+
+    def attempt(ctx: AttemptContext):
+        sim = make_sim(ctx.run_config)
+        ctx.effective_chunk = sim._agent_chunk or None
+        manifest = RunManifest(run_dir) if run_dir is not None else None
+        callback = None
+        if run_dir is not None:
+            from dgen_tpu.io.export import RunExporter
+
+            callback = RunExporter(
+                run_dir, sim.host_agent_id, sim.host_mask,
+                manifest=manifest, **(export_kw or {}),
+            )
+        resume_year = None
+        do_resume = ctx.resume and checkpoint_dir is not None
+        if do_resume:
+            # crash-consistent frontier: never resume past a year whose
+            # exports are not durably on disk, or the missing years
+            # would stay missing forever.  An exporting run with NO
+            # durably-complete year (frontier None — killed before the
+            # first export landed, or a damaged/absent manifest) must
+            # restart from scratch even when checkpoints exist:
+            # resuming from an uncapped checkpoint would permanently
+            # skip the un-exported early years.
+            if manifest is not None and callback is not None:
+                frontier = manifest.complete_through(sim.years)
+                if frontier is None:
+                    do_resume = False
+                else:
+                    resume_year = ckpt.latest_valid_year(
+                        checkpoint_dir, sim.table.n_agents,
+                        max_year=frontier,
+                    )
+            else:
+                # no exporter: checkpoints are the only artifact, so
+                # the newest valid one is the frontier
+                resume_year = ckpt.latest_valid_year(
+                    checkpoint_dir, sim.table.n_agents,
+                )
+            if resume_year is None:
+                do_resume = False
+        if do_resume:
+            logger.info(
+                "supervised attempt %d: resuming after year %s",
+                ctx.attempt, resume_year,
+            )
+        res = sim.run(
+            callback=callback, collect=collect,
+            checkpoint_dir=checkpoint_dir,
+            resume=do_resume, resume_year=resume_year,
+        )
+        return res, sim, callback, manifest
+
+    sup = Supervisor(policy=policy, seed=seed)
+
+    # degradation warnings land in the manifest ledger even when the
+    # attempt that triggered them failed before flushing anything else
+    def on_degrade(msg: str) -> None:
+        if run_dir is not None:
+            RunManifest(run_dir).note(f"supervisor degradation: {msg}")
+
+    try:
+        (res, sim, exporter, manifest), report = sup.run(
+            attempt, rc, resume=resume, on_degrade=on_degrade,
+        )
+    finally:
+        # a registry THIS call armed must not outlive the run — a
+        # leftover clause would fire on whatever hits the site next
+        # (e.g. a serving process in the same interpreter)
+        if installed is not None and faults_mod.active() is installed:
+            faults_mod.install(None)
+    if manifest is not None and checkpoint_dir is not None:
+        manifest.record_checkpoints(checkpoint_dir, sim.years)
+    if exporter is not None:
+        exporter.stamp_meta(supervisor=report.to_json())
+    return res, report

@@ -46,6 +46,7 @@ from dgen_tpu.models.simulation import (
     starting_state_kw,
 )
 from dgen_tpu.ops import sizing as sizing_ops
+from dgen_tpu.resilience.faults import fault_point
 from dgen_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -351,6 +352,10 @@ class ServeEngine:
         change nothing) and slices the first n answers back out. The
         two paths are bit-identical per row.
         """
+        # resilience drill hook: a device failure on the serving path —
+        # the batcher must fail only this batch's futures (its worker
+        # thread and the queue's load-shed/occupancy signals survive)
+        fault_point("serve_query")
         rows = np.asarray(rows, dtype=np.int32)
         n = rows.shape[0]
         if bucket is not None:

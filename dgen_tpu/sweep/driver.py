@@ -58,6 +58,7 @@ from dgen_tpu.sweep.plan import (
     SweepPlan,
     plan_sweep,
 )
+from dgen_tpu.resilience.faults import fault_point
 from dgen_tpu.sweep.results import SweepResults
 from dgen_tpu.utils import timing
 from dgen_tpu.utils.logging import get_logger
@@ -460,6 +461,11 @@ class SweepSimulation:
         guard = None
         try:
             for k, idx in enumerate(group.indices):
+                # resilience drill hook: a scenario dying between the
+                # scenarios of a loop-mode group; the supervisor's
+                # retry re-enters at (scenario, year) via the
+                # per-scenario checkpoint layout
+                fault_point("sweep_scenario")
                 sim = self.sims[idx]
                 scn_ckpt = (
                     ckpt.scenario_dir(checkpoint_dir, self.labels[idx])

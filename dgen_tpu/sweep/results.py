@@ -203,6 +203,9 @@ class SweepResults:
              "scenarios": [self.labels[i] for i in g.indices]}
             for g in self.plan.groups
         ]
-        with open(os.path.join(run_dir, "sweep.json"), "w") as f:
-            json.dump(report, f, indent=1)
+        from dgen_tpu.resilience.atomic import atomic_write_json
+
+        atomic_write_json(
+            os.path.join(run_dir, "sweep.json"), report, indent=1,
+        )
         return run_dir

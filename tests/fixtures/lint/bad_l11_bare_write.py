@@ -1,0 +1,36 @@
+"""L11 fixture: bare run-artifact writes outside the temp+rename
+helpers (dgen_tpu.resilience.atomic)."""
+
+import json
+import os
+
+
+def write_meta_bare(run_dir, meta):
+    # L11: open(..., "w") in place — a kill mid-write truncates it
+    with open(os.path.join(run_dir, "meta.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def write_frame_bare(df, run_dir):
+    # L11: direct to_parquet at the published path
+    df.to_parquet(os.path.join(run_dir, "agent_outputs", "year=2014.parquet"))
+
+
+def write_meta_safe(run_dir, meta):
+    # fine: the temp+rename dance happens in this function
+    path = os.path.join(run_dir, "meta.json")
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+
+
+def write_frame_safe(df, path):
+    from dgen_tpu.resilience.atomic import atomic_write
+
+    def _w(tmp):
+        # fine: handed to atomic_write by the enclosing function
+        with open(tmp, "w") as f:
+            f.write(df.to_json())
+
+    atomic_write(path, _w)

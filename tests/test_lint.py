@@ -355,7 +355,7 @@ def test_jit_wrapper_assignment_marks_root():
 def test_bad_fixture_files_each_trigger_their_rule():
     findings = lint_paths([FIXTURES])
     got = rules_of(findings)
-    for rule in ("L1", "L2", "L3", "L4", "L6", "L7", "L8", "L10"):
+    for rule in ("L1", "L2", "L3", "L4", "L6", "L7", "L8", "L10", "L11"):
         assert rule in got, f"{rule} not triggered by its fixture"
 
 

@@ -1,0 +1,716 @@
+"""Resilience subsystem tests: fault spec grammar + deterministic
+registry, error classification, supervisor retry/degradation
+(OOM -> chunk halving, repeated host-IO -> serialized fallback),
+crash-consistent atomic writes + the content-hashed run manifest
+(verify on clean vs deliberately-truncated directories), the
+kill-at-every-site fault matrix with bit-exact recovery, sweep
+(scenario, year) resume under an injected scenario death, the serving
+batcher surviving an injected query failure, and dgenlint L11."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig
+from dgen_tpu.lint import lint_source
+from dgen_tpu.resilience import faults
+from dgen_tpu.resilience.atomic import atomic_write, atomic_write_json
+from dgen_tpu.resilience.drill import (
+    DRILL_SPECS,
+    compare_run_dirs,
+    make_synth_runner,
+    run_drill,
+)
+from dgen_tpu.resilience.manifest import RunManifest, verify_run_dir
+from dgen_tpu.resilience.supervisor import (
+    FATAL,
+    HOSTIO,
+    OOM,
+    TRANSIENT,
+    AttemptContext,
+    RetryPolicy,
+    Supervisor,
+    classify_error,
+    run_supervised,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: tiny-population drill configuration shared by every e2e test here
+#: (one set of program shapes -> one compile, cached across tests)
+N_AGENTS, END_YEAR = 96, 2016
+FAST_POLICY = RetryPolicy(
+    max_retries=3, backoff_base_s=0.001, min_agent_chunk=32,
+)
+
+
+def _no_sleep(_s: float) -> None:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# fault spec grammar + registry
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_grammar():
+    cl = faults.parse_spec("ckpt_save@2; year_step@3x2:oom ;hostio_io")
+    assert [(c.site, c.nth, c.times, c.kind) for c in cl] == [
+        ("ckpt_save", 2, 1, "error"),
+        ("year_step", 3, 2, "oom"),
+        ("hostio_io", 1, 1, "error"),
+    ]
+
+
+def test_fault_spec_rejects_unknowns():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.parse_spec("not_a_site@1")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.parse_spec("ckpt_save:explode")
+
+
+def test_registry_fires_deterministically():
+    reg = faults.FaultRegistry.parse("ckpt_save@2x2")
+    reg.hit("ckpt_save")                      # hit 1: no fire
+    for _ in range(2):                        # hits 2, 3: fire
+        with pytest.raises(faults.FaultError):
+            reg.hit("ckpt_save")
+    reg.hit("ckpt_save")                      # hit 4: done firing
+    assert reg.hits("ckpt_save") == 4
+    assert reg.fired("ckpt_save") == 2
+
+
+def test_fault_point_noop_without_registry():
+    assert faults.active() is None
+    faults.fault_point("ckpt_save")           # must not raise or count
+
+
+def test_injected_context_restores_previous():
+    with faults.injected("ckpt_save@1") as reg:
+        assert faults.active() is reg
+        with pytest.raises(faults.FaultError):
+            faults.fault_point("ckpt_save")
+    assert faults.active() is None
+
+
+def test_simulated_oom_carries_resource_exhausted():
+    e = faults.SimulatedOOM("year_step", 3)
+    assert "RESOURCE_EXHAUSTED" in str(e)
+    assert classify_error(e) == OOM
+
+
+# ---------------------------------------------------------------------------
+# classification + supervisor policies (no device work)
+# ---------------------------------------------------------------------------
+
+def test_classify_error_matrix():
+    assert classify_error(RuntimeError("RESOURCE_EXHAUSTED: oom")) == OOM
+    assert classify_error(faults.FaultError("hostio_io", "error", 1)) \
+        == HOSTIO
+    assert classify_error(faults.FaultError("ingest", "error", 1)) \
+        == TRANSIENT
+    assert classify_error(OSError("disk")) == HOSTIO
+    assert classify_error(ConnectionError("flake")) == TRANSIENT
+    assert classify_error(ValueError("bug")) == FATAL
+    assert classify_error(AssertionError("invariant")) == FATAL
+    assert classify_error(RuntimeError("???")) == TRANSIENT
+
+
+def test_supervisor_oom_halves_chunk_until_floor():
+    calls = []
+
+    def attempt(ctx: AttemptContext):
+        calls.append(ctx.run_config.agent_chunk)
+        if (ctx.run_config.agent_chunk or 10**9) > 64:
+            raise faults.SimulatedOOM("year_step", len(calls))
+        return "ok"
+
+    sup = Supervisor(
+        RetryPolicy(max_retries=5, backoff_base_s=0.0, min_agent_chunk=32),
+        sleep=_no_sleep,
+    )
+    result, report = sup.run(attempt, RunConfig(agent_chunk=256))
+    assert result == "ok"
+    assert calls == [256, 128, 64]
+    assert report.retries == 2
+    assert report.final_agent_chunk == 64
+    assert all("oom" in d for d in report.degradations)
+
+
+def test_supervisor_oom_engages_streaming_from_whole_table():
+    """A whole-table OOM (agent_chunk unset) degrades to the policy
+    floor via the streaming machinery."""
+    seen = []
+
+    def attempt(ctx: AttemptContext):
+        seen.append(ctx.run_config.agent_chunk)
+        if ctx.run_config.agent_chunk is None:
+            raise faults.SimulatedOOM("year_step", 1)
+        return ctx.run_config.agent_chunk
+
+    sup = Supervisor(
+        RetryPolicy(max_retries=2, backoff_base_s=0.0, min_agent_chunk=32),
+        sleep=_no_sleep,
+    )
+    result, report = sup.run(attempt, RunConfig())
+    assert result == 32 and seen == [None, 32]
+
+
+def test_supervisor_oom_at_floor_gives_up_immediately():
+    """A deterministic OOM with agent_chunk already at the policy
+    floor has no degradation left — re-running it is noise, so the
+    supervisor re-raises instead of burning the retry budget."""
+    calls = []
+
+    def attempt(ctx: AttemptContext):
+        calls.append(ctx.run_config.agent_chunk)
+        raise faults.SimulatedOOM("year_step", len(calls))
+
+    sup = Supervisor(
+        RetryPolicy(max_retries=5, backoff_base_s=0.0, min_agent_chunk=32),
+        sleep=_no_sleep,
+    )
+    with pytest.raises(faults.SimulatedOOM) as ei:
+        sup.run(attempt, RunConfig(agent_chunk=32))
+    assert calls == [32], "no retry may run after degradation exhausted"
+    assert ei.value.supervisor_report.retries == 0
+
+
+def test_supervisor_fatal_never_retries():
+    def attempt(ctx):
+        raise ValueError("a bug, not weather")
+
+    sup = Supervisor(FAST_POLICY, sleep=_no_sleep)
+    with pytest.raises(ValueError) as ei:
+        sup.run(attempt, RunConfig())
+    assert ei.value.supervisor_report.retries == 0
+
+
+def test_supervisor_hostio_fallback_serializes():
+    seen = []
+
+    def attempt(ctx: AttemptContext):
+        seen.append(ctx.run_config.async_host_io)
+        if ctx.run_config.async_host_io is not False:
+            raise faults.FaultError("hostio_io", "error", len(seen))
+        return "ok"
+
+    sup = Supervisor(
+        RetryPolicy(max_retries=4, backoff_base_s=0.0,
+                    hostio_failures_before_fallback=2),
+        sleep=_no_sleep,
+    )
+    result, report = sup.run(attempt, RunConfig())
+    assert result == "ok"
+    # failure 1: plain retry; failure 2: serialized fallback
+    assert seen == [None, None, False]
+    assert any("serialized" in d for d in report.degradations)
+    assert report.final_async_host_io is False
+
+
+def test_supervisor_backoff_deterministic():
+    p = RetryPolicy(backoff_base_s=0.1, backoff_factor=2.0,
+                    jitter_frac=0.2)
+    import random
+
+    a = [p.backoff_s(k, random.Random(7)) for k in range(4)]
+    b = [p.backoff_s(k, random.Random(7)) for k in range(4)]
+    assert a == b
+    assert all(x < y for x, y in zip(a, a[1:])), "must grow"
+
+
+# ---------------------------------------------------------------------------
+# atomic writes + manifest
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_publishes_or_nothing(tmp_path):
+    p = str(tmp_path / "meta.json")
+    atomic_write_json(p, {"ok": 1})
+    assert json.load(open(p)) == {"ok": 1}
+
+    def boom(tmp):
+        with open(tmp, "w") as f:
+            f.write("partial")
+        raise OSError("writer died")
+
+    with pytest.raises(OSError):
+        atomic_write(str(tmp_path / "new.json"), boom)
+    assert not os.path.exists(tmp_path / "new.json")
+    assert not os.path.exists(tmp_path / "new.json.tmp")
+    # a failed overwrite leaves the previous version intact
+    with pytest.raises(OSError):
+        atomic_write(p, boom)
+    assert json.load(open(p)) == {"ok": 1}
+
+
+def test_atomic_write_fault_kinds(tmp_path):
+    p = str(tmp_path / "a.json")
+    with faults.injected("export_write@1"):
+        with pytest.raises(faults.FaultError):
+            atomic_write_json(p, {"x": 1})
+    assert not os.path.exists(p) and not os.path.exists(p + ".tmp")
+    with faults.injected("export_torn@1:truncate"):
+        with pytest.raises(faults.FaultError):
+            atomic_write_json(p, {"x": 1, "pad": "y" * 64})
+    # torn kind damages the LANDED file — exactly what verify catches
+    assert os.path.exists(p)
+    with pytest.raises(json.JSONDecodeError):
+        json.load(open(p))
+
+
+def _make_manifested_dir(tmp_path):
+    run_dir = str(tmp_path / "run")
+    os.makedirs(os.path.join(run_dir, "agent_outputs"))
+    m = RunManifest(run_dir)
+    for year in (2014, 2016):
+        rel = os.path.join("agent_outputs", f"year={year}.parquet")
+        atomic_write(
+            os.path.join(run_dir, rel),
+            lambda tmp, y=year: open(tmp, "wb").write(
+                b"parquet-bytes-%d" % y),
+        )
+        m.record_artifact(year, rel)
+        m.mark_year_complete(year)
+    return run_dir, m
+
+
+def test_manifest_verify_clean_and_truncated(tmp_path):
+    run_dir, m = _make_manifested_dir(tmp_path)
+    rep = RunManifest(run_dir).verify()           # reload from disk
+    assert rep.ok and rep.years_complete == [2014, 2016]
+
+    # truncation (torn storage) is flagged as corrupt
+    victim = os.path.join(run_dir, "agent_outputs", "year=2016.parquet")
+    with open(victim, "rb+") as f:
+        f.truncate(4)
+    rep = RunManifest(run_dir).verify()
+    assert not rep.ok
+    assert rep.corrupt == [os.path.join("agent_outputs",
+                                        "year=2016.parquet")]
+    assert rep.years_complete == [2014]
+
+    # deletion is flagged as missing; unrecorded + stale tmp are listed
+    os.remove(victim)
+    open(os.path.join(run_dir, "agent_outputs",
+                      "year=2018.parquet"), "wb").write(b"x")
+    open(os.path.join(run_dir, "agent_outputs",
+                      "year=2014.parquet.tmp"), "wb").write(b"x")
+    rep = RunManifest(run_dir).verify()
+    assert rep.missing and rep.unrecorded and rep.stale_tmp
+
+
+def test_manifest_complete_through_stops_at_gap(tmp_path):
+    run_dir, m = _make_manifested_dir(tmp_path)
+    years = [2014, 2016, 2018]
+    assert m.complete_through(years) == 2016
+    victim = os.path.join(run_dir, "agent_outputs", "year=2014.parquet")
+    with open(victim, "rb+") as f:
+        f.truncate(2)
+    m2 = RunManifest(run_dir)
+    assert m2.complete_through(years) is None, \
+        "a damaged early year must pull the frontier back"
+
+
+def test_verify_cli_exit_codes(tmp_path):
+    run_dir, _ = _make_manifested_dir(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.resilience", "verify", run_dir],
+        capture_output=True, text=True, cwd=REPO, env=env,
+    )
+    assert ok.returncode == 0, ok.stderr
+    assert json.loads(ok.stdout)["ok"] is True
+    with open(os.path.join(run_dir, "agent_outputs",
+                           "year=2016.parquet"), "rb+") as f:
+        f.truncate(3)
+    bad = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.resilience", "verify", run_dir],
+        capture_output=True, text=True, cwd=REPO, env=env,
+    )
+    assert bad.returncode == 1
+    assert json.loads(bad.stdout)["ok"] is False
+
+
+# ---------------------------------------------------------------------------
+# the fault matrix (the acceptance drill): kill at every run-path site,
+# recover under the supervisor, bit-exact artifacts + verifying manifest
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fault_matrix(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("fault-matrix"))
+    rec = run_drill(
+        root, n_agents=N_AGENTS, end_year=END_YEAR, policy=FAST_POLICY,
+    )
+    return root, rec
+
+
+def test_fault_matrix_every_site_recovers(fault_matrix):
+    _root, rec = fault_matrix
+    assert {name for name, _ in DRILL_SPECS} == set(rec["sites"])
+    for name, site in rec["sites"].items():
+        assert site["fired"] >= 1, f"{name}: fault never fired"
+        assert site["retries"] >= 1, f"{name}: supervisor never retried"
+        assert site["verify_ok"], f"{name}: manifest verify failed"
+        assert not site["parquet"]["mismatched"], \
+            f"{name}: artifacts diverged from the uninterrupted run"
+        assert site["ok"], f"{name}: {site}"
+    assert rec["ok"]
+
+
+def test_fault_matrix_oom_degraded_and_stamped(fault_matrix):
+    root, rec = fault_matrix
+    oom = rec["sites"]["year_step_oom"]
+    assert any("agent_chunk" in d for d in oom["degradations"])
+    # the supervisor's recovery report is stamped into the run's
+    # provenance, and the degradation into its manifest ledger
+    meta = json.load(open(os.path.join(root, "year_step_oom",
+                                       "meta.json")))
+    assert meta["supervisor"]["retries"] >= 1
+    assert meta["supervisor"]["degradations"]
+    man = json.load(open(os.path.join(root, "year_step_oom",
+                                      "manifest.json")))
+    assert any("degradation" in n for n in man["notes"])
+    # checkpoints were hash-recorded post-run and verify
+    assert man["checkpoints"]
+
+
+def test_fault_matrix_clean_baseline_manifest(fault_matrix):
+    root, rec = fault_matrix
+    reports = verify_run_dir(os.path.join(root, "clean"))
+    assert all(r.ok for r in reports)
+    meta = json.load(open(os.path.join(root, "clean", "meta.json")))
+    assert meta["supervisor"]["retries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# resume semantics: collect parity + checkpoint-state parity
+# ---------------------------------------------------------------------------
+
+def test_resume_collect_and_checkpoint_state_parity(tmp_path):
+    """An interrupted-and-resumed run's collected years and final
+    checkpointed carry are bit-exact vs an uninterrupted run."""
+    import jax
+
+    from dgen_tpu.io import checkpoint as ckpt
+
+    make_sim = make_synth_runner(n_agents=N_AGENTS, end_year=END_YEAR)
+    clean_dir = str(tmp_path / "clean")
+    res_c, rep_c = run_supervised(
+        make_sim, RunConfig(), run_dir=clean_dir, collect=True,
+        policy=FAST_POLICY,
+    )
+    assert rep_c.retries == 0
+
+    faulted_dir = str(tmp_path / "faulted")
+    with faults.injected("hostio_io@2") as reg:
+        res_f, rep_f = run_supervised(
+            make_sim, RunConfig(), run_dir=faulted_dir, collect=True,
+            policy=FAST_POLICY,
+        )
+    assert reg.fired("hostio_io") == 1 and rep_f.retries == 1
+    # the resumed attempt re-ran exactly the unfinished tail
+    assert res_f.years and res_f.years == res_c.years[-len(res_f.years):]
+    off = len(res_c.years) - len(res_f.years)
+    for k, v in res_f.agent.items():
+        np.testing.assert_array_equal(
+            v, res_c.agent[k][off:], err_msg=f"collect parity: {k}")
+
+    n = make_sim(RunConfig()).table.n_agents
+    y_c, carry_c = ckpt.restore_year(
+        os.path.join(clean_dir, "checkpoints"), n)
+    y_f, carry_f = ckpt.restore_year(
+        os.path.join(faulted_dir, "checkpoints"), n)
+    assert y_c == y_f == res_c.years[-1]
+    for leaf_c, leaf_f in zip(
+        jax.tree.leaves(carry_c), jax.tree.leaves(carry_f)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(leaf_c), np.asarray(leaf_f))
+
+
+def test_resume_restarts_when_nothing_durably_exported(tmp_path):
+    """Frontier None with valid checkpoints: an exporting run whose
+    exports never landed (or whose manifest is gone) must restart from
+    scratch — resuming from an uncapped checkpoint would permanently
+    skip the un-exported early years."""
+    import shutil
+
+    make_sim = make_synth_runner(n_agents=N_AGENTS, end_year=END_YEAR)
+    run_dir = str(tmp_path / "run")
+    res, _rep = run_supervised(
+        make_sim, RunConfig(), run_dir=run_dir, collect=False,
+        policy=FAST_POLICY,
+    )
+    all_years = res.years
+    # simulate "killed before any export landed": checkpoints survive,
+    # exports and the manifest do not
+    for name in ("agent_outputs", "finance_series", "manifest.json"):
+        p = os.path.join(run_dir, name)
+        shutil.rmtree(p) if os.path.isdir(p) else os.remove(p)
+    res2, _rep2 = run_supervised(
+        make_sim, RunConfig(), run_dir=run_dir, collect=False,
+        policy=FAST_POLICY, resume=True,
+    )
+    assert res2.years == all_years, \
+        "must re-run (and re-export) every year, not resume past them"
+    assert all(r.ok for r in verify_run_dir(run_dir))
+    man = RunManifest(run_dir)
+    assert man.complete_through(all_years) == all_years[-1]
+
+
+def test_run_supervised_uninstalls_own_registry(tmp_path):
+    """A registry armed from RunConfig.faults must not outlive the
+    run — a leftover clause would fire on the next site hit in the
+    same process."""
+    make_sim = make_synth_runner(n_agents=N_AGENTS, end_year=END_YEAR)
+    assert faults.active() is None
+    res, rep = run_supervised(
+        make_sim, RunConfig(faults="year_step@2"),
+        run_dir=str(tmp_path / "run"), collect=False, policy=FAST_POLICY,
+    )
+    assert rep.retries == 1
+    assert faults.active() is None, "registry leaked past run_supervised"
+
+
+def test_simulation_resume_year_pinned(tmp_path):
+    """Simulation.run(resume_year=...) re-enters at the PINNED year,
+    re-running (and re-exporting) everything after it."""
+    make_sim = make_synth_runner(n_agents=N_AGENTS, end_year=END_YEAR)
+    sim = make_sim(RunConfig())
+    cd = str(tmp_path / "ckpt")
+    res = sim.run(collect=False, checkpoint_dir=cd)
+    first = sim.years[0]
+    sim2 = make_sim(RunConfig())
+    res2 = sim2.run(
+        collect=True, checkpoint_dir=cd, resume=True, resume_year=first,
+    )
+    assert res2.years == sim.years[1:]
+
+
+def test_latest_valid_year_walks_past_corrupt(tmp_path):
+    from dgen_tpu.io import checkpoint as ckpt
+
+    make_sim = make_synth_runner(n_agents=N_AGENTS, end_year=END_YEAR)
+    sim = make_sim(RunConfig())
+    cd = str(tmp_path / "ckpt")
+    sim.run(collect=False, checkpoint_dir=cd)
+    years = ckpt.valid_years(cd)
+    assert years == sim.years
+    n = sim.table.n_agents
+    assert ckpt.latest_valid_year(cd, n) == years[-1]
+    assert ckpt.latest_valid_year(cd, n, max_year=years[0]) == years[0]
+    # damage the newest step: the walk lands on the previous one
+    import shutil
+
+    step = os.path.join(cd, str(years[-1]))
+    for root, _dirs, files in os.walk(step):
+        for f in files:
+            p = os.path.join(root, f)
+            with open(p, "rb+") as fh:
+                fh.truncate(1)
+    assert len(years) > 1, "drill grid should checkpoint >= 2 years"
+    assert ckpt.latest_valid_year(cd, n) == years[-2]
+    shutil.rmtree(cd)
+
+
+# ---------------------------------------------------------------------------
+# off-path sites: ingest, sweep, serve
+# ---------------------------------------------------------------------------
+
+def test_ingest_fault_is_transient_and_retryable(tmp_path):
+    from dgen_tpu.io import ingest
+
+    p = str(tmp_path / "t.csv")
+    with open(p, "w") as f:  # dgenlint: disable=L11 — test fixture data
+        f.write("year,v_res,v_com,v_ind\n2014,1,2,3\n")
+    with faults.injected("ingest@1") as reg:
+        with pytest.raises(faults.FaultError) as ei:
+            ingest._read_csv(p)
+        assert classify_error(ei.value) == TRANSIENT
+        rows = ingest._read_csv(p)               # transient: retry works
+    assert reg.fired("ingest") == 1 and rows[0]["year"] == "2014"
+
+
+def test_sweep_scenario_fault_resumes_at_scenario_year(tmp_path):
+    """Loop-mode sweep: an injected death between scenarios is retried
+    by the supervisor with resume=True; the re-entered sweep skips the
+    completed scenario's years and runs the unstarted one bit-exact."""
+    from dgen_tpu.config import ScenarioConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.sweep import SweepSimulation
+
+    cfg = ScenarioConfig(name="t", start_year=2014, end_year=END_YEAR,
+                         anchor_years=())
+    pop = synth.generate_population(
+        N_AGENTS, states=["DE", "CA"], seed=11, pad_multiple=64)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions)
+
+    def make_sweep():
+        return SweepSimulation(
+            pop.table, pop.profiles, pop.tariffs, [inputs, inputs], cfg,
+            RunConfig(sizing_iters=8), labels=["a", "b"],
+            max_vmap_scenarios=0,      # force loop mode (the fault site)
+        )
+
+    assert all(g.mode == "loop" for g in make_sweep().plan.groups)
+    clean = make_sweep().run(collect=True)
+
+    cd = str(tmp_path / "ckpt")
+    sweep = make_sweep()
+
+    def attempt(ctx: AttemptContext):
+        return sweep.run(
+            collect=True, checkpoint_dir=cd, resume=ctx.resume)
+
+    with faults.injected("sweep_scenario@2") as reg:
+        results, report = Supervisor(
+            FAST_POLICY, sleep=_no_sleep).run(attempt, RunConfig())
+    assert reg.fired("sweep_scenario") == 1 and report.retries == 1
+    # scenario "a" completed before the death: the resumed sweep finds
+    # its (scenario, year) checkpoints complete and re-runs nothing
+    assert results.runs[0].years == []
+    # scenario "b" never started: the resumed sweep runs it in full,
+    # bit-exact vs an uninterrupted sweep
+    assert results.runs[1].years == clean.runs[1].years
+    for k, v in results.runs[1].agent.items():
+        np.testing.assert_array_equal(v, clean.runs[1].agent[k])
+
+
+class _FakeServeEngine:
+    """Just enough engine surface for the Microbatcher: the resilience
+    drill cares about the batcher's failure isolation, not the device
+    math."""
+
+    warm_buckets = {1, 2, 4}
+
+    def rows_for(self, agent_ids):
+        return np.asarray(agent_ids, dtype=np.int32)
+
+    def year_index(self, year):
+        return 0
+
+    def inputs_for(self, overrides):
+        return None
+
+    def query_rows(self, rows, year_idx, inputs=None, bucket=None):
+        faults.fault_point("serve_query")
+        return {"npv": rows.astype(np.float32) * 2.0}
+
+
+def test_serve_batcher_survives_injected_query_failure():
+    """An injected device failure fails only that batch's futures; the
+    worker thread, subsequent queries, and the load-shed/occupancy
+    stats all survive (the serve-side fault drill)."""
+    from dgen_tpu.config import ServeConfig
+    from dgen_tpu.serve.batcher import Microbatcher
+
+    mb = Microbatcher(
+        _FakeServeEngine(),
+        ServeConfig(max_batch=4, max_wait_ms=1.0, max_queue=8, port=0),
+    )
+    try:
+        with faults.injected("serve_query@1") as reg:
+            with pytest.raises(faults.FaultError):
+                mb.query([3], timeout=5.0)
+            out = mb.query([3, 5], timeout=5.0)   # the batcher survives
+        assert reg.fired("serve_query") == 1
+        np.testing.assert_allclose(out["npv"], [6.0, 10.0])
+        stats = mb.stats()
+        assert stats["queue_depth"] == 0
+        assert stats["batches"] >= 1
+        assert stats["batch_occupancy"] is not None
+    finally:
+        mb.close()
+
+
+# ---------------------------------------------------------------------------
+# dgenlint L11
+# ---------------------------------------------------------------------------
+
+L11_BAD = (
+    "import json, os\n"
+    "def write_meta(run_dir, meta):\n"
+    "    with open(os.path.join(run_dir, 'meta.json'), 'w') as f:\n"
+    "        json.dump(meta, f)\n"
+    "def write_frame(df, path):\n"
+    "    df.to_parquet(path)\n"
+)
+
+L11_SAFE = (
+    "import json, os\n"
+    "from dgen_tpu.resilience.atomic import atomic_write\n"
+    "def write_meta(path, meta):\n"
+    "    def _w(tmp):\n"
+    "        with open(tmp, 'w') as f:\n"
+    "            json.dump(meta, f)\n"
+    "    atomic_write(path, _w)\n"
+    "def write_inline(path, blob):\n"
+    "    tmp = path + '.tmp'\n"
+    "    with open(tmp, 'wb') as f:\n"
+    "        f.write(blob)\n"
+    "    os.replace(tmp, path)\n"
+    "def read_side(path):\n"
+    "    with open(path) as f:\n"
+    "        return f.read()\n"
+)
+
+
+def test_l11_flags_bare_writes():
+    hits = [f for f in lint_source(L11_BAD, modname="dgen_tpu.io.bad")
+            if f.rule == "L11"]
+    assert len(hits) == 2
+    assert {h.line for h in hits} == {3, 6}
+
+
+def test_l11_exempts_temp_rename_paths():
+    assert [f for f in lint_source(L11_SAFE, modname="dgen_tpu.io.good")
+            if f.rule == "L11"] == []
+
+
+def test_l11_suppression_comment():
+    src = L11_BAD.replace(
+        "'w') as f:", "'w') as f:  # dgenlint: disable=L11")
+    hits = [f for f in lint_source(src, modname="dgen_tpu.io.bad")
+            if f.rule == "L11"]
+    assert {h.line for h in hits} == {6}
+
+
+# ---------------------------------------------------------------------------
+# true process death (kill kind): subprocess drill — slow tier
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_kill_mid_checkpoint_resumes_cleanly(tmp_path):
+    """A real ``os._exit`` mid-checkpoint (the preemption model): the
+    dead run's directory resumes under the supervisor CLI and verifies
+    clean."""
+    run_dir = str(tmp_path / "run")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    args = [
+        sys.executable, "-m", "dgen_tpu.resilience", "run",
+        "--agents", "96", "--states", "DE", "CA",
+        "--end-year", "2016", "--run-dir", run_dir,
+    ]
+    dead = subprocess.run(
+        args + ["--faults", "ckpt_save@2:kill"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert dead.returncode == faults.KILL_EXIT_CODE, dead.stderr[-2000:]
+    revived = subprocess.run(
+        args + ["--resume"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=600,
+    )
+    assert revived.returncode == 0, revived.stderr[-2000:]
+    out = json.loads(revived.stdout)
+    assert out["ok"] is True
+    verify = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.resilience", "verify", run_dir],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120,
+    )
+    assert verify.returncode == 0, verify.stdout[-2000:]

@@ -34,6 +34,20 @@ if [ "$mode" != "--test-only" ]; then
     # narrows
     echo "== dgenlint L10 (request-path compile guard) =="
     python -m dgen_tpu.lint --select L10 dgen_tpu/serve || rc=1
+    # L11 guards crash consistency (docs/resilience.md): any bare
+    # open(...,'w')/to_parquet of a run artifact outside the
+    # temp+rename helpers — gate the artifact-writing layers by name
+    echo "== dgenlint L11 (crash-consistent artifact writes) =="
+    python -m dgen_tpu.lint --select L11 \
+        dgen_tpu/io dgen_tpu/sweep dgen_tpu/resilience || rc=1
+    # supervisor smoke drill (docs/resilience.md): one injected
+    # mid-run failure + one injected checkpoint-save failure must be
+    # retried/resumed with bit-exact artifacts and a verifying
+    # manifest; the full matrix runs in tier-1 (tests/test_resilience)
+    echo "== resilience smoke drill (python -m dgen_tpu.resilience drill) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill \
+        --agents 96 --end-year 2016 --sites year_step,ckpt_save \
+        >/tmp/_drill.json || rc=1
 fi
 
 if [ "$mode" != "--lint-only" ]; then

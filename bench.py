@@ -369,7 +369,7 @@ def _cpu_baseline(sim, pop) -> float:
         from dgen_tpu.models.simulation import year_step
         args = (table1, sim.profiles, sim.tariffs, sim.inputs, carry1,
                 jnp.asarray(1, dtype=jnp.int32))
-        kw = sim._step_kwargs(first_year=False)
+        kw = sim.step_kwargs(first_year=False)
         kw["sizing_impl"] = "xla"  # Pallas kernel is TPU-only
         # year_step donates the carry (dgenlint L7): every invocation
         # gets its own copy so carry1's buffers survive for the reps
@@ -591,6 +591,40 @@ def main() -> None:
         "async_host_io": _RC().async_io_enabled,
         "async_io": None if _BENCH_ASYNC else {"skipped": "knob off"},
     }
+
+    # static J6 cost fingerprints of the entry points this bench drives
+    # (tools/prog_baseline.json — kept in lockstep with the tree by the
+    # `python -m dgen_tpu.lint --programs` gate in check.sh/CI):
+    # stamped into the payload so a measured-wall regression in a
+    # MULTICHIP_r0*-style round can be correlated with — or ruled out
+    # against — a static program-cost change, without compiling
+    # anything inside the bench budget.
+    try:
+        from dgen_tpu.lint.prog.baseline import (
+            default_baseline_path,
+            load_baseline,
+        )
+
+        _pb = load_baseline(default_baseline_path())
+        if _pb is None:
+            raise OSError("no committed baseline (run the program "
+                          "auditor with --update-baselines)")
+        payload["prog_cost"] = {
+            "source": "tools/prog_baseline.json",
+            "jax": _pb.get("jax"),
+            "platform": _pb.get("platform"),
+            "entries": {
+                k: {
+                    "flops": v.get("flops"),
+                    "bytes_accessed": v.get("bytes_accessed"),
+                    "program_hash": v.get("program_hash"),
+                }
+                for k, v in _pb.get("entries", {}).items()
+            },
+        }
+    except (OSError, ValueError) as e:
+        payload["prog_cost"] = {"error": str(e)[:200]}
+
     cleanup_dirs: list = []   # tempdirs the backstop must not leak
 
     import shutil

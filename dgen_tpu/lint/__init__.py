@@ -11,6 +11,16 @@ or programmatically::
     findings = lint.lint_paths(["dgen_tpu"])      # [] when clean
     findings = lint.lint_source(src)              # one snippet
 
+Program half (imports jax — pulled lazily, the static linter stays
+import-light)::
+
+    JAX_PLATFORMS=cpu python -m dgen_tpu.lint --programs
+
+:mod:`dgen_tpu.lint.prog` traces + lowers every registered jitted
+entry point over the static-config grid on CPU (no devices, no data)
+and runs rules J0-J6 over the jaxprs/StableHLO, including the J6
+cost-fingerprint gate against ``tools/prog_baseline.json``.
+
 Runtime half: :class:`dgen_tpu.lint.guard.RetraceGuard` counts fresh
 XLA compiles per simulation year and fails when a steady-state year
 retraces (imported lazily — the static linter must not initialize a

@@ -1,24 +1,120 @@
 """CLI: ``python -m dgen_tpu.lint [paths...]``.
 
-Exit status: 0 clean, 1 findings, 2 usage error. ``--json`` emits a
-machine-readable finding list (one object per finding); the default
-text format is ``path:line: RULE message``, one per line.
+Two halves share the exit convention (0 clean, 1 findings, 2 usage
+error):
+
+* default — the AST linter (rules L1-L11) over source paths; no jax
+  import, safe anywhere.
+* ``--programs`` — the jaxpr/HLO program auditor (rules J0-J6,
+  :mod:`dgen_tpu.lint.prog`): traces and lowers every registered
+  jitted entry point over the static-config grid on the CPU backend
+  (``JAX_PLATFORMS`` defaults to cpu for the audit; no devices, no
+  data) and gates compiled cost fingerprints against
+  ``tools/prog_baseline.json``.
+
+``--json`` emits a machine-readable finding list (one object per
+finding); the default text format is ``path:line: RULE message``, one
+per line.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from dgen_tpu.lint import PACKAGE_ROOT, RULES, lint_paths
 
 
+def _findings_out(findings, as_json: bool, label: str) -> int:
+    if as_json:
+        print(json.dumps(
+            [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in findings
+            ],
+            indent=1,
+        ))
+    else:
+        for f in findings:
+            print(f)
+        n = len(findings)
+        print(
+            f"{label}: {n} finding{'s' if n != 1 else ''}"
+            if n else f"{label}: clean",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+def _run_programs(args) -> int:
+    # the auditor only ever needs to TRACE — never run — so default to
+    # the CPU backend unless the operator pinned one explicitly (a TPU
+    # bring-up just to parse programs wastes minutes and a chip)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from dgen_tpu.lint import prog
+
+    if args.list_programs:
+        for name in prog.entry_names():
+            print(name)
+        return 0
+    entries = None
+    if args.entries:
+        entries = [e.strip() for e in args.entries.split(",") if e.strip()]
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",") if r.strip()]
+    try:
+        findings, report = prog.audit_programs(
+            entries=entries,
+            grid=args.grid,
+            select=select,
+            baseline_path=args.baseline,
+            update_baselines=args.update_baselines,
+            tolerance=args.tolerance,
+        )
+    except ValueError as e:
+        print(f"dgenlint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(
+            {
+                "findings": [
+                    {"rule": f.rule, "path": f.path, "line": f.line,
+                     "message": f.message}
+                    for f in findings
+                ],
+                "report": report,
+            },
+            indent=1,
+        ))
+        return 1 if findings else 0
+    for name, e in sorted(report["entries"].items()):
+        print(
+            f"dgenlint-prog: {name}: {e['variants']} variant(s) -> "
+            f"{e['predicted_compile_groups']} compile group(s)"
+            + (f", {e['failed']} FAILED" if e["failed"] else ""),
+            file=sys.stderr,
+        )
+    j6 = report.get("j6") or {}
+    if j6.get("note"):
+        print(f"dgenlint-prog: {j6['note']}", file=sys.stderr)
+    if j6.get("updated"):
+        print(
+            f"dgenlint-prog: baseline written to {j6['updated']} "
+            f"({len(j6['entries'])} entries)",
+            file=sys.stderr,
+        )
+    return _findings_out(findings, False, "dgenlint-prog")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dgen_tpu.lint",
-        description="dgenlint: JAX/TPU anti-pattern linter "
-                    "(rules documented in docs/lint.md)",
+        description="dgenlint: JAX/TPU anti-pattern linter + program "
+                    "auditor (rules documented in docs/lint.md)",
     )
     ap.add_argument(
         "paths", nargs="*",
@@ -35,12 +131,57 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true",
         help="print the rule ids and summaries, then exit",
     )
+    prog_group = ap.add_argument_group(
+        "program auditor (--programs)",
+    )
+    prog_group.add_argument(
+        "--programs", action="store_true",
+        help="audit the lowered jaxpr/StableHLO of every registered "
+             "jitted entry point (rules J0-J6) instead of linting "
+             "source",
+    )
+    prog_group.add_argument(
+        "--entries", metavar="NAMES",
+        help="comma-separated registry entries to audit (default: all; "
+             "see --list-programs)",
+    )
+    prog_group.add_argument(
+        "--grid", choices=("default", "fast"), default="default",
+        help="static-config grid depth: 'fast' audits each entry's "
+             "base point only",
+    )
+    prog_group.add_argument(
+        "--list-programs", action="store_true",
+        help="print the registered entry names, then exit",
+    )
+    prog_group.add_argument(
+        "--baseline", metavar="PATH",
+        help="J6 cost-baseline JSON (default: tools/prog_baseline.json)",
+    )
+    prog_group.add_argument(
+        "--update-baselines", action="store_true",
+        help="rewrite the J6 baseline from the current programs "
+             "instead of gating against it",
+    )
+    prog_group.add_argument(
+        "--tolerance", type=float, default=None,
+        help="override the J6 relative drift tolerance",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rule_id, (summary, _impl) in RULES.items():
             print(f"{rule_id}  {summary}")
+        # the J-rules live behind --programs but share the id space;
+        # their id table is jax-free (the implementations are not)
+        from dgen_tpu.lint.prog_ids import PROGRAM_RULE_SUMMARIES
+
+        for rule_id, summary in PROGRAM_RULE_SUMMARIES.items():
+            print(f"{rule_id}  {summary}  (--programs)")
         return 0
+
+    if args.programs or args.list_programs:
+        return _run_programs(args)
 
     select = None
     if args.select:
@@ -50,26 +191,7 @@ def main(argv=None) -> int:
     except (ValueError, OSError, SyntaxError) as e:
         print(f"dgenlint: {e}", file=sys.stderr)
         return 2
-
-    if args.json:
-        print(json.dumps(
-            [
-                {"rule": f.rule, "path": f.path, "line": f.line,
-                 "message": f.message}
-                for f in findings
-            ],
-            indent=1,
-        ))
-    else:
-        for f in findings:
-            print(f)
-        n = len(findings)
-        print(
-            f"dgenlint: {n} finding{'s' if n != 1 else ''}"
-            if n else "dgenlint: clean",
-            file=sys.stderr,
-        )
-    return 1 if findings else 0
+    return _findings_out(findings, args.json, "dgenlint")
 
 
 if __name__ == "__main__":

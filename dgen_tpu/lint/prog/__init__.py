@@ -1,0 +1,151 @@
+"""dgenlint-prog: the jaxpr/HLO-level program auditor.
+
+The AST rules (L1-L11) and the runtime RetraceGuard bracket the repo's
+performance contract from source and from execution; this package
+checks the artifact in between — the *compiled program* — on a
+CPU-only CI runner, no devices, no data:
+
+    JAX_PLATFORMS=cpu python -m dgen_tpu.lint --programs
+
+Every jitted entry point (year_step, the chunked scan variant,
+sweep_year_step, the serve query program, size_agents, the bill
+kernels) is abstract-interpreted over the supported static-config grid
+(daylight_compact x bf16_banks x net_billing x sweep vmap/loop) via
+``jax.jit(...).trace(...).lower()`` on a tiny synthetic world, and the
+J-rules run over the resulting jaxprs/StableHLO:
+
+  J1  oversized constants captured into the program
+  J2  dtype drift (f64 anywhere; bf16/f16 accumulation)
+  J3  host callbacks/transfers inside compiled code
+  J4  donation verification (declared carries actually donated)
+  J5  compile-group fingerprints (steady-state years must share ONE
+      program; loop-mode sweeps must reuse year_step's)
+  J6  cost fingerprints (compiled flops/bytes vs a committed baseline
+      with a tolerance gate — a perf-regression gate with zero timing
+      noise)
+
+Unlike the static L-half, this package imports jax (it must trace);
+``dgen_tpu.lint`` itself stays import-light and pulls it lazily.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from dgen_tpu.lint.core import Finding
+from dgen_tpu.lint.prog import baseline as baseline_mod
+from dgen_tpu.lint.prog.jrules import PROGRAM_RULES, run_program_rules
+from dgen_tpu.lint.prog.registry import (
+    build_registry,
+    entry_names,
+    select_entries,
+)
+from dgen_tpu.lint.prog.spec import (  # noqa: F401  (public API)
+    AUDIT_SPEC_VERSION,
+    Bound,
+    ProgramAudit,
+    ProgramSpec,
+    anchor_for,
+    lower_spec,
+)
+
+__all__ = [
+    "PROGRAM_RULES", "ProgramAudit", "ProgramSpec", "Bound",
+    "audit_programs", "build_registry", "entry_names", "lower_spec",
+    "run_program_rules",
+]
+
+
+def audit_programs(
+    entries: Optional[List[str]] = None,
+    grid: str = "default",
+    select: Optional[List[str]] = None,
+    baseline_path: Optional[str] = None,
+    update_baselines: bool = False,
+    with_cost: bool = True,
+    tolerance: Optional[float] = None,
+) -> Tuple[List[Finding], dict]:
+    """Audit the entry-point registry; returns (findings, report).
+
+    ``entries``: subset of registry entry names (default: all).
+    ``grid="fast"``: base grid points only (test tier).
+    ``select``: subset of J-rule ids. ``with_cost=False`` skips the
+    compile step entirely (J6 reports nothing). The report carries the
+    per-spec fingerprints, predicted compile-group counts, the J6
+    status and — with ``update_baselines`` — the freshly written
+    baseline document.
+    """
+    from dgen_tpu.utils import compilecache
+
+    compilecache.enable()   # amortize the J6 compiles across runs
+    specs = select_entries(build_registry(grid), entries)
+    run_j6 = with_cost and (select is None or "J6" in select)
+    if update_baselines and not run_j6:
+        # an explicitly requested baseline write must never be a
+        # silent no-op (the operator would commit a stale gate)
+        raise ValueError(
+            "--update-baselines requires the J6 rule: drop --select, "
+            "include J6 in it, and keep cost analysis enabled"
+        )
+    audits = [lower_spec(s, with_cost=run_j6) for s in specs]
+    findings = run_program_rules(
+        audits,
+        select=None if select is None
+        else [r for r in select if r != "J6"],
+    )
+
+    report: dict = {
+        "grid": grid,
+        "n_programs": len(audits),
+        "entries": {},
+        "j6": None,
+    }
+    by_entry: dict = {}
+    for a in audits:
+        e = by_entry.setdefault(
+            a.spec.entry, {"variants": 0, "programs": set(), "failed": 0}
+        )
+        e["variants"] += 1
+        if a.error:
+            e["failed"] += 1
+        else:
+            e["programs"].add(a.fingerprint)
+    for name, e in by_entry.items():
+        report["entries"][name] = {
+            "variants": e["variants"],
+            # the statically predicted compile count for this entry
+            # across the audited grid (RetraceGuard's one-compile-per-
+            # group invariant, measured before any hardware run)
+            "predicted_compile_groups": len(e["programs"]),
+            "failed": e["failed"],
+        }
+
+    if run_j6:
+        path = baseline_path or baseline_mod.default_baseline_path()
+        # an --entries subset must neither report the deselected
+        # programs as stale nor delete them from the committed file
+        partial = bool(entries)
+        if update_baselines:
+            doc = baseline_mod.update_baseline(
+                path, audits,
+                tolerance=(
+                    tolerance if tolerance is not None
+                    else baseline_mod.DEFAULT_TOLERANCE
+                ),
+                partial=partial,
+            )
+            report["j6"] = {
+                "updated": path,
+                "entries": sorted(doc["entries"]),
+                "fingerprints": doc["entries"],
+                "note": None,
+            }
+        else:
+            j6_findings, status = baseline_mod.compare_to_baseline(
+                audits, baseline_mod.load_baseline(path),
+                tolerance=tolerance, partial=partial,
+            )
+            findings.extend(j6_findings)
+            report["j6"] = status
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings, report

@@ -1,0 +1,231 @@
+"""J6: the cost-fingerprint regression gate.
+
+For each cost-marked registry entry the auditor compiles the tiny
+abstract program (CPU, no execution) and reads
+``compiled.cost_analysis()`` — flops, bytes accessed, transcendentals —
+plus the captured-constant byte total and the location-stripped program
+hash. Those numbers are *deterministic functions of the compiled
+program* at the fixed audit shapes: zero timing noise, zero hardware
+dependence within a backend. They are committed to
+``tools/prog_baseline.json``; any PR whose lowered programs grow
+(or shrink) a fingerprint beyond the tolerance fails the gate until it
+explicitly refreshes the baseline (``python -m dgen_tpu.lint --programs
+--update-baselines``) — making "this change made the compiled year
+step 2x more expensive" a reviewable diff line instead of a TPU-day.
+
+Cost numbers are only comparable within one (jax version, platform,
+audit-spec version) triple, so the baseline records all three and the
+gate downgrades to an advisory note when they differ. The CI lint
+step pins its jax to the baseline's recorded version so the gate
+ENFORCES there; a jax upgrade re-baselines in its own PR.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from dgen_tpu.lint.core import Finding
+from dgen_tpu.lint.prog.spec import AUDIT_SPEC_VERSION, ProgramAudit
+
+#: default relative tolerance on flops / bytes-accessed drift
+DEFAULT_TOLERANCE = 0.02
+#: absolute slack on captured-constant bytes (tiny shared constants —
+#: month one-hots, daylight gather indices — may legitimately move)
+CONST_BYTES_SLACK = 64 * 1024
+
+#: the gated metrics (relative tolerance); program_hash and
+#: transcendentals are recorded but informational
+GATED_METRICS = ("flops", "bytes_accessed")
+
+
+def default_baseline_path() -> str:
+    """``tools/prog_baseline.json`` at the repo root (next to the
+    ``dgen_tpu`` package)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(os.path.dirname(pkg), "tools",
+                        "prog_baseline.json")
+
+
+def collect_fingerprints(audits: List[ProgramAudit]) -> Dict[str, dict]:
+    """Cost fingerprints of the cost-marked, successfully-compiled
+    audits, keyed by spec id."""
+    out: Dict[str, dict] = {}
+    for a in audits:
+        if a.cost_analysis is None or a.error:
+            continue
+        out[a.spec.spec_id] = {
+            "flops": a.cost_analysis["flops"],
+            "bytes_accessed": a.cost_analysis["bytes_accessed"],
+            "transcendentals": a.cost_analysis["transcendentals"],
+            "const_bytes": a.const_bytes,
+            "program_hash": a.fingerprint,
+        }
+    return out
+
+
+def load_baseline(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _environment() -> dict:
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "spec": AUDIT_SPEC_VERSION,
+    }
+
+
+def baseline_applicable(baseline: Optional[dict]) -> Tuple[bool, str]:
+    """Whether the committed baseline is comparable in THIS
+    environment; the reason string becomes the advisory note when it
+    is not."""
+    if baseline is None:
+        return False, "no baseline file (run --update-baselines to seed it)"
+    env = _environment()
+    for key in ("jax", "platform", "spec"):
+        if baseline.get(key) != env[key]:
+            return False, (
+                f"baseline {key}={baseline.get(key)!r} != "
+                f"{env[key]!r}; cost fingerprints are only comparable "
+                "within one (jax, platform, spec) triple — re-baseline "
+                "with --update-baselines"
+            )
+    return True, ""
+
+
+def compare_to_baseline(
+    audits: List[ProgramAudit],
+    baseline: Optional[dict],
+    tolerance: Optional[float] = None,
+    partial: bool = False,
+) -> Tuple[List[Finding], dict]:
+    """The J6 gate: (findings, status). Status carries the advisory
+    note (inapplicable baseline), the per-entry deltas, and the fresh
+    fingerprints (for --json consumers and bench stamping).
+
+    ``partial``: the audits cover an ``--entries`` subset of the
+    registry — baseline entries absent from the subset are someone
+    else's programs, not stale, so the stale-entry sweep is skipped."""
+    current = collect_fingerprints(audits)
+    status: dict = {
+        "environment": _environment(),
+        "fingerprints": current,
+        "deltas": {},
+        "note": None,
+    }
+    ok, why = baseline_applicable(baseline)
+    if not ok:
+        status["note"] = f"J6 gate skipped: {why}"
+        return [], status
+
+    tol = tolerance if tolerance is not None \
+        else float(baseline.get("tolerance", DEFAULT_TOLERANCE))
+    status["tolerance"] = tol
+    entries = baseline.get("entries", {})
+    anchors = {a.spec.spec_id: a.spec.anchor for a in audits}
+    findings: List[Finding] = []
+
+    def emit(spec_id: str, msg: str) -> None:
+        path, line = anchors.get(spec_id, ("<unknown>", 0))
+        findings.append(Finding("J6", path, line, f"[{spec_id}] {msg}"))
+
+    for spec_id, cur in sorted(current.items()):
+        base = entries.get(spec_id)
+        if base is None:
+            emit(spec_id, (
+                "no committed cost baseline for this entry — run "
+                "`python -m dgen_tpu.lint --programs "
+                "--update-baselines` and commit tools/prog_baseline.json"
+            ))
+            continue
+        deltas = {}
+        for metric in GATED_METRICS:
+            old = float(base.get(metric, 0.0))
+            new = float(cur[metric])
+            rel = (new - old) / old if old else (0.0 if not new else 1.0)
+            deltas[metric] = round(rel, 6)
+            if abs(rel) > tol:
+                direction = "grew" if rel > 0 else "shrank"
+                hint = (
+                    "grew without a baseline update — a perf "
+                    "regression gate with zero timing noise; if the "
+                    "growth is intended, refresh the baseline "
+                    "(--update-baselines) so the cost change is an "
+                    "explicit, reviewable diff"
+                    if rel > 0 else
+                    "shrank — lock the improvement in with "
+                    "--update-baselines so a later regression back to "
+                    "the old cost cannot pass unnoticed"
+                )
+                emit(spec_id, (
+                    f"compiled {metric} {direction} {abs(rel) * 100:.1f}% "
+                    f"({old:.6g} -> {new:.6g}, tolerance "
+                    f"{tol * 100:.1f}%): {hint}"
+                ))
+        old_cb = int(base.get("const_bytes", 0))
+        if cur["const_bytes"] > old_cb + CONST_BYTES_SLACK:
+            emit(spec_id, (
+                f"captured-constant bytes grew {old_cb} -> "
+                f"{cur['const_bytes']} (> {CONST_BYTES_SLACK} B slack): "
+                "something new is baked into the program — pass it as "
+                "a traced argument, or re-baseline if deliberate"
+            ))
+        status["deltas"][spec_id] = deltas
+
+    if not partial:
+        # an entry the registry still PRODUCES but which failed to
+        # lower is a J0 finding, not a stale baseline — deleting its
+        # committed gate would be exactly wrong
+        produced = {a.spec.spec_id for a in audits}
+        for spec_id in sorted(set(entries) - set(current) - produced):
+            emit(spec_id, (
+                "baseline entry no longer produced by the registry — "
+                "remove it via --update-baselines so the baseline "
+                "stays in lockstep with the audited entry set"
+            ))
+    return findings, status
+
+
+def update_baseline(
+    path: str,
+    audits: List[ProgramAudit],
+    tolerance: float = DEFAULT_TOLERANCE,
+    partial: bool = False,
+) -> dict:
+    """Rewrite the baseline from the current audits (atomic publish:
+    a killed writer cannot truncate the committed gate).
+
+    ``partial`` (an ``--entries`` subset): MERGE into the existing
+    baseline instead of replacing it — a targeted refresh must not
+    delete the committed gate for every other program. Refused when
+    the existing baseline was recorded under a different environment
+    (the untouched entries would be incomparable with the fresh ones).
+    """
+    from dgen_tpu.resilience.atomic import atomic_write_json
+
+    entries = collect_fingerprints(audits)
+    if partial:
+        existing = load_baseline(path)
+        if existing is not None:
+            ok, why = baseline_applicable(existing)
+            if not ok:
+                raise ValueError(
+                    "refusing a partial baseline update: " + why
+                )
+            entries = dict(existing.get("entries", {}), **entries)
+    doc = dict(
+        _environment(),
+        tolerance=tolerance,
+        entries=entries,
+    )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    atomic_write_json(path, doc, indent=1, sort_keys=True)
+    return doc

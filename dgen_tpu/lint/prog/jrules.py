@@ -1,0 +1,264 @@
+"""Program-level rules J1-J6 (plus J0, the lower-failure backstop).
+
+Where the L-rules pattern-match source, these inspect the artifact the
+performance contract is actually about: the traced jaxpr and lowered
+StableHLO of every registered entry point. Each rule is a generator
+``rule(audit) -> message`` over one :class:`~dgen_tpu.lint.prog.spec.
+ProgramAudit`; J5/J6 additionally see the whole audit set (compile-
+group identity is a cross-program property, and the cost gate compares
+against a committed baseline). Findings anchor at the entry point's
+``def`` line, where the L-rule suppression mechanics
+(``# dgenlint: disable=J2``) apply unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from dgen_tpu.lint.core import Finding, ModuleInfo, parse_file
+from dgen_tpu.lint.prog_ids import PROGRAM_RULE_SUMMARIES
+from dgen_tpu.lint.prog.spec import (
+    ProgramAudit,
+    donated_partition,
+    eqn_avals,
+    walk_jaxpr,
+)
+
+# J3: primitives that embed a host round-trip / callback in compiled
+# code. ``device_put`` is NOT here — inside jit it is a placement
+# annotation, not a transfer.
+_J3_PRIMITIVES = {
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "infeed", "outfeed", "copy_to_host_async",
+}
+
+# J2: accumulation primitives whose OUTPUT must be f32 — the PR 2 bf16
+# contract is "bf16 streams, f32 accumulate, bank-precision store",
+# which lowers as f32-output reductions followed by an explicit
+# convert; a reduction that OUTPUTS bf16/f16 accumulated at low
+# precision.
+_J2_ACCUM_PRIMITIVES = {
+    "reduce_sum", "reduce_prod", "dot_general", "cumsum",
+    "reduce_window_sum", "conv_general_dilated",
+}
+
+#: the GENERAL reduce/reduce_window primitives accumulate only when
+#: their computation adds/multiplies (a bf16 max/min is lossless)
+_J2_GENERAL_REDUCE = {"reduce", "reduce_window"}
+_J2_ACCUM_OPS = {"add", "mul"}
+
+
+def _accumulating_reduce(eqn) -> bool:
+    from dgen_tpu.lint.prog.spec import _subjaxprs
+
+    stack = []
+    for p in eqn.params.values():
+        stack.extend(_subjaxprs(p))
+    while stack:
+        j = stack.pop()
+        for sub in j.eqns:
+            if sub.primitive.name in _J2_ACCUM_OPS:
+                return True
+            for p in sub.params.values():
+                stack.extend(_subjaxprs(p))
+    return False
+
+_WIDE_DTYPES = ("float64", "complex128")
+_NARROW_ACCUM_DTYPES = ("bfloat16", "float16")
+
+
+def rule_j1(audit: ProgramAudit) -> Iterable[str]:
+    """Oversized constants captured into the program: each one is
+    re-uploaded per executable, bloats HBM alongside the real banks,
+    and (being baked into the computation) defeats the compile cache
+    whenever its VALUE changes. Banks belong in traced arguments."""
+    for shape, dtype, nbytes in audit.oversized_consts:
+        yield (
+            f"captured constant {dtype}{list(shape)} "
+            f"({nbytes / 1024:.0f} KiB) exceeds the "
+            f"{audit.spec.max_const_bytes // 1024} KiB audit ceiling — "
+            "pass it as a traced argument instead of baking it into "
+            "the program"
+        )
+
+
+def rule_j2(audit: ProgramAudit) -> Iterable[str]:
+    """Dtype drift: f64 anywhere in the program (TPU-emulated, doubles
+    HBM), and low-precision accumulation — reductions/contractions
+    whose output aval is bf16/f16 (the bf16-banks contract accumulates
+    in f32 and only STORES at bank precision)."""
+    seen: set = set()
+    for eqn in walk_jaxpr(audit.jaxpr):
+        prim = eqn.primitive.name
+        for aval in eqn_avals(eqn):
+            dt = str(getattr(aval, "dtype", ""))
+            if dt in _WIDE_DTYPES:
+                key = ("wide", prim, dt)
+                if key not in seen:
+                    seen.add(key)
+                    yield (
+                        f"{dt} value flows through `{prim}` — f64 must "
+                        "not reach the device path (L3's runtime twin)"
+                    )
+        if prim in _J2_ACCUM_PRIMITIVES or (
+            prim in _J2_GENERAL_REDUCE and _accumulating_reduce(eqn)
+        ):
+            for v in eqn.outvars:
+                dt = str(getattr(getattr(v, "aval", None), "dtype", ""))
+                if dt in _NARROW_ACCUM_DTYPES:
+                    key = ("accum", prim, dt)
+                    if key not in seen:
+                        seen.add(key)
+                        yield (
+                            f"`{prim}` accumulates at {dt}: the bf16-"
+                            "banks contract is f32 accumulation with a "
+                            "bank-precision STORE (accumulate f32, then "
+                            "convert) — an 8760-term bf16 sum loses "
+                            "~3 digits"
+                        )
+
+
+def rule_j3(audit: ProgramAudit) -> Iterable[str]:
+    """Host callbacks / transfers inside compiled code: every one
+    fences the device pipeline on a host round-trip per dispatch."""
+    seen: set = set()
+    for eqn in walk_jaxpr(audit.jaxpr):
+        prim = eqn.primitive.name
+        if prim in _J3_PRIMITIVES and prim not in seen:
+            seen.add(prim)
+            yield (
+                f"`{prim}` embedded in the compiled program stalls "
+                "every dispatch on a host callback — hoist it to the "
+                "driver (or io.hostio) outside the jit boundary"
+            )
+
+
+def rule_j4(audit: ProgramAudit) -> Iterable[str]:
+    """Donation verification: every leaf of a declared donated carry
+    must actually be marked donated in the lowered program, and
+    NOTHING else may be (donating the resident table/banks would let
+    XLA reuse buffers that every later year still reads)."""
+    if audit.args_info is None:
+        return
+    in_ok, in_bad, out_bad = donated_partition(audit)
+    if audit.spec.donate_args and in_bad:
+        yield (
+            f"{in_bad} of {in_ok + in_bad} carry leaves are NOT "
+            "donated — the cross-year carry must ride "
+            "donate_argnames=('carry',) so XLA aliases the update in "
+            "place (two live copies per in-flight year otherwise)"
+        )
+    if out_bad:
+        yield (
+            f"{out_bad} leaves OUTSIDE the declared carry are donated "
+            "— donating resident table/bank buffers hands their HBM "
+            "to XLA while later years still read them"
+        )
+
+
+def rule_j5(
+    audit: ProgramAudit, by_id: Dict[str, ProgramAudit]
+) -> Iterable[str]:
+    """Compile-group fingerprinting: a steady-state probe (same entry,
+    later year index) must lower to the IDENTICAL program — the static
+    half of RetraceGuard's one-compile-per-group invariant — and
+    entries declared program-sharing (loop-mode sweep vs year_step)
+    must fingerprint-match, or every scenario pays a fresh compile."""
+    if audit.steady_fingerprint is not None \
+            and audit.steady_fingerprint != audit.fingerprint:
+        yield (
+            "steady-state probe lowers to a DIFFERENT program than the "
+            "previous year's — something non-static (a shape, a baked "
+            "value, a python branch on the year) varies per year, so "
+            "every steady-state step would recompile (RetraceGuard "
+            "would fail this run at year 3)"
+        )
+    ref_id = audit.spec.expect_same_as
+    if ref_id is not None:
+        ref = by_id.get(ref_id)
+        if ref is None or ref.error:
+            yield (
+                f"cannot cross-check against '{ref_id}' (not audited "
+                "or failed to lower)"
+            )
+        elif ref.fingerprint != audit.fingerprint:
+            yield (
+                f"program fingerprint differs from '{ref_id}' — these "
+                "are declared to share ONE compiled executable (loop-"
+                "mode sweeps reuse year_step's program; a kwargs drift "
+                "between the sweep driver and Simulation.step_kwargs "
+                "compiles one extra program PER SCENARIO)"
+            )
+
+
+#: rule id -> (summary, per-audit impl); J5 takes the cross-audit map,
+#: J6 lives in dgen_tpu.lint.prog.baseline (it needs the baseline
+#: file). Summaries come from the jax-free id table
+#: (dgen_tpu.lint.prog_ids) so `--list-rules` needn't import jax.
+_IMPLS = {
+    "J0": None, "J1": rule_j1, "J2": rule_j2, "J3": rule_j3,
+    "J4": rule_j4, "J5": rule_j5, "J6": None,
+}
+PROGRAM_RULES: Dict[str, Tuple[str, object]] = {
+    rule_id: (summary, _IMPLS[rule_id])
+    for rule_id, summary in PROGRAM_RULE_SUMMARIES.items()
+}
+
+
+def _suppressed(
+    cache: Dict[str, Optional[ModuleInfo]], rule: str,
+    path: str, line: int,
+) -> bool:
+    if path not in cache:
+        try:
+            cache[path] = parse_file(path)
+        except (OSError, SyntaxError, ValueError):
+            cache[path] = None
+    m = cache[path]
+    return m.is_suppressed(rule, line) if m is not None else False
+
+
+def run_program_rules(
+    audits: List[ProgramAudit],
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """J0-J5 over a set of audits (J6 is applied by the baseline
+    module, which owns the comparison): suppression comments at each
+    entry's anchor line are honored, L-rule style. Findings are
+    prefixed with the ``entry@variant`` they were observed in."""
+    chosen = set(select) if select is not None else set(PROGRAM_RULES)
+    unknown = chosen - set(PROGRAM_RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown program rule id(s): {', '.join(sorted(unknown))}"
+        )
+    by_id = {a.spec.spec_id: a for a in audits}
+    mod_cache: Dict[str, Optional[ModuleInfo]] = {}
+    findings: List[Finding] = []
+
+    def emit(rule: str, audit: ProgramAudit, msg: str) -> None:
+        path, line = audit.spec.anchor
+        if not _suppressed(mod_cache, rule, path, line):
+            findings.append(Finding(
+                rule, path, line, f"[{audit.spec.spec_id}] {msg}"
+            ))
+
+    for audit in audits:
+        if audit.error:
+            if "J0" in chosen:
+                emit("J0", audit, (
+                    f"failed to trace/lower: {audit.error} — the entry "
+                    "point or its abstract-spec builder is broken"
+                ))
+            continue
+        for rule in ("J1", "J2", "J3", "J4"):
+            if rule not in chosen:
+                continue
+            _summary, impl = PROGRAM_RULES[rule]
+            for msg in impl(audit):
+                emit(rule, audit, msg)
+        if "J5" in chosen:
+            for msg in rule_j5(audit, by_id):
+                emit("J5", audit, msg)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

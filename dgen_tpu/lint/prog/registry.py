@@ -1,0 +1,472 @@
+"""The audited entry-point registry.
+
+Every jitted program the repo ships is named here with an abstract-spec
+builder: the tiny synthetic world it lowers against and the
+static-config grid points it must stay clean on. The grid axes mirror
+the REAL compile vocabulary (``RunConfig.daylight_compact`` x
+``RunConfig.bf16_banks`` x the host-decided ``net_billing`` flag, plus
+the sweep's vmap/loop split and the streaming ``agent_chunk`` scan) —
+the audited programs are built through the SAME kwarg paths production
+uses (:meth:`Simulation.step_kwargs`, the sweep driver's group
+overrides, the serve engine's static set), so a knob that silently
+changes the compiled program changes an audited fingerprint here
+first.
+
+Entries (see ``docs/lint.md`` for the operator-facing table):
+
+====================  =====================================================
+``year_step``         the jitted one-year program, full (dl x bf16 x nb)
+                      cartesian grid; first-year/steady pair + steady
+                      repeat probe at the base point
+``year_step_chunked`` the streaming lax.scan variant (``agent_chunk``)
+``sweep_year_step``   vmap-mode sweep (S=2 scenario axis)
+``sweep_loop``        loop-mode sweep — must fingerprint-match
+                      ``year_step`` (zero extra compiles, PR 3 contract)
+``serve_query``       the serve engine's bucket program
+``size_agents``       the standalone sizing engine
+``import_sums``       the candidate bucket-sums bill kernel (+ daylight
+                      layout and bf16-bank input variants)
+``import_sums_pair``  the rate-switch fused twin
+``bucket_sums``       the full-reduction engine (battery forward runs)
+====================  =====================================================
+
+Grid depth: ``grid="fast"`` audits each entry's base point only (test
+tier); the default audits every declared variant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.lint.prog.spec import (
+    AUDIT_CHUNK,
+    AUDIT_ECON_YEARS,
+    AUDIT_END_YEAR,
+    AUDIT_N_AGENTS,
+    AUDIT_QUERY_BUCKET,
+    AUDIT_SIZING_ITERS,
+    AUDIT_STATES,
+    AUDIT_SWEEP_S,
+    Bound,
+    ProgramSpec,
+    anchor_for,
+)
+
+# -- tiny worlds (memoized per compile-relevant flag set) -------------------
+
+_WORLDS: Dict[tuple, object] = {}
+
+
+def _world(daylight: bool = False, bf16: bool = False, chunk: int = 0):
+    """A built Simulation over the fixed tiny synthetic population.
+
+    One world per (daylight, bf16, chunk): Simulation's __init__ is
+    where the daylight layout, bank dtype conversion, padding and the
+    static run flags are decided, so reusing it keeps the audited
+    programs on the production construction path.
+    """
+    key = (daylight, bf16, chunk)
+    if key not in _WORLDS:
+        from dgen_tpu.config import RunConfig, ScenarioConfig
+        from dgen_tpu.io import synth
+        from dgen_tpu.models import scenario as scen
+        from dgen_tpu.models.simulation import Simulation
+
+        cfg = ScenarioConfig(
+            name="prog-audit", start_year=2014, end_year=AUDIT_END_YEAR,
+        )
+        pop = synth.generate_population(
+            AUDIT_N_AGENTS, states=list(AUDIT_STATES), seed=7,
+            pad_multiple=32,
+        )
+        inputs = scen.uniform_inputs(
+            cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+            overrides={
+                "attachment_rate": jnp.full((pop.table.n_groups,), 0.4)
+            },
+        )
+        rc = RunConfig(
+            sizing_iters=AUDIT_SIZING_ITERS, agent_chunk=chunk,
+            agent_pad_multiple=32, daylight_compact=daylight,
+            bf16_banks=bf16,
+        )
+        _WORLDS[key] = Simulation(
+            pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc,
+            econ_years=AUDIT_ECON_YEARS,
+        )
+    return _WORLDS[key]
+
+
+def _yi(i: int):
+    return jnp.asarray(i, dtype=jnp.int32)
+
+
+# -- per-entry bound builders ----------------------------------------------
+
+def _year_step_bound(daylight, bf16, net_billing, first_year,
+                     year: int, chunk: int = 0) -> Bound:
+    from dgen_tpu.models.simulation import SimCarry, year_step
+
+    sim = _world(daylight, bf16, chunk)
+    kwargs = sim.step_kwargs(first_year)
+    kwargs["net_billing"] = net_billing
+    carry = SimCarry.zeros(sim.table.n_agents)
+    return Bound(
+        fn=year_step,
+        args=(sim.table, sim.profiles, sim.tariffs, sim.inputs, carry,
+              _yi(year)),
+        kwargs=kwargs,
+    )
+
+
+def _sweep_bound(net_billing, bf16, first_year, year: int) -> Bound:
+    from dgen_tpu.models.scenario import stack_scenarios
+    from dgen_tpu.models.simulation import SimCarry
+    from dgen_tpu.sweep.driver import sweep_year_step
+
+    sim = _world(False, bf16)
+    members = [
+        sim.inputs,
+        dataclasses.replace(
+            sim.inputs, itc_fraction=sim.inputs.itc_fraction * 0.8
+        ),
+    ][:AUDIT_SWEEP_S]
+    inputs_s = stack_scenarios(members).inputs
+    # the sweep driver's group kwargs: step_kwargs + per-group
+    # net-billing override, mesh dropped inside the vmapped body
+    kwargs = sim.step_kwargs(first_year)
+    kwargs["net_billing"] = net_billing
+    kwargs["mesh"] = None
+    zeros = SimCarry.zeros(sim.table.n_agents)
+    carry = jax.tree.map(
+        lambda x: jnp.zeros((AUDIT_SWEEP_S,) + x.shape, x.dtype), zeros
+    )
+    return Bound(
+        fn=sweep_year_step,
+        args=(sim.table, sim.profiles, sim.tariffs, inputs_s, carry,
+              _yi(year)),
+        kwargs=kwargs,
+    )
+
+
+def _sweep_loop_bound(year: int) -> Bound:
+    """Loop-mode sweep: the sweep driver runs each scenario through a
+    :meth:`Simulation.with_inputs` sibling of the base sim — build the
+    bound through that REAL path so a drift in how siblings construct
+    their step kwargs (vs the base program J5 compares against) lowers
+    a different program here and fails the identity check."""
+    from dgen_tpu.models.simulation import SimCarry, year_step
+
+    sim = _world(False, False)
+    variant = dataclasses.replace(
+        sim.inputs, itc_fraction=sim.inputs.itc_fraction * 0.8
+    )
+    # the planner pins net_billing per scenario group (driver.py)
+    sib = sim.with_inputs(variant, net_billing=True)
+    carry = SimCarry.zeros(sib.table.n_agents)
+    return Bound(
+        fn=year_step,
+        args=(sib.table, sib.profiles, sib.tariffs, sib.inputs, carry,
+              _yi(year)),
+        kwargs=sib.step_kwargs(False),
+    )
+
+
+def _serve_bound(daylight, year: int) -> Bound:
+    from dgen_tpu.serve.engine import query_program, query_static_kwargs
+
+    sim = _world(daylight, False)
+    # the ServeEngine static set, via the SAME constructor the engine
+    # uses — an engine-side change to the set changes the audited
+    # program here, not just production
+    statics = query_static_kwargs(sim)
+    idx = jnp.zeros(AUDIT_QUERY_BUCKET, dtype=jnp.int32)
+    return Bound(
+        fn=query_program,
+        args=(sim.table, sim.profiles, sim.tariffs, sim.inputs, idx,
+              _yi(year)),
+        kwargs=statics,
+    )
+
+
+def _size_agents_bound(net_billing, daylight, bf16) -> Bound:
+    from dgen_tpu.models.scenario import apply_year
+    from dgen_tpu.models.simulation import (
+        build_econ_inputs,
+        compute_nem_allowed,
+        starting_state_kw,
+    )
+    from dgen_tpu.ops import sizing as sizing_ops
+
+    sim = _world(daylight, bf16)
+    # the envs build runs eagerly on tiny arrays — host-side spec
+    # construction, not part of the audited program
+    ya = apply_year(sim.table, sim.inputs, _yi(0))
+    state_kw = starting_state_kw(sim.table, sim.inputs)
+    nem = compute_nem_allowed(sim.table, sim.inputs, _yi(0), state_kw)
+    envs = build_econ_inputs(
+        sim.table, sim.profiles, sim.tariffs, ya, nem,
+        sim.table.incentives, rate_switch=sim._rate_switch,
+    )
+    fn = jax.jit(partial(
+        sizing_ops.size_agents,
+        n_periods=sim.tariffs.max_periods, n_years=sim.econ_years,
+        n_iters=AUDIT_SIZING_ITERS, keep_hourly=False, impl="xla",
+        net_billing=net_billing, daylight=sim._daylight,
+    ))
+    return Bound(fn=fn, args=(envs,), kwargs={})
+
+
+def _kernel_arrays(bf16: bool):
+    """Tiny deterministic bill-kernel operands: [8, 8760] streams with
+    a 2-period TOU bucket map (n_buckets = 24)."""
+    n, h, r = 8, 8760, 5
+    rng = np.random.default_rng(11)
+    dt = jnp.bfloat16 if bf16 else jnp.float32
+    load = jnp.asarray(rng.random((n, h), dtype=np.float32), dtype=dt)
+    gen = jnp.asarray(rng.random((n, h), dtype=np.float32), dtype=dt)
+    sell = jnp.asarray(
+        np.full((n, h), 0.05, dtype=np.float32), dtype=dt
+    )
+    hour = np.arange(h)
+    month = np.minimum(hour // 730, 11)
+    period = (hour % 24 >= 17).astype(np.int64)
+    bucket = jnp.asarray(
+        np.broadcast_to(month * 2 + period, (n, h)), dtype=jnp.int32
+    )
+    scales = jnp.asarray(
+        np.linspace(0.0, 2.0, n * r, dtype=np.float32).reshape(n, r)
+    )
+    return load, gen, sell, bucket, scales
+
+
+def _import_sums_bound(layout_on: bool, bf16: bool) -> Bound:
+    from dgen_tpu.ops import billpallas
+
+    layout = None
+    if layout_on:
+        sim = _world(True, False)
+        layout = sim._daylight
+    load, gen, sell, bucket, scales = _kernel_arrays(bf16)
+    return Bound(
+        fn=billpallas.import_sums,
+        args=(load, gen, sell, bucket, scales),
+        kwargs=dict(n_buckets=24, impl="xla", bf16=False, mesh=None,
+                    layout=layout),
+    )
+
+
+def _import_sums_pair_bound() -> Bound:
+    from dgen_tpu.ops import billpallas
+
+    load, gen, sell, bucket, scales = _kernel_arrays(False)
+    return Bound(
+        fn=billpallas.import_sums_pair,
+        args=(load, gen, sell, bucket, sell * 0.5, bucket, scales),
+        kwargs=dict(n_buckets=24, impl="xla", mesh=None, layout=None),
+    )
+
+
+def _bucket_sums_bound() -> Bound:
+    from dgen_tpu.ops import billpallas
+
+    load, gen, sell, bucket, scales = _kernel_arrays(False)
+    return Bound(
+        fn=billpallas.bucket_sums,
+        args=(load, gen, sell, bucket, scales),
+        kwargs=dict(n_buckets=24, impl="xla", mesh=None),
+    )
+
+
+# -- registry ---------------------------------------------------------------
+
+def _v(dl, bf, nb, fy=None, extra: str = "") -> str:
+    out = f"dl{int(dl)}-bf{int(bf)}-nb{int(nb)}"
+    if fy is not None:
+        out += f"-fy{int(fy)}"
+    return out + extra
+
+
+def build_registry(grid: str = "default") -> List[ProgramSpec]:
+    """All program specs, deterministic order. ``grid="fast"`` keeps
+    each entry's base point only (the probes J4/J5/J6 need)."""
+    if grid not in ("default", "fast"):
+        raise ValueError(f"unknown grid '{grid}' (default|fast)")
+    from dgen_tpu.models.simulation import year_step
+    from dgen_tpu.ops import billpallas
+    from dgen_tpu.ops.sizing import size_agents
+    from dgen_tpu.serve.engine import query_program
+    from dgen_tpu.sweep.driver import sweep_year_step
+
+    ys_anchor = anchor_for(year_step)
+    specs: List[ProgramSpec] = []
+
+    # year_step: full cartesian over the static-config grid. The base
+    # point carries the first-year probe, the steady-repeat probe
+    # (year 1 vs year 2 must be the SAME program — the one-compile-
+    # per-group invariant RetraceGuard enforces at runtime) and the
+    # J6 cost fingerprint.
+    base = (False, False, True)
+    points = (
+        [(dl, bf, nb)
+         for dl in (False, True) for bf in (False, True)
+         for nb in (True, False)]
+        if grid == "default" else [base]
+    )
+    for dl, bf, nb in points:
+        is_base = (dl, bf, nb) == base
+        specs.append(ProgramSpec(
+            entry="year_step", variant=_v(dl, bf, nb, fy=False),
+            build=partial(_year_step_bound, dl, bf, nb, False, 1),
+            steady=(
+                partial(_year_step_bound, dl, bf, nb, False, 2)
+                if is_base else None
+            ),
+            anchor=ys_anchor, donate_args=(4,), cost=is_base,
+        ))
+        if is_base:
+            specs.append(ProgramSpec(
+                entry="year_step", variant=_v(dl, bf, nb, fy=True),
+                build=partial(_year_step_bound, dl, bf, nb, True, 0),
+                anchor=ys_anchor, donate_args=(4,),
+            ))
+
+    # streaming-scan variant (agent_chunk): the program national runs
+    # actually compile
+    specs.append(ProgramSpec(
+        entry="year_step_chunked", variant="dl0-bf0-nb1-fy0",
+        build=partial(
+            _year_step_bound, False, False, True, False, 1, AUDIT_CHUNK
+        ),
+        anchor=ys_anchor, donate_args=(4,), cost=True,
+    ))
+
+    # sweep vmap mode (scenario axis S=2)
+    sw_anchor = anchor_for(sweep_year_step)
+    sweep_points = (
+        [(True, False), (False, False), (True, True)]
+        if grid == "default" else [(True, False)]
+    )
+    for nb, bf in sweep_points:
+        is_base = (nb, bf) == (True, False)
+        specs.append(ProgramSpec(
+            entry="sweep_year_step", variant=_v(False, bf, nb, fy=False),
+            build=partial(_sweep_bound, nb, bf, False, 1),
+            steady=(
+                partial(_sweep_bound, nb, bf, False, 2)
+                if is_base else None
+            ),
+            anchor=sw_anchor, donate_args=(4,), cost=is_base,
+        ))
+
+    # sweep loop mode: scenario-major over the SAME compiled
+    # single-scenario year_step — audited as a fingerprint-identity
+    # cross-check through the REAL with_inputs sibling path (a drift
+    # in how siblings construct their step kwargs would compile one
+    # extra program PER SCENARIO, which J5 reports here)
+    specs.append(ProgramSpec(
+        entry="sweep_loop", variant="dl0-bf0-nb1-fy0",
+        build=partial(_sweep_loop_bound, 1),
+        anchor=sw_anchor, donate_args=(4,),
+        expect_same_as="year_step@dl0-bf0-nb1-fy0",
+    ))
+
+    # serve query program (net_billing pinned True by the engine)
+    q_anchor = anchor_for(query_program)
+    serve_points = (
+        [False, True] if grid == "default" else [False]
+    )
+    for dl in serve_points:
+        is_base = not dl
+        specs.append(ProgramSpec(
+            entry="serve_query", variant=_v(dl, False, True),
+            build=partial(_serve_bound, dl, 0),
+            steady=partial(_serve_bound, dl, 1) if is_base else None,
+            anchor=q_anchor, cost=is_base,
+        ))
+
+    # standalone sizing engine
+    sz_anchor = anchor_for(size_agents)
+    size_points = (
+        [(True, False, False), (False, False, False),
+         (True, True, False), (True, False, True)]
+        if grid == "default" else [(True, False, False)]
+    )
+    for nb, dl, bf in size_points:
+        is_base = (nb, dl, bf) == (True, False, False)
+        specs.append(ProgramSpec(
+            entry="size_agents", variant=_v(dl, bf, nb),
+            build=partial(_size_agents_bound, nb, dl, bf),
+            anchor=sz_anchor, cost=is_base,
+        ))
+
+    # bill kernels (XLA engine pinned: the audit fingerprints must not
+    # depend on which backend happens to trace them)
+    k_anchor = anchor_for(billpallas.import_sums)
+    kernel_points = (
+        [(False, False), (True, False), (False, True)]
+        if grid == "default" else [(False, False)]
+    )
+    for layout_on, bf in kernel_points:
+        is_base = (layout_on, bf) == (False, False)
+        specs.append(ProgramSpec(
+            entry="import_sums",
+            variant=f"layout{int(layout_on)}-bf{int(bf)}",
+            build=partial(_import_sums_bound, layout_on, bf),
+            anchor=k_anchor, cost=is_base,
+        ))
+    if grid == "default":
+        specs.append(ProgramSpec(
+            entry="import_sums_pair", variant="layout0-bf0",
+            build=_import_sums_pair_bound,
+            anchor=anchor_for(billpallas.import_sums_pair),
+        ))
+    specs.append(ProgramSpec(
+        entry="bucket_sums", variant="layout0-bf0",
+        build=_bucket_sums_bound,
+        anchor=anchor_for(billpallas.bucket_sums), cost=True,
+    ))
+    return specs
+
+
+def entry_names(grid: str = "default") -> List[str]:
+    seen: List[str] = []
+    for s in build_registry(grid):
+        if s.entry not in seen:
+            seen.append(s.entry)
+    return seen
+
+
+def select_entries(
+    specs: List[ProgramSpec], entries: Optional[List[str]]
+) -> List[ProgramSpec]:
+    if not entries:
+        return specs
+    known = {s.entry for s in specs}
+    unknown = [e for e in entries if e not in known]
+    if unknown:
+        raise ValueError(
+            f"unknown program entries: {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(known))})"
+        )
+    chosen = [s for s in specs if s.entry in entries]
+    # keep J5 cross-references resolvable — but a pulled-in spec the
+    # user did not select is audited for fingerprint identity ONLY:
+    # stripping its cost flag keeps it out of the J6 gate and out of
+    # any --update-baselines merge (docs/lint.md: a subset audit gates
+    # only the selected programs)
+    ids = {s.spec_id for s in chosen}
+    for s in specs:
+        if any(
+            c.expect_same_as == s.spec_id and s.spec_id not in ids
+            for c in chosen
+        ):
+            chosen.append(dataclasses.replace(s, cost=False))
+            ids.add(s.spec_id)
+    return chosen

@@ -1,0 +1,259 @@
+"""Program-audit specs: lowered-program bundles the J-rules run over.
+
+The AST half of dgenlint (rules L1-L11) sees *source shapes*; this
+module sees *compiled-program shapes*. A :class:`ProgramSpec` names one
+jitted entry point at one static-config grid point and knows how to
+build a TINY abstract invocation of it — a synthetic 64-agent
+population, 4 model years, 8 economics years — purely to TRACE and
+LOWER the program (``jax.jit(...).trace(...).lower()``): no device
+execution, no real data, CPU-only. The resulting
+:class:`ProgramAudit` carries everything the J-rules inspect:
+
+* the closed jaxpr (captured constants, primitive/aval walk — J1/J2/J3),
+* ``lowered.args_info`` (per-leaf donation flags — J4),
+* a location-stripped StableHLO fingerprint (compile-group identity —
+  J5),
+* and, for cost entries, ``compiled.cost_analysis()`` (flops /
+  bytes-accessed — the J6 baseline gate).
+
+The spec scale is deliberately fixed (:data:`AUDIT_N_AGENTS` etc.):
+cost fingerprints are only comparable against a baseline computed at
+the same shapes, so these constants are part of the baseline contract
+(bump :data:`AUDIT_SPEC_VERSION` when changing them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import inspect
+import re
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+#: bump when the abstract-spec shapes/config change — baselines are
+#: only comparable within one spec version
+AUDIT_SPEC_VERSION = "prog-audit-v1"
+
+AUDIT_N_AGENTS = 64
+AUDIT_STATES = ("DE", "CA")
+AUDIT_END_YEAR = 2020          # 2014..2020 step 2 -> 4 model years
+AUDIT_ECON_YEARS = 8
+AUDIT_SIZING_ITERS = 4
+AUDIT_CHUNK = 16               # streaming-scan variant: 64 agents / 16
+AUDIT_QUERY_BUCKET = 4         # serve bucket width audited
+AUDIT_SWEEP_S = 2              # scenario-axis width audited
+
+#: J1 default ceiling for any single constant captured into a program
+#: at audit scale. The sanctioned shared constants (month one-hots,
+#: daylight gather indices) stay well under it; a baked-in profile bank
+#: or agent-table leaf lands far over it. Per-spec overridable.
+MAX_CONST_BYTES = 1 << 20      # 1 MiB
+
+_LOC_RE = re.compile(r"loc\(.*?\)|#loc\d*(?: = .*)?$", re.MULTILINE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Bound:
+    """One concrete invocation to lower: ``fn.trace(*args, **kwargs)``.
+
+    ``fn`` must be a jit-wrapped callable; ``kwargs`` carries the
+    static arguments (hashable compile-time values)."""
+
+    fn: Any
+    args: tuple
+    kwargs: dict
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One (entry point, static-config grid point) to audit.
+
+    ``spec_id`` is ``entry@variant`` — stable across runs, used for J5
+    cross-references and J6 baseline keys. ``anchor`` is the (path,
+    line) findings attach to, which is where ``# dgenlint:
+    disable=J<n>`` suppression comments are honored (same mechanics as
+    the L-rules). ``donate_args``: positional indices of the traced
+    argument pytrees that MUST be donated (J4) — every leaf under them
+    donated, no leaf outside them donated. ``steady`` builds a second
+    invocation that models the next steady-state step (a later year
+    index); J5 requires it to lower to the identical program.
+    ``expect_same_as``: spec_id whose fingerprint this one must equal
+    (the loop-mode sweep's zero-extra-compile invariant). ``cost``
+    marks the J6 baseline entries.
+    """
+
+    entry: str
+    variant: str
+    build: Callable[[], Bound]
+    anchor: Tuple[str, int]
+    donate_args: Tuple[int, ...] = ()
+    steady: Optional[Callable[[], Bound]] = None
+    expect_same_as: Optional[str] = None
+    cost: bool = False
+    max_const_bytes: int = MAX_CONST_BYTES
+
+    @property
+    def spec_id(self) -> str:
+        return f"{self.entry}@{self.variant}" if self.variant else self.entry
+
+
+@dataclasses.dataclass
+class ProgramAudit:
+    """A lowered :class:`ProgramSpec` plus everything the rules read."""
+
+    spec: ProgramSpec
+    jaxpr: Any                     # jax.core.ClosedJaxpr
+    args_info: Any                 # lowered.args_info (donation flags)
+    fingerprint: str               # sha256 of location-stripped StableHLO
+    steady_fingerprint: Optional[str]
+    const_bytes: int
+    oversized_consts: List[Tuple[tuple, str, int]]   # (shape, dtype, nbytes)
+    cost_analysis: Optional[Dict[str, float]]        # cost entries only
+    error: Optional[str] = None    # build/lower failure (itself a finding)
+
+
+def anchor_for(fn: Any) -> Tuple[str, int]:
+    """(source path, def line) of a (possibly jit-wrapped) callable —
+    the line J-findings attach to and where suppressions are read."""
+    target = inspect.unwrap(fn, stop=lambda f: False)
+    for cand in (target, getattr(fn, "__wrapped__", None), fn):
+        if cand is None:
+            continue
+        try:
+            path = inspect.getsourcefile(cand)
+            _, line = inspect.getsourcelines(cand)
+            if path:
+                return path, line
+        except (TypeError, OSError):
+            continue
+    return "<unknown>", 0
+
+
+def program_fingerprint(text: str) -> str:
+    """sha256 of the StableHLO module with location metadata stripped
+    (loc() spans carry source line numbers, which would make the
+    fingerprint churn on every unrelated edit above the entry)."""
+    return hashlib.sha256(_LOC_RE.sub("", text).encode()).hexdigest()
+
+
+def walk_jaxpr(closed) -> Iterator[Any]:
+    """Yield every eqn of a ClosedJaxpr, descending into sub-jaxprs
+    (pjit bodies, scan/cond/while branches, custom_* calls)."""
+    stack = [closed.jaxpr]
+    seen = set()
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        for eqn in j.eqns:
+            yield eqn
+            for p in eqn.params.values():
+                stack.extend(_subjaxprs(p))
+
+
+def _subjaxprs(p) -> List[Any]:
+    out = []
+    if hasattr(p, "jaxpr"):           # ClosedJaxpr
+        out.append(p.jaxpr)
+    elif hasattr(p, "eqns"):          # raw Jaxpr
+        out.append(p)
+    elif isinstance(p, (tuple, list)):
+        for q in p:
+            out.extend(_subjaxprs(q))
+    return out
+
+
+def eqn_avals(eqn) -> Iterator[Any]:
+    """All in/out avals of one eqn (literals included)."""
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            yield aval
+
+
+def _const_nbytes(c) -> int:
+    try:
+        return int(np.asarray(c).nbytes)
+    except (TypeError, ValueError):
+        return 0
+
+
+def lower_spec(spec: ProgramSpec, with_cost: bool = False) -> ProgramAudit:
+    """Trace + lower one spec (and its steady probe); compile only when
+    ``with_cost`` and the spec is a cost entry. Never executes."""
+    try:
+        bound = spec.build()
+        traced = bound.fn.trace(*bound.args, **bound.kwargs)
+        lowered = traced.lower()
+        text = lowered.as_text()
+        fp = program_fingerprint(text)
+        closed = traced.jaxpr
+        oversized = []
+        total = 0
+        for c in getattr(closed, "consts", ()):
+            nb = _const_nbytes(c)
+            total += nb
+            if nb > spec.max_const_bytes:
+                arr = np.asarray(c)
+                oversized.append((tuple(arr.shape), str(arr.dtype), nb))
+        steady_fp = None
+        if spec.steady is not None:
+            sb = spec.steady()
+            steady_fp = program_fingerprint(
+                sb.fn.trace(*sb.args, **sb.kwargs).lower().as_text()
+            )
+        cost = None
+        if with_cost and spec.cost:
+            ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            cost = {
+                "flops": float(ca.get("flops", 0.0)),
+                "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+                "transcendentals": float(ca.get("transcendentals", 0.0)),
+            }
+        return ProgramAudit(
+            spec=spec, jaxpr=closed, args_info=lowered.args_info,
+            fingerprint=fp, steady_fingerprint=steady_fp,
+            const_bytes=total, oversized_consts=oversized,
+            cost_analysis=cost,
+        )
+    except Exception as e:  # noqa: BLE001 — a spec that cannot even
+        # lower is itself a finding (J0), not an auditor crash
+        return ProgramAudit(
+            spec=spec, jaxpr=None, args_info=None, fingerprint="",
+            steady_fingerprint=None, const_bytes=0, oversized_consts=[],
+            cost_analysis=None,
+            error=f"{type(e).__name__}: {e}",
+        )
+
+
+def donated_partition(audit: ProgramAudit) -> Tuple[int, int, int]:
+    """(donated-in-expected, undonated-in-expected, donated-elsewhere)
+    leaf counts, per the spec's ``donate_args`` positions.
+
+    ``args_info`` mirrors the traced ``(args, kwargs)`` call tree with
+    per-leaf ``ArgInfo(aval, donated)``; static arguments do not
+    appear. The J4 contract is positional: every leaf under a declared
+    carry position donated, and nothing else (donating the resident
+    table would hand XLA the banks' buffers every year)."""
+    args, _kwargs = audit.args_info
+    expected = set(audit.spec.donate_args)
+    in_ok = in_bad = out_bad = 0
+    for i, sub in enumerate(args):
+        leaves = jax.tree.leaves(
+            sub, is_leaf=lambda x: hasattr(x, "donated")
+        )
+        for leaf in leaves:
+            if i in expected:
+                if getattr(leaf, "donated", False):
+                    in_ok += 1
+                else:
+                    in_bad += 1
+            elif getattr(leaf, "donated", False):
+                out_bad += 1
+    return in_ok, in_bad, out_bad

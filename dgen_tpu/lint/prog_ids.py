@@ -1,0 +1,21 @@
+"""Program-auditor rule ids and one-line summaries — deliberately
+jax-free: the default CLI half (``python -m dgen_tpu.lint``,
+``--list-rules`` included) must stay importable without jax
+(docs/lint.md). The rule *implementations* live in
+:mod:`dgen_tpu.lint.prog.jrules`, whose import chain pulls jax; that
+module builds its registry from this table so the two cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+PROGRAM_RULE_SUMMARIES: Dict[str, str] = {
+    "J0": "entry point fails to trace/lower",
+    "J1": "oversized constants captured into the program",
+    "J2": "dtype drift (f64 / low-precision accumulation)",
+    "J3": "host callbacks/transfers inside compiled code",
+    "J4": "carry donation verification (input_output_aliases)",
+    "J5": "compile-group fingerprint invariants",
+    "J6": "cost-fingerprint regression gate (baseline JSON)",
+}

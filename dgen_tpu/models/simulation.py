@@ -1090,7 +1090,14 @@ class Simulation:
         self.tariffs = tariffs
         self.inputs = inputs
 
-    def _step_kwargs(self, first_year: bool) -> dict:
+    def step_kwargs(self, first_year: bool) -> dict:
+        """The full :func:`year_step` argument set this run compiles
+        under — every static (compile-time) knob plus the traced-shape
+        controls. Public contract shared by the sweep driver (which
+        overrides ``net_billing``/``mesh`` per scenario group), bench,
+        and the program auditor (``dgen_tpu.lint.prog``), so the
+        program that gets AUDITED is byte-for-byte the program that
+        RUNS."""
         # Under a >1-device mesh the bucket-sums engine runs per-shard
         # via shard_map (billpallas._maybe_shard_agents), so the Pallas
         # kernel stays live on multi-chip TPU meshes.
@@ -1109,6 +1116,10 @@ class Simulation:
             net_billing=self._net_billing,
             daylight=self._daylight,
         )
+
+    #: legacy private alias — internal call sites (and tests that
+    #: monkeypatch the instance attribute) resolve through this name
+    _step_kwargs = step_kwargs
 
     def _hbm_check(self) -> Optional[dict]:
         """Modeled-vs-actual device memory: compare the chunk model's

@@ -827,8 +827,15 @@ def _check_buckets(n_buckets: int) -> None:
         )
 
 
-@partial(jax.jit,
-         static_argnames=("n_buckets", "impl", "bf16", "mesh", "layout"))
+#: compile-time arguments of the bucket-sums engines — the shared
+#: static vocabulary (like YEAR_STEP_STATIC_ARGNAMES /
+#: serve.engine.QUERY_STATIC_ARGNAMES): the program auditor
+#: (dgen_tpu.lint.prog) lowers these kernels over the same set, so the
+#: audited bill-kernel programs are the ones production compiles
+SUMS_STATIC_ARGNAMES = ("n_buckets", "impl", "bf16", "mesh", "layout")
+
+
+@partial(jax.jit, static_argnames=SUMS_STATIC_ARGNAMES)
 def import_sums(
     load: jax.Array,      # [N, 8760]
     gen: jax.Array,       # [N, 8760]
@@ -869,7 +876,9 @@ def import_sums(
     return imp[:, :, :n_buckets], imp[:, :, SELL_COL]
 
 
-@partial(jax.jit, static_argnames=("n_buckets", "impl", "mesh", "layout"))
+@partial(jax.jit, static_argnames=tuple(
+    n for n in SUMS_STATIC_ARGNAMES if n != "bf16"
+))
 def import_sums_pair(
     load: jax.Array,       # [N, 8760]
     gen: jax.Array,        # [N, 8760]
@@ -914,7 +923,9 @@ def import_sums_pair(
             imp_b[:, :, :n_buckets], imp_b[:, :, SELL_COL])
 
 
-@partial(jax.jit, static_argnames=("n_buckets", "impl", "mesh"))
+@partial(jax.jit, static_argnames=tuple(
+    n for n in SUMS_STATIC_ARGNAMES if n not in ("bf16", "layout")
+))
 def bucket_sums(
     load: jax.Array,
     gen: jax.Array,

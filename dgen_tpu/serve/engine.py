@@ -78,13 +78,35 @@ class QueryOutputs:
 QUERY_FIELDS = tuple(f.name for f in dataclasses.fields(QueryOutputs))
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "n_periods", "econ_years", "sizing_iters", "sizing_impl",
-        "rate_switch", "net_billing", "daylight",
-    ),
+#: compile-time arguments of :func:`query_program` — shared with the
+#: program auditor (dgen_tpu.lint.prog), whose serve entry lowers the
+#: same program over the same static vocabulary
+QUERY_STATIC_ARGNAMES = (
+    "n_periods", "econ_years", "sizing_iters", "sizing_impl",
+    "rate_switch", "net_billing", "daylight",
 )
+
+
+def query_static_kwargs(sim: "Simulation") -> dict:
+    """The serving static set for :func:`query_program` over a built
+    Simulation — ONE constructor shared by :class:`ServeEngine` and
+    the program auditor, so the audited serve program is byte-for-byte
+    the program production compiles. ``net_billing`` is pinned True:
+    an override can close a NEM gate the base scenario holds open, and
+    True is numerically exact either way (the False flag is only ever
+    a compile-time kernel skip)."""
+    return dict(
+        n_periods=sim.tariffs.max_periods,
+        econ_years=sim.econ_years,
+        sizing_iters=sim.run_config.sizing_iters,
+        sizing_impl="auto",
+        rate_switch=sim._rate_switch,
+        net_billing=True,
+        daylight=sim._daylight,
+    )
+
+
+@partial(jax.jit, static_argnames=QUERY_STATIC_ARGNAMES)
 def query_program(
     table,
     profiles,
@@ -257,15 +279,7 @@ class ServeEngine:
         for row in np.flatnonzero(mask):
             self._id_to_row.setdefault(int(ids[row]), int(row))
         self.n_agents = int(mask.sum())
-        self._static_kwargs = dict(
-            n_periods=sim.tariffs.max_periods,
-            econ_years=sim.econ_years,
-            sizing_iters=sim.run_config.sizing_iters,
-            sizing_impl="auto",
-            rate_switch=sim._rate_switch,
-            net_billing=True,
-            daylight=sim._daylight,
-        )
+        self._static_kwargs = query_static_kwargs(sim)
         self._override_cache: "OrderedDict[str, ScenarioInputs]" = (
             OrderedDict()
         )

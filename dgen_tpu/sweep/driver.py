@@ -284,7 +284,7 @@ class SweepSimulation:
                 lambda x: self.base._put(x, repl), inputs_s
             )
 
-        kwargs = self.base._step_kwargs(first_year=True)
+        kwargs = self.base.step_kwargs(first_year=True)
         kwargs["net_billing"] = group.net_billing
         # a 1-device mesh adds nothing inside a vmapped body (the
         # planner sends >1-device meshes to loop mode); dropping it

@@ -1,0 +1,47 @@
+"""J1 fixture: a profile-bank-sized constant baked into the program.
+
+The bank must ride as a traced ARGUMENT (uploaded once, shared by
+every executable); captured like this it is embedded per-program —
+HBM bloat and a compile-cache miss whenever its value changes. The
+suppressed twin shows the L-rule-style opt-out at the anchor line.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ~2.2 MiB — far over the audit's 1 MiB per-constant ceiling
+_BAKED_BANK = np.linspace(
+    0.0, 1.0, 64 * 8760, dtype=np.float32
+).reshape(64, 8760)
+
+
+@jax.jit
+def baked_bank_step(idx):
+    bank = jnp.asarray(_BAKED_BANK)     # captured as a program constant
+    return jnp.sum(bank[idx], axis=1)
+
+
+@jax.jit  # dgenlint: disable=J1  (fixture: reviewed opt-out at the anchor)
+def baked_bank_step_suppressed(idx):
+    bank = jnp.asarray(_BAKED_BANK)
+    return jnp.sum(bank[idx], axis=1)
+
+
+def specs():
+    """(flagged spec, suppressed spec) for the auditor tests."""
+    from dgen_tpu.lint.prog import Bound, ProgramSpec, anchor_for
+
+    idx = jnp.zeros(4, dtype=jnp.int32)
+    return (
+        ProgramSpec(
+            entry="fixture_j1", variant="",
+            build=lambda: Bound(baked_bank_step, (idx,), {}),
+            anchor=anchor_for(baked_bank_step),
+        ),
+        ProgramSpec(
+            entry="fixture_j1_suppressed", variant="",
+            build=lambda: Bound(baked_bank_step_suppressed, (idx,), {}),
+            anchor=anchor_for(baked_bank_step_suppressed),
+        ),
+    )

@@ -1,0 +1,59 @@
+"""J2 fixture: low-precision accumulation + (under x64) f64 drift.
+
+An 8760-term bf16 sum loses ~3 significant digits — the bf16-banks
+contract (PR 2) accumulates in f32 and only STORES at bank precision.
+``jnp.sum`` honors that automatically (it upcasts half-precision
+accumulators to f32), so the bad twin is the shape that BYPASSES the
+upcast: a hand-rolled ``lax.reduce`` / bf16 contraction, exactly what
+a "faster" custom bucket sum would reach for. The good twin shows the
+sanctioned idiom: accumulate f32, convert the stored result.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bf16_accumulate(x):
+    # hand-rolled bucket sum sidestepping jnp's f32 upcast:
+    # bf16-output reduce_sum in the jaxpr (flagged)
+    zero = jnp.zeros((), dtype=x.dtype)
+    return jax.lax.reduce(x, zero, jax.lax.add, (1,))
+
+
+@jax.jit
+def bf16_store_f32_accumulate(x):
+    # the sanctioned contract: f32 accumulate, bank-precision store
+    return jnp.sum(x.astype(jnp.float32), axis=1).astype(x.dtype)
+
+
+@jax.jit
+def f64_promote(x):
+    # only produces a f64 aval when x64 is enabled (the auditor test
+    # lowers this under jax.experimental.enable_x64)
+    return x.astype("float64") * 2.0
+
+
+def specs():
+    """(flagged bf16 spec, clean bf16 spec, f64 spec)."""
+    from dgen_tpu.lint.prog import Bound, ProgramSpec, anchor_for
+
+    x = jnp.zeros((4, 8760), dtype=jnp.bfloat16)
+    xf = jnp.zeros((4, 16), dtype=jnp.float32)
+    return (
+        ProgramSpec(
+            entry="fixture_j2_bf16", variant="",
+            build=lambda: Bound(bf16_accumulate, (x,), {}),
+            anchor=anchor_for(bf16_accumulate),
+        ),
+        ProgramSpec(
+            entry="fixture_j2_clean", variant="",
+            build=lambda: Bound(bf16_store_f32_accumulate, (x,), {}),
+            anchor=anchor_for(bf16_store_f32_accumulate),
+        ),
+        ProgramSpec(
+            entry="fixture_j2_f64", variant="",
+            build=lambda: Bound(f64_promote, (xf,), {}),
+            anchor=anchor_for(f64_promote),
+        ),
+    )

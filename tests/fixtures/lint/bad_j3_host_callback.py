@@ -1,0 +1,30 @@
+"""J3 fixture: a host callback embedded in compiled code.
+
+`jax.debug.print` lowers to a `debug_callback` primitive — every
+dispatch of the program fences on a host round-trip (the runtime cost
+L8 warns about at the source level, observed here in the jaxpr).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def callback_step(x):
+    # suppressed for the SOURCE rule so this fixture isolates the
+    # lowered-program rule (J3)
+    jax.debug.print("sum={s}", s=jnp.sum(x))  # dgenlint: disable=L8
+    return x * 2.0
+
+
+def specs():
+    from dgen_tpu.lint.prog import Bound, ProgramSpec, anchor_for
+
+    x = jnp.zeros((8,), dtype=jnp.float32)
+    return (
+        ProgramSpec(
+            entry="fixture_j3", variant="",
+            build=lambda: Bound(callback_step, (x,), {}),
+            anchor=anchor_for(callback_step),
+        ),
+    )

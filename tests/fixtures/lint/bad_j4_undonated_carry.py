@@ -1,0 +1,40 @@
+"""J4 fixture: a step program that threads a carry without donating it
+(the lowered-program twin of the AST rule L7 — here the check is on
+``lowered.args_info``, so even a donation declared-but-dropped by a
+wrapper would be caught), plus a wrong donation TARGET: donating the
+resident table hands its buffers to XLA while later steps still read
+them.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step_impl(table, carry):
+    return carry + jnp.mean(table)
+
+
+step_no_donate = jax.jit(_step_impl)
+step_donates_table = jax.jit(_step_impl, donate_argnums=(0,))
+
+
+def specs():
+    """(undonated-carry spec, wrong-target spec)."""
+    from dgen_tpu.lint.prog import Bound, ProgramSpec, anchor_for
+
+    table = jnp.zeros((16,), dtype=jnp.float32)
+    carry = jnp.zeros((16,), dtype=jnp.float32)
+    return (
+        ProgramSpec(
+            entry="fixture_j4", variant="",
+            build=lambda: Bound(step_no_donate, (table, carry), {}),
+            anchor=anchor_for(step_no_donate),
+            donate_args=(1,),
+        ),
+        ProgramSpec(
+            entry="fixture_j4_wrong_target", variant="",
+            build=lambda: Bound(step_donates_table, (table, carry), {}),
+            anchor=anchor_for(step_donates_table),
+            donate_args=(1,),
+        ),
+    )

@@ -1,0 +1,32 @@
+"""J5 fixture: a driver whose steady-state steps lower to DIFFERENT
+programs — here because the per-year invocation shape churns (the
+static-config analogue of a retrace storm; RetraceGuard would fail
+this at year 3, the auditor fails it before any hardware run).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def churning_step(x):
+    return x * 2.0
+
+
+def specs():
+    from dgen_tpu.lint.prog import Bound, ProgramSpec, anchor_for
+
+    return (
+        ProgramSpec(
+            entry="fixture_j5", variant="",
+            # year N runs at [64]; year N+1 at [128]: one fresh
+            # compile per steady-state year
+            build=lambda: Bound(
+                churning_step, (jnp.zeros(64, jnp.float32),), {}
+            ),
+            steady=lambda: Bound(
+                churning_step, (jnp.zeros(128, jnp.float32),), {}
+            ),
+            anchor=anchor_for(churning_step),
+        ),
+    )

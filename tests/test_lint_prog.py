@@ -1,0 +1,373 @@
+"""dgenlint-prog tests: every J-rule with a positive (known-bad
+program -> finding) and negative (sanctioned idiom -> clean) case via
+the fixture programs, suppression at the anchor line, the donation
+check against the REAL year_step, the J6 baseline gate failing on an
+injected cost regression, CLI plumbing, and — the enforcement
+contract — the full entry-point registry auditing green."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from dgen_tpu.lint import prog
+from dgen_tpu.lint.prog import baseline as baseline_mod
+from dgen_tpu.lint.prog import lower_spec, run_program_rules
+from dgen_tpu.lint.prog.registry import build_registry
+from dgen_tpu.lint.prog.spec import donated_partition
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "lint"
+)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fixture(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(FIXTURES, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# J1 — oversized captured constants (+ suppression mechanics)
+# ---------------------------------------------------------------------------
+
+def test_j1_positive_and_suppressed():
+    flagged, suppressed = _fixture("bad_j1_baked_constant").specs()
+    findings = run_program_rules([lower_spec(flagged)])
+    assert rules_of(findings) == {"J1"}
+    assert "captured constant" in findings[0].message
+    # same program, `# dgenlint: disable=J1` at the anchor line
+    assert run_program_rules([lower_spec(suppressed)]) == []
+
+
+# ---------------------------------------------------------------------------
+# J2 — dtype drift
+# ---------------------------------------------------------------------------
+
+def test_j2_bf16_accumulation_flagged_f32_store_clean():
+    bad, clean, _f64 = _fixture("bad_j2_bf16_accum").specs()
+    findings = run_program_rules([lower_spec(bad)])
+    assert rules_of(findings) == {"J2"}
+    assert "bfloat16" in findings[0].message
+    assert run_program_rules([lower_spec(clean)]) == []
+
+
+def test_j2_f64_under_x64():
+    from jax.experimental import enable_x64
+
+    _bad, _clean, f64 = _fixture("bad_j2_bf16_accum").specs()
+    with enable_x64():
+        audit = lower_spec(f64)
+    findings = [
+        f for f in run_program_rules([audit]) if "float64" in f.message
+    ]
+    assert findings and findings[0].rule == "J2"
+
+
+# ---------------------------------------------------------------------------
+# J3 — host callbacks in compiled code
+# ---------------------------------------------------------------------------
+
+def test_j3_callback_flagged():
+    (spec,) = _fixture("bad_j3_host_callback").specs()
+    findings = run_program_rules([lower_spec(spec)])
+    assert rules_of(findings) == {"J3"}
+    assert "debug_callback" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# J4 — donation verification
+# ---------------------------------------------------------------------------
+
+def test_j4_undonated_and_wrong_target():
+    no_donate, wrong_target = _fixture("bad_j4_undonated_carry").specs()
+    findings = run_program_rules([lower_spec(no_donate)])
+    assert rules_of(findings) == {"J4"}
+    assert "NOT donated" in findings[0].message
+    findings = run_program_rules([lower_spec(wrong_target)])
+    # the carry is still undonated AND the table is wrongly donated
+    msgs = " ".join(f.message for f in findings)
+    assert "OUTSIDE the declared carry" in msgs
+
+
+def test_j4_real_year_step_donates_exactly_the_carry():
+    """The repo contract, verified on the lowered REAL program: every
+    SimCarry leaf donated, nothing else (table/banks/inputs stay
+    resident)."""
+    spec = next(
+        s for s in build_registry("fast")
+        if s.spec_id == "year_step@dl0-bf0-nb1-fy0"
+    )
+    audit = lower_spec(spec)
+    assert audit.error is None
+    in_ok, in_bad, out_bad = donated_partition(audit)
+    assert in_bad == 0 and out_bad == 0
+    assert in_ok == 10  # MarketState's 9 leaves + batt_adopters_cum
+
+
+# ---------------------------------------------------------------------------
+# J5 — compile-group fingerprints
+# ---------------------------------------------------------------------------
+
+def test_j5_shape_churn_flagged():
+    (spec,) = _fixture("bad_j5_shape_churn").specs()
+    findings = run_program_rules([lower_spec(spec)])
+    assert rules_of(findings) == {"J5"}
+    assert "DIFFERENT program" in findings[0].message
+
+
+def test_j5_real_year_step_steady_state_is_one_program():
+    spec = next(
+        s for s in build_registry("fast")
+        if s.spec_id == "year_step@dl0-bf0-nb1-fy0"
+    )
+    audit = lower_spec(spec)
+    assert audit.steady_fingerprint == audit.fingerprint
+
+
+# ---------------------------------------------------------------------------
+# J6 — the cost-fingerprint regression gate
+# ---------------------------------------------------------------------------
+
+def _import_sums_audits():
+    specs = [
+        s for s in build_registry("fast") if s.entry == "import_sums"
+    ]
+    return [lower_spec(s, with_cost=True) for s in specs]
+
+
+@pytest.fixture(scope="module")
+def cost_audits():
+    return _import_sums_audits()
+
+
+def _doctored_baseline(audits, **overrides):
+    doc = {
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "spec": prog.AUDIT_SPEC_VERSION,
+        "tolerance": 0.02,
+        "entries": {},
+    }
+    for spec_id, fp in baseline_mod.collect_fingerprints(audits).items():
+        doc["entries"][spec_id] = dict(fp, **overrides)
+    return doc
+
+
+def test_j6_gate_fails_on_injected_cost_regression(cost_audits):
+    """The acceptance-criterion drill: against a baseline recorded at
+    HALF the flops, the current program reads as a 2x cost growth and
+    the gate must fail."""
+    doc = _doctored_baseline(cost_audits)
+    for e in doc["entries"].values():
+        e["flops"] = e["flops"] / 2.0
+    findings, status = baseline_mod.compare_to_baseline(cost_audits, doc)
+    assert findings and all(f.rule == "J6" for f in findings)
+    assert any("grew" in f.message for f in findings)
+    assert status["note"] is None
+
+
+def test_j6_gate_flags_shrink_and_const_growth(cost_audits):
+    doc = _doctored_baseline(cost_audits)
+    for e in doc["entries"].values():
+        e["bytes_accessed"] = e["bytes_accessed"] * 2.0   # we "shrank"
+        e["const_bytes"] = 0                              # consts "grew"
+    findings, _status = baseline_mod.compare_to_baseline(cost_audits, doc)
+    msgs = " ".join(f.message for f in findings)
+    assert "shrank" in msgs
+    assert "captured-constant bytes grew" in msgs
+
+
+def test_j6_gate_clean_against_faithful_baseline(cost_audits):
+    doc = _doctored_baseline(cost_audits)
+    findings, status = baseline_mod.compare_to_baseline(cost_audits, doc)
+    assert findings == []
+    assert status["deltas"]
+
+
+def test_j6_gate_skips_on_environment_mismatch(cost_audits):
+    doc = _doctored_baseline(cost_audits)
+    doc["jax"] = "0.0.0-not-this-one"
+    for e in doc["entries"].values():
+        e["flops"] = 1.0    # wildly wrong, but not comparable
+    findings, status = baseline_mod.compare_to_baseline(cost_audits, doc)
+    assert findings == []
+    assert "skipped" in status["note"]
+
+
+def test_j6_gate_flags_missing_and_stale_entries(cost_audits):
+    doc = _doctored_baseline(cost_audits)
+    doc["entries"]["ghost_entry@dl0"] = {"flops": 1.0, "bytes_accessed": 1.0}
+    (first_key,) = [k for k in list(doc["entries"]) if "import_sums" in k]
+    del doc["entries"][first_key]
+    findings, _status = baseline_mod.compare_to_baseline(cost_audits, doc)
+    msgs = " ".join(f.message for f in findings)
+    assert "no committed cost baseline" in msgs
+    assert "no longer produced" in msgs
+
+
+def test_j6_partial_audit_skips_stale_sweep_and_merges(tmp_path, cost_audits):
+    """An --entries subset must neither flag the deselected programs
+    as stale nor delete them on --update-baselines."""
+    doc = _doctored_baseline(cost_audits)
+    doc["entries"]["year_step@dl0-bf0-nb1-fy0"] = {
+        "flops": 1.0, "bytes_accessed": 1.0, "const_bytes": 0,
+    }
+    findings, _status = baseline_mod.compare_to_baseline(
+        cost_audits, doc, partial=True
+    )
+    assert findings == []   # the deselected entry is not "stale"
+
+    path = str(tmp_path / "prog_baseline.json")
+    baseline_mod.update_baseline(path, cost_audits)
+    with open(path, encoding="utf-8") as f:
+        before = json.load(f)
+    before["entries"]["year_step@dl0-bf0-nb1-fy0"] = {"flops": 1.0}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(before, f)
+    merged = baseline_mod.update_baseline(path, cost_audits, partial=True)
+    assert "year_step@dl0-bf0-nb1-fy0" in merged["entries"]
+
+    # ...but a partial merge across environments is refused (the
+    # untouched entries would be incomparable with the fresh ones)
+    before["jax"] = "0.0.0-not-this-one"
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(before, f)
+    with pytest.raises(ValueError, match="partial baseline update"):
+        baseline_mod.update_baseline(path, cost_audits, partial=True)
+
+
+def test_j6_cli_entries_subset_gates_green():
+    """The documented targeted invocation must pass on a clean tree
+    (the full committed baseline contains entries the subset does not
+    produce)."""
+    findings, status = prog.audit_programs(
+        entries=["import_sums"], grid="fast"
+    )
+    stale = [f for f in findings if "no longer produced" in f.message]
+    assert stale == []
+    if status["j6"].get("note") is None:   # comparable environment
+        assert findings == []
+
+
+def test_entries_subset_does_not_cost_gate_pulled_in_crossrefs(tmp_path):
+    """sweep_loop pulls in year_step for the J5 identity check, but an
+    --entries=sweep_loop run must not J6-gate (or, with
+    --update-baselines, refresh) year_step's committed fingerprint."""
+    path = str(tmp_path / "prog_baseline.json")
+    findings, report = prog.audit_programs(
+        entries=["sweep_loop"], grid="fast",
+        baseline_path=path, update_baselines=True,
+    )
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert not any(
+        "year_step" in k for k in report["j6"]["fingerprints"]
+    )
+
+
+def test_j6_update_baseline_roundtrip(tmp_path, cost_audits):
+    path = str(tmp_path / "prog_baseline.json")
+    doc = baseline_mod.update_baseline(path, cost_audits)
+    with open(path, encoding="utf-8") as f:
+        on_disk = json.load(f)
+    assert on_disk == doc
+    findings, _status = baseline_mod.compare_to_baseline(
+        cost_audits, on_disk
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the enforcement contract: the registry audits green
+# ---------------------------------------------------------------------------
+
+def test_registry_audits_green():
+    """The full entry-point registry (every entry's base grid point)
+    lowers and passes J0-J5 — the same invariant `tools/check.sh` and
+    the CI fast tier gate at full grid depth with the J6 baseline."""
+    findings, report = prog.audit_programs(grid="fast", with_cost=False)
+    assert findings == [], "\n".join(str(f) for f in findings)
+    expected = {
+        "year_step", "year_step_chunked", "sweep_year_step",
+        "sweep_loop", "serve_query", "size_agents", "import_sums",
+        "bucket_sums",
+    }
+    assert expected <= set(report["entries"])
+    for name, e in report["entries"].items():
+        assert e["failed"] == 0, name
+        # the one-compile-per-group invariant, statically predicted
+        assert e["predicted_compile_groups"] <= e["variants"], name
+
+
+@pytest.mark.slow
+def test_registry_full_grid_with_baseline_gate():
+    """Full static-config grid + the committed J6 baseline (skips the
+    cost compare automatically under a different jax version)."""
+    findings, report = prog.audit_programs(grid="default")
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert report["n_programs"] >= 20
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing
+# ---------------------------------------------------------------------------
+
+def test_cli_list_programs_and_rules():
+    out = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.lint", "--list-programs"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0
+    assert "year_step" in out.stdout and "import_sums" in out.stdout
+
+    rules = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.lint", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert rules.returncode == 0
+    for rule in ("J1", "J6"):
+        assert rule in rules.stdout
+
+
+def test_cli_unknown_entry_is_usage_error():
+    with pytest.raises(ValueError, match="unknown program entries"):
+        prog.audit_programs(entries=["nope"], grid="fast")
+
+
+def test_update_baselines_with_select_excluding_j6_is_an_error():
+    """An explicitly requested baseline write must never be a silent
+    no-op."""
+    with pytest.raises(ValueError, match="update-baselines requires"):
+        prog.audit_programs(
+            select=["J1"], update_baselines=True, grid="fast"
+        )
+
+
+def test_errored_entry_is_not_reported_as_stale_baseline(cost_audits):
+    """A spec that fails to lower is a J0 finding; its committed cost
+    gate must not be reported as stale (deleting it would be exactly
+    wrong)."""
+    from dgen_tpu.lint.prog import ProgramSpec
+
+    doc = _doctored_baseline(cost_audits)
+    broken = ProgramSpec(
+        entry="import_sums", variant="layout0-bf0",
+        build=lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+        anchor=("<fixture>", 1), cost=True,
+    )
+    audit = lower_spec(broken, with_cost=True)
+    assert audit.error is not None
+    findings, _status = baseline_mod.compare_to_baseline([audit], doc)
+    assert not any("no longer produced" in f.message for f in findings)

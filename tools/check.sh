@@ -17,6 +17,13 @@ rc=0
 if [ "$mode" != "--test-only" ]; then
     echo "== dgenlint (python -m dgen_tpu.lint) =="
     python -m dgen_tpu.lint || rc=1
+    # style baseline: pyflakes + import order only (see [tool.ruff] in
+    # pyproject.toml); advisory if ruff is absent. Lives in the LINT
+    # block — `--lint-only` (the CI fast tier's gate) must not skip it.
+    if command -v ruff >/dev/null 2>&1; then
+        echo "== ruff (pyflakes + import order) =="
+        ruff check dgen_tpu tests tools || rc=1
+    fi
     # the sweep subsystem is inside the default lint root already; an
     # explicit pass keeps it gated even if the default root narrows
     echo "== dgenlint (dgen_tpu/sweep) =="
@@ -40,6 +47,13 @@ if [ "$mode" != "--test-only" ]; then
     echo "== dgenlint L11 (crash-consistent artifact writes) =="
     python -m dgen_tpu.lint --select L11 \
         dgen_tpu/io dgen_tpu/sweep dgen_tpu/resilience || rc=1
+    # program auditor (docs/lint.md "The program auditor"): every
+    # jitted entry point traced + lowered over the static-config grid
+    # on the CPU backend (no devices, no data) — rules J0-J5 over the
+    # jaxprs/StableHLO plus the J6 cost-fingerprint gate against
+    # tools/prog_baseline.json
+    echo "== dgenlint-prog (python -m dgen_tpu.lint --programs) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.lint --programs || rc=1
     # supervisor smoke drill (docs/resilience.md): one injected
     # mid-run failure + one injected checkpoint-save failure must be
     # retried/resumed with bit-exact artifacts and a verifying
@@ -51,13 +65,6 @@ if [ "$mode" != "--test-only" ]; then
 fi
 
 if [ "$mode" != "--lint-only" ]; then
-    # optional style baseline: pyflakes + import order only (see
-    # [tool.ruff] in pyproject.toml); advisory if ruff is absent
-    if command -v ruff >/dev/null 2>&1; then
-        echo "== ruff (pyflakes + import order) =="
-        ruff check dgen_tpu tests || rc=1
-    fi
-
     # tier-1 ('not slow') includes the fast sweep tests
     # (tests/test_sweep.py) — the push gate covers the sweep engine
     echo "== tier-1 tests (ROADMAP.md) =="

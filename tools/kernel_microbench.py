@@ -30,7 +30,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from dgen_tpu.ops import billpallas as bp
+from dgen_tpu.ops import billpallas as bp  # noqa: E402  (needs the path hack)
 
 H = 8760
 H_PAD = bp.H_PAD

@@ -67,6 +67,14 @@ Knobs (env):
                           throughput, batch occupancy and p50/p99
                           request latency into the payload (the
                           trajectory's first latency numbers)
+  DGEN_TPU_BENCH_FLEET    <N>: boot an N-replica serving fleet behind
+                          the routing front (dgen_tpu.serve.fleet),
+                          drive closed-loop HTTP load through it, and
+                          SIGKILL one replica mid-load — stamps
+                          replica count, boot walls, the failover
+                          recovery wall, shed rate, and client
+                          p50/p99 THROUGH the failure into the
+                          payload (docs/serve.md "Fleet operations")
   DGEN_TPU_BENCH_ASYNC    1: A/B the background host-IO pipeline
                           (io.hostio) — the SAME export+checkpoint run
                           with the pipeline on vs the serialized
@@ -115,6 +123,9 @@ _BENCH_FAULTS = os.environ.get(
 _BENCH_SERVE = os.environ.get("DGEN_TPU_BENCH_SERVE", "").strip()
 if _BENCH_SERVE in ("0", "false"):
     _BENCH_SERVE = ""
+_BENCH_FLEET = os.environ.get("DGEN_TPU_BENCH_FLEET", "").strip()
+if _BENCH_FLEET in ("0", "false"):
+    _BENCH_FLEET = ""
 
 
 def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
@@ -533,6 +544,158 @@ def _serve_bench(
         "batch_occupancy": stats.get("batch_occupancy"),
         "batches": stats.get("batches"),
         "rejected": stats.get("rejected"),
+    }
+
+
+def _fleet_bench(
+    n_agents: int, n_replicas: int, duration_s: float = 10.0
+) -> dict:
+    """Fleet load + failover bench: boot N replica processes behind
+    the routing front (shared AOT compile cache; boot walls stamped),
+    drive closed-loop HTTP clients through the front, SIGKILL one
+    replica a third of the way in, and report what the *client* saw
+    through the failure — achieved QPS, shed rate (503 fraction), and
+    p50/p99 request latency with retries included — plus the
+    supervisor's measured recovery wall (death -> READY again)."""
+    import http.client
+    import signal as _signal
+    import threading
+
+    from dgen_tpu.config import FleetConfig
+    from dgen_tpu.serve.fleet import ReplicaSupervisor, default_replica_cmd
+    from dgen_tpu.serve.front import (
+        FleetFront,
+        drain_front,
+        start_front_in_thread,
+    )
+
+    agents = min(n_agents, 8192)
+    serve_args = [
+        "--agents", str(agents), "--end-year", "2022",
+        "--max-batch", "64", "--max-wait-ms", "2",
+    ]
+    cfg = FleetConfig(
+        n_replicas=n_replicas, port=0, poll_interval_s=0.1,
+        request_timeout_s=5.0, breaker_failures=2,
+        breaker_cooldown_s=0.5, retry_after_s=0.0,
+    )
+    t0 = time.time()
+    sup = ReplicaSupervisor(default_replica_cmd(serve_args), cfg).start()
+    try:
+        booted = sup.wait_ready(timeout=600.0)
+        boot_wall_s = time.time() - t0
+        boot_walls = {h.index: round(h.boot_wall_s or 0.0, 2)
+                      for h in sup.ready_handles()}
+        front = FleetFront(sup, cfg).start()
+        srv = start_front_in_thread(front)
+        port = srv.server_address[1]
+
+        stop_at = time.time() + duration_s
+        kill_at = time.time() + duration_s / 3.0
+        killed = [False]
+        lats: list = []
+        shed = [0]        # real 503s: load shedding / drain / unrouted
+        conn_fail = [0]   # transport failures (dropped connections)
+        done = [0]
+        lock = threading.Lock()
+        rng_years = list(range(2014, 2023))
+
+        def client(ci: int) -> None:
+            from dgen_tpu.serve.fleet import HTTP_ERRORS
+
+            rng = np.random.default_rng(ci)
+            while time.time() < stop_at:
+                if not killed[0] and time.time() >= kill_at:
+                    killed[0] = True
+                    sup.terminate_replica(0, _signal.SIGKILL)
+                body = json.dumps({
+                    "agent_ids": [int(rng.integers(0, agents))],
+                    "year": int(
+                        rng_years[int(rng.integers(0, len(rng_years)))]),
+                }).encode()
+                t_req = time.monotonic()
+                status = -1
+                while time.time() < stop_at:
+                    try:
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=15.0)
+                        try:
+                            conn.request("POST", "/query", body=body)
+                            r = conn.getresponse()
+                            status = r.status
+                            r.read()
+                        finally:
+                            conn.close()
+                    except HTTP_ERRORS:
+                        status = -1
+                    if status != 503 and status != -1:
+                        break
+                    # 503 = the fleet shed/drained; -1 = a dropped
+                    # connection — distinct stamps: shed_rate must
+                    # measure load shedding, not transport failures
+                    with lock:
+                        if status == 503:
+                            shed[0] += 1
+                        else:
+                            conn_fail[0] += 1
+                    time.sleep(0.05)
+                with lock:
+                    lats.append(time.monotonic() - t_req)
+                    if status == 200:
+                        done[0] += 1
+
+        n_clients = max(2, min(16, n_replicas * 4))
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration_s + 120.0)
+        elapsed = time.time() - t0
+        recovered = sup.wait_ready(timeout=120.0)
+        recovery_s = sup.replicas[0].last_recovery_s
+        mz = front.metricz()
+        drain_front(front, srv)
+        srv.server_close()
+    finally:
+        # no bench failure may leak replica subprocesses; idempotent
+        # after the drain above
+        sup.stop(drain=False, timeout=10.0)
+    arr = np.asarray(sorted(lats), dtype=np.float64)
+    total_attempts = len(lats) + shed[0] + conn_fail[0]
+    return {
+        "replicas": n_replicas,
+        "agents": agents,
+        "clients": n_clients,
+        "booted": booted,
+        "boot_wall_s": round(boot_wall_s, 2),
+        "replica_boot_walls_s": boot_walls,
+        "duration_s": round(elapsed, 2),
+        "requests": done[0],
+        "qps_achieved": round(done[0] / max(elapsed, 1e-9), 1),
+        "failover": {
+            "killed_replica": 0,
+            "recovered_full_strength": recovered,
+            "recovery_wall_s": (
+                round(recovery_s, 3) if recovery_s is not None else None),
+            "restart_boot_wall_s": (
+                round(sup.replicas[0].boot_wall_s, 3)
+                if sup.replicas[0].boot_wall_s is not None else None),
+        },
+        "shed_503": shed[0],
+        "shed_rate": round(shed[0] / max(total_attempts, 1), 4),
+        "conn_failures": conn_fail[0],
+        "latency_through_failure_s": {
+            "p50": round(float(np.percentile(arr, 50)), 4)
+            if arr.size else None,
+            "p99": round(float(np.percentile(arr, 99)), 4)
+            if arr.size else None,
+            "max": round(float(arr.max()), 4) if arr.size else None,
+        },
+        "front": {k: mz.get(k) for k in (
+            "retries", "forward_failures", "unrouted", "shed",
+            "occupancy_weighted")},
     }
 
 
@@ -1006,6 +1169,24 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["serve"] = {
                     "qps_target": qps,
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- fleet failover bench (DGEN_TPU_BENCH_FLEET=<N>): N replicas
+    # behind the routing front, one SIGKILLed mid-load — boot walls,
+    # recovery wall, shed rate and p50/p99 THROUGH the failure
+    # (docs/serve.md "Fleet operations") ---
+    if _BENCH_FLEET:
+        n_rep = int(_BENCH_FLEET)
+        if not spendable(point_est + 120.0):
+            skipped["fleet"] = "budget"
+        else:
+            try:
+                payload["fleet"] = _fleet_bench(n_agents, n_rep)
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["fleet"] = {
+                    "replicas": n_rep,
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

@@ -225,6 +225,14 @@ class ServeConfig:
     #: request ever pays a compile (RunConfig.guard_retrace then holds
     #: from the first query on)
     warmup: bool = True
+    #: per-request deadline: a handler waits at most this long on the
+    #: batcher future before answering 504 (a hung device program must
+    #: cost one bounded request, never a wedged handler thread)
+    request_timeout_s: float = 60.0
+    #: per-connection socket timeout: a client that never finishes
+    #: sending its body (or never reads its response) releases the
+    #: handler thread after this long instead of holding it forever
+    socket_timeout_s: float = 30.0
 
     def __post_init__(self) -> None:
         _check(_is_pow2(self.max_batch), "max_batch must be a power of two")
@@ -233,6 +241,8 @@ class ServeConfig:
         _check(self.max_wait_ms >= 0.0, "max_wait_ms must be >= 0")
         _check(self.max_queue >= 1, "max_queue must be >= 1")
         _check(0 <= self.port <= 65535, "port out of range")
+        _check(self.request_timeout_s > 0.0, "request_timeout_s must be > 0")
+        _check(self.socket_timeout_s > 0.0, "socket_timeout_s must be > 0")
 
     @property
     def buckets(self) -> Tuple[int, ...]:
@@ -250,7 +260,8 @@ class ServeConfig:
         """Env switches, same conventions as :meth:`RunConfig.from_env`:
         DGEN_TPU_SERVE_MAX_BATCH, DGEN_TPU_SERVE_WAIT_MS,
         DGEN_TPU_SERVE_QUEUE, DGEN_TPU_SERVE_HOST, DGEN_TPU_SERVE_PORT,
-        DGEN_TPU_SERVE_WARMUP (0/false = off)."""
+        DGEN_TPU_SERVE_WARMUP (0/false = off),
+        DGEN_TPU_SERVE_REQ_TIMEOUT_S, DGEN_TPU_SERVE_SOCK_TIMEOUT_S."""
         env = os.environ.get
         if "max_batch" not in overrides and env("DGEN_TPU_SERVE_MAX_BATCH"):
             overrides["max_batch"] = int(env("DGEN_TPU_SERVE_MAX_BATCH"))
@@ -266,4 +277,102 @@ class ServeConfig:
             overrides["warmup"] = env("DGEN_TPU_SERVE_WARMUP") not in (
                 "0", "false", "off"
             )
+        if ("request_timeout_s" not in overrides
+                and env("DGEN_TPU_SERVE_REQ_TIMEOUT_S")):
+            overrides["request_timeout_s"] = float(
+                env("DGEN_TPU_SERVE_REQ_TIMEOUT_S"))
+        if ("socket_timeout_s" not in overrides
+                and env("DGEN_TPU_SERVE_SOCK_TIMEOUT_S")):
+            overrides["socket_timeout_s"] = float(
+                env("DGEN_TPU_SERVE_SOCK_TIMEOUT_S"))
+        return cls(**overrides)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Settings for the multi-replica serving fleet
+    (:mod:`dgen_tpu.serve.fleet` / :mod:`dgen_tpu.serve.front`): how
+    many replicas, when a replica counts as routable, how the front's
+    per-replica circuit breakers trip and recover, when the fleet sheds
+    load, and how a drain is bounded.  Env prefix: ``DGEN_TPU_FLEET_*``
+    (:meth:`from_env`)."""
+
+    #: replica processes the supervisor keeps alive
+    n_replicas: int = 2
+    #: front bind address (0 = ephemeral, for tests/drills)
+    host: str = "127.0.0.1"
+    port: int = 8177
+    #: a freshly spawned replica must reach READY (portfile written AND
+    #: /readyz green) within this wall, or it is killed and counted as
+    #: a death
+    boot_timeout_s: float = 180.0
+    #: supervisor monitor cadence (liveness polls, restart scheduling)
+    poll_interval_s: float = 0.2
+    #: crash-loop circuit breaker: more than this many deaths inside
+    #: ``restart_window_s`` marks the replica FAILED (no more restarts
+    #: — a crash loop burns CPU and log space, never heals itself)
+    max_restarts: int = 5
+    restart_window_s: float = 120.0
+    #: front per-replica breaker: consecutive forward failures/timeouts
+    #: that OPEN the breaker, and how long it stays open before one
+    #: HALF_OPEN probe request is allowed through
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 1.0
+    #: front -> replica forward deadline (connect + response); a hung
+    #: replica costs one timeout, then the breaker takes it out
+    request_timeout_s: float = 30.0
+    #: shed when aggregate READY-replica queue depth exceeds this
+    #: fraction of aggregate queue capacity (sum of max_queue)
+    shed_queue_frac: float = 0.8
+    #: Retry-After seconds stamped on every fleet 503 (shed, drain,
+    #: no-replica) — the client's bounded-retry contract
+    retry_after_s: float = 1.0
+    #: fleet /metricz scrape cadence (the load-shed signal's freshness)
+    metricz_interval_s: float = 0.5
+    #: graceful drain bound: in-flight requests get this long to finish
+    #: after SIGTERM before the process exits anyway
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        _check(self.n_replicas >= 1, "n_replicas must be >= 1")
+        _check(0 <= self.port <= 65535, "port out of range")
+        _check(self.boot_timeout_s > 0, "boot_timeout_s must be > 0")
+        _check(self.poll_interval_s > 0, "poll_interval_s must be > 0")
+        _check(self.max_restarts >= 0, "max_restarts must be >= 0")
+        _check(self.restart_window_s > 0, "restart_window_s must be > 0")
+        _check(self.breaker_failures >= 1, "breaker_failures must be >= 1")
+        _check(self.breaker_cooldown_s >= 0,
+               "breaker_cooldown_s must be >= 0")
+        _check(self.request_timeout_s > 0, "request_timeout_s must be > 0")
+        _check(0.0 < self.shed_queue_frac <= 1.0,
+               "shed_queue_frac must be in (0, 1]")
+        _check(self.retry_after_s >= 0, "retry_after_s must be >= 0")
+        _check(self.metricz_interval_s > 0, "metricz_interval_s must be > 0")
+        _check(self.drain_timeout_s > 0, "drain_timeout_s must be > 0")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "FleetConfig":
+        """Env switches: DGEN_TPU_FLEET_REPLICAS, DGEN_TPU_FLEET_HOST,
+        DGEN_TPU_FLEET_PORT, DGEN_TPU_FLEET_BOOT_TIMEOUT_S,
+        DGEN_TPU_FLEET_MAX_RESTARTS, DGEN_TPU_FLEET_BREAKER_FAILURES,
+        DGEN_TPU_FLEET_BREAKER_COOLDOWN_S,
+        DGEN_TPU_FLEET_REQ_TIMEOUT_S, DGEN_TPU_FLEET_SHED_FRAC,
+        DGEN_TPU_FLEET_RETRY_AFTER_S, DGEN_TPU_FLEET_DRAIN_TIMEOUT_S."""
+        env = os.environ.get
+        for key, envname, conv in (
+            ("n_replicas", "DGEN_TPU_FLEET_REPLICAS", int),
+            ("host", "DGEN_TPU_FLEET_HOST", str),
+            ("port", "DGEN_TPU_FLEET_PORT", int),
+            ("boot_timeout_s", "DGEN_TPU_FLEET_BOOT_TIMEOUT_S", float),
+            ("max_restarts", "DGEN_TPU_FLEET_MAX_RESTARTS", int),
+            ("breaker_failures", "DGEN_TPU_FLEET_BREAKER_FAILURES", int),
+            ("breaker_cooldown_s",
+             "DGEN_TPU_FLEET_BREAKER_COOLDOWN_S", float),
+            ("request_timeout_s", "DGEN_TPU_FLEET_REQ_TIMEOUT_S", float),
+            ("shed_queue_frac", "DGEN_TPU_FLEET_SHED_FRAC", float),
+            ("retry_after_s", "DGEN_TPU_FLEET_RETRY_AFTER_S", float),
+            ("drain_timeout_s", "DGEN_TPU_FLEET_DRAIN_TIMEOUT_S", float),
+        ):
+            if key not in overrides and env(envname):
+                overrides[key] = conv(env(envname))
         return cls(**overrides)

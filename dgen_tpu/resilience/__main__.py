@@ -22,7 +22,13 @@
     The full fault matrix on a small CPU population — every run-path
     fault site injected mid-run, recovered, and compared bit-exact
     against an uninterrupted baseline (tools/check.sh runs a smoke
-    configuration of this).
+    configuration of this).  ``--serve-fleet`` runs the serving-fleet
+    drill instead: a real replica fleet behind the routing front with
+    a replica killed and a replica hung under closed-loop load,
+    asserted self-healing with answers bit-identical to a
+    single-replica oracle (docs/serve.md "Fleet operations")::
+
+        python -m dgen_tpu.resilience drill --serve-fleet --replicas 2
 """
 
 from __future__ import annotations
@@ -97,6 +103,17 @@ def _cmd_drill(args) -> int:
     from dgen_tpu.utils import compilecache
 
     compilecache.enable()
+    if args.serve_fleet:
+        from dgen_tpu.resilience.fleetdrill import run_fleet_drill
+
+        rec = run_fleet_drill(
+            replicas=args.replicas, agents=args.agents,
+            end_year=args.end_year, requests=args.requests,
+        )
+        # the event/boot detail is for logs, not the summary line
+        rec.pop("supervisor_events", None)
+        print(json.dumps(rec, indent=1))
+        return 0 if rec["ok"] else 1
     root = args.root or tempfile.mkdtemp(prefix="dgen-fault-drill-")
     specs = DRILL_SPECS
     if args.sites:
@@ -153,6 +170,15 @@ def main(argv=None) -> int:
     drl.add_argument("--sites", default=None,
                      help="comma list of drill names to run "
                           "(default: the full matrix)")
+    drl.add_argument("--serve-fleet", action="store_true",
+                     help="fleet drill instead: boot a replica fleet, "
+                          "kill + hang replicas under closed-loop "
+                          "load, assert self-healing + bit-exact "
+                          "answers (docs/serve.md)")
+    drl.add_argument("--replicas", type=int, default=2,
+                     help="fleet drill: replica count")
+    drl.add_argument("--requests", type=int, default=80,
+                     help="fleet drill: client requests")
     drl.set_defaults(fn=_cmd_drill)
 
     args = ap.parse_args(argv)

@@ -19,7 +19,7 @@ Spec grammar (``DGEN_TPU_FAULTS``)::
     nth     := 1-based hit index at which the clause starts firing
                (default 1 — the first hit)
     times   := how many consecutive hits fire (default 1)
-    kind    := "error" (default) | "oom" | "kill" | "truncate"
+    kind    := "error" (default) | "oom" | "kill" | "truncate" | "hang"
 
 Examples::
 
@@ -42,6 +42,11 @@ Kinds:
 * ``truncate`` — only at artifact sites (``export_torn``): truncate
   the just-landed file to half its bytes, then raise — the model of a
   torn write / partial flush that ``manifest verify`` exists to catch.
+* ``hang`` — sleep ``DGEN_TPU_FAULT_HANG_S`` seconds (default 20) at
+  the site, then continue normally: the model of a stalled-not-dead
+  process (wedged device, paging storm).  Liveness probes stay green;
+  only deadline enforcement (the serve layer's request timeout, the
+  fleet front's forward timeout + breaker) can route around it.
 
 The uninstalled fast path is one module-global ``None`` check per
 site, so production runs pay nothing.
@@ -52,6 +57,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 from typing import Dict, List, Optional
 
 #: process exit code used by the ``kill`` kind — distinct from common
@@ -100,9 +106,40 @@ SITES: Dict[str, str] = {
         "the serving path (the batcher must fail the batch's futures, "
         "never its worker thread)"
     ),
+    "serve_replica_kill": (
+        "serve.engine.ServeEngine.query_rows — a serving replica "
+        "dying mid-query (``kill``: os._exit with requests in flight; "
+        "the fleet front must fail over and the supervisor restart it)"
+    ),
+    "serve_replica_hang": (
+        "serve.engine.ServeEngine.query_rows — a serving replica "
+        "stalling mid-query (``hang``: the batcher worker sleeps "
+        "DGEN_TPU_FAULT_HANG_S seconds, stalling every queued batch; "
+        "the front's forward timeout + breaker must route around it)"
+    ),
+    "front_route": (
+        "serve.front.FleetFront._route — a forward attempt to the "
+        "chosen replica failing at the routing layer (connect "
+        "refused/reset); the front must count it against that "
+        "replica's breaker and retry on another replica"
+    ),
 }
 
-KINDS = ("error", "oom", "kill", "truncate")
+KINDS = ("error", "oom", "kill", "truncate", "hang")
+
+#: how long a ``hang`` fault stalls its site (seconds); env-tunable so
+#: drills can pick a stall longer than the front's forward timeout but
+#: short enough to watch the fleet heal inside a smoke budget
+HANG_ENV = "DGEN_TPU_FAULT_HANG_S"
+HANG_DEFAULT_S = 20.0
+
+
+def hang_seconds() -> float:
+    raw = os.environ.get(HANG_ENV, "").strip()
+    try:
+        return float(raw) if raw else HANG_DEFAULT_S
+    except ValueError:
+        return HANG_DEFAULT_S
 
 
 class FaultError(RuntimeError):
@@ -224,6 +261,13 @@ class FaultRegistry:
             if clause is not None:
                 self._fired[site] = self._fired.get(site, 0) + 1
         if clause is None:
+            return
+        if clause.kind == "hang":
+            # model a stall, not a death: hold the site for the
+            # configured wall, then continue NORMALLY — the caller
+            # never learns it hung, exactly like a wedged device or a
+            # GC/paging stall.  Timeout enforcement is the test.
+            time.sleep(hang_seconds())
             return
         if clause.kind == "kill":
             # model a preemption/OOM-kill: no cleanup, no finally, no

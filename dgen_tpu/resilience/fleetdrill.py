@@ -1,0 +1,376 @@
+"""The serve-fleet drill: shoot at a live multi-replica serving fleet
+and prove it self-heals.
+
+``python -m dgen_tpu.resilience drill --serve-fleet`` boots a real
+fleet (N replica processes behind the routing front, all on CPU),
+drives closed-loop client load through the front, and — mid-load —
+**kills** one replica (``serve_replica_kill@k:kill``: ``os._exit``
+with requests in flight) and **hangs** another
+(``serve_replica_hang@m:hang``: the batcher worker stalls longer than
+the front's forward timeout).  The drill passes only if:
+
+* **every client request is eventually answered** — bounded
+  503-retries are the one failure mode a client may see (the front
+  never surfaces 502/504; terminal failures are retryable 503s with
+  Retry-After);
+* **answers are bit-identical to a single-replica oracle** — the
+  drill computes every request's expected row in-process on one
+  engine over the same synthetic population at the same bucket shape
+  (``min_bucket == max_batch`` pins one compiled shape fleet-wide, so
+  coalescing with strangers cannot perturb a row — docs/serve.md);
+* **the fleet returns to full READY strength** — the supervisor
+  restarted the killed replica (fast, via the shared AOT compile
+  cache) and the hung replica's breaker re-closed after its HALF_OPEN
+  probe;
+* **the zero-steady-state-compile invariant holds on every replica**
+  — each replica's ``/metricz`` reports the RetraceGuard compile/trace
+  counts armed after warmup (the dynamic half; the static half is the
+  program auditor's J5 fingerprint gate in tools/check.sh), and all
+  must be zero, restarted replica included;
+* **p99 stays bounded through the failure** — the client-observed
+  p99 (retries included) must stay under ``p99_bound_s``.
+
+Fault hit counts include warmup: each warmup bucket execution visits
+``query_rows`` once, so a spec like ``serve_replica_kill@4:kill`` with
+one bucket fires on the replica's third *served* query.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dgen_tpu.resilience.faults import KILL_EXIT_CODE
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: what-if variants the drill load mixes in (distinct coalescing keys,
+#: same compiled shape)
+OVERRIDE_VARIANTS = (
+    None,
+    {"scale": {"itc_fraction": 0.5}},
+    {"set": {"elec_price_escalator": 0.005}},
+)
+
+
+def _request_plan(k: int, n_agents: int, years: List[int]) -> dict:
+    """Deterministic request k -> body (the oracle computes the same
+    plan, so client answers are comparable row-for-row)."""
+    return {
+        "agent_ids": [k % n_agents],
+        "year": years[k % len(years)],
+        "overrides": OVERRIDE_VARIANTS[k % len(OVERRIDE_VARIANTS)],
+    }
+
+
+def _post(port: int, body: dict, timeout: float) -> tuple:
+    from dgen_tpu.serve.fleet import http_json
+
+    status, blob, headers = http_json(
+        port, "/query", method="POST",
+        body=json.dumps(body).encode(), timeout=timeout,
+    )
+    return status, blob, headers.get("Retry-After")
+
+
+def _get(port: int, path: str, timeout: float = 5.0) -> Optional[dict]:
+    from dgen_tpu.serve.fleet import HTTP_ERRORS, http_json
+
+    try:
+        status, blob, _ = http_json(port, path, timeout=timeout)
+        if status != 200:
+            return None
+        return json.loads(blob)
+    except HTTP_ERRORS:
+        return None
+
+
+def run_fleet_drill(
+    *,
+    replicas: int = 2,
+    agents: int = 64,
+    end_year: int = 2016,
+    econ_years: int = 4,
+    sizing_iters: int = 6,
+    requests: int = 80,
+    clients: int = 4,
+    bucket: int = 8,
+    kill_at: int = 4,
+    hang_at: int = 24,
+    hang_s: float = 6.0,
+    forward_timeout_s: float = 2.5,
+    max_client_retries: int = 200,
+    p99_bound_s: float = 30.0,
+    seed: int = 7,
+) -> Dict[str, object]:
+    """Run the drill (module docstring); returns the drill record
+    (``ok`` + the numbers a bench payload stamps)."""
+    from dgen_tpu.config import FleetConfig
+    from dgen_tpu.serve.fleet import ReplicaSupervisor, default_replica_cmd
+    from dgen_tpu.serve.server import _rows_to_json
+
+    t_drill0 = time.perf_counter()
+
+    # -- single-replica oracle (also pre-warms the shared compile
+    # cache, which is exactly how a production fleet boots fast) ------
+    serve_argv = [
+        "--agents", str(agents), "--end-year", str(end_year),
+        "--seed", str(seed),
+        "--econ-years", str(econ_years),
+        "--sizing-iters", str(sizing_iters),
+        "--max-batch", str(bucket), "--min-bucket", str(bucket),
+        "--max-wait-ms", "2",
+    ]
+    import argparse
+
+    import dgen_tpu.serve.__main__ as serve_cli
+    from dgen_tpu.serve.engine import ServeEngine
+
+    # the oracle builds through the SAME population path the replica
+    # CLI uses, so "bit-identical to a single-replica run" compares
+    # like with like
+    sim = serve_cli._build_sim(argparse.Namespace(
+        agents=agents, start_year=2014, end_year=end_year, seed=seed,
+        econ_years=econ_years, sizing_iters=sizing_iters,
+    ))
+    oracle = ServeEngine(sim)
+    t0 = time.perf_counter()
+    oracle.warmup([bucket])
+    oracle_warm_s = time.perf_counter() - t0
+    n_real = oracle.n_agents
+    years = list(oracle.years)
+
+    expected: List[dict] = []
+    for k in range(requests):
+        plan = _request_plan(k, n_real, years)
+        out = oracle.query(
+            plan["agent_ids"], year=plan["year"],
+            overrides=plan["overrides"], bucket=bucket,
+        )
+        expected.append(_rows_to_json(out, cash_flow=False)[0])
+
+    # -- the fleet, with per-replica fault specs on incarnation 0 -----
+    def env_for(index: int, spawn_count: int) -> Optional[dict]:
+        if spawn_count != 0:
+            return None   # a restarted replica comes back clean
+        if index == 0:
+            return {"DGEN_TPU_FAULTS":
+                    f"serve_replica_kill@{kill_at}:kill"}
+        if index == 1 and replicas > 1:
+            return {
+                "DGEN_TPU_FAULTS":
+                    f"serve_replica_hang@{hang_at}:hang",
+                "DGEN_TPU_FAULT_HANG_S": str(hang_s),
+            }
+        return None
+
+    fleet_cfg = FleetConfig(
+        n_replicas=replicas, port=0,
+        poll_interval_s=0.1,
+        request_timeout_s=forward_timeout_s,
+        breaker_failures=2, breaker_cooldown_s=1.0,
+        retry_after_s=0.0,
+        metricz_interval_s=0.25,
+    )
+    sup = ReplicaSupervisor(
+        default_replica_cmd(serve_argv), fleet_cfg, env_for=env_for,
+    ).start()
+    try:
+        rec = _drive_fleet(
+            sup, fleet_cfg, expected=expected, n_real=n_real,
+            years=years, replicas=replicas, agents=agents,
+            requests=requests, clients=clients,
+            kill_at=kill_at, hang_at=hang_at, hang_s=hang_s,
+            forward_timeout_s=forward_timeout_s,
+            max_client_retries=max_client_retries,
+            p99_bound_s=p99_bound_s,
+        )
+    finally:
+        # no exception path may leak N serving subprocesses — the CI
+        # lint gate runs this drill on every push.  Idempotent: the
+        # success path already drained + stopped the fleet.
+        sup.stop(drain=False, timeout=10.0)
+    rec["oracle_warmup_s"] = round(oracle_warm_s, 3)
+    rec["drill_wall_s"] = round(time.perf_counter() - t_drill0, 3)
+    logger.info(
+        "serve-fleet drill: %s (answered %d/%d, 503-retries %d, "
+        "mismatches %d, kill recovery %.2fs, p99 %.2fs)",
+        "ok" if rec["ok"] else "FAILED", rec["answered"], requests,
+        rec["retries_503"], len(rec["mismatches"]),
+        rec["kill"]["recovery_s"] or -1.0, rec["latency_s"]["p99"],
+    )
+    return rec
+
+
+def _drive_fleet(
+    sup, fleet_cfg, *, expected, n_real, years, replicas, agents,
+    requests, clients, kill_at, hang_at, hang_s, forward_timeout_s,
+    max_client_retries, p99_bound_s,
+) -> Dict[str, object]:
+    """The fleet-facing half of the drill: load, faults, asserts.
+    Runs under run_fleet_drill's finally so the fleet is always torn
+    down."""
+    from dgen_tpu.serve.fleet import HTTP_ERRORS as http_errors
+    from dgen_tpu.serve.front import FleetFront, start_front_in_thread
+
+    booted = sup.wait_ready(timeout=120.0)
+    boot_reports = {}
+    for h in sup.ready_handles():
+        hz = _get(h.port, "/healthz") or {}
+        boot_reports[h.index] = hz.get("boot")
+    front = FleetFront(sup, fleet_cfg).start()
+    srv = start_front_in_thread(front)
+    front_port = srv.server_address[1]
+
+    # -- closed-loop load ---------------------------------------------
+    answers: Dict[int, dict] = {}
+    failures: List[dict] = []
+    latencies: List[float] = []
+    retries_503 = [0]
+    next_k = iter(range(requests))
+    next_lock = threading.Lock()
+    rec_lock = threading.Lock()
+
+    def client() -> None:
+        while True:
+            with next_lock:
+                k = next(next_k, None)
+            if k is None:
+                return
+            plan = _request_plan(k, n_real, years)
+            t0 = time.monotonic()
+            status, blob, retry_after = None, b"", None
+            for attempt in range(max_client_retries + 1):
+                try:
+                    status, blob, retry_after = _post(
+                        front_port, plan,
+                        timeout=2 * forward_timeout_s + 10.0,
+                    )
+                except http_errors as e:
+                    status, blob = -1, repr(e).encode()
+                if status == 200:
+                    break
+                # the contract: the ONLY retryable client-visible
+                # failure is 503 (+ Retry-After); anything else is a
+                # drill failure recorded below
+                if status != 503:
+                    break
+                with rec_lock:
+                    retries_503[0] += 1
+                time.sleep(min(float(retry_after or 0.1) or 0.1, 0.5))
+            wall = time.monotonic() - t0
+            with rec_lock:
+                latencies.append(wall)
+                if status == 200:
+                    answers[k] = json.loads(blob)
+                else:
+                    failures.append({
+                        "k": k, "status": status,
+                        "body": blob[:200].decode("utf-8", "replace"),
+                    })
+
+    t_load0 = time.perf_counter()
+    threads = [
+        threading.Thread(target=client, daemon=True,
+                         name=f"drill-client-{i}")
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600.0)
+    load_wall_s = time.perf_counter() - t_load0
+
+    # -- post-load asserts --------------------------------------------
+    # the killed replica must be back: full READY strength
+    recovered = sup.wait_ready(timeout=90.0)
+
+    mismatches = []
+    for k, got in sorted(answers.items()):
+        want_row = expected[k]
+        rows = got.get("results") or [None]
+        if rows[0] != want_row:
+            mismatches.append(k)
+
+    kill_seen = KILL_EXIT_CODE in sup.replicas[0].exit_codes
+    hang_fired = 0
+    steady_compiles: Dict[str, Optional[int]] = {}
+    steady_traces: Dict[str, Optional[int]] = {}
+    for h in sup.ready_handles():
+        mz = _get(h.port, "/metricz") or {}
+        steady_compiles[str(h.index)] = mz.get("steady_state_compiles")
+        steady_traces[str(h.index)] = mz.get("steady_state_traces")
+        hang_fired += int(
+            (mz.get("faults_fired") or {}).get("serve_replica_hang", 0))
+
+    lat = np.asarray(sorted(latencies), dtype=np.float64)
+    p50 = float(np.percentile(lat, 50)) if lat.size else 0.0
+    p99 = float(np.percentile(lat, 99)) if lat.size else 0.0
+
+    front_mz = front.metricz()
+
+    from dgen_tpu.serve.front import drain_front
+
+    drained = drain_front(front, srv)
+    srv.server_close()
+
+    compiles_clean = all(
+        c == 0 for c in steady_compiles.values()
+    ) and bool(steady_compiles)
+    ok = bool(
+        booted
+        and len(answers) == requests
+        and not failures
+        and not mismatches
+        and recovered
+        and kill_seen
+        and (hang_fired >= 1 if replicas > 1 else True)
+        and compiles_clean
+        and p99 <= p99_bound_s
+    )
+    rec = {
+        "ok": ok,
+        "replicas": replicas,
+        "agents": agents,
+        "requests": requests,
+        "answered": len(answers),
+        "mismatches": mismatches,
+        "client_failures": failures,
+        "retries_503": retries_503[0],
+        "booted": booted,
+        "recovered_full_strength": recovered,
+        "kill": {
+            "spec": f"serve_replica_kill@{kill_at}:kill",
+            "exit_77_seen": kill_seen,
+            "recovery_s": sup.replicas[0].last_recovery_s,
+            "restart_boot_wall_s": sup.replicas[0].boot_wall_s,
+        },
+        "hang": {
+            "spec": f"serve_replica_hang@{hang_at}:hang",
+            "hang_s": hang_s,
+            "fired": hang_fired,
+        },
+        "steady_state_compiles": steady_compiles,
+        "steady_state_traces": steady_traces,
+        "latency_s": {
+            "p50": round(p50, 3),
+            "p99": round(p99, 3),
+            "max": round(float(lat.max()) if lat.size else 0.0, 3),
+            "p99_bound_s": p99_bound_s,
+        },
+        "front": {
+            k: front_mz.get(k)
+            for k in ("requests", "shed", "retries",
+                      "forward_failures", "unrouted")
+        },
+        "boot": boot_reports,
+        "load_wall_s": round(load_wall_s, 3),
+        "drained": drained,
+        "supervisor_events": list(sup.events),
+    }
+    return rec

@@ -14,6 +14,12 @@ fixed-shape, microbatched device programs.
               overrides={"scale": {"itc_fraction": 0.0}})
 
 HTTP front-end: ``python -m dgen_tpu.serve`` (see docs/serve.md).
+Fleet: ``python -m dgen_tpu.serve --fleet N`` — a
+:class:`~dgen_tpu.serve.fleet.ReplicaSupervisor` keeps N replica
+processes alive and readiness-gated behind a
+:class:`~dgen_tpu.serve.front.FleetFront` that round-robins, breaks
+circuits, sheds load, and drains gracefully (docs/serve.md "Fleet
+operations").
 """
 
 from dgen_tpu.serve.batcher import Microbatcher, QueueFullError  # noqa: F401
@@ -26,3 +32,8 @@ from dgen_tpu.serve.engine import (  # noqa: F401
     override_key,
     query_program,
 )
+from dgen_tpu.serve.fleet import (  # noqa: F401
+    ReplicaSupervisor,
+    default_replica_cmd,
+)
+from dgen_tpu.serve.front import CircuitBreaker, FleetFront  # noqa: F401

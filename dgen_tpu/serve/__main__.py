@@ -2,20 +2,194 @@
 endpoint over a synthetic population (or a preset's population when a
 reference input mount exists).
 
-    python -m dgen_tpu.serve --agents 8192 --port 8178
-    curl -s localhost:8178/healthz
-    curl -s -XPOST localhost:8178/query -d \\
-        '{"agent_ids": [17], "year": 2026,
-          "overrides": {"scale": {"itc_fraction": 0.0}}}'
+Three modes:
+
+* **single process** (default) — the PR 5 behavior::
+
+      python -m dgen_tpu.serve --agents 8192 --port 8178
+      curl -s localhost:8178/healthz
+
+* **fleet** — supervise N replicas behind the routing front
+  (docs/serve.md "Fleet operations")::
+
+      python -m dgen_tpu.serve --fleet 3 --agents 8192 --port 8177
+      curl -s localhost:8177/metricz     # fleet-aggregated
+
+* **replica** — one fleet member (normally spawned by the supervisor,
+  not by hand): binds ``--port 0``, writes ``--portfile`` once the
+  socket is bound, warms up in the background so ``/healthz`` answers
+  (liveness) while ``/readyz`` stays 503 until warmup completes
+  (readiness), and arms any ``DGEN_TPU_FAULTS`` spec from its
+  environment (the fleet drill injects per-replica faults this way)::
+
+      python -m dgen_tpu.serve --replica-index 0 --port 0 \\
+          --portfile /tmp/replica-0.json --agents 8192
 
 Serve knobs come from :class:`dgen_tpu.config.ServeConfig` (env:
-DGEN_TPU_SERVE_*); the population/scenario build mirrors the bench
-driver's synthetic path.
+DGEN_TPU_SERVE_*), fleet knobs from :class:`~dgen_tpu.config.
+FleetConfig` (env: DGEN_TPU_FLEET_*); the population/scenario build
+mirrors the bench driver's synthetic path.  SIGTERM always means
+graceful drain (finish in-flight, then exit).
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
+import os
+import threading
+
+
+def _build_sim(args):
+    """One synthetic population + Simulation from the CLI args — the
+    same build in every mode, so every replica of a fleet (and the
+    drill's single-replica oracle) computes over identical banks."""
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.simulation import Simulation
+
+    cfg = ScenarioConfig(
+        name="serve", start_year=args.start_year, end_year=args.end_year,
+        anchor_years=(),
+    )
+    pop = synth.generate_population(args.agents, seed=args.seed)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions
+    )
+    rc = RunConfig.from_env()
+    if args.sizing_iters is not None:
+        rc = dataclasses.replace(rc, sizing_iters=args.sizing_iters)
+    kw = {}
+    if args.econ_years is not None:
+        kw["econ_years"] = args.econ_years
+    return Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc, **kw
+    )
+
+
+def _serve_config(args):
+    from dgen_tpu.config import ServeConfig
+
+    overrides = {}
+    for k, v in (
+        ("host", args.host), ("port", args.port),
+        ("max_batch", args.max_batch), ("max_wait_ms", args.max_wait_ms),
+        ("min_bucket", args.min_bucket),
+    ):
+        if v is not None:
+            overrides[k] = v
+    if args.no_warmup:
+        overrides["warmup"] = False
+    return ServeConfig.from_env(**overrides)
+
+
+def _run_single(args) -> None:
+    from dgen_tpu.serve.engine import ServeEngine
+    from dgen_tpu.serve.server import ServeApp, serve_forever
+
+    app = ServeApp(ServeEngine(_build_sim(args)), _serve_config(args))
+    serve_forever(app)
+
+
+def _run_replica(args) -> None:
+    """One fleet member: bind first (liveness), portfile second
+    (discovery), warm up third (readiness)."""
+    from dgen_tpu.resilience import faults
+    from dgen_tpu.serve.engine import ServeEngine
+    from dgen_tpu.serve.server import ServeApp, make_server, serve_forever
+    from dgen_tpu.utils.logging import get_logger
+
+    logger = get_logger()
+    faults.install_from_env()   # the drill's per-replica fault specs
+    app = ServeApp(
+        ServeEngine(_build_sim(args)), _serve_config(args),
+        replica_index=args.replica_index, defer_warmup=True,
+    )
+    srv = make_server(app)
+    if args.portfile:
+        tmp = args.portfile + ".tmp"
+        with open(tmp, "w") as f:   # dgenlint: disable=L11
+            json.dump({
+                "pid": os.getpid(),
+                "port": srv.server_address[1],
+                "replica_index": args.replica_index,
+            }, f)
+        os.replace(tmp, args.portfile)
+
+    def _warm() -> None:
+        try:
+            app.warmup_now()
+        except Exception:  # noqa: BLE001 — never-ready is the signal
+            logger.exception(
+                "replica %s warmup failed; staying unready",
+                args.replica_index,
+            )
+
+    threading.Thread(
+        target=_warm, name="dgen-serve-warmup", daemon=True
+    ).start()
+    serve_forever(app, srv)
+
+
+def _run_fleet(args) -> None:
+    from dgen_tpu.config import FleetConfig
+    from dgen_tpu.serve.fleet import ReplicaSupervisor, default_replica_cmd
+    from dgen_tpu.serve.front import (
+        FleetFront,
+        install_sigterm_drain_front,
+        make_front_server,
+    )
+    from dgen_tpu.utils.logging import get_logger
+
+    logger = get_logger()
+    overrides = {"n_replicas": args.fleet}
+    if args.host is not None:
+        overrides["host"] = args.host
+    if args.port is not None:
+        overrides["port"] = args.port
+    fleet_cfg = FleetConfig.from_env(**overrides)
+
+    serve_args = [
+        "--agents", str(args.agents),
+        "--start-year", str(args.start_year),
+        "--end-year", str(args.end_year),
+        "--seed", str(args.seed),
+    ]
+    if args.econ_years is not None:
+        serve_args += ["--econ-years", str(args.econ_years)]
+    if args.sizing_iters is not None:
+        serve_args += ["--sizing-iters", str(args.sizing_iters)]
+    if args.max_batch is not None:
+        serve_args += ["--max-batch", str(args.max_batch)]
+    if args.min_bucket is not None:
+        serve_args += ["--min-bucket", str(args.min_bucket)]
+    if args.max_wait_ms is not None:
+        serve_args += ["--max-wait-ms", str(args.max_wait_ms)]
+    if args.no_warmup:
+        serve_args += ["--no-warmup"]
+
+    sup = ReplicaSupervisor(
+        default_replica_cmd(serve_args), fleet_cfg,
+    ).start()
+    front = FleetFront(sup, fleet_cfg).start()
+    srv = make_front_server(front)
+    install_sigterm_drain_front(front, srv)
+    host, port = srv.server_address[:2]
+    logger.info(
+        "dgen-tpu serve fleet: %d replicas (%d agents each), front on "
+        "http://%s:%d (/query /healthz /readyz /metricz); fleet dir %s",
+        fleet_cfg.n_replicas, args.agents, host, port, sup.fleet_dir,
+    )
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("fleet front: shutting down")
+    finally:
+        srv.server_close()
+        front.close()
+        sup.stop(drain=True)
 
 
 def main(argv=None) -> None:
@@ -27,49 +201,36 @@ def main(argv=None) -> None:
     ap.add_argument("--start-year", type=int, default=2014)
     ap.add_argument("--end-year", type=int, default=2050)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--econ-years", type=int, default=None)
+    ap.add_argument("--sizing-iters", type=int, default=None)
     ap.add_argument("--host", default=None)
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--min-bucket", type=int, default=None)
     ap.add_argument("--max-wait-ms", type=float, default=None)
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="supervise N replicas behind the routing front")
+    ap.add_argument("--replica-index", type=int, default=None,
+                    help="run as fleet replica I (spawned by the "
+                         "supervisor)")
+    ap.add_argument("--portfile", default=None,
+                    help="replica mode: write {pid, port} here once "
+                         "the socket is bound")
     args = ap.parse_args(argv)
 
     from dgen_tpu.utils import compilecache
 
     compilecache.enable()
 
-    from dgen_tpu.config import RunConfig, ScenarioConfig, ServeConfig
-    from dgen_tpu.io import synth
-    from dgen_tpu.models import scenario as scen
-    from dgen_tpu.models.simulation import Simulation
-    from dgen_tpu.serve.engine import ServeEngine
-    from dgen_tpu.serve.server import ServeApp, serve_forever
-
-    overrides = {}
-    for k, v in (
-        ("host", args.host), ("port", args.port),
-        ("max_batch", args.max_batch), ("max_wait_ms", args.max_wait_ms),
-    ):
-        if v is not None:
-            overrides[k] = v
-    if args.no_warmup:
-        overrides["warmup"] = False
-    serve_cfg = ServeConfig.from_env(**overrides)
-
-    cfg = ScenarioConfig(
-        name="serve", start_year=args.start_year, end_year=args.end_year,
-        anchor_years=(),
-    )
-    pop = synth.generate_population(args.agents, seed=args.seed)
-    inputs = scen.uniform_inputs(
-        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions
-    )
-    sim = Simulation(
-        pop.table, pop.profiles, pop.tariffs, inputs, cfg,
-        RunConfig.from_env(),
-    )
-    app = ServeApp(ServeEngine(sim), serve_cfg)
-    serve_forever(app)
+    if args.fleet is not None and args.replica_index is not None:
+        ap.error("--fleet and --replica-index are mutually exclusive")
+    if args.fleet is not None:
+        _run_fleet(args)
+    elif args.replica_index is not None or args.portfile:
+        _run_replica(args)
+    else:
+        _run_single(args)
 
 
 if __name__ == "__main__":

@@ -366,10 +366,16 @@ class ServeEngine:
         change nothing) and slices the first n answers back out. The
         two paths are bit-identical per row.
         """
-        # resilience drill hook: a device failure on the serving path —
-        # the batcher must fail only this batch's futures (its worker
-        # thread and the queue's load-shed/occupancy signals survive)
+        # resilience drill hooks: a device failure on the serving path
+        # (the batcher must fail only this batch's futures — its worker
+        # thread and the queue's load-shed/occupancy signals survive),
+        # a replica dying mid-query (kill: the fleet front fails over,
+        # the supervisor restarts), and a replica stalling mid-query
+        # (hang: the worker sleeps, stalling every queued batch — the
+        # front's forward timeout + breaker route around it)
         fault_point("serve_query")
+        fault_point("serve_replica_kill")
+        fault_point("serve_replica_hang")
         rows = np.asarray(rows, dtype=np.int32)
         n = rows.shape[0]
         if bucket is not None:

@@ -1,0 +1,459 @@
+"""ReplicaSupervisor: N serving replicas, kept alive and routable.
+
+The serving story so far is one process; the north star is "heavy
+traffic from millions of users", and one process is one preemption away
+from zero capacity.  This module turns ``python -m dgen_tpu.serve``
+into a *fleet*: a supervisor spawns N replica processes, gates each on
+**readiness** (not liveness — a replica is routable only after its
+``/readyz`` reports warmup complete; replicas boot in seconds because
+they share the AOT compile cache, ``utils/compilecache.py``), restarts
+dead replicas under the resilience layer's :class:`~dgen_tpu.
+resilience.supervisor.RetryPolicy` backoff, and refuses to feed a
+crash loop (more than ``FleetConfig.max_restarts`` deaths inside
+``restart_window_s`` marks the replica FAILED instead of burning CPU
+on restart storms).
+
+Replica discovery is a **portfile**: each replica binds an ephemeral
+port (``--port 0``), then atomically writes
+``<fleet_dir>/replica-<i>.json`` (pid, port) — the supervisor polls
+for the file, then polls ``/readyz`` until green.  No registry, no
+race: the file appears only after the socket is bound.
+
+Lifecycle per replica::
+
+    SPAWNING --portfile--> BOOTING --/readyz 200--> READY
+        |                     |                       |
+        +----- process death / boot timeout ----------+
+                              |
+                    BACKOFF (RetryPolicy) --> SPAWNING ...
+                              |
+                    FAILED (crash-loop breaker tripped)
+
+The routing front (:mod:`dgen_tpu.serve.front`) holds a supervisor and
+routes over :meth:`ReplicaSupervisor.ready_handles`; the fault drill
+(``python -m dgen_tpu.resilience drill --serve-fleet``) shoots at it.
+
+This module imports no jax: supervision is pure process/socket work,
+and must stay responsive while replicas compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from dgen_tpu.config import FleetConfig
+from dgen_tpu.resilience.supervisor import RetryPolicy
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+# replica lifecycle states
+SPAWNING = "spawning"   # process launched, portfile not yet written
+BOOTING = "booting"     # port known, /readyz not yet green
+READY = "ready"         # routable
+BACKOFF = "backoff"     # dead, restart scheduled
+FAILED = "failed"       # crash-loop breaker tripped; no more restarts
+STOPPED = "stopped"     # supervisor shut it down
+
+#: replicas are children of this process; discovery and probing are
+#: loopback-only regardless of what interface the front binds
+REPLICA_HOST = "127.0.0.1"
+
+#: every transport failure a one-shot local HTTP call can raise — ONE
+#: tuple shared by the supervisor's probes, the front's forwards and
+#: scrapes, and the drill's clients, so no caller can under-catch
+#: (a replica dying mid-response raises BadStatusLine, an
+#: HTTPException, NOT an OSError)
+HTTP_ERRORS = (OSError, http.client.HTTPException, ValueError)
+
+
+def http_json(port: int, path: str, *, method: str = "GET",
+              body: Optional[bytes] = None, timeout: float = 5.0,
+              host: str = REPLICA_HOST) -> tuple:
+    """One-shot HTTP request to a local replica/front: ``(status, raw
+    body bytes, headers dict)``.  Transport failures raise members of
+    :data:`HTTP_ERRORS`; callers decide whether to swallow (probes,
+    scrapes) or fail over (the front's forwards)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(
+            method, path, body=body,
+            headers=(
+                {"Content-Type": "application/json"}
+                if body is not None else {}
+            ),
+        )
+        r = conn.getresponse()
+        return r.status, r.read(), dict(r.getheaders())
+    finally:
+        conn.close()
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """One replica slot's mutable state (the supervisor owns writes;
+    readers snapshot under the supervisor lock)."""
+
+    index: int
+    portfile: str
+    state: str = SPAWNING
+    proc: Optional[subprocess.Popen] = None
+    port: Optional[int] = None
+    pid: Optional[int] = None
+    #: completed spawns (0 on the first; env_for sees it, so a drill
+    #: can arm faults on incarnation 0 only)
+    spawn_count: int = 0
+    spawned_at: float = 0.0
+    ready_at: Optional[float] = None
+    boot_wall_s: Optional[float] = None
+    restart_at: Optional[float] = None
+    deaths: deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=64))
+    exit_codes: List[int] = dataclasses.field(default_factory=list)
+    last_death_at: Optional[float] = None
+    #: wall from last death to back READY (the failover recovery
+    #: number the drill and bench stamp)
+    last_recovery_s: Optional[float] = None
+
+    def summary(self) -> dict:
+        return {
+            "index": self.index,
+            "state": self.state,
+            "port": self.port,
+            "pid": self.pid,
+            "spawn_count": self.spawn_count,
+            "deaths": len(self.deaths),
+            "exit_codes": list(self.exit_codes),
+            "boot_wall_s": (
+                round(self.boot_wall_s, 3)
+                if self.boot_wall_s is not None else None
+            ),
+            "last_recovery_s": (
+                round(self.last_recovery_s, 3)
+                if self.last_recovery_s is not None else None
+            ),
+        }
+
+
+def default_replica_cmd(
+    serve_args: Sequence[str],
+) -> Callable[[int, str], List[str]]:
+    """The standard replica command: ``python -m dgen_tpu.serve
+    --replica-index I --port 0 --portfile F <serve_args>``."""
+
+    def cmd_for(index: int, portfile: str) -> List[str]:
+        return [
+            sys.executable, "-m", "dgen_tpu.serve",
+            "--replica-index", str(index),
+            "--port", "0", "--portfile", portfile,
+            *serve_args,
+        ]
+
+    return cmd_for
+
+
+class ReplicaSupervisor:
+    """Spawn, readiness-gate, monitor, restart (bounded) N replicas.
+
+    Parameters
+    ----------
+    cmd_for : ``(index, portfile) -> argv`` — the replica command.
+        Tests substitute a stub; production uses
+        :func:`default_replica_cmd`.
+    config : :class:`~dgen_tpu.config.FleetConfig`.
+    policy : restart backoff (:class:`RetryPolicy`; only its
+        ``backoff_s`` schedule is used here — classification is the
+        exit code, restart bounding is the crash-loop window).
+    env_for : optional ``(index, spawn_count) -> dict`` of EXTRA env
+        for a spawn (the fleet drill arms per-replica fault specs on
+        incarnation 0 only).  ``DGEN_TPU_FAULTS`` is stripped from the
+        inherited environment either way: a spec meant for the parent
+        must never leak into every replica.
+    fleet_dir : portfiles + per-replica logs (default: a fresh
+        tempdir).
+    """
+
+    def __init__(
+        self,
+        cmd_for: Callable[[int, str], List[str]],
+        config: Optional[FleetConfig] = None,
+        policy: Optional[RetryPolicy] = None,
+        env_for: Optional[Callable[[int, int], Optional[dict]]] = None,
+        fleet_dir: Optional[str] = None,
+        seed: int = 0,
+    ) -> None:
+        self.config = config or FleetConfig()
+        self.policy = policy or RetryPolicy()
+        self._cmd_for = cmd_for
+        self._env_for = env_for
+        self.fleet_dir = fleet_dir or tempfile.mkdtemp(prefix="dgen-fleet-")
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self._rng = random.Random(seed)
+        self._lock = threading.RLock()
+        self._stopping = False
+        self._monitor: Optional[threading.Thread] = None
+        self.events: deque = deque(maxlen=1000)
+        self.replicas: List[ReplicaHandle] = [
+            ReplicaHandle(
+                index=i,
+                portfile=os.path.join(self.fleet_dir, f"replica-{i}.json"),
+            )
+            for i in range(self.config.n_replicas)
+        ]
+
+    # -- events --------------------------------------------------------
+
+    def _event(self, index: int, event: str, **detail) -> None:
+        rec = {"t": round(time.time(), 3), "replica": index,
+               "event": event, **detail}
+        self.events.append(rec)
+        logger.info("fleet: replica %d %s %s", index, event,
+                    detail or "")
+
+    # -- spawning ------------------------------------------------------
+
+    def _spawn(self, h: ReplicaHandle) -> None:
+        if os.path.exists(h.portfile):
+            os.unlink(h.portfile)
+        env = os.environ.copy()
+        # a fault spec armed for THIS process must not leak into every
+        # replica; drills arm per-replica specs through env_for
+        env.pop("DGEN_TPU_FAULTS", None)
+        env["DGEN_TPU_SERVE_REPLICA"] = str(h.index)
+        extra = self._env_for(h.index, h.spawn_count) if self._env_for else None
+        if extra:
+            env.update({k: str(v) for k, v in extra.items()})
+        log_path = os.path.join(
+            self.fleet_dir, f"replica-{h.index}.log")
+        # append-only diagnostics, not a run artifact: a torn tail is
+        # exactly what a crashed replica's log should show
+        logf = open(log_path, "ab")  # dgenlint: disable=L11
+        try:
+            h.proc = subprocess.Popen(
+                self._cmd_for(h.index, h.portfile),
+                stdout=logf, stderr=subprocess.STDOUT, env=env,
+            )
+        finally:
+            logf.close()   # the child holds its own fd now
+        h.spawn_count += 1
+        h.spawned_at = time.monotonic()
+        h.port = None
+        h.pid = h.proc.pid
+        h.state = SPAWNING
+        self._event(h.index, "spawned", pid=h.proc.pid,
+                    incarnation=h.spawn_count - 1)
+
+    def start(self) -> "ReplicaSupervisor":
+        with self._lock:
+            for h in self.replicas:
+                self._spawn(h)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="dgen-fleet-monitor",
+            daemon=True,
+        )
+        self._monitor.start()
+        return self
+
+    # -- monitoring ----------------------------------------------------
+
+    @staticmethod
+    def _probe_ready(port: int) -> bool:
+        try:
+            status, _, _ = http_json(port, "/readyz", timeout=2.0)
+            return status == 200
+        except HTTP_ERRORS:
+            # includes BadStatusLine from a replica dying mid-response
+            # — the probe reports unready, the liveness poll then sees
+            # the death
+            return False
+
+    def _on_death(self, h: ReplicaHandle, rc: Optional[int]) -> None:
+        now = time.monotonic()
+        h.deaths.append(now)
+        if rc is not None:
+            h.exit_codes.append(rc)
+        h.last_death_at = now
+        h.port = None
+        window = [t for t in h.deaths
+                  if now - t <= self.config.restart_window_s]
+        if len(window) > self.config.max_restarts:
+            h.state = FAILED
+            self._event(h.index, "crash_loop", exit_code=rc,
+                        deaths_in_window=len(window))
+            return
+        backoff = self.policy.backoff_s(
+            min(len(window) - 1, 6), self._rng)
+        h.restart_at = now + backoff
+        h.state = BACKOFF
+        self._event(h.index, "died", exit_code=rc,
+                    restart_in_s=round(backoff, 3))
+
+    def _boot_timeout(self, h: ReplicaHandle) -> None:
+        self._event(h.index, "boot_timeout")
+        rc = None
+        if h.proc is not None:
+            if h.proc.poll() is None:
+                h.proc.kill()
+                try:
+                    h.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass   # unkillable (D-state); poll again next tick
+            rc = h.proc.poll()
+        self._on_death(h, rc)
+
+    def _tick(self) -> None:
+        # readiness probes are network round-trips (up to 2 s); run
+        # them OUTSIDE the lock so the front's per-request
+        # ready_handles() snapshot never waits behind a stalling probe.
+        # The monitor thread is the only state mutator, so a handle
+        # probed here cannot change state underneath us.
+        with self._lock:
+            to_probe = [
+                (h, h.port) for h in self.replicas
+                if h.state == BOOTING and h.port is not None
+            ]
+        probe_ok = {h.index: self._probe_ready(port)
+                    for h, port in to_probe}
+        now = time.monotonic()
+        with self._lock:
+            for h in self.replicas:
+                if h.state in (SPAWNING, BOOTING, READY):
+                    rc = h.proc.poll() if h.proc is not None else 1
+                    if rc is not None:
+                        self._on_death(h, rc)
+                        continue
+                if h.state == SPAWNING:
+                    if os.path.isfile(h.portfile):
+                        try:
+                            with open(h.portfile) as f:
+                                data = json.load(f)
+                            h.port = int(data["port"])
+                        except (OSError, ValueError, KeyError):
+                            pass   # partially visible; next tick re-reads
+                        else:
+                            h.state = BOOTING
+                            self._event(h.index, "bound", port=h.port)
+                    elif now - h.spawned_at > self.config.boot_timeout_s:
+                        self._boot_timeout(h)
+                elif h.state == BOOTING:
+                    if probe_ok.get(h.index, False):
+                        h.state = READY
+                        h.ready_at = now
+                        h.boot_wall_s = now - h.spawned_at
+                        if h.last_death_at is not None:
+                            h.last_recovery_s = now - h.last_death_at
+                        self._event(
+                            h.index, "ready",
+                            boot_wall_s=round(h.boot_wall_s, 3),
+                            recovery_s=(
+                                round(h.last_recovery_s, 3)
+                                if h.last_recovery_s is not None else None
+                            ),
+                        )
+                    elif now - h.spawned_at > self.config.boot_timeout_s:
+                        self._boot_timeout(h)
+                elif h.state == BACKOFF:
+                    if h.restart_at is not None and now >= h.restart_at:
+                        self._spawn(h)
+
+    def _monitor_loop(self) -> None:
+        while not self._stopping:
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the monitor must outlive
+                # any single bad tick: a dead monitor means an
+                # unsupervised fleet that still LOOKS supervised
+                logger.exception("fleet monitor: tick failed")
+            time.sleep(self.config.poll_interval_s)
+
+    # -- queries -------------------------------------------------------
+
+    def ready_handles(self) -> List[ReplicaHandle]:
+        """Snapshot of routable replicas (READY, port known)."""
+        with self._lock:
+            return [h for h in self.replicas
+                    if h.state == READY and h.port is not None]
+
+    def states(self) -> Dict[int, str]:
+        with self._lock:
+            return {h.index: h.state for h in self.replicas}
+
+    def summary(self) -> List[dict]:
+        with self._lock:
+            return [h.summary() for h in self.replicas]
+
+    def wait_ready(self, n: Optional[int] = None,
+                   timeout: float = 180.0) -> bool:
+        """Block until >= n replicas are READY (default: all of them).
+        False on timeout — callers decide whether partial strength is
+        acceptable."""
+        want = self.config.n_replicas if n is None else n
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.ready_handles()) >= want:
+                return True
+            time.sleep(min(self.config.poll_interval_s, 0.1))
+        return len(self.ready_handles()) >= want
+
+    # -- control -------------------------------------------------------
+
+    def terminate_replica(self, index: int,
+                          sig: int = signal.SIGKILL) -> bool:
+        """Deliver ``sig`` to a replica (benches shoot fleets with
+        this; drills prefer deterministic fault specs).  The monitor
+        then sees the death and handles restart."""
+        with self._lock:
+            h = self.replicas[index]
+            if h.proc is None or h.proc.poll() is not None:
+                return False
+            h.proc.send_signal(sig)
+            self._event(index, "signalled", sig=int(sig))
+            return True
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Stop the fleet: SIGTERM every live replica (graceful drain
+        — each finishes its in-flight batches), bounded wait, SIGKILL
+        stragglers.  ``drain=False`` goes straight to SIGKILL."""
+        timeout = timeout if timeout is not None else (
+            self.config.drain_timeout_s)
+        with self._lock:
+            if self._stopping and all(
+                h.state == STOPPED for h in self.replicas
+            ):
+                return   # already stopped (drain_front + CLI finally)
+            self._stopping = True
+        if self._monitor is not None and self._monitor.is_alive():
+            self._monitor.join(timeout=10.0)
+        live = [h for h in self.replicas
+                if h.proc is not None and h.proc.poll() is None]
+        for h in live:
+            h.proc.send_signal(
+                signal.SIGTERM if drain else signal.SIGKILL)
+        deadline = time.monotonic() + timeout
+        for h in live:
+            remaining = max(deadline - time.monotonic(), 0.1)
+            try:
+                h.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    "fleet: replica %d did not drain in %.1fs; killing",
+                    h.index, timeout)
+                h.proc.kill()
+                h.proc.wait(timeout=10.0)
+        with self._lock:
+            for h in self.replicas:
+                h.state = STOPPED
+        self._event(-1, "fleet_stopped")

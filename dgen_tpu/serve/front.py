@@ -1,0 +1,522 @@
+"""The fleet's routing front: round-robin over READY replicas, with
+per-replica circuit breakers, bounded failover retry, load shedding,
+and graceful drain.
+
+One tiny HTTP process sits in front of the
+:class:`~dgen_tpu.serve.fleet.ReplicaSupervisor`'s N replicas and owns
+the client-facing contract:
+
+* **Routing** — ``POST /query`` round-robins over replicas that are
+  READY *and* whose breaker admits traffic.  A forward failure
+  (connect refused/reset, forward timeout, replica 5xx) is retried
+  exactly once on a *different* replica — safe because every query is
+  idempotent (a pure function of banks/inputs/agent/year; see
+  docs/serve.md) — then surfaces as 503 + Retry-After.  The front
+  never answers 502/504: every terminal failure is a retryable 503,
+  so a well-behaved client's only failure mode is a bounded retry
+  loop.
+* **Circuit breakers** — ``FleetConfig.breaker_failures`` consecutive
+  failures OPEN a replica's breaker (no traffic); after
+  ``breaker_cooldown_s`` one HALF_OPEN probe request is admitted —
+  success closes the breaker, failure re-opens it.  This takes a hung
+  replica out of rotation after a handful of timeouts instead of
+  paying the timeout on every request.
+* **Load shedding** — a scrape thread aggregates replica ``/metricz``
+  every ``metricz_interval_s``; when summed queue depth exceeds
+  ``shed_queue_frac`` of summed queue capacity, new queries are shed
+  with 503 + Retry-After *at the front*, before they cost a forward.
+  Shedding beats collapse: the fleet's queues stay bounded, p99 stays
+  a queue wait instead of a timeout.
+* **Drain** — SIGTERM (or :func:`drain_front`) stops admitting
+  queries (503 + Retry-After, ``/readyz`` red), waits for in-flight
+  forwards, then SIGTERMs the replicas (each drains its own batches)
+  and exits.
+
+The ``front_route`` fault site fires on every forward attempt, so the
+fleet drill can inject routing-layer failures and assert the breaker +
+retry machinery heals them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import signal
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Dict, Optional
+
+from dgen_tpu.config import FleetConfig
+from dgen_tpu.resilience.faults import FaultError, fault_point
+from dgen_tpu.serve.fleet import (
+    HTTP_ERRORS,
+    ReplicaSupervisor,
+    http_json,
+)
+from dgen_tpu.serve.server import InflightTracker, _JsonHandler
+from dgen_tpu.utils import timing
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+# breaker states
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Per-replica admission state machine (thread-safe).
+
+    CLOSED: traffic flows; ``failures_to_open`` *consecutive* failures
+    trip it OPEN.  OPEN: no traffic until ``cooldown_s`` elapses, then
+    exactly ONE probe request is admitted (HALF_OPEN).  Probe success
+    → CLOSED (counter reset); probe failure → OPEN again with a fresh
+    cooldown.  ``clock`` is injectable so tests drive time."""
+
+    def __init__(self, failures_to_open: int = 3, cooldown_s: float = 1.0,
+                 clock=time.monotonic) -> None:
+        self.failures_to_open = failures_to_open
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self.n_opened = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a request be routed here now?  Mutating: an OPEN breaker
+        past its cooldown transitions to HALF_OPEN and admits exactly
+        one probe — call it only on the replica actually being picked."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if (self._clock() - self._opened_at) >= self.cooldown_s:
+                    self._state = HALF_OPEN
+                    return True   # the one probe
+                return False
+            return False          # HALF_OPEN: probe already in flight
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive = 0
+            self._opened_at = None
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if (self._state == HALF_OPEN
+                    or self._consecutive >= self.failures_to_open):
+                if self._state != OPEN:
+                    self.n_opened += 1
+                self._state = OPEN
+                self._opened_at = self._clock()
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+                "times_opened": self.n_opened,
+            }
+
+
+class FleetFront:
+    """Routing + shedding + drain over a supervisor's replicas (module
+    docstring).  Transport-independent: :meth:`route_query` takes and
+    returns bytes, so it is unit-testable without sockets and the
+    handler stays a thin shell."""
+
+    def __init__(self, supervisor: ReplicaSupervisor,
+                 config: Optional[FleetConfig] = None) -> None:
+        self.sup = supervisor
+        self.config = config or supervisor.config
+        self.t_start = time.time()
+        self._drain = InflightTracker()
+        self._breakers: Dict[int, CircuitBreaker] = {}
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._scrape_thread: Optional[threading.Thread] = None
+        #: replica index -> (monotonic scrape time, /metricz payload)
+        self._metricz: Dict[int, tuple] = {}
+        self._lat = timing.LogHistogram()
+        # counters (under _lock)
+        self.n_requests = 0
+        self.n_shed = 0
+        self.n_drained = 0
+        self.n_retries = 0
+        self.n_forward_failures = 0
+        self.n_unrouted = 0
+
+    # -- breakers ------------------------------------------------------
+
+    def breaker(self, index: int) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(index)
+            if br is None:
+                br = CircuitBreaker(
+                    failures_to_open=self.config.breaker_failures,
+                    cooldown_s=self.config.breaker_cooldown_s,
+                )
+                self._breakers[index] = br
+            return br
+
+    # -- scrape / shed -------------------------------------------------
+
+    def start(self) -> "FleetFront":
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, name="dgen-front-scrape",
+            daemon=True,
+        )
+        self._scrape_thread.start()
+        return self
+
+    def _scrape_loop(self) -> None:
+        while not self._closed:
+            for h in self.sup.ready_handles():
+                payload = self._scrape_one(h.port)
+                if payload is not None:
+                    self._metricz[h.index] = (time.monotonic(), payload)
+            time.sleep(self.config.metricz_interval_s)
+
+    @staticmethod
+    def _scrape_one(port: int) -> Optional[dict]:
+        try:
+            status, blob, _ = http_json(port, "/metricz", timeout=2.0)
+            if status != 200:
+                return None
+            return json.loads(blob)
+        except HTTP_ERRORS:
+            return None
+
+    def _fresh_metricz(self) -> Dict[int, dict]:
+        """Scrapes younger than 3 intervals, restricted to replicas
+        that are READY right now."""
+        now = time.monotonic()
+        horizon = 3.0 * self.config.metricz_interval_s
+        ready = {h.index for h in self.sup.ready_handles()}
+        # dict() is one atomic C-level copy under the GIL: the scrape
+        # thread may insert concurrently, and iterating the live dict
+        # here would raise "changed size during iteration" mid-request
+        snap = dict(self._metricz)
+        return {
+            i: p for i, (t, p) in snap.items()
+            if i in ready and (now - t) <= horizon
+        }
+
+    def shed_now(self) -> bool:
+        """Occupancy-driven load shedding: aggregate queue depth over
+        READY replicas vs aggregate capacity.  No fresh signal = no
+        shedding (never shed blind)."""
+        fresh = self._fresh_metricz()
+        if not fresh:
+            return False
+        depth = sum(int(p.get("queue_depth", 0)) for p in fresh.values())
+        cap = sum(int(p.get("max_queue", 0)) for p in fresh.values())
+        return cap > 0 and depth >= self.config.shed_queue_frac * cap
+
+    # -- routing -------------------------------------------------------
+
+    def _pick(self, exclude: set):
+        """Next routable replica in round-robin order, honoring
+        breakers.  ``allow()`` is only called on the candidate actually
+        being picked (a HALF_OPEN probe slot must not be consumed by
+        mere consideration)."""
+        handles = sorted(
+            (h for h in self.sup.ready_handles()
+             if h.index not in exclude),
+            key=lambda h: h.index,
+        )
+        if not handles:
+            return None
+        start = next(self._rr)
+        for k in range(len(handles)):
+            h = handles[(start + k) % len(handles)]
+            if self.breaker(h.index).allow():
+                return h
+        return None
+
+    def _forward(self, h, raw: bytes) -> tuple:
+        status, blob, _ = http_json(
+            h.port, "/query", method="POST", body=raw,
+            timeout=self.config.request_timeout_s,
+        )
+        return status, blob
+
+    @staticmethod
+    def _blob(payload: dict) -> bytes:
+        return json.dumps(payload).encode("utf-8")
+
+    def _retry_after(self) -> Dict[str, str]:
+        return {"Retry-After": str(
+            int(self.config.retry_after_s)
+            if float(self.config.retry_after_s).is_integer()
+            else self.config.retry_after_s
+        )}
+
+    def route_query(self, raw: bytes) -> tuple:
+        """(status, body bytes, extra headers) for one client /query.
+        Replica answers (200 and 4xx alike) pass through byte-for-byte;
+        front-generated failures are always retryable 503s."""
+        t0 = time.monotonic()
+        with self._lock:
+            self.n_requests += 1
+        if self.draining:
+            with self._lock:
+                self.n_drained += 1
+            return 503, self._blob(
+                {"error": "fleet is draining", "retry": True,
+                 "draining": True}
+            ), self._retry_after()
+        if self.shed_now():
+            with self._lock:
+                self.n_shed += 1
+            return 503, self._blob(
+                {"error": "fleet overloaded; shedding load",
+                 "retry": True, "shed": True}
+            ), self._retry_after()
+        self._drain.enter()
+        try:
+            tried: set = set()
+            last_err = None
+            for attempt in range(2):   # initial + ONE other-replica retry
+                h = self._pick(tried)
+                if h is None:
+                    break
+                tried.add(h.index)
+                if attempt > 0:
+                    with self._lock:
+                        self.n_retries += 1
+                br = self.breaker(h.index)
+                try:
+                    # drill hook: a routing-layer forward failure
+                    # (connect refused/reset before the replica saw
+                    # anything) — must count against THIS replica's
+                    # breaker and fail over like any transport error
+                    fault_point("front_route")
+                    code, blob = self._forward(h, raw)
+                except (FaultError, *HTTP_ERRORS) as e:
+                    br.record_failure()
+                    with self._lock:
+                        self.n_forward_failures += 1
+                    last_err = f"{type(e).__name__}: {e}"
+                    continue
+                if code == 503:
+                    # replica alive but shedding/draining: not a breaker
+                    # failure; prefer another replica, else surface it
+                    br.record_success()
+                    last_err = "replica 503"
+                    continue
+                if code >= 500:
+                    br.record_failure()
+                    with self._lock:
+                        self.n_forward_failures += 1
+                    last_err = f"replica {code}"
+                    continue
+                br.record_success()
+                self._lat.record(time.monotonic() - t0)
+                return code, blob, {}
+            with self._lock:
+                self.n_unrouted += 1
+            self._lat.record(time.monotonic() - t0)
+            return 503, self._blob(
+                {"error": "no replica available", "retry": True,
+                 "detail": last_err}
+            ), self._retry_after()
+        finally:
+            self._drain.exit()
+
+    # -- probe endpoints -----------------------------------------------
+
+    def healthz(self) -> dict:
+        return {
+            "status": "ok",
+            "live": True,
+            "role": "fleet-front",
+            "ready": bool(self.sup.ready_handles()) and not self.draining,
+            "draining": self.draining,
+            "uptime_s": round(time.time() - self.t_start, 1),
+            "replicas": [
+                {**h.summary(), "breaker": self.breaker(h.index).to_json()}
+                for h in self.sup.replicas
+            ],
+            "events_tail": list(self.sup.events)[-20:],
+        }
+
+    def readyz(self) -> tuple:
+        ok = bool(self.sup.ready_handles()) and not self.draining
+        return (200 if ok else 503), {
+            "ready": ok,
+            "ready_replicas": len(self.sup.ready_handles()),
+            "draining": self.draining,
+        }
+
+    def metricz(self) -> dict:
+        """Fleet-aggregated metrics: summed queue depths, weighted
+        occupancy, per-replica breaker state + last /metricz scrape."""
+        fresh = self._fresh_metricz()
+        depth = sum(int(p.get("queue_depth", 0)) for p in fresh.values())
+        cap = sum(int(p.get("max_queue", 0)) for p in fresh.values())
+        w_occ = None
+        batches = sum(
+            int(p.get("batches", 0) or 0) for p in fresh.values())
+        if batches:
+            w_occ = sum(
+                float(p.get("batch_occupancy") or 0.0)
+                * int(p.get("batches", 0) or 0)
+                for p in fresh.values()
+            ) / batches
+        with self._lock:
+            counters = {
+                "requests": self.n_requests,
+                "shed": self.n_shed,
+                "drained": self.n_drained,
+                "retries": self.n_retries,
+                "forward_failures": self.n_forward_failures,
+                "unrouted": self.n_unrouted,
+            }
+        snap = self._lat.snapshot()
+        return {
+            "role": "fleet-front",
+            "ready_replicas": len(self.sup.ready_handles()),
+            "n_replicas": self.sup.config.n_replicas,
+            "queue_depth": depth,
+            "queue_capacity": cap,
+            "occupancy_weighted": (
+                round(w_occ, 4) if w_occ is not None else None),
+            "draining": self.draining,
+            "shedding": self.shed_now(),
+            **counters,
+            "latency_ms": {
+                "p50": round(snap["p50"] * 1e3, 3),
+                "p90": round(snap["p90"] * 1e3, 3),
+                "p99": round(snap["p99"] * 1e3, 3),
+                "count": snap["count"],
+            },
+            "replicas": {
+                str(h.index): {
+                    "state": h.state,
+                    "breaker": self.breaker(h.index).to_json(),
+                    "metricz": fresh.get(h.index),
+                }
+                for h in self.sup.replicas
+            },
+        }
+
+    # -- drain / shutdown ----------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._drain.draining
+
+    @property
+    def inflight(self) -> int:
+        return self._drain.inflight
+
+    def begin_drain(self) -> None:
+        self._drain.begin_drain()
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        return self._drain.wait_idle(timeout)
+
+    def close(self) -> None:
+        self._closed = True
+
+
+class _FrontHandler(_JsonHandler):
+    """Thin HTTP shell over :class:`FleetFront`."""
+
+    @property
+    def front(self) -> FleetFront:
+        return self.server.front  # type: ignore[attr-defined]
+
+    def _socket_timeout_s(self) -> float:
+        # a front request spans up to two forward attempts
+        return 2.0 * self.front.config.request_timeout_s + 5.0
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server contract
+        if self.path == "/healthz":
+            self._send(200, self.front.healthz())
+        elif self.path == "/readyz":
+            code, payload = self.front.readyz()
+            self._send(code, payload)
+        elif self.path == "/metricz":
+            self._send(200, self.front.metricz())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server contract
+        raw = self._read_body()
+        if raw is None:
+            return
+        if self.path != "/query":
+            self._send(404, {"error": f"no route {self.path}"})
+            return
+        code, blob, headers = self.front.route_query(raw)
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(blob)
+
+
+def make_front_server(front: FleetFront) -> ThreadingHTTPServer:
+    """Bind the front's HTTP server (port 0 = ephemeral)."""
+    srv = ThreadingHTTPServer(
+        (front.config.host, front.config.port), _FrontHandler
+    )
+    srv.front = front  # type: ignore[attr-defined]
+    return srv
+
+
+def start_front_in_thread(front: FleetFront) -> ThreadingHTTPServer:
+    srv = make_front_server(front)
+    t = threading.Thread(
+        target=srv.serve_forever, name="dgen-front-http", daemon=True
+    )
+    t.start()
+    return srv
+
+
+def drain_front(front: FleetFront, srv: ThreadingHTTPServer,
+                stop_fleet: bool = True,
+                timeout: Optional[float] = None) -> bool:
+    """Fleet-wide graceful drain: stop admitting at the front, wait for
+    in-flight forwards, SIGTERM the replicas (each drains its own
+    batches), stop the accept loop."""
+    timeout = timeout if timeout is not None else (
+        front.config.drain_timeout_s)
+    front.begin_drain()
+    idle = front.wait_idle(timeout)
+    if stop_fleet:
+        front.sup.stop(drain=True, timeout=timeout)
+    front.close()
+    srv.shutdown()
+    return idle
+
+
+def install_sigterm_drain_front(front: FleetFront,
+                                srv: ThreadingHTTPServer) -> None:
+    """SIGTERM = drain the whole fleet.  Main-thread only (CPython
+    signal contract); the drain runs on a helper thread."""
+
+    def _on_term(signum, frame) -> None:
+        logger.info("fleet front: SIGTERM — draining fleet")
+        threading.Thread(
+            target=drain_front, args=(front, srv),
+            name="dgen-front-drain", daemon=True,
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_term)

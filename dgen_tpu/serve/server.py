@@ -1,29 +1,56 @@
 """Threaded JSON-over-HTTP front-end for the serving engine.
 
 Stdlib-only (http.server) by design: the repo's hard dependency set
-stays jax+numpy, and the endpoint shape — one POST route, two GET
+stays jax+numpy, and the endpoint shape — one POST route, three GET
 probes — does not need a framework. One process serves:
 
   * ``POST /query``   {"agent_ids": [...], "year": 2026,
                        "overrides": {"scale": {"itc_fraction": 0.5}},
                        "cash_flow": false}
                       -> {"year": ..., "results": [{...} per agent]}
-  * ``GET  /healthz`` liveness + the shared provenance stamp
+  * ``GET  /healthz`` LIVENESS: the process is up and answering, plus
+                      the shared provenance stamp
                       (io.export.provenance_stamp: git sha, config
-                      hash, backend) + warm bucket shapes
+                      hash, backend), replica identity, warm bucket
+                      shapes, and the boot report (warmup wall,
+                      compile-cache hit/miss counts)
+  * ``GET  /readyz``  READINESS: 200 only once warmup completed and
+                      warm_buckets is non-empty (and the process is
+                      not draining) — the signal the fleet front and
+                      any external LB route on.  Liveness != readiness:
+                      a booting replica is alive but unroutable.
   * ``GET  /metricz`` lifetime serving stats: p50/p99 request latency,
                       queue depth, batch occupancy (utils.timing
-                      histograms + Microbatcher counters)
+                      histograms + Microbatcher counters), replica
+                      identity, steady-state compile counts.
 
 Handlers never build programs (dgenlint L10): every device program was
 compiled at engine warmup; a handler only validates, enqueues, and
 formats.
+
+Timeout discipline (the first satellite of the fleet PR): every way a
+request can wedge a handler thread is bounded —
+
+  * a client that never finishes sending (or never reads) trips the
+    per-connection socket timeout (``ServeConfig.socket_timeout_s``);
+  * a hung engine call trips the per-request deadline
+    (``ServeConfig.request_timeout_s``) and answers **504**, with the
+    still-queued future cancelled so the stalled work is dropped, not
+    executed after the stall clears.
+
+Graceful drain (reused by the fleet): :func:`drain` flips the app to
+draining (new queries answer 503 + Retry-After and ``/readyz`` goes
+red so routers stop sending), waits for in-flight requests, flushes
+the batcher's queued batches, then stops the accept loop.
+:func:`install_sigterm_drain` wires that to SIGTERM for the CLI.
 """
 
 from __future__ import annotations
 
 import json
 import math
+import os
+import signal
 import threading
 import time
 from concurrent.futures import TimeoutError as FutureTimeout
@@ -36,7 +63,7 @@ from dgen_tpu.config import ServeConfig
 from dgen_tpu.io.export import provenance_stamp
 from dgen_tpu.serve.batcher import Microbatcher, QueueFullError
 from dgen_tpu.serve.engine import QUERY_FIELDS, OverrideError, ServeEngine
-from dgen_tpu.utils import timing
+from dgen_tpu.utils import compilecache, timing
 from dgen_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -45,9 +72,58 @@ logger = get_logger()
 #: few KB; anything near this is malformed or hostile
 _MAX_BODY_BYTES = 1 << 20
 
-#: per-request wait bound on the batcher future — covers a device hang
-#: without wedging every handler thread forever
-_QUERY_TIMEOUT_S = 60.0
+#: Retry-After stamped on a single replica's 503s (queue full, drain);
+#: the fleet front has its own knob (FleetConfig.retry_after_s)
+_RETRY_AFTER_S = 1
+
+#: env var carrying the replica index into a fleet-spawned process
+#: (set by serve.fleet; surfaces in /healthz and /metricz identity)
+REPLICA_ENV = "DGEN_TPU_SERVE_REPLICA"
+
+
+class DrainingError(RuntimeError):
+    """The process is draining: no new queries are admitted; clients
+    should retry against another replica (HTTP 503 + Retry-After)."""
+
+
+class InflightTracker:
+    """Drain bookkeeping shared by the replica app and the fleet
+    front: count in-flight requests, flip a draining flag, and wait
+    (bounded) for the count to reach zero."""
+
+    def __init__(self) -> None:
+        self.draining = False
+        self._inflight = 0
+        self._cv = threading.Condition()
+
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    def enter(self) -> None:
+        with self._cv:
+            self._inflight += 1
+
+    def exit(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify_all()
+
+    @property
+    def inflight(self) -> int:
+        with self._cv:
+            return self._inflight
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until nothing is in flight (True) or the timeout
+        lapses (False — the caller exits anyway; drain is bounded)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
 
 
 def _num(v) -> "float | None":
@@ -75,20 +151,46 @@ def _rows_to_json(out: Dict[str, np.ndarray], cash_flow: bool) -> list:
     return rows
 
 
+def _env_replica_index() -> Optional[int]:
+    raw = os.environ.get(REPLICA_ENV, "").strip()
+    try:
+        return int(raw) if raw else None
+    except ValueError:
+        return None
+
+
 class ServeApp:
     """The server's state: engine + batcher + provenance, shared by
-    every handler thread."""
+    every handler thread.
+
+    ``defer_warmup=True`` skips warmup at construction so the HTTP
+    socket can bind (and /healthz answer) while bucket programs are
+    still compiling — the caller then runs :meth:`warmup_now` (usually
+    on a thread; the replica CLI does).  ``/readyz`` stays 503 until
+    warmup completes: liveness != readiness.
+    """
 
     def __init__(
         self,
         engine: ServeEngine,
         config: Optional[ServeConfig] = None,
         provenance: Optional[dict] = None,
+        replica_index: Optional[int] = None,
+        defer_warmup: bool = False,
     ) -> None:
         self.engine = engine
         self.config = config or ServeConfig()
         self.batcher = Microbatcher(engine, self.config)
         self.t_start = time.time()
+        self.replica_index = (
+            replica_index if replica_index is not None
+            else _env_replica_index()
+        )
+        self._drain = InflightTracker()
+        self.boot_report: dict = {}
+        self._warmup_done = not self.config.warmup
+        self._steady_guard = None
+        self._closed = False
         # one stamp at construction: /healthz must stay allocation-free
         # and subprocess-free per probe
         self.provenance = provenance if provenance is not None else (
@@ -96,25 +198,100 @@ class ServeApp:
                 engine.sim.run_config, engine.sim.scenario, self.config,
             )
         )
-        if self.config.warmup:
-            t0 = time.time()
-            engine.warmup(self.config.buckets)
-            logger.info(
-                "serve warmup: %d bucket programs in %.1fs",
-                len(self.config.buckets), time.time() - t0,
-            )
+        if self.config.warmup and not defer_warmup:
+            self.warmup_now()
+        elif self._warmup_done:
+            # warmup disabled (debug): steady-state compiles are then
+            # an honest >0 — count them from the start
+            self._arm_steady_guard()
+
+    # -- boot ----------------------------------------------------------
+
+    def warmup_now(self) -> None:
+        """Compile/load every bucket program, recording the boot report
+        (warmup wall + compile-cache hit/miss counts — ``hits ==
+        requests`` proves a shared-cache fast boot: nothing was
+        compiled, every program deserialized from the cache a sibling
+        replica or previous incarnation populated).  Idempotent."""
+        if self._warmup_done:
+            return
+        t0 = time.time()
+        with compilecache.HitCounter() as hc:
+            self.engine.warmup(self.config.buckets)
+        wall = time.time() - t0
+        self.boot_report = {
+            "warmup_s": round(wall, 3),
+            "buckets": list(self.config.buckets),
+            "compile_cache": {
+                **hc.to_json(),
+                "dir": (compilecache.stats() or {}).get("dir"),
+            },
+        }
+        self._warmup_done = True
+        self._arm_steady_guard()
+        logger.info(
+            "serve warmup: %d bucket programs in %.1fs "
+            "(cache hits %d / misses %d)",
+            len(self.config.buckets), wall, hc.hits, hc.misses,
+        )
+
+    def _arm_steady_guard(self) -> None:
+        """Count (never fail on) post-warmup compiles/traces; /metricz
+        reports them so the fleet drill can assert the zero-steady-
+        state-compile invariant on every replica from outside."""
+        from dgen_tpu.lint.guard import RetraceGuard
+
+        self._steady_guard = RetraceGuard(
+            max_compiles=1 << 30, max_traces=None,
+            context="serve steady state",
+        ).start()
+
+    @property
+    def ready(self) -> bool:
+        """Routable: warmup complete, at least one warm bucket program,
+        and not draining.  (Liveness is 'the process answers /healthz';
+        this is the stricter signal the front routes on.)"""
+        return (
+            self._warmup_done
+            and bool(self.engine.warm_buckets)
+            and not self.draining
+        )
 
     # -- endpoint bodies (transport-independent, unit-testable) --------
+
+    def identity(self) -> dict:
+        """Who is answering: stamped into /healthz and /metricz so a
+        fleet operator can tell replicas apart."""
+        return {
+            "pid": os.getpid(),
+            "replica_index": self.replica_index,
+            "boot_time_unix": round(self.t_start, 3),
+            "uptime_s": round(time.time() - self.t_start, 1),
+        }
 
     def healthz(self) -> dict:
         return {
             "status": "ok",
-            "uptime_s": round(time.time() - self.t_start, 1),
+            "live": True,
+            "ready": self.ready,
+            "draining": self.draining,
             "n_agents": self.engine.n_agents,
             "years": self.engine.years,
             "buckets": list(self.config.buckets),
             "warm_buckets": sorted(self.engine.warm_buckets),
+            "boot": self.boot_report,
+            **self.identity(),
             **self.provenance,
+        }
+
+    def readyz(self) -> tuple:
+        """(status_code, payload): 200 only when routable."""
+        ok = self.ready
+        return (200 if ok else 503), {
+            "ready": ok,
+            "draining": self.draining,
+            "warmup_done": self._warmup_done,
+            "warm_buckets": sorted(self.engine.warm_buckets),
         }
 
     def metricz(self) -> dict:
@@ -127,85 +304,169 @@ class ServeApp:
                 "p99": round(snap["p99"] * 1e3, 3),
                 "count": snap["count"],
             }
-        rec["uptime_s"] = round(time.time() - self.t_start, 1)
+        rec.update(self.identity())
+        rec["draining"] = self.draining
+        if self._steady_guard is not None:
+            rec["steady_state_compiles"] = self._steady_guard.n_compiles
+            rec["steady_state_traces"] = self._steady_guard.n_traces
+        # an armed fault registry (drills) reports what actually fired,
+        # so the fleet drill can confirm its injection from outside
+        from dgen_tpu.resilience import faults as faults_mod
+
+        reg = faults_mod.active()
+        if reg is not None:
+            rec["faults_fired"] = {
+                s: reg.fired(s) for s in faults_mod.SITES
+                if reg.fired(s)
+            }
         return rec
 
+    @property
+    def draining(self) -> bool:
+        return self._drain.draining
+
     def run_query(self, body: dict) -> dict:
-        agent_ids = body.get("agent_ids")
-        if not isinstance(agent_ids, list) or not agent_ids:
-            raise ValueError("'agent_ids' must be a non-empty list")
-        year = body.get("year")
-        overrides = body.get("overrides")
-        fut = self.batcher.submit(agent_ids, year, overrides)
+        if self.draining:
+            raise DrainingError(
+                "replica is draining; retry against another replica"
+            )
+        self._drain.enter()
         try:
-            out = fut.result(_QUERY_TIMEOUT_S)
-        except FutureTimeout:
-            # the client gets a 504 either way; cancel so a request
-            # still QUEUED is dropped instead of executed after the
-            # stall clears (double work exactly at the overload point)
-            fut.cancel()
-            raise
-        return {
-            "year": self.engine.years[self.engine.year_index(year)],
-            "results": _rows_to_json(out, bool(body.get("cash_flow"))),
-        }
+            agent_ids = body.get("agent_ids")
+            if not isinstance(agent_ids, list) or not agent_ids:
+                raise ValueError("'agent_ids' must be a non-empty list")
+            year = body.get("year")
+            overrides = body.get("overrides")
+            fut = self.batcher.submit(agent_ids, year, overrides)
+            try:
+                out = fut.result(self.config.request_timeout_s)
+            except FutureTimeout:
+                # the client gets a 504 either way; cancel so a request
+                # still QUEUED is dropped instead of executed after the
+                # stall clears (double work exactly at the overload
+                # point)
+                fut.cancel()
+                raise
+            return {
+                "year": self.engine.years[self.engine.year_index(year)],
+                "results": _rows_to_json(out, bool(body.get("cash_flow"))),
+            }
+        finally:
+            self._drain.exit()
+
+    # -- drain / shutdown ----------------------------------------------
+
+    def begin_drain(self) -> None:
+        """Stop admitting new queries (503 + Retry-After; /readyz goes
+        red so routers stop sending).  In-flight requests keep running;
+        :meth:`wait_idle` + :meth:`close` finish the job."""
+        self._drain.begin_drain()
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until no request is in flight (True) or the timeout
+        lapses (False — the caller exits anyway; drain is bounded)."""
+        return self._drain.wait_idle(timeout)
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         self.batcher.close()
+        if self._steady_guard is not None:
+            self._steady_guard.stop()
+
+    @property
+    def inflight(self) -> int:
+        return self._drain.inflight
 
 
-class _Handler(BaseHTTPRequestHandler):
-    """Routes to the :class:`ServeApp` attached to the server."""
+class _JsonHandler(BaseHTTPRequestHandler):
+    """Shared JSON plumbing for the replica handler and the fleet
+    front's handler: per-connection socket timeout, JSON responses
+    with optional extra headers, quiet logging."""
 
     protocol_version = "HTTP/1.1"
 
-    @property
-    def app(self) -> ServeApp:
-        return self.server.app  # type: ignore[attr-defined]
+    #: overridden per-app in setup(); BaseHTTPRequestHandler applies it
+    #: as the connection's socket timeout
+    timeout = 30.0
 
-    def _send(self, code: int, payload: dict, close: bool = False) -> None:
+    def _socket_timeout_s(self) -> float:
+        return self.timeout
+
+    def setup(self) -> None:
+        # a client that stops sending mid-body (or never reads its
+        # response) must release this handler thread: the socket
+        # timeout bounds every rfile.read/wfile.write
+        self.timeout = self._socket_timeout_s()
+        super().setup()
+
+    def _send(self, code: int, payload: dict, close: bool = False,
+              headers: Optional[Dict[str, str]] = None) -> None:
         blob = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(blob)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         if close:
             # advertises the close AND sets self.close_connection
             self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(blob)
 
+    def _read_body(self, route_check=None) -> Optional[bytes]:
+        """Read (or refuse) a POST body BEFORE routing: any response
+        sent with unread body bytes on a keep-alive connection desyncs
+        the stream (the leftover bytes parse as the next request line)
+        — refusal paths therefore close the connection explicitly.
+        Returns None when a refusal was already sent."""
+        if self.headers.get("Transfer-Encoding"):
+            # chunked bodies are not length-delimited; refuse + close
+            # rather than leave chunk framing in the stream
+            self._send(411, {"error": "Content-Length required"},
+                       close=True)
+            return None
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            self._send(400, {"error": "bad Content-Length"}, close=True)
+            return None
+        if length > _MAX_BODY_BYTES:
+            self._send(413, {"error": "request body too large"},
+                       close=True)
+            return None
+        return self.rfile.read(length)
+
     def log_message(self, fmt: str, *args) -> None:  # quiet by default
         logger.debug("serve http: " + fmt, *args)
+
+
+class _Handler(_JsonHandler):
+    """Routes to the :class:`ServeApp` attached to the server."""
+
+    @property
+    def app(self) -> ServeApp:
+        return self.server.app  # type: ignore[attr-defined]
+
+    def _socket_timeout_s(self) -> float:
+        return self.app.config.socket_timeout_s
 
     def do_GET(self) -> None:  # noqa: N802 — http.server contract
         if self.path == "/healthz":
             self._send(200, self.app.healthz())
+        elif self.path == "/readyz":
+            code, payload = self.app.readyz()
+            self._send(code, payload)
         elif self.path == "/metricz":
             self._send(200, self.app.metricz())
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802 — http.server contract
-        # read (or refuse) the body BEFORE routing: any response sent
-        # with unread body bytes on a keep-alive connection desyncs the
-        # stream (the leftover bytes parse as the next request line) —
-        # refusal paths therefore close the connection explicitly
-        if self.headers.get("Transfer-Encoding"):
-            # chunked bodies are not length-delimited; refuse + close
-            # rather than leave chunk framing in the stream
-            self._send(411, {"error": "Content-Length required"},
-                       close=True)
+        raw = self._read_body()
+        if raw is None:
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-        except (TypeError, ValueError):
-            self._send(400, {"error": "bad Content-Length"}, close=True)
-            return
-        if length > _MAX_BODY_BYTES:
-            self._send(413, {"error": "request body too large"},
-                       close=True)
-            return
-        raw = self.rfile.read(length)
         if self.path != "/query":
             self._send(404, {"error": f"no route {self.path}"})
             return
@@ -213,8 +474,14 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.loads(raw or b"{}")
             self._send(200, self.app.run_query(body))
         except QueueFullError as e:
-            # admission control: tell the client to back off
-            self._send(503, {"error": str(e), "retry": True})
+            # admission control: tell the client to back off — and for
+            # how long (load-shed 503s always carry Retry-After)
+            self._send(503, {"error": str(e), "retry": True},
+                       headers={"Retry-After": str(_RETRY_AFTER_S)})
+        except DrainingError as e:
+            self._send(503, {"error": str(e), "retry": True,
+                             "draining": True},
+                       headers={"Retry-After": str(_RETRY_AFTER_S)})
         except (KeyError, ValueError, OverrideError) as e:
             # KeyError's str() re-quotes its message; unwrap it
             msg = e.args[0] if isinstance(e, KeyError) and e.args else str(e)
@@ -235,13 +502,50 @@ def make_server(app: ServeApp) -> ThreadingHTTPServer:
     return srv
 
 
-def serve_forever(app: ServeApp) -> None:
-    """Run until SIGINT; closes the batcher on the way out."""
-    srv = make_server(app)
+def drain(app: ServeApp, srv: ThreadingHTTPServer,
+          timeout: float = 30.0) -> bool:
+    """Graceful drain, reused by the fleet front's replica shutdown:
+    stop admitting queries (503 + Retry-After, /readyz red), wait for
+    in-flight requests (bounded by ``timeout``), flush the batcher's
+    queued batches, then stop the accept loop.  Returns True when
+    everything in flight finished inside the bound."""
+    app.begin_drain()
+    idle = app.wait_idle(timeout)
+    app.close()          # flushes queued batches, stops the worker
+    srv.shutdown()       # serve_forever returns; listeners stop
+    return idle
+
+
+def install_sigterm_drain(app: ServeApp, srv: ThreadingHTTPServer,
+                          timeout: float = 30.0) -> None:
+    """SIGTERM = graceful drain (the fleet supervisor's stop signal and
+    every container runtime's).  Must be called from the main thread
+    (CPython signal contract); the drain itself runs on a helper thread
+    so the handler returns immediately."""
+
+    def _on_term(signum, frame) -> None:
+        logger.info("serve: SIGTERM — draining (timeout %.1fs)", timeout)
+        threading.Thread(
+            target=drain, args=(app, srv, timeout),
+            name="dgen-serve-drain", daemon=True,
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+
+def serve_forever(app: ServeApp, srv: Optional[ThreadingHTTPServer] = None,
+                  drain_timeout_s: float = 30.0) -> None:
+    """Run until SIGINT (immediate) or SIGTERM (graceful drain);
+    closes the batcher on the way out.  Pass a pre-bound ``srv`` when
+    the caller needed the port before blocking (the replica CLI binds
+    first, writes its portfile, then serves)."""
+    if srv is None:
+        srv = make_server(app)
     host, port = srv.server_address[:2]
+    install_sigterm_drain(app, srv, timeout=drain_timeout_s)
     logger.info(
         "dgen-tpu serve: %d agents, years %s-%s, buckets %s on "
-        "http://%s:%d (/query /healthz /metricz)",
+        "http://%s:%d (/query /healthz /readyz /metricz)",
         app.engine.n_agents, app.engine.years[0], app.engine.years[-1],
         list(app.config.buckets), host, port,
     )

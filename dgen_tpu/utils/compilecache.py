@@ -119,6 +119,51 @@ def ensure_safe_for_backend() -> None:
         disable()
 
 
+class HitCounter:
+    """Counts persistent-cache hits/misses inside a ``with`` region via
+    jax.monitoring events (one ``cache_hits`` event per deserialized
+    executable; one ``compile_requests_use_cache`` per compile request
+    that consulted the cache — misses are the difference).
+
+    The serving fleet's boot report uses this to *prove* shared-cache
+    fast boot: a replica whose warmup reports ``hits == requests``
+    compiled nothing, it deserialized its bucket programs from the
+    cache a sibling (or a previous incarnation) populated."""
+
+    def __init__(self) -> None:
+        self.hits = 0
+        self.requests = 0
+
+    @property
+    def misses(self) -> int:
+        return max(self.requests - self.hits, 0)
+
+    def __enter__(self) -> "HitCounter":
+        def _cb(event: str, **kw) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                self.hits += 1
+            elif event == "/jax/compilation_cache/compile_requests_use_cache":
+                self.requests += 1
+
+        from jax._src import monitoring
+
+        self._cb = _cb
+        monitoring.register_event_listener(_cb)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from jax._src import monitoring
+
+        try:
+            monitoring._unregister_event_listener_by_callback(self._cb)
+        except ValueError:  # already gone (test teardown ordering)
+            pass
+
+    def to_json(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "requests": self.requests}
+
+
 def stats() -> dict:
     """Entry count / bytes of the active cache (for meta.json stamps)."""
     d = _enabled_dir or cache_dir()

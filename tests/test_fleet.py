@@ -1,0 +1,638 @@
+"""Serving-fleet tests (dgen_tpu.serve.fleet / serve.front): the
+circuit-breaker state machine, readiness gating, crash-loop breaker,
+kill-under-load failover with byte-identical answers, graceful drain,
+load-shed 503s with Retry-After, and the replica-side satellites
+(liveness/readiness split, identity stamps, the enforced per-request
+504 deadline).
+
+Two tiers of fidelity:
+
+* **stub replicas** — a tiny no-jax HTTP process speaking the replica
+  protocol (portfile + /readyz + /query echo), so supervisor/front
+  semantics are tested in milliseconds per boot;
+* **real replicas** — actual ``python -m dgen_tpu.serve`` processes
+  over the same synthetic population as an in-process oracle, so
+  failover answers are asserted bit-identical to a single-replica run
+  (the fleet drill runs the full kill+hang matrix; tier-1 keeps a
+  lean kill-only version, the drill itself is `slow` + tools/check.sh).
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+
+import numpy as np
+import pytest
+
+from dgen_tpu.config import (
+    FleetConfig,
+    RunConfig,
+    ScenarioConfig,
+    ServeConfig,
+)
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+from dgen_tpu.resilience import faults
+from dgen_tpu.resilience.supervisor import RetryPolicy
+from dgen_tpu.serve.engine import ServeEngine
+from dgen_tpu.serve.fleet import FAILED, READY, ReplicaSupervisor
+from dgen_tpu.serve.front import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    FleetFront,
+    drain_front,
+    start_front_in_thread,
+)
+from dgen_tpu.serve.server import DrainingError, ServeApp, _rows_to_json
+
+# ---------------------------------------------------------------------------
+# Circuit breaker unit matrix
+# ---------------------------------------------------------------------------
+
+def test_breaker_open_half_open_close_matrix():
+    clock = [0.0]
+    br = CircuitBreaker(failures_to_open=3, cooldown_s=5.0,
+                        clock=lambda: clock[0])
+    # CLOSED admits; consecutive failures below threshold stay CLOSED
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED and br.allow()
+    # a success resets the consecutive count
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED
+    # third consecutive failure trips OPEN; no traffic inside cooldown
+    br.record_failure()
+    assert br.state == OPEN
+    assert not br.allow()
+    clock[0] = 4.9
+    assert not br.allow()
+    # cooldown elapsed: exactly ONE half-open probe is admitted
+    clock[0] = 5.1
+    assert br.allow()
+    assert br.state == HALF_OPEN
+    assert not br.allow()          # second probe refused
+    # probe success -> CLOSED with a fresh failure budget
+    br.record_success()
+    assert br.state == CLOSED and br.allow()
+    assert br.to_json()["consecutive_failures"] == 0
+    assert br.to_json()["times_opened"] == 1
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    clock = [0.0]
+    br = CircuitBreaker(failures_to_open=2, cooldown_s=1.0,
+                        clock=lambda: clock[0])
+    br.record_failure()
+    br.record_failure()
+    assert br.state == OPEN
+    clock[0] = 1.5
+    assert br.allow() and br.state == HALF_OPEN
+    # probe failed: OPEN again, with a FRESH cooldown from now
+    br.record_failure()
+    assert br.state == OPEN
+    clock[0] = 2.0    # only 0.5s into the new cooldown
+    assert not br.allow()
+    clock[0] = 2.6
+    assert br.allow() and br.state == HALF_OPEN
+    br.record_success()
+    assert br.state == CLOSED
+
+
+# ---------------------------------------------------------------------------
+# Stub-replica harness (no jax: supervisor/front semantics in ms)
+# ---------------------------------------------------------------------------
+
+_STUB = '''
+import http.server, json, os, sys, time
+
+portfile = sys.argv[1]
+t0 = time.time()
+ready_delay = float(os.environ.get("STUB_READY_DELAY", "0"))
+ready_flag = os.environ.get("STUB_READY_FLAG", "")
+query_sleep = float(os.environ.get("STUB_QUERY_SLEEP", "0"))
+queue_depth = int(os.environ.get("STUB_QUEUE_DEPTH", "0"))
+max_queue = int(os.environ.get("STUB_MAX_QUEUE", "256"))
+
+
+class H(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _send(self, code, payload):
+        blob = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def log_message(self, *a):
+        pass
+
+    def _ready(self):
+        if ready_flag:
+            return os.path.exists(ready_flag)
+        return (time.time() - t0) >= ready_delay
+
+    def do_GET(self):
+        if self.path == "/readyz":
+            self._send(200 if self._ready() else 503,
+                       {"ready": self._ready()})
+        elif self.path == "/healthz":
+            self._send(200, {"live": True, "pid": os.getpid()})
+        elif self.path == "/metricz":
+            self._send(200, {"queue_depth": queue_depth,
+                             "max_queue": max_queue,
+                             "batches": 2, "batch_occupancy": 0.5,
+                             "pid": os.getpid()})
+        else:
+            self._send(404, {})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n)
+        if query_sleep:
+            time.sleep(query_sleep)
+        # deterministic pure function of the body: what "idempotent,
+        # replica-independent answer" means for a stub
+        self._send(200, {"results": [{"echo": raw.decode()}]})
+
+
+srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+tmp = portfile + ".tmp"
+with open(tmp, "w") as f:
+    json.dump({"pid": os.getpid(), "port": srv.server_address[1]}, f)
+os.replace(tmp, portfile)
+srv.serve_forever()
+'''
+
+
+@pytest.fixture
+def stub_script(tmp_path):
+    p = tmp_path / "stub_replica.py"
+    p.write_text(_STUB)
+    return str(p)
+
+
+def _stub_cmd(script):
+    def cmd_for(index, portfile):
+        return [sys.executable, script, portfile]
+    return cmd_for
+
+
+def _fast_cfg(n, **kw):
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("boot_timeout_s", 30.0)
+    kw.setdefault("metricz_interval_s", 0.05)
+    kw.setdefault("breaker_cooldown_s", 0.2)
+    kw.setdefault("retry_after_s", 1.0)
+    return FleetConfig(n_replicas=n, port=0, **kw)
+
+
+_FAST_POLICY = RetryPolicy(backoff_base_s=0.01, jitter_frac=0.0)
+
+
+def _expected_echo(body: bytes) -> dict:
+    return {"results": [{"echo": body.decode()}]}
+
+
+def test_readiness_gates_routing(stub_script, tmp_path):
+    """A live-but-unready replica receives no traffic; it joins the
+    rotation only once /readyz goes green (liveness != readiness)."""
+    flag = str(tmp_path / "ready.flag")
+
+    def env_for(index, spawn_count):
+        return {"STUB_READY_FLAG": flag} if index == 1 else None
+
+    sup = ReplicaSupervisor(
+        _stub_cmd(stub_script), _fast_cfg(2), policy=_FAST_POLICY,
+        env_for=env_for, fleet_dir=str(tmp_path / "fleet"),
+    ).start()
+    try:
+        assert sup.wait_ready(n=1, timeout=20.0)
+        time.sleep(0.1)   # a few monitor ticks: replica 1 stays unready
+        assert sup.states()[0] == READY
+        assert sup.states()[1] != READY
+        front = FleetFront(sup, sup.config)
+        body = json.dumps({"agent_ids": [1]}).encode()
+        for _ in range(6):
+            code, blob, _hdr = front.route_query(body)
+            assert code == 200
+            assert json.loads(blob) == _expected_echo(body)
+        # flip readiness: replica 1 must join
+        with open(flag, "w") as f:
+            f.write("go")
+        assert sup.wait_ready(n=2, timeout=20.0)
+    finally:
+        sup.stop(drain=False, timeout=5.0)
+
+
+def test_crash_loop_breaker_stops_restart_storm(tmp_path):
+    """A replica that dies on every boot is restarted at most
+    max_restarts times inside the window, then marked FAILED."""
+    cfg = _fast_cfg(1, max_restarts=2, restart_window_s=60.0)
+
+    def cmd_for(index, portfile):
+        return [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+    sup = ReplicaSupervisor(
+        cmd_for, cfg, policy=_FAST_POLICY,
+        fleet_dir=str(tmp_path / "fleet"),
+    ).start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while (sup.states()[0] != FAILED
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        h = sup.replicas[0]
+        assert h.state == FAILED
+        spawns_at_fail = h.spawn_count
+        # 1 initial + at most max_restarts restarts
+        assert spawns_at_fail <= cfg.max_restarts + 1
+        assert all(rc == 3 for rc in h.exit_codes)
+        # and it STAYS failed: no restart storm after the breaker
+        time.sleep(0.3)
+        assert h.spawn_count == spawns_at_fail
+        assert any(e["event"] == "crash_loop" for e in sup.events)
+    finally:
+        sup.stop(drain=False, timeout=5.0)
+
+
+def test_kill_under_load_failover_and_restart(stub_script, tmp_path):
+    """Kill one replica mid-load: every request is still answered, the
+    answers stay byte-identical to the pure function a single replica
+    computes, and the supervisor restarts the dead replica back to
+    full READY strength."""
+    sup = ReplicaSupervisor(
+        _stub_cmd(stub_script),
+        _fast_cfg(2, breaker_failures=2, request_timeout_s=5.0),
+        policy=_FAST_POLICY, fleet_dir=str(tmp_path / "fleet"),
+    ).start()
+    try:
+        assert sup.wait_ready(timeout=20.0)
+        front = FleetFront(sup, sup.config)
+        killed = False
+        for k in range(30):
+            if k == 8:
+                assert sup.terminate_replica(0, signal.SIGKILL)
+                killed = True
+            body = json.dumps({"agent_ids": [k]}).encode()
+            code, blob, _hdr = front.route_query(body)
+            assert code == 200, (k, code, blob)
+            assert json.loads(blob) == _expected_echo(body), k
+        assert killed
+        # the fleet heals: the monitor observes the death (the stub
+        # answers fast enough that the whole load loop can finish
+        # inside one poll tick), restarts, and returns to READY
+        h0 = sup.replicas[0]
+        deadline = time.monotonic() + 20.0
+        while not h0.exit_codes and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert -9 in h0.exit_codes
+        while ((h0.state != READY or h0.spawn_count < 2)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert h0.state == READY and h0.spawn_count == 2
+        assert h0.last_recovery_s is not None
+        assert sup.wait_ready(timeout=20.0)
+    finally:
+        sup.stop(drain=False, timeout=5.0)
+
+
+def test_front_retries_on_other_replica_and_breaker_opens(
+        stub_script, tmp_path):
+    """An injected routing-layer failure (front_route fault site) on
+    the first forward attempt is retried on another replica; repeated
+    failures open the picked replica's breaker."""
+    sup = ReplicaSupervisor(
+        _stub_cmd(stub_script), _fast_cfg(2, breaker_failures=2),
+        policy=_FAST_POLICY, fleet_dir=str(tmp_path / "fleet"),
+    ).start()
+    try:
+        assert sup.wait_ready(timeout=20.0)
+        front = FleetFront(sup, sup.config)
+        body = json.dumps({"q": 1}).encode()
+        # hits 1 and 4: each affected request loses its FIRST forward
+        # attempt only (a request makes up to two attempts, and both
+        # hit the front_route site)
+        with faults.injected("front_route@1;front_route@4"):
+            for _ in range(3):
+                code, blob, _hdr = front.route_query(body)
+                assert code == 200
+                assert json.loads(blob) == _expected_echo(body)
+        assert front.n_retries == 2
+        assert front.n_forward_failures == 2
+        # every failure was charged to the replica it was routed to
+        states = [front.breaker(i).to_json() for i in (0, 1)]
+        assert sum(s["consecutive_failures"] for s in states) >= 1
+    finally:
+        sup.stop(drain=False, timeout=5.0)
+
+
+def test_load_shed_503_carries_retry_after(stub_script, tmp_path):
+    """Aggregated /metricz queue depth beyond shed_queue_frac *
+    capacity sheds new queries at the front: 503 + Retry-After."""
+    sup = ReplicaSupervisor(
+        _stub_cmd(stub_script), _fast_cfg(1, shed_queue_frac=0.8),
+        policy=_FAST_POLICY, fleet_dir=str(tmp_path / "fleet"),
+        env_for=lambda i, sc: {"STUB_QUEUE_DEPTH": "90",
+                               "STUB_MAX_QUEUE": "100"},
+    ).start()
+    try:
+        assert sup.wait_ready(timeout=20.0)
+        front = FleetFront(sup, sup.config).start()
+        deadline = time.monotonic() + 5.0
+        while not front.shed_now() and time.monotonic() < deadline:
+            time.sleep(0.05)   # first scrape lands
+        assert front.shed_now()
+        code, blob, hdr = front.route_query(b"{}")
+        assert code == 503
+        assert "Retry-After" in hdr
+        payload = json.loads(blob)
+        assert payload["retry"] is True and payload.get("shed") is True
+        assert front.n_shed == 1
+        mz = front.metricz()
+        assert mz["queue_depth"] == 90
+        assert mz["queue_capacity"] == 100
+        assert mz["shedding"] is True
+        assert mz["replicas"]["0"]["breaker"]["state"] == CLOSED
+        front.close()
+    finally:
+        sup.stop(drain=False, timeout=5.0)
+
+
+def test_drain_completes_inflight_then_rejects(stub_script, tmp_path):
+    """Graceful drain: the in-flight request finishes 200; new queries
+    are rejected 503 + Retry-After; replicas are SIGTERMed."""
+    sup = ReplicaSupervisor(
+        _stub_cmd(stub_script), _fast_cfg(1),
+        policy=_FAST_POLICY, fleet_dir=str(tmp_path / "fleet"),
+        env_for=lambda i, sc: {"STUB_QUERY_SLEEP": "0.4"},
+    ).start()
+    try:
+        assert sup.wait_ready(timeout=20.0)
+        front = FleetFront(sup, sup.config)
+        srv = start_front_in_thread(front)
+        results = {}
+
+        def slow_query():
+            body = json.dumps({"agent_ids": [9]}).encode()
+            results["rc"] = front.route_query(body)
+
+        t = threading.Thread(target=slow_query, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 2.0
+        while front.inflight == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert front.inflight == 1
+        drained = drain_front(front, srv, stop_fleet=True, timeout=10.0)
+        t.join(10.0)
+        srv.server_close()
+        assert drained is True
+        code, blob, _hdr = results["rc"]
+        assert code == 200   # the in-flight request completed
+        # post-drain: rejected with Retry-After, nothing routed
+        code, blob, hdr = front.route_query(b"{}")
+        assert code == 503 and "Retry-After" in hdr
+        assert json.loads(blob)["draining"] is True
+        # replicas were SIGTERMed by the drain
+        assert all(p.poll() is not None
+                   for p in (h.proc for h in sup.replicas))
+    finally:
+        sup.stop(drain=False, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Replica-side satellites: liveness/readiness split, identity, 504
+# ---------------------------------------------------------------------------
+
+CFG = ScenarioConfig(
+    name="fleet-test", start_year=2014, end_year=2016, anchor_years=()
+)
+SERVE_CFG = ServeConfig(
+    max_batch=4, min_bucket=4, max_wait_ms=20.0, max_queue=32, port=0
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    pop = synth.generate_population(64, seed=7)
+    inputs = scen.uniform_inputs(
+        CFG, n_groups=pop.table.n_groups, n_regions=pop.n_regions
+    )
+    sim = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, CFG,
+        RunConfig(sizing_iters=6), econ_years=4,
+    )
+    eng = ServeEngine(sim)
+    eng.warmup(SERVE_CFG.buckets)
+    return eng
+
+
+def test_liveness_readiness_split_and_boot_report(engine):
+    app = ServeApp(engine, SERVE_CFG, replica_index=3,
+                   defer_warmup=True)
+    try:
+        # live but NOT ready: warmup deferred
+        h = app.healthz()
+        assert h["live"] is True and h["ready"] is False
+        code, payload = app.readyz()
+        assert code == 503 and payload["ready"] is False
+        assert payload["warmup_done"] is False
+        # warmup completes -> ready, with the boot report stamped
+        app.warmup_now()
+        code, payload = app.readyz()
+        assert code == 200 and payload["ready"] is True
+        assert payload["warm_buckets"]
+        boot = app.healthz()["boot"]
+        assert boot["warmup_s"] >= 0.0
+        assert boot["buckets"] == list(SERVE_CFG.buckets)
+        cc = boot["compile_cache"]
+        assert {"hits", "misses", "requests"} <= set(cc)
+    finally:
+        app.close()
+
+
+def test_metricz_and_healthz_carry_identity(engine):
+    app = ServeApp(engine, SERVE_CFG, replica_index=5)
+    try:
+        for rec in (app.healthz(), app.metricz()):
+            assert rec["pid"] == os.getpid()
+            assert rec["replica_index"] == 5
+            assert rec["boot_time_unix"] == pytest.approx(
+                app.t_start, abs=1.0)
+            assert rec["uptime_s"] >= 0.0
+        mz = app.metricz()
+        assert "steady_state_compiles" in mz
+        assert "steady_state_traces" in mz
+    finally:
+        app.close()
+
+
+def test_request_deadline_enforced_504(engine, monkeypatch):
+    """A hung engine call costs one bounded request (FutureTimeout ->
+    504 at the HTTP layer), not a wedged handler thread."""
+    monkeypatch.setenv(faults.HANG_ENV, "1.5")
+    cfg = ServeConfig(
+        max_batch=4, min_bucket=4, max_wait_ms=5.0, max_queue=32,
+        port=0, request_timeout_s=0.25,
+    )
+    app = ServeApp(engine, cfg)
+    try:
+        with faults.injected("serve_replica_hang@1:hang") as reg:
+            t0 = time.monotonic()
+            with pytest.raises(FutureTimeout):
+                app.run_query({"agent_ids": [1], "year": 2014})
+            wall = time.monotonic() - t0
+        assert reg.fired("serve_replica_hang") == 1
+        assert wall < 1.4   # answered at the deadline, not the hang
+        assert app.inflight == 0
+    finally:
+        app.close()
+
+
+def test_draining_app_rejects_and_unreadies(engine):
+    app = ServeApp(engine, SERVE_CFG)
+    try:
+        assert app.ready
+        app.begin_drain()
+        assert not app.ready
+        assert app.readyz()[0] == 503
+        with pytest.raises(DrainingError):
+            app.run_query({"agent_ids": [1]})
+        assert app.wait_idle(timeout=1.0)
+    finally:
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# Real replicas: failover answers bit-identical to the oracle
+# ---------------------------------------------------------------------------
+
+#: must mirror the `engine` fixture exactly — the oracle and the
+#: replica processes compute over the same synthetic population
+_REAL_SERVE_ARGS = [
+    "--agents", "64", "--end-year", "2016", "--seed", "7",
+    "--econ-years", "4", "--sizing-iters", "6",
+    "--max-batch", "4", "--min-bucket", "4", "--max-wait-ms", "2",
+]
+
+
+def test_real_fleet_kill_failover_bit_identical(engine, tmp_path):
+    """Two real replica processes behind the front; queries through
+    the routing layer are bit-identical to the in-process oracle, stay
+    so while one replica is SIGKILLed mid-load, and the fleet returns
+    to full READY strength (fast reboot off the shared compile
+    cache)."""
+    from dgen_tpu.serve.fleet import default_replica_cmd
+
+    cfg = FleetConfig(
+        n_replicas=2, port=0, poll_interval_s=0.1,
+        request_timeout_s=10.0, breaker_failures=2,
+        breaker_cooldown_s=0.5, retry_after_s=0.0,
+        metricz_interval_s=0.25,
+    )
+    sup = ReplicaSupervisor(
+        default_replica_cmd(_REAL_SERVE_ARGS), cfg,
+        policy=_FAST_POLICY, fleet_dir=str(tmp_path / "fleet"),
+    ).start()
+    try:
+        assert sup.wait_ready(timeout=120.0), sup.summary()
+        front = FleetFront(sup, cfg)
+
+        def ask(k):
+            plan = {"agent_ids": [k % engine.n_agents], "year": 2016}
+            body = json.dumps(plan).encode()
+            code, blob, _hdr = front.route_query(body)
+            assert code == 200, (k, code, blob)
+            got = json.loads(blob)["results"][0]
+            want = _rows_to_json(
+                engine.query(plan["agent_ids"], year=2016, bucket=4),
+                cash_flow=False,
+            )[0]
+            assert got == want, f"answer drift for request {k}"
+
+        for k in range(4):
+            ask(k)
+        assert sup.terminate_replica(0, signal.SIGKILL)
+        for k in range(4, 16):
+            ask(k)   # failover path: every answer still oracle-exact
+        assert sup.wait_ready(timeout=60.0), sup.summary()
+        assert sup.replicas[0].last_recovery_s is not None
+        # the reboot rode the shared compile cache (no fresh compiles)
+        import http.client
+
+        h0 = sup.replicas[0]
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", h0.port, timeout=10.0)
+        conn.request("GET", "/healthz")
+        hz = json.loads(conn.getresponse().read())
+        conn.close()
+        assert hz["boot"]["compile_cache"]["misses"] == 0
+        ask(99)
+    finally:
+        sup.stop(drain=True, timeout=15.0)
+    assert all(h.proc.poll() is not None for h in sup.replicas)
+
+
+@pytest.mark.slow
+def test_fleet_drill_end_to_end():
+    """The acceptance drill: kill + hang under closed-loop load; every
+    request answered bit-exactly, bounded 503 retries only, full READY
+    strength restored, zero steady-state compiles on every replica."""
+    from dgen_tpu.resilience.fleetdrill import run_fleet_drill
+
+    rec = run_fleet_drill(requests=48)
+    assert rec["ok"], {
+        k: rec[k] for k in (
+            "answered", "mismatches", "client_failures",
+            "recovered_full_strength", "steady_state_compiles",
+            "kill", "hang", "latency_s",
+        )
+    }
+    assert rec["kill"]["exit_77_seen"]
+    assert rec["steady_state_compiles"] == {"0": 0, "1": 0}
+
+
+def test_fleet_config_validation_and_env(monkeypatch):
+    with pytest.raises(ValueError, match="n_replicas"):
+        FleetConfig(n_replicas=0)
+    with pytest.raises(ValueError, match="shed_queue_frac"):
+        FleetConfig(shed_queue_frac=1.5)
+    monkeypatch.setenv("DGEN_TPU_FLEET_REPLICAS", "5")
+    monkeypatch.setenv("DGEN_TPU_FLEET_SHED_FRAC", "0.5")
+    monkeypatch.setenv("DGEN_TPU_SERVE_REQ_TIMEOUT_S", "7.5")
+    cfg = FleetConfig.from_env()
+    assert cfg.n_replicas == 5 and cfg.shed_queue_frac == 0.5
+    assert FleetConfig.from_env(n_replicas=2).n_replicas == 2
+    assert ServeConfig.from_env().request_timeout_s == 7.5
+    with pytest.raises(ValueError, match="request_timeout_s"):
+        ServeConfig(request_timeout_s=0.0)
+
+
+def test_fault_spec_new_sites_and_hang_kind(monkeypatch):
+    """The three fleet fault sites parse, and the hang kind stalls
+    without raising (deadline enforcement is elsewhere)."""
+    for spec in ("serve_replica_kill@4:kill",
+                 "serve_replica_hang@2:hang",
+                 "front_route@1x3"):
+        (clause,) = faults.parse_spec(spec)
+        assert clause.site in faults.SITES
+    monkeypatch.setenv(faults.HANG_ENV, "0.2")
+    with faults.injected("serve_replica_hang@1:hang") as reg:
+        t0 = time.monotonic()
+        faults.fault_point("serve_replica_hang")   # stalls, no raise
+        wall = time.monotonic() - t0
+        faults.fault_point("serve_replica_hang")   # past the clause
+    assert reg.fired("serve_replica_hang") == 1
+    assert 0.15 <= wall < 2.0
+    assert np.isclose(faults.hang_seconds(), 0.2)

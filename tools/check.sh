@@ -62,6 +62,14 @@ if [ "$mode" != "--test-only" ]; then
     JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill \
         --agents 96 --end-year 2016 --sites year_step,ckpt_save \
         >/tmp/_drill.json || rc=1
+    # serve-fleet smoke drill (docs/serve.md "Fleet operations"): boot
+    # a 2-replica fleet behind the routing front, kill one replica and
+    # hang the other under closed-loop load, and assert self-healing —
+    # every request answered bit-exactly vs a single-replica oracle,
+    # full READY strength restored, zero steady-state compiles
+    echo "== serve fleet drill (python -m dgen_tpu.resilience drill --serve-fleet) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --serve-fleet \
+        --replicas 2 --agents 64 --requests 60 >/tmp/_fleet.json || rc=1
 fi
 
 if [ "$mode" != "--lint-only" ]; then

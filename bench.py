@@ -75,6 +75,14 @@ Knobs (env):
                           recovery wall, shed rate, and client
                           p50/p99 THROUGH the failure into the
                           payload (docs/serve.md "Fleet operations")
+  DGEN_TPU_BENCH_GANG     <P>: boot a P-process CPU/gloo simulation
+                          gang under the gang supervisor
+                          (dgen_tpu.resilience.gang), SIGKILL one
+                          worker mid-year, and assert recovery —
+                          stamps process count, clean/recovery walls,
+                          restart count and agent-years/sec per
+                          process count into the payload
+                          (docs/resilience.md "Gang runbook")
   DGEN_TPU_BENCH_ASYNC    1: A/B the background host-IO pipeline
                           (io.hostio) — the SAME export+checkpoint run
                           with the pipeline on vs the serialized
@@ -126,6 +134,9 @@ if _BENCH_SERVE in ("0", "false"):
 _BENCH_FLEET = os.environ.get("DGEN_TPU_BENCH_FLEET", "").strip()
 if _BENCH_FLEET in ("0", "false"):
     _BENCH_FLEET = ""
+_BENCH_GANG = os.environ.get("DGEN_TPU_BENCH_GANG", "").strip()
+if _BENCH_GANG in ("0", "false"):
+    _BENCH_GANG = ""
 
 
 def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
@@ -699,6 +710,73 @@ def _fleet_bench(
     }
 
 
+def _gang_bench(n_processes: int, agents: int = 256,
+                end_year: int = 2018) -> dict:
+    """Gang recovery bench: a clean P-process CPU/gloo gang (throughput
+    baseline), then the same run with one worker SIGKILLed mid-year —
+    stamps the restart count, the recovery wall (death -> clean finish)
+    and agent-years/sec at this process count, so the trajectory
+    records what a mid-run host loss actually costs a multi-process
+    run (docs/resilience.md "Gang runbook")."""
+    import tempfile
+
+    from dgen_tpu.config import GangConfig, ScenarioConfig
+    from dgen_tpu.resilience.gang import GangSupervisor
+    from dgen_tpu.resilience.supervisor import RetryPolicy
+
+    scen = ScenarioConfig(name="gangbench", start_year=2014,
+                          end_year=end_year, anchor_years=())
+    years = [int(y) for y in scen.model_years]
+    root = tempfile.mkdtemp(prefix="dgen-bench-gang-")
+    cfg = GangConfig(n_processes=n_processes,
+                     total_devices=n_processes)
+    worker_env = {
+        "DGEN_AGENTS": str(agents),
+        "DGEN_END_YEAR": str(end_year),
+        "DGEN_GANG_SIZING_ITERS": "8",
+    }
+
+    def gang(run_dir, env_for=None, seed=0):
+        return GangSupervisor(
+            run_dir, years, config=cfg,
+            policy=RetryPolicy(backoff_base_s=0.05),
+            env_for=env_for, worker_env=worker_env, seed=seed,
+        )
+
+    t0 = time.perf_counter()
+    rep_clean = gang(os.path.join(root, "clean")).run()
+    clean_wall = time.perf_counter() - t0
+    kill_worker = min(1, n_processes - 1)
+
+    def kill_env(i, attempt):
+        if i == kill_worker and attempt == 0:
+            return {"DGEN_TPU_FAULTS": "gang_worker_kill@2:kill"}
+        return None
+
+    t0 = time.perf_counter()
+    rep_kill = gang(os.path.join(root, "kill"), env_for=kill_env,
+                    seed=1).run()
+    kill_wall = time.perf_counter() - t0
+    agent_years = agents * len(years)
+    return {
+        "processes": n_processes,
+        "agents": agents,
+        "years": len(years),
+        "clean_wall_s": round(clean_wall, 2),
+        "agent_years_per_sec": {
+            str(n_processes): round(agent_years / max(clean_wall, 1e-9), 1)
+        },
+        "clean_restarts": rep_clean.restarts,
+        "kill": {
+            "wall_s": round(kill_wall, 2),
+            "restarts": rep_kill.restarts,
+            "recovery_wall_s": round(rep_kill.recovery_wall_s, 3),
+            "succeeded": rep_kill.succeeded,
+            "completed_through": rep_kill.completed_through,
+        },
+    }
+
+
 #: process start — the budget clock (module import pays the jax/backend
 #: bring-up, which belongs inside the budget)
 _T0 = time.time()
@@ -1187,6 +1265,24 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["fleet"] = {
                     "replicas": n_rep,
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- gang recovery bench (DGEN_TPU_BENCH_GANG=<P>): a P-process
+    # CPU/gloo gang, clean + one-worker-SIGKILLed — restart count,
+    # recovery wall and per-process-count throughput
+    # (docs/resilience.md "Gang runbook") ---
+    if _BENCH_GANG:
+        n_gang = int(_BENCH_GANG)
+        if not spendable(point_est + 180.0):
+            skipped["gang"] = "budget"
+        else:
+            try:
+                payload["gang"] = _gang_bench(n_gang)
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["gang"] = {
+                    "processes": n_gang,
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

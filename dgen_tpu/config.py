@@ -162,6 +162,22 @@ class RunConfig:
             "0", "false", "off"
         )
 
+    @property
+    def async_io_multiprocess_optin(self) -> bool:
+        """Whether a MULTI-PROCESS (jax.distributed) run may use the
+        async host-IO pipeline.  Each process only ever writes its own
+        addressable shard, so the pipeline is sound there — but the
+        serialized per-shard path stays the default: multi-process runs
+        engage the pipeline only on an explicit opt-in (the field set
+        True, or ``DGEN_TPU_ASYNC_IO`` explicitly set truthy — same
+        value vocabulary as the kill switch), never on the
+        single-process default of "on unless set"."""
+        if self.async_host_io is not None:
+            return bool(self.async_host_io)
+        return os.environ.get("DGEN_TPU_ASYNC_IO", "") not in (
+            "", "0", "false", "off"
+        )
+
     @classmethod
     def from_env(cls, **overrides) -> "RunConfig":
         if "n_devices" not in overrides and os.environ.get("DGEN_TPU_DEVICES"):
@@ -375,4 +391,133 @@ class FleetConfig:
         ):
             if key not in overrides and env(envname):
                 overrides[key] = conv(env(envname))
+        return cls(**overrides)
+
+
+@dataclasses.dataclass(frozen=True)
+class GangConfig:
+    """Settings for the multi-process gang supervisor
+    (:mod:`dgen_tpu.resilience.gang`): how many worker processes a
+    simulation gang runs at, when a worker counts as stalled, how many
+    whole-gang restarts the crash-loop breaker allows, and the elastic
+    shrink plan a permanently-lost host falls back to.  Env prefix:
+    ``DGEN_TPU_GANG_*`` (:meth:`from_env`).
+
+    Unlike the serving fleet (independent replicas), a jax.distributed
+    gang is all-or-nothing: one dead or stalled worker poisons every
+    collective, so recovery is always tear-down-and-relaunch of the
+    WHOLE gang from the manifest frontier."""
+
+    #: worker processes in the gang (``DGEN_NUM_PROCESSES``)
+    n_processes: int = 2
+    #: accelerator devices per worker.  ``total_devices`` (when set)
+    #: overrides this per launch so an elastic shrink keeps the GLOBAL
+    #: mesh size constant on CPU (4 procs x 1 dev -> 2 procs x 2 dev):
+    #: the same compiled program, bit-identical resumes.  On real TPU
+    #: hardware the per-host device count is fixed and a shrink lowers
+    #: the global device count instead.
+    devices_per_process: int = 1
+    total_devices: Optional[int] = None
+    #: jax platform pinned into each worker ("" = inherit; CPU gangs
+    #: are the test/drill shape, the multi-host TPU path sets "")
+    platform: str = "cpu"
+    #: a freshly spawned gang must produce its first per-year heartbeat
+    #: (worker boot + distributed bring-up + first-year compile) within
+    #: this wall, or the gang is torn down and counted as a death
+    boot_timeout_s: float = 600.0
+    #: once a worker has heartbeat at least one completed year, a
+    #: heartbeat older than this marks the worker STALLED (wedged
+    #: device, paging storm) — the gang is torn down and relaunched.
+    #: This is a FLOOR: the supervisor scales the live bound to
+    #: GangSupervisor.STALL_GRACE_FACTOR x the slowest observed
+    #: year-over-year heartbeat gap, so gangs whose steady-state years
+    #: are simply long are not killed as stalled
+    stall_timeout_s: float = 120.0
+    #: supervisor monitor cadence
+    poll_interval_s: float = 0.2
+    #: crash-loop breaker: more than this many gang deaths inside
+    #: ``restart_window_s`` stops restarts at the current process count
+    #: (the shrink plan, if any, then takes over)
+    max_restarts: int = 3
+    restart_window_s: float = 600.0
+    #: elastic fallback: process counts to drop to, in order, when the
+    #: crash-loop breaker trips — the run resumes from the manifest
+    #: frontier at P' workers instead of dying (empty = fail instead)
+    shrink_plan: Tuple[int, ...] = ()
+    #: SIGTERM drain bound: workers get this long to agree on a save
+    #: year (the synchronized emergency-checkpoint barrier) and exit
+    #: before the supervisor kills them
+    drain_timeout_s: float = 60.0
+    #: coordinator bind host (workers are children of this process)
+    coordinator_host: str = "127.0.0.1"
+
+    def __post_init__(self) -> None:
+        _check(self.n_processes >= 1, "n_processes must be >= 1")
+        _check(self.devices_per_process >= 1,
+               "devices_per_process must be >= 1")
+        _check(self.total_devices is None or self.total_devices >= 1,
+               "total_devices must be None or >= 1")
+        _check(self.boot_timeout_s > 0, "boot_timeout_s must be > 0")
+        _check(self.stall_timeout_s > 0, "stall_timeout_s must be > 0")
+        _check(self.poll_interval_s > 0, "poll_interval_s must be > 0")
+        _check(self.max_restarts >= 0, "max_restarts must be >= 0")
+        _check(self.restart_window_s > 0, "restart_window_s must be > 0")
+        plan = self.shrink_plan
+        _check(
+            all(1 <= p < self.n_processes for p in plan)
+            and all(a > b for a, b in zip(plan, plan[1:])),
+            "shrink_plan must be strictly decreasing process counts "
+            "below n_processes",
+        )
+        if self.total_devices is not None:
+            # a plan entry that does not divide total_devices would
+            # silently fall back to devices_per_process and change the
+            # GLOBAL mesh size mid-run — the invariant the elastic
+            # resume's same-program expectations ride; fail at
+            # construction, not at the relaunch that needed it
+            _check(
+                all(self.total_devices % p == 0
+                    for p in (self.n_processes, *plan)),
+                "total_devices must divide evenly at n_processes and "
+                "every shrink_plan entry (the global mesh size must "
+                "stay constant through an elastic shrink)",
+            )
+        _check(self.drain_timeout_s > 0, "drain_timeout_s must be > 0")
+
+    def devices_for(self, n_processes: int) -> int:
+        """Per-worker device count for a launch at ``n_processes``:
+        ``total_devices`` split evenly when set and divisible, else
+        ``devices_per_process``."""
+        total = self.total_devices
+        if total is not None and total % n_processes == 0:
+            return total // n_processes
+        return self.devices_per_process
+
+    @classmethod
+    def from_env(cls, **overrides) -> "GangConfig":
+        """Env switches: DGEN_TPU_GANG_PROCESSES,
+        DGEN_TPU_GANG_DEVICES_PER_PROCESS, DGEN_TPU_GANG_TOTAL_DEVICES,
+        DGEN_TPU_GANG_PLATFORM, DGEN_TPU_GANG_BOOT_TIMEOUT_S,
+        DGEN_TPU_GANG_STALL_TIMEOUT_S, DGEN_TPU_GANG_MAX_RESTARTS,
+        DGEN_TPU_GANG_SHRINK_PLAN (comma list, e.g. "2,1"),
+        DGEN_TPU_GANG_DRAIN_TIMEOUT_S."""
+        env = os.environ.get
+        for key, envname, conv in (
+            ("n_processes", "DGEN_TPU_GANG_PROCESSES", int),
+            ("devices_per_process",
+             "DGEN_TPU_GANG_DEVICES_PER_PROCESS", int),
+            ("total_devices", "DGEN_TPU_GANG_TOTAL_DEVICES", int),
+            ("platform", "DGEN_TPU_GANG_PLATFORM", str),
+            ("boot_timeout_s", "DGEN_TPU_GANG_BOOT_TIMEOUT_S", float),
+            ("stall_timeout_s", "DGEN_TPU_GANG_STALL_TIMEOUT_S", float),
+            ("max_restarts", "DGEN_TPU_GANG_MAX_RESTARTS", int),
+            ("drain_timeout_s", "DGEN_TPU_GANG_DRAIN_TIMEOUT_S", float),
+        ):
+            if key not in overrides and env(envname):
+                overrides[key] = conv(env(envname))
+        if "shrink_plan" not in overrides and env("DGEN_TPU_GANG_SHRINK_PLAN"):
+            overrides["shrink_plan"] = tuple(
+                int(p) for p in
+                env("DGEN_TPU_GANG_SHRINK_PLAN").split(",") if p.strip()
+            )
         return cls(**overrides)

@@ -237,10 +237,18 @@ class RunExporter:
         # crash-consistent artifact ledger (resilience.manifest.
         # RunManifest): every landed partition is content-hash
         # recorded and each year marked complete once its surfaces are
-        # all on disk — the supervisor's resume frontier. Single-
-        # controller only: multi-host shard writes are per-process and
-        # a process-0 ledger would claim completeness it cannot see.
-        self._manifest = manifest if jax.process_count() == 1 else None
+        # all on disk — the supervisor's resume frontier. Multi-host
+        # runs must pass a per-process SHARD ledger (RunManifest with
+        # shard=process_index): each process records only its own
+        # parts and the coordinator-side GangManifest merge decides
+        # completeness.  A single-controller ledger on a multi-process
+        # run would claim completeness it cannot see, so it is dropped.
+        if (
+            manifest is not None and jax.process_count() > 1
+            and getattr(manifest, "shard", None) is None
+        ):
+            manifest = None
+        self._manifest = manifest
         self.keep = np.asarray(mask) > 0
         self._ids_full = np.asarray(agent_id)
         self.agent_id = self._ids_full[self.keep]

@@ -243,6 +243,36 @@ class CheckpointConsumer:
         pass
 
 
+class DeviceCheckpointConsumer:
+    """The multi-process checkpoint stage: orbax saves GLOBAL arrays
+    (each process contributes its addressable shards collectively), so
+    the carry snapshot must stay a DEVICE array — a ``jax.device_get``
+    of a non-fully-addressable carry would raise.  The snapshot is
+    stashed at submit time and saved, still on device, by the io
+    thread; every process's io thread issues saves in the same year
+    order, so the collective rendezvous lines up."""
+
+    name = "ckpt_device"
+    timer_name = "ckpt_save"
+    # needs_device keeps consume() firing with no fetched payload; the
+    # pipeline holding the year's outs alongside is the (small) price
+    needs_device = True
+
+    def __init__(self, writer) -> None:
+        self.writer = writer
+        self._snaps: Dict[int, Any] = {}
+
+    def device_payload(self, year, year_idx, outs, carry):
+        self._snaps[int(year_idx)] = carry
+        return None
+
+    def consume(self, year, year_idx, host, outs) -> None:
+        self.writer.save(year, self._snaps.pop(int(year_idx)))
+
+    def finalize(self, stats, failed) -> None:
+        self._snaps.clear()
+
+
 class CallbackConsumer:
     """An arbitrary user callback, run unchanged on the io thread: its
     own device fetches overlap device compute, just not batched with
@@ -267,15 +297,24 @@ class CallbackConsumer:
         self.cb(year, year_idx, outs)
 
     def finalize(self, stats, failed) -> None:
-        pass
+        # an exporter driven through the generic stage (the
+        # multi-process path) still stamps the pipeline's provenance
+        stamp = getattr(self.cb, "stamp_hostio", None)
+        if stamp is not None:
+            stamp(stats)
 
 
 def consumer_for_callback(cb):
     """The pipeline stage for a run callback: exporters implementing the
     split fetch/write protocol (``device_payload`` + ``write_host``)
-    get the batched-fetch fast path; anything else runs as-is on the io
+    get the batched-fetch fast path; anything else — including
+    exporters on MULTI-PROCESS runs, whose per-shard ``__call__`` path
+    must do its own addressable-shard reads — runs as-is on the io
     thread."""
-    if hasattr(cb, "device_payload") and hasattr(cb, "write_host"):
+    if (
+        hasattr(cb, "device_payload") and hasattr(cb, "write_host")
+        and jax.process_count() == 1
+    ):
         return ExportConsumer(cb)
     return CallbackConsumer(cb)
 

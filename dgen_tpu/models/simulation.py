@@ -1287,6 +1287,7 @@ class Simulation:
         checkpoint_dir: Optional[str] = None,
         resume: bool = False,
         resume_year: Optional[int] = None,
+        should_stop: Optional[Callable[[int, int], bool]] = None,
     ) -> SimResults:
         """Run every model year; returns stacked host results.
 
@@ -1313,8 +1314,21 @@ class Simulation:
         with the host IO overlapped against device compute.
         ``RunConfig.async_host_io=False`` (env ``DGEN_TPU_ASYNC_IO=0``)
         restores the serialized per-year path, which also remains in
-        force for ``debug_invariants``, profiling, and multi-process
-        runs (whose shard writes must stay with their own process).
+        force for ``debug_invariants`` and profiling.  Multi-process
+        runs default to serialized too, but may OPT IN to the pipeline
+        (``DGEN_TPU_ASYNC_IO=1`` or ``async_host_io=True``): each
+        process's pipeline writes only its own addressable shard, so
+        the per-shard export/checkpoint semantics are preserved —
+        ``collect=True`` still serializes there (collection fetches
+        the full global arrays).
+
+        ``should_stop(year, year_idx)`` is evaluated after each
+        completed year (exports dispatched, checkpoint issued); True
+        ends the run early with the completed years' results — the
+        gang worker's synchronized SIGTERM/emergency-checkpoint
+        barrier runs through this hook, so every process of a
+        jax.distributed gang must call it the same number of times
+        (it may contain collectives).
         """
         start_idx = 0
         carry = self.init_carry()
@@ -1366,24 +1380,32 @@ class Simulation:
         # background host-IO pipeline (io.hostio): the default for any
         # single-process run with a host consumer. debug_invariants and
         # profiling need per-year host sync; multi-process runs keep
-        # the synchronous per-shard writes with their own process.
+        # the synchronous per-shard writes unless the operator opts in
+        # explicitly (each process's pipeline writes only its own
+        # addressable shard — but collection fetches GLOBAL arrays, so
+        # collect=True always serializes there).
         async_io = (
             self.run_config.async_io_enabled
             and not debug and not profile_dir
-            and jax.process_count() == 1
+            and (
+                jax.process_count() == 1
+                or (self.run_config.async_io_multiprocess_optin
+                    and not collect)
+            )
             and (collect or callback is not None or ckpt_writer is not None)
         )
         self.hostio_stats = None
+        self._stop_idx: Optional[int] = None
         try:
             if async_io:
                 carry, collected, hourly = self._run_years_async(
                     carry, start_idx, callback, collect, ckpt_writer,
-                    agent_fields,
+                    agent_fields, should_stop,
                 )
             else:
                 carry, collected, hourly = self._run_years_sync(
                     carry, start_idx, callback, collect, ckpt_writer,
-                    agent_fields, debug, profile_dir,
+                    agent_fields, debug, profile_dir, should_stop,
                 )
         finally:
             # in the finally: a mid-run exception must not abandon
@@ -1406,8 +1428,12 @@ class Simulation:
             {k: np.stack(v) for k, v in collected.items()}
             if collect and collected[agent_fields[0]] else {}
         )
+        end_idx = (
+            self._stop_idx if self._stop_idx is not None
+            else len(self.years)
+        )
         return SimResults(
-            years=self.years[start_idx:],
+            years=self.years[start_idx:end_idx],
             agent=agent,
             state_hourly_net_mw=np.stack(hourly) if hourly else None,
         )
@@ -1420,6 +1446,7 @@ class Simulation:
         collect: bool,
         ckpt_writer,
         agent_fields: List[str],
+        should_stop=None,
     ) -> tuple[SimCarry, Dict[str, list], List[np.ndarray]]:
         """The async host-IO year loop (io.hostio.HostPipeline): years
         are dispatched back to back exactly like the no-consumer
@@ -1447,7 +1474,14 @@ class Simulation:
         if callback is not None:
             consumers.append(hostio.consumer_for_callback(callback))
         if ckpt_writer is not None:
-            consumers.append(hostio.CheckpointConsumer(ckpt_writer))
+            # multi-process carries are global arrays: orbax saves them
+            # collectively from DEVICE shards (a host fetch would raise
+            # on non-addressable data)
+            consumers.append(
+                hostio.CheckpointConsumer(ckpt_writer)
+                if jax.process_count() == 1
+                else hostio.DeviceCheckpointConsumer(ckpt_writer)
+            )
 
         pipeline = None
         guard = None
@@ -1484,6 +1518,13 @@ class Simulation:
                 )
                 if guard is not None:
                     guard.check(f"year {year}")
+                if should_stop is not None and should_stop(year, yi):
+                    logger.info(
+                        "cooperative stop after year %d (%d/%d)",
+                        year, yi + 1, len(self.years),
+                    )
+                    self._stop_idx = yi + 1
+                    break
         except BaseException:
             loop_failed = True
             raise
@@ -1511,6 +1552,7 @@ class Simulation:
         agent_fields: List[str],
         debug: bool,
         profile_dir: Optional[str],
+        should_stop=None,
     ) -> tuple[SimCarry, Dict[str, list], List[np.ndarray]]:
         """The serialized year loop: the no-consumer pipelined path,
         plus the per-year host-sync parity oracle for the async
@@ -1665,6 +1707,18 @@ class Simulation:
                         hourly.append(host["_hourly"])
                 if guard is not None:
                     guard.check(f"year {year}")
+                if should_stop is not None and should_stop(year, yi):
+                    # the year's exports and checkpoint save were
+                    # already issued above; every gang process reaches
+                    # this barrier once per year, so they all agree on
+                    # the same stop year (the synchronized emergency-
+                    # checkpoint contract)
+                    logger.info(
+                        "cooperative stop after year %d (%d/%d)",
+                        year, yi + 1, len(self.years),
+                    )
+                    self._stop_idx = yi + 1
+                    break
 
         except BaseException:
             loop_failed = True

@@ -1,6 +1,7 @@
 """Multi-device execution: mesh construction, state-balanced agent
-partitioning, and shard_map-ped kernels with ICI collectives — the
-TPU-native replacement for the reference's one-GCP-Batch-task-per-state
-scale-out (SURVEY.md §2.6)."""
+partitioning, shard_map-ped kernels with ICI collectives, and the
+elastic P->P' resharded checkpoint restore — the TPU-native replacement
+for the reference's one-GCP-Batch-task-per-state scale-out
+(SURVEY.md §2.6)."""
 
-from dgen_tpu.parallel import mesh, partition  # noqa: F401
+from dgen_tpu.parallel import elastic, mesh, partition  # noqa: F401

@@ -1,0 +1,111 @@
+"""Elastic resharded restore: resume a checkpoint written at P
+processes under a P′-process topology.
+
+A jax.distributed gang is not elastic mid-run — one lost host kills
+every collective, and the correct recovery is tear-down-and-relaunch
+(:mod:`dgen_tpu.resilience.gang`).  What CAN be elastic is the
+*restore*: orbax persists the cross-year :class:`~dgen_tpu.models.
+simulation.SimCarry` as a global array (each process wrote its
+addressable shards), so a relaunched gang of a DIFFERENT size re-places
+the same global carry under its OWN mesh's NamedSharding (the
+SNIPPETS.md [1]/[3] pattern: a sharded ShapeDtypeStruct template hands
+orbax the target layout, and each process reads exactly the shards it
+now owns).  A run that lost a host permanently resumes on fewer
+workers instead of dying.
+
+Two invariants make this sound:
+
+* the checkpoint is keyed by the PADDED global agent count, which is a
+  property of the population (``pad_table``), not of the topology that
+  wrote it — so the global shape matches across P -> P′;
+* the restored carry feeds the same ``year_step`` executable path; only
+  the placement changed, so no program is re-derived here (the new
+  topology compiles its own program exactly as a fresh run would).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from dgen_tpu.parallel.mesh import AGENT_AXIS
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+def carry_sharding(mesh: Optional[Mesh]) -> Optional[NamedSharding]:
+    """The agent-axis NamedSharding a SimCarry restores onto under
+    ``mesh`` (None = single-device host restore)."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, PartitionSpec(AGENT_AXIS))
+
+
+def validate_topology(n_agents: int, mesh: Optional[Mesh]) -> None:
+    """Fail fast (with the fix named) when the padded agent table does
+    not divide over the new topology's device count — the one way an
+    elastic restore can be impossible."""
+    if mesh is None:
+        return
+    d = int(mesh.devices.size)
+    if n_agents % d:
+        raise ValueError(
+            f"elastic restore: padded agent count {n_agents} does not "
+            f"divide over {d} devices; pad the population to a multiple "
+            "of the largest device count the run may shrink through "
+            "(models.agents.pad_table / RunConfig.agent_pad_multiple)"
+        )
+
+
+def restore_resharded(
+    checkpoint_dir: str,
+    n_agents: int,
+    year: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    scenario: Optional[str] = None,
+) -> Tuple[int, object]:
+    """(year, carry): restore a checkpointed SimCarry under the CURRENT
+    topology, regardless of the process/device topology that wrote it.
+
+    Passing the new mesh's sharding makes orbax read each process's
+    now-addressable shards straight to their devices — no full-array
+    host copy, no dependence on the writing gang's shard layout."""
+    from dgen_tpu.io import checkpoint as ckpt
+
+    validate_topology(n_agents, mesh)
+    return ckpt.restore_year(
+        checkpoint_dir, n_agents, year,
+        sharding=carry_sharding(mesh), scenario=scenario,
+    )
+
+
+def resume_year_for(
+    checkpoint_dir: str,
+    n_agents: int,
+    frontier: Optional[int],
+    mesh: Optional[Mesh] = None,
+    scenario: Optional[str] = None,
+) -> Optional[int]:
+    """The year a relaunched gang re-enters at: the newest checkpoint
+    that actually RESTORES under the CURRENT topology, capped at the
+    manifest frontier (never resume past a year whose exports are not
+    durably on disk), walking back past corrupt/torn steps
+    (:func:`dgen_tpu.io.checkpoint.latest_valid_year`).  ``None`` (no
+    frontier, or nothing restorable at or below it) means restart from
+    scratch.
+
+    Every worker of a gang evaluates this against the same shared
+    directory in the same order, so all P′ processes independently
+    agree on the resume year — and the validation restores are
+    themselves collective, issued in lockstep."""
+    if frontier is None:
+        return None
+    from dgen_tpu.io import checkpoint as ckpt
+
+    validate_topology(n_agents, mesh)
+    return ckpt.latest_valid_year(
+        checkpoint_dir, n_agents, max_year=frontier,
+        sharding=carry_sharding(mesh), scenario=scenario,
+    )

@@ -96,14 +96,31 @@ def initialize_multihost(
     coordinator = coordinator or os.environ.get("DGEN_COORDINATOR")
     if not coordinator:
         return False
-    num_processes = int(
-        num_processes if num_processes is not None
-        else os.environ["DGEN_NUM_PROCESSES"]
-    )
-    process_id = int(
-        process_id if process_id is not None
-        else os.environ["DGEN_PROCESS_ID"]
-    )
+
+    def from_env(value: Optional[int], var: str) -> int:
+        if value is not None:
+            return int(value)
+        raw = os.environ.get(var)
+        if raw is None or not raw.strip():
+            # a bare KeyError here would read as a bug in THIS code;
+            # it is an operator error in the launch env, so say exactly
+            # which variable is missing and what the contract is
+            raise ValueError(
+                f"DGEN_COORDINATOR is set ({coordinator!r}) but {var} "
+                "is missing: a multi-host launch needs DGEN_COORDINATOR, "
+                "DGEN_NUM_PROCESSES and DGEN_PROCESS_ID set on every "
+                "process (docs/userguide.md 'Multi-host runs')"
+            )
+        try:
+            return int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{var}={raw!r} is not an integer (multi-host launch "
+                "env, docs/userguide.md 'Multi-host runs')"
+            ) from None
+
+    num_processes = from_env(num_processes, "DGEN_NUM_PROCESSES")
+    process_id = from_env(process_id, "DGEN_PROCESS_ID")
     import jax
 
     jax.distributed.initialize(
@@ -126,6 +143,32 @@ def shard_states_from_env() -> Optional[List[str]]:
     return [s for s in raw.split(",") if s] if raw else None
 
 
+def pin_platform_from_env() -> None:
+    """Apply ``DGEN_PLATFORM`` / ``DGEN_CPU_DEVICES`` /
+    ``JAX_CPU_COLLECTIVES_IMPLEMENTATION`` in-process BEFORE backend
+    bring-up.  Needed on hosts whose site hooks import jax at
+    interpreter startup, where the plain env vars are silently baked
+    into an already-chosen backend — shared by :func:`main` and the
+    gang worker (:mod:`dgen_tpu.resilience.gangworker`)."""
+    plat = os.environ.get("DGEN_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    if os.environ.get("DGEN_CPU_DEVICES"):
+        from dgen_tpu.utils import compat
+
+        compat.set_cpu_device_count(int(os.environ["DGEN_CPU_DEVICES"]))
+    impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION")
+    if impl:
+        # multi-process CPU gangs need gloo collectives selected before
+        # the first backend client is created; the env var alone does
+        # not survive a site hook's early jax import
+        import jax
+
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+
+
 def main() -> None:
     """Per-shard entrypoint (``python -m dgen_tpu.parallel.launch``):
     runs a reference-input scenario for this shard's states.
@@ -144,15 +187,7 @@ def main() -> None:
     ``JAX_PLATFORMS`` env var is silently overridden (CI runs the
     launch entrypoint on virtual CPU devices this way).
     """
-    plat = os.environ.get("DGEN_PLATFORM")
-    if plat:
-        import jax
-
-        jax.config.update("jax_platforms", plat)
-    if os.environ.get("DGEN_CPU_DEVICES"):
-        from dgen_tpu.utils import compat
-
-        compat.set_cpu_device_count(int(os.environ["DGEN_CPU_DEVICES"]))
+    pin_platform_from_env()
     distributed = initialize_multihost()
 
     from dgen_tpu.utils import compilecache

@@ -10,8 +10,15 @@ Layers, bottom up:
   artifact ledger; ``verify`` audits any run directory.
 * :mod:`~dgen_tpu.resilience.supervisor` — bounded retry + checkpoint
   resume + graceful degradation around Simulation/sweep runs.
+* :mod:`~dgen_tpu.resilience.gang` — the multi-process layer: a
+  jax.distributed worker gang supervised as a unit (heartbeats, whole-
+  gang teardown/relaunch from the merged shard-ledger frontier, crash-
+  loop breaker, elastic P -> P' resharded resume via
+  :mod:`dgen_tpu.parallel.elastic`).
 
-CLI: ``python -m dgen_tpu.resilience {run,verify,drill}``.
+CLI: ``python -m dgen_tpu.resilience {run,verify,drill}``
+(``drill --gang`` runs the worker-kill / stall / elastic-resume gang
+drill).
 """
 
 from dgen_tpu.resilience.atomic import (  # noqa: F401
@@ -29,7 +36,13 @@ from dgen_tpu.resilience.faults import (  # noqa: F401
     injected,
     install_from_env,
 )
+from dgen_tpu.resilience.gang import (  # noqa: F401
+    GangCrashLoop,
+    GangReport,
+    GangSupervisor,
+)
 from dgen_tpu.resilience.manifest import (  # noqa: F401
+    GangManifest,
     RunManifest,
     VerifyReport,
     verify_run_dir,

@@ -103,12 +103,28 @@ def _cmd_drill(args) -> int:
     from dgen_tpu.utils import compilecache
 
     compilecache.enable()
+    end_year = args.end_year or (2018 if args.gang else 2016)
+    if args.gang:
+        from dgen_tpu.resilience.gangdrill import run_gang_drill
+
+        root = args.root or tempfile.mkdtemp(prefix="dgen-gang-drill-")
+        rec = run_gang_drill(
+            root,
+            processes=args.gang_processes,
+            shrink_to=args.gang_shrink,
+            total_devices=args.gang_devices or None,
+            agents=args.agents,
+            end_year=end_year,
+            stall=not args.no_gang_stall,
+        )
+        print(json.dumps(rec, indent=1))
+        return 0 if rec["ok"] else 1
     if args.serve_fleet:
         from dgen_tpu.resilience.fleetdrill import run_fleet_drill
 
         rec = run_fleet_drill(
             replicas=args.replicas, agents=args.agents,
-            end_year=args.end_year, requests=args.requests,
+            end_year=end_year, requests=args.requests,
         )
         # the event/boot detail is for logs, not the summary line
         rec.pop("supervisor_events", None)
@@ -125,7 +141,7 @@ def _cmd_drill(args) -> int:
                   file=sys.stderr)
             return 2
     rec = run_drill(
-        root, n_agents=args.agents, end_year=args.end_year, specs=specs,
+        root, n_agents=args.agents, end_year=end_year, specs=specs,
     )
     print(json.dumps(rec, indent=1))
     return 0 if rec["ok"] else 1
@@ -164,7 +180,10 @@ def main(argv=None) -> int:
 
     drl = sub.add_parser("drill", help="fault matrix smoke drill")
     drl.add_argument("--agents", type=int, default=96)
-    drl.add_argument("--end-year", type=int, default=2016)
+    drl.add_argument("--end-year", type=int, default=None,
+                     help="last model year (default 2016; 2018 for "
+                          "--gang so the stall round has a steady-"
+                          "state year to land in)")
     drl.add_argument("--root", default=None,
                      help="drill directory (default: a fresh tempdir)")
     drl.add_argument("--sites", default=None,
@@ -175,6 +194,27 @@ def main(argv=None) -> int:
                           "kill + hang replicas under closed-loop "
                           "load, assert self-healing + bit-exact "
                           "answers (docs/serve.md)")
+    drl.add_argument("--gang", action="store_true",
+                     help="gang drill instead: a multi-process CPU/gloo "
+                          "jax.distributed gang with a worker "
+                          "SIGKILLed mid-year, a worker stalled, and a "
+                          "P->P' elastic resharded resume — parquet "
+                          "shards byte-identical to an uninterrupted "
+                          "baseline, merged-manifest verify clean "
+                          "(docs/resilience.md 'Gang runbook'). "
+                          "--end-year 2018+ (>= 3 model years) enables "
+                          "the stall round")
+    drl.add_argument("--gang-processes", type=int, default=4,
+                     help="gang drill: worker process count P")
+    drl.add_argument("--gang-shrink", type=int, default=2,
+                     help="gang drill: elastic-resume process count P' "
+                          "(0 = skip the elastic round)")
+    drl.add_argument("--gang-devices", type=int, default=0,
+                     help="gang drill: total devices across the gang "
+                          "(0 = one per worker); kept constant through "
+                          "the P->P' shrink so resumes are bit-exact")
+    drl.add_argument("--no-gang-stall", action="store_true",
+                     help="gang drill: skip the heartbeat-stall round")
     drl.add_argument("--replicas", type=int, default=2,
                      help="fleet drill: replica count")
     drl.add_argument("--requests", type=int, default=80,

@@ -123,6 +123,24 @@ SITES: Dict[str, str] = {
         "refused/reset); the front must count it against that "
         "replica's breaker and retry on another replica"
     ),
+    "gang_worker_kill": (
+        "resilience.gangworker per-year export callback — a gang "
+        "worker process dying mid-year (``kill``: preemption/OOM-kill "
+        "with collectives in flight); the gang supervisor must tear "
+        "down and relaunch the WHOLE gang from the manifest frontier"
+    ),
+    "gang_heartbeat_stall": (
+        "resilience.gang.write_heartbeat — a gang worker stalling "
+        "instead of dying (``hang``: wedged device, paging storm); the "
+        "process stays alive, so only the supervisor's heartbeat "
+        "staleness check can catch it"
+    ),
+    "gang_barrier": (
+        "resilience.gangworker.StopFlag.should_stop — the gang's "
+        "synchronized stop/emergency-checkpoint barrier failing (a "
+        "collective error at the year boundary); the worker dies and "
+        "the supervisor restarts the gang"
+    ),
 }
 
 KINDS = ("error", "oom", "kill", "truncate", "hang")

@@ -1,0 +1,597 @@
+"""GangSupervisor: a preemption-safe jax.distributed worker gang.
+
+The multi-process analogue of the serving fleet's
+:class:`~dgen_tpu.serve.fleet.ReplicaSupervisor` — with one decisive
+difference: serving replicas are independent, a simulation gang is
+**all-or-nothing**.  P workers share one ``jax.distributed``
+coordinator and one global mesh; a single preempted host leaves every
+peer wedged inside a collective.  jax.distributed gangs are not
+elastic mid-run, so recovery is always:
+
+1. **detect** — per-worker liveness (exit codes) plus per-worker
+   heartbeat files (a worker that is alive but has stopped completing
+   years is STALLED: wedged device, paging storm — only staleness
+   catches it);
+2. **tear down** — SIGKILL the WHOLE gang (peers blocked in dead
+   collectives cannot drain; the crash-consistent artifact layer is
+   what makes this safe);
+3. **relaunch from the manifest frontier** — the coordinator-side
+   merge of the per-process shard ledgers
+   (:class:`~dgen_tpu.resilience.manifest.GangManifest`) names the
+   last year EVERY process durably exported; the relaunched workers
+   resume from the newest checkpoint at or below it
+   (:func:`dgen_tpu.parallel.elastic.resume_year_for`), re-exporting
+   exactly the missing years;
+4. **bounded** — restarts ride the resilience layer's
+   :class:`~dgen_tpu.resilience.supervisor.RetryPolicy` backoff with a
+   crash-loop breaker; when the breaker trips and
+   :class:`~dgen_tpu.config.GangConfig.shrink_plan` names a smaller
+   gang, the run resumes **elastically** at P′ workers — the orbax
+   checkpoint written at P is re-placed under the new mesh's
+   NamedSharding (:mod:`dgen_tpu.parallel.elastic`) instead of the run
+   dying with the lost host.
+
+SIGTERM to the supervisor (a preemption notice) triggers a
+**synchronized emergency checkpoint**: the signal is forwarded to
+every worker, whose per-year stop barrier
+(:class:`~dgen_tpu.resilience.gangworker.StopFlag`) makes all P
+processes agree on the save year — every shard exports and checkpoints
+through the same year, then exits cleanly.
+
+This module imports no jax: supervision is pure process/file/socket
+work and must stay responsive while workers compile or wedge.
+
+Scope: workers are spawned as LOCAL child processes — the
+single-machine multi-process shape (CPU/gloo drills, CI, a single TPU
+host).  A gang spanning machines plugs a remote launcher into
+``cmd_for`` (an ssh/scheduler wrapper argv; heartbeats/portfiles then
+need a shared filesystem) or keeps its cluster scheduler's task-level
+restart and reuses the same manifest-frontier + elastic-restore
+recovery from there.
+
+Worker env contract (consumed by :mod:`dgen_tpu.resilience.gangworker`
+via :func:`dgen_tpu.parallel.launch.initialize_multihost`)::
+
+    DGEN_COORDINATOR       host:port of process 0's coordinator
+    DGEN_NUM_PROCESSES     P
+    DGEN_PROCESS_ID        0..P-1
+    DGEN_PLATFORM          jax platform pin (cpu for test gangs)
+    DGEN_CPU_DEVICES       devices per worker (cpu gangs)
+    DGEN_GANG_DIR          heartbeat / done-file / log directory
+    DGEN_RUN_DIR           export + shard-ledger directory
+    DGEN_GANG_FRONTIER     manifest frontier year ("" = from scratch)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from dgen_tpu.config import GangConfig
+from dgen_tpu.resilience import faults as faults_mod
+from dgen_tpu.resilience.atomic import atomic_write_json
+from dgen_tpu.resilience.manifest import GangManifest
+from dgen_tpu.resilience.supervisor import RetryPolicy
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: gang-level outcome states
+COMPLETE = "complete"     # every worker exited 0, all years run
+PREEMPTED = "preempted"   # clean synchronized stop before the last year
+DIED = "died"             # a worker death/stall tore the gang down
+
+
+# -- heartbeats / done files (shared with gangworker) ------------------------
+
+def heartbeat_path(gang_dir: str, index: int) -> str:
+    return os.path.join(gang_dir, f"worker-{index}.hb.json")
+
+
+def done_path(gang_dir: str, index: int) -> str:
+    return os.path.join(gang_dir, f"worker-{index}.done.json")
+
+
+def write_heartbeat(path: str, **info) -> None:
+    """One atomic heartbeat write (workers call this per completed
+    year).  The supervisor reads freshness off the file mtime, so the
+    content is diagnostics, not protocol."""
+    # resilience drill hook: a ``hang`` here models a stalled-not-dead
+    # worker — the heartbeat goes stale while the process stays alive,
+    # and only the supervisor's staleness check can catch it
+    faults_mod.fault_point("gang_heartbeat_stall")
+    atomic_write_json(path, {"t": time.time(), **info})
+
+
+def read_json(path: str) -> Optional[dict]:
+    try:
+        import json
+
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An ephemeral port for the gang coordinator.  (Bind-and-release
+    has a theoretical reuse race; each attempt draws a fresh port, so
+    a collision costs one retry, not the run.)"""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def default_worker_cmd(extra_args: Sequence[str] = ()) -> Callable:
+    """The standard gang worker command (all configuration rides env)."""
+
+    def cmd_for(index: int, n_processes: int) -> List[str]:
+        return [
+            sys.executable, "-m", "dgen_tpu.resilience.gangworker",
+            *extra_args,
+        ]
+
+    return cmd_for
+
+
+# -- report ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class GangAttempt:
+    attempt: int
+    processes: int
+    frontier: Optional[int]
+    outcome: str                 # COMPLETE / PREEMPTED / DIED
+    reason: Optional[str] = None   # death/stall detail
+    worker: Optional[int] = None
+    exit_code: Optional[int] = None
+    wall_s: float = 0.0
+
+
+@dataclasses.dataclass
+class GangReport:
+    """What a supervised gang run cost — stamped into bench payloads
+    (``DGEN_TPU_BENCH_GANG``) and the coordinator manifest's notes."""
+
+    processes_initial: int = 0
+    processes_final: int = 0
+    attempts: List[GangAttempt] = dataclasses.field(default_factory=list)
+    restarts: int = 0
+    shrinks: List[str] = dataclasses.field(default_factory=list)
+    #: wall seconds from the first gang death to the final clean exit
+    recovery_wall_s: float = 0.0
+    succeeded: bool = False
+    preempted: bool = False
+    #: last completed model year (from the workers' done files)
+    completed_through: Optional[int] = None
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["recovery_wall_s"] = round(self.recovery_wall_s, 4)
+        for a in d["attempts"]:
+            a["wall_s"] = round(a["wall_s"], 4)
+        return d
+
+
+class GangCrashLoop(RuntimeError):
+    """The gang died more than ``max_restarts`` times inside the
+    breaker window at every process count the shrink plan allows."""
+
+    def __init__(self, msg: str, report: GangReport) -> None:
+        super().__init__(msg)
+        self.gang_report = report
+
+
+# -- the supervisor ----------------------------------------------------------
+
+class GangSupervisor:
+    """Launch, monitor, and restart a P-process simulation gang
+    (module docstring has the recovery contract).
+
+    Parameters
+    ----------
+    run_dir : export directory (per-process shard ledgers + parquet
+        shards land here; the resume frontier is derived from it).
+    years : the scenario's model-year grid (frontier computation and
+        the post-run checkpoint recording need it).
+    cmd_for : ``(index, n_processes) -> argv``; default
+        :func:`default_worker_cmd`.  Tests substitute stubs.
+    config / policy : :class:`~dgen_tpu.config.GangConfig` knobs and
+        the restart backoff schedule.
+    env_for : optional ``(index, attempt) -> dict`` of EXTRA worker
+        env (drills arm per-worker fault specs on attempt 0 only).
+        ``DGEN_TPU_FAULTS`` is stripped from the inherited environment
+        either way.
+    worker_env : env shared by every worker every attempt (population
+        size, end year, ...).
+    """
+
+    def __init__(
+        self,
+        run_dir: str,
+        years: Sequence[int],
+        cmd_for: Optional[Callable[[int, int], List[str]]] = None,
+        config: Optional[GangConfig] = None,
+        policy: Optional[RetryPolicy] = None,
+        env_for: Optional[Callable[[int, int], Optional[dict]]] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+        gang_dir: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+        seed: int = 0,
+    ) -> None:
+        self.run_dir = run_dir
+        self.years = [int(y) for y in years]
+        self.config = config or GangConfig()
+        self.policy = policy or RetryPolicy()
+        self._cmd_for = cmd_for or default_worker_cmd()
+        self._env_for = env_for
+        self.worker_env = dict(worker_env or {})
+        self.gang_dir = gang_dir or tempfile.mkdtemp(prefix="dgen-gang-")
+        os.makedirs(self.gang_dir, exist_ok=True)
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            run_dir, "checkpoints")
+        self._rng = random.Random(seed)
+        self._procs: List[subprocess.Popen] = []
+        self._stop_requested = False
+
+    # -- SIGTERM drain --------------------------------------------------
+
+    def request_stop(self) -> None:
+        """Forward a preemption notice: SIGTERM every worker (their
+        per-year stop barrier synchronizes the emergency checkpoint)
+        and stop restarting.  Safe from a signal handler."""
+        self._stop_requested = True
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+
+    def install_sigterm_drain(self) -> None:
+        """Route the supervisor process's own SIGTERM to
+        :meth:`request_stop` (the CLI arms this)."""
+        signal.signal(signal.SIGTERM, lambda *_: self.request_stop())
+
+    # -- spawning -------------------------------------------------------
+
+    def _spawn_gang(self, n_processes: int, attempt: int,
+                    frontier: Optional[int]) -> None:
+        port = free_port(self.config.coordinator_host)
+        dpp = self.config.devices_for(n_processes)
+        self._procs = []
+        for i in range(n_processes):
+            # stale liveness files from the previous incarnation must
+            # not satisfy this attempt's checks
+            for path in (heartbeat_path(self.gang_dir, i),
+                         done_path(self.gang_dir, i)):
+                if os.path.exists(path):
+                    os.unlink(path)
+            env = os.environ.copy()
+            # a spec meant for the supervisor must not leak into every
+            # worker; drills arm per-worker specs through env_for
+            env.pop("DGEN_TPU_FAULTS", None)
+            if self.config.platform == "cpu":
+                # the legacy host-platform device-count flag would
+                # fight DGEN_CPU_DEVICES on CPU test gangs; real-TPU
+                # gangs keep the operator's XLA tuning flags
+                env.pop("XLA_FLAGS", None)
+            env.update({
+                "DGEN_COORDINATOR":
+                    f"{self.config.coordinator_host}:{port}",
+                "DGEN_NUM_PROCESSES": str(n_processes),
+                "DGEN_PROCESS_ID": str(i),
+                "DGEN_GANG_DIR": self.gang_dir,
+                "DGEN_RUN_DIR": self.run_dir,
+                "DGEN_GANG_FRONTIER":
+                    "" if frontier is None else str(frontier),
+                "PYTHONUNBUFFERED": "1",
+            })
+            if self.config.platform:
+                env["DGEN_PLATFORM"] = self.config.platform
+                if self.config.platform == "cpu":
+                    env["DGEN_CPU_DEVICES"] = str(dpp)
+                    env["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+            env.update(self.worker_env)
+            extra = self._env_for(i, attempt) if self._env_for else None
+            if extra:
+                env.update({k: str(v) for k, v in extra.items()})
+            log_path = os.path.join(self.gang_dir, f"worker-{i}.log")
+            # append-only diagnostics, not a run artifact: a torn tail
+            # is exactly what a SIGKILLed worker's log should show
+            logf = open(log_path, "ab")  # dgenlint: disable=L11
+            try:
+                self._procs.append(subprocess.Popen(
+                    self._cmd_for(i, n_processes),
+                    stdout=logf, stderr=subprocess.STDOUT, env=env,
+                ))
+            finally:
+                logf.close()   # the child holds its own fd now
+        logger.info(
+            "gang attempt %d: %d workers x %d device(s), coordinator "
+            ":%d, frontier=%s", attempt, n_processes, dpp, port,
+            frontier,
+        )
+
+    def _teardown(self) -> None:
+        """SIGKILL every live worker.  Peers of a dead worker are
+        blocked inside dead collectives — there is nothing to drain;
+        the crash-consistent artifact layer makes this safe."""
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        for p in self._procs:
+            try:
+                p.wait(timeout=30.0)
+            except subprocess.TimeoutExpired:
+                logger.warning("gang: worker pid %d unkillable", p.pid)
+
+    # -- monitoring -----------------------------------------------------
+
+    def _resume_plan(self) -> Optional[int]:
+        """One manifest pass per (re)launch: compute the merged resume
+        frontier (deep verify — a torn frontier artifact must pull the
+        resume back, so the hashing here is the safety property), then
+        prune every part and ledger record BEYOND it on the same
+        loaded ledgers.  Without the prune a dead epoch's partial
+        shards survive an elastic P -> P' relaunch — duplicate rows
+        under load_surface, and mixed epoch stamps that wedge the
+        merged completeness check forever.  None = fresh directory,
+        start from scratch."""
+        try:
+            gm = GangManifest(self.run_dir)
+        except OSError:
+            return None
+        if not gm.shards:
+            return None
+        frontier = gm.frontier(self.years)
+        removed = gm.prune_after(frontier)
+        if removed:
+            logger.info(
+                "gang: pruned %d stale artifact(s) beyond frontier %s",
+                len(removed), frontier,
+            )
+        return frontier
+
+    #: a worker is stalled when its heartbeat is older than
+    #: max(stall_timeout_s, this factor x the slowest year-over-year
+    #: heartbeat gap observed across the gang) — so a gang whose
+    #: steady-state years are simply long is not killed as stalled;
+    #: before any gap is measured the bound is boot_timeout_s
+    STALL_GRACE_FACTOR = 3.0
+
+    def _monitor(self, n_processes: int, attempt: int,
+                 frontier: Optional[int]) -> GangAttempt:
+        """Watch one gang incarnation to its outcome."""
+        t0 = time.monotonic()
+        spawn_t = time.monotonic()
+        # worker -> {mtime, has_year, gap}: heartbeat files are parsed
+        # only when their mtime changes (staleness itself is pure stat)
+        hb_state: Dict[int, dict] = {}
+        # False even when a stop is already pending: THIS incarnation's
+        # workers still need their SIGTERM forwarded (request_stop is
+        # idempotent), or the synchronized emergency checkpoint the
+        # stop exists for would never run
+        sigterm_sent = False
+        drain_deadline: Optional[float] = None
+        rec = GangAttempt(
+            attempt=attempt, processes=n_processes, frontier=frontier,
+            outcome=DIED,
+        )
+        while True:
+            now = time.monotonic()
+            if self._stop_requested and not sigterm_sent:
+                self.request_stop()   # forward to this incarnation
+                sigterm_sent = True
+            if sigterm_sent and drain_deadline is None:
+                drain_deadline = now + self.config.drain_timeout_s
+
+            rcs = [p.poll() for p in self._procs]
+            bad = [
+                (i, rc) for i, rc in enumerate(rcs)
+                if rc is not None and rc != 0
+            ]
+            if bad:
+                i, rc = bad[0]
+                rec.outcome, rec.reason = DIED, "worker_exit"
+                rec.worker, rec.exit_code = i, rc
+                rec.wall_s = time.monotonic() - t0
+                self._teardown()
+                return rec
+            if all(rc == 0 for rc in rcs):
+                dones = [read_json(done_path(self.gang_dir, i))
+                         for i in range(n_processes)]
+                preempted = any(
+                    d is not None and d.get("preempted") for d in dones)
+                rec.outcome = PREEMPTED if preempted else COMPLETE
+                rec.wall_s = time.monotonic() - t0
+                rec.exit_code = 0
+                return rec
+
+            # liveness by heartbeat: boot grace until the first YEAR
+            # heartbeat (distributed bring-up + first compile), then a
+            # staleness bound scaled to the gang's own observed year
+            # cadence (STALL_GRACE_FACTOR) with stall_timeout_s as the
+            # floor — a long steady-state year is not a stall
+            measured = max(
+                (s["gap"] for s in hb_state.values()
+                 if s.get("gap") is not None),
+                default=None,
+            )
+            stall_bound = (
+                max(self.config.stall_timeout_s,
+                    self.STALL_GRACE_FACTOR * measured)
+                if measured is not None
+                else max(self.config.stall_timeout_s,
+                         self.config.boot_timeout_s)
+            )
+            for i, rc in enumerate(rcs):
+                if rc is not None:
+                    continue
+                hb = heartbeat_path(self.gang_dir, i)
+                try:
+                    st = os.stat(hb)
+                except OSError:
+                    st = None
+                state = hb_state.setdefault(
+                    i, {"mtime": None, "has_year": False, "gap": None})
+                if st is not None and st.st_mtime != state["mtime"]:
+                    doc = read_json(hb)
+                    has_year = bool(doc and doc.get("year") is not None)
+                    if (
+                        has_year and state["has_year"]
+                        and state["mtime"] is not None
+                    ):
+                        gap = st.st_mtime - state["mtime"]
+                        state["gap"] = max(state["gap"] or 0.0, gap)
+                    state["mtime"] = st.st_mtime
+                    state["has_year"] = state["has_year"] or has_year
+                if state["has_year"]:
+                    age = time.time() - state["mtime"]
+                    if age > stall_bound:
+                        rec.outcome, rec.reason = DIED, "heartbeat_stall"
+                        rec.worker = i
+                        rec.wall_s = time.monotonic() - t0
+                        self._teardown()
+                        return rec
+                elif now - spawn_t > self.config.boot_timeout_s:
+                    rec.outcome, rec.reason = DIED, "boot_timeout"
+                    rec.worker = i
+                    rec.wall_s = time.monotonic() - t0
+                    self._teardown()
+                    return rec
+
+            if drain_deadline is not None and now > drain_deadline:
+                # workers did not finish the synchronized stop in time
+                rec.outcome, rec.reason = DIED, "drain_timeout"
+                rec.wall_s = time.monotonic() - t0
+                self._teardown()
+                return rec
+            time.sleep(self.config.poll_interval_s)
+
+    # -- the run loop ---------------------------------------------------
+
+    def run(self) -> GangReport:
+        """Drive the gang to completion (or a clean preemption stop),
+        restarting from the manifest frontier on every death, shrinking
+        per the plan when the crash-loop breaker trips.  Raises
+        :class:`GangCrashLoop` (report attached) when the budget is
+        spent.  No exit path leaks workers: any exception —
+        KeyboardInterrupt in a backoff sleep, a partial spawn failure,
+        a crash-loop raise — tears the live gang down on the way out
+        (jax.distributed workers otherwise sit forever waiting for
+        peers that will never come)."""
+        try:
+            return self._run_loop()
+        finally:
+            self._teardown()
+
+    def _run_loop(self) -> GangReport:
+        cfg = self.config
+        report = GangReport(
+            processes_initial=cfg.n_processes,
+            processes_final=cfg.n_processes,
+        )
+        plan = [cfg.n_processes, *cfg.shrink_plan]
+        plan_idx = 0
+        deaths: deque = deque(maxlen=256)
+        attempt = 0
+        t_first_death: Optional[float] = None
+        while True:
+            n_proc = plan[plan_idx]
+            report.processes_final = n_proc
+            frontier = self._resume_plan()
+            self._spawn_gang(n_proc, attempt, frontier)
+            rec = self._monitor(n_proc, attempt, frontier)
+            report.attempts.append(rec)
+            if rec.outcome in (COMPLETE, PREEMPTED):
+                report.succeeded = True
+                report.preempted = rec.outcome == PREEMPTED
+                if t_first_death is not None:
+                    report.recovery_wall_s = (
+                        time.monotonic() - t_first_death
+                    )
+                dones = [read_json(done_path(self.gang_dir, i))
+                         for i in range(n_proc)]
+                through = [
+                    d.get("completed_through") for d in dones
+                    if d is not None
+                    and d.get("completed_through") is not None
+                ]
+                report.completed_through = (
+                    min(through) if through else None
+                )
+                self._finalize(report)
+                return report
+            # a death/stall: breaker bookkeeping, then backoff/relaunch
+            now = time.monotonic()
+            if t_first_death is None:
+                t_first_death = now
+            deaths.append(now)
+            logger.warning(
+                "gang death (attempt %d, %s worker=%s rc=%s); frontier "
+                "was %s", attempt, rec.reason, rec.worker, rec.exit_code,
+                frontier,
+            )
+            if self._stop_requested:
+                raise GangCrashLoop(
+                    "gang did not drain cleanly after stop request",
+                    report,
+                )
+            window = [t for t in deaths
+                      if now - t <= cfg.restart_window_s]
+            if len(window) > cfg.max_restarts:
+                if plan_idx + 1 < len(plan):
+                    plan_idx += 1
+                    # fresh slate at P': clear the death window so the
+                    # shrunk gang relaunches promptly (first-retry
+                    # backoff) instead of inheriting the pre-shrink
+                    # window's near-maximum exponential wait
+                    deaths.clear()
+                    window = []
+                    msg = (
+                        f"crash-loop breaker at P={n_proc}: shrinking "
+                        f"to P'={plan[plan_idx]} (elastic resharded "
+                        "resume from the manifest frontier)"
+                    )
+                    report.shrinks.append(msg)
+                    logger.warning("gang: %s", msg)
+                else:
+                    raise GangCrashLoop(
+                        f"gang crash loop: >{cfg.max_restarts} deaths "
+                        f"in {cfg.restart_window_s:.0f}s at every "
+                        f"process count in {plan}", report,
+                    )
+            backoff = self.policy.backoff_s(
+                min(max(len(window) - 1, 0), 6), self._rng)
+            report.restarts += 1
+            time.sleep(backoff)
+            attempt += 1
+
+    def _finalize(self, report: GangReport) -> None:
+        """Coordinator-side post-run recording: checkpoint tree hashes
+        plus the supervision summary into ``manifest-gang.json``."""
+        try:
+            gm = GangManifest(self.run_dir)
+        except OSError:
+            return
+        if os.path.isdir(self.checkpoint_dir):
+            gm.record_checkpoints(self.checkpoint_dir, self.years)
+        gm.note(
+            f"gang supervisor: restarts={report.restarts} "
+            f"P={report.processes_initial}->{report.processes_final} "
+            f"preempted={report.preempted} "
+            f"recovery_wall_s={report.recovery_wall_s:.3f}"
+        )

@@ -1,0 +1,373 @@
+"""The gang drill: prove, on a CPU/gloo gang, that a multi-process
+simulation run survives worker death, worker stall, and a permanent
+P -> P' shrink — with artifacts indistinguishable from an
+uninterrupted gang.
+
+``python -m dgen_tpu.resilience drill --gang`` runs it (tools/check.sh
+wires a 2-process smoke configuration; the bench stamps its timings
+under ``DGEN_TPU_BENCH_GANG``).  Rounds:
+
+* **baseline** — a clean P-process gang to completion (the comparison
+  oracle; also proves the supervisor adds zero restarts to a healthy
+  gang).
+* **kill** — one worker SIGKILLed mid-year (``gang_worker_kill@2:kill``
+  via ``os._exit``, collectives in flight).  The supervisor must tear
+  the whole gang down, relaunch from the merged manifest frontier, and
+  finish with every per-process parquet shard **byte-identical** to the
+  baseline and a clean merged-manifest verify.
+* **stall** — one worker hangs instead of dying
+  (``gang_heartbeat_stall@4:hang``); only heartbeat staleness can catch
+  it.  Same recovery contract.  (Needs >= 3 model years so the stall
+  lands after the steady-state compile; skipped otherwise.)
+* **elastic** — the gang is stopped after its first year through the
+  synchronized SIGTERM-analogue barrier (``DGEN_GANG_STOP_AFTER`` on
+  worker 0 ONLY — the other workers learn of the stop via the barrier),
+  then resumed at P' < P workers over the same total device count: the
+  orbax checkpoint written at P re-places under the P' mesh
+  (parallel.elastic) and the resumed years' rows must be exactly the
+  baseline's (the shard files differ in how rows are split across
+  processes, so pre-stop years compare byte-for-byte and post-resume
+  years compare row-for-row after aligning on agent_id).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dgen_tpu.config import GangConfig, ScenarioConfig
+from dgen_tpu.resilience.gang import GangSupervisor
+from dgen_tpu.resilience.manifest import verify_run_dir
+from dgen_tpu.resilience.supervisor import RetryPolicy
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: per-process surfaces the gang exports (state_hourly is off in the
+#: drill configuration)
+GANG_SURFACES = ("agent_outputs", "finance_series")
+
+
+def _parts_by_year(run_dir: str, surface: str) -> Dict[int, List[str]]:
+    d = os.path.join(run_dir, surface)
+    out: Dict[int, List[str]] = {}
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".parquet"):
+            continue
+        year = int(name.split("=")[1].split("-")[0].split(".")[0])
+        out.setdefault(year, []).append(name)
+    return out
+
+
+def _read_rows(paths: List[str]):
+    import pandas as pd
+
+    df = pd.concat(
+        [pd.read_parquet(p) for p in paths], ignore_index=True,
+    )
+    return df.sort_values("agent_id").reset_index(drop=True)
+
+
+#: float tolerance for years RECOMPUTED on a different process layout
+#: (the elastic P -> P' resume): the restored carry is bit-exact and a
+#: same-topology restart is byte-identical (the kill round proves it),
+#: but each process's XLA executable re-associates the f32 hour-axis
+#: sums differently when its addressable device count changes — the
+#: same envelope as the chunked-vs-whole equivalence suite
+ELASTIC_RTOL = 5e-5
+ELASTIC_ATOL = 1e-3
+
+
+def compare_gang_run_dirs(baseline: str, other: str,
+                          rtol: float = 0.0,
+                          atol: float = 0.0) -> Dict[str, object]:
+    """Compare two gang run directories surface by surface, year by
+    year.  Years whose part SETS match compare byte-for-byte; years
+    split differently across processes compare row-for-row after
+    aligning on ``agent_id`` (multi-host exports are full f32).
+    ``rtol``/``atol`` of 0 demand exact value equality (same-topology
+    recovery); the elastic drill passes :data:`ELASTIC_RTOL` /
+    :data:`ELASTIC_ATOL` for its recomputed years."""
+    rec: Dict[str, object] = {
+        "mismatched": [], "year_mismatch": [], "compared": 0,
+        "row_compared_years": [],
+    }
+    for surface in GANG_SURFACES:
+        a, b = (_parts_by_year(baseline, surface),
+                _parts_by_year(other, surface))
+        if set(a) != set(b):
+            rec["year_mismatch"].append(
+                f"{surface}: {sorted(a)} vs {sorted(b)}")
+            continue
+        for year in sorted(a):
+            rec["compared"] += 1
+            pa = [os.path.join(baseline, surface, n) for n in a[year]]
+            pb = [os.path.join(other, surface, n) for n in b[year]]
+            if a[year] == b[year]:
+                same = all(
+                    open(x, "rb").read() == open(y, "rb").read()
+                    for x, y in zip(pa, pb)
+                )
+                if same:
+                    continue
+            # different shard split (or byte mismatch worth explaining):
+            # align rows on agent_id and demand exact value equality
+            da, db = _read_rows(pa), _read_rows(pb)
+            rec["row_compared_years"].append(f"{surface}/{year}")
+            try:
+                if list(da.columns) != list(db.columns) or len(da) != len(db):
+                    raise AssertionError("shape/columns differ")
+                for col in da.columns:
+                    va = np.stack(da[col].values)
+                    vb = np.stack(db[col].values)
+                    if va.dtype.kind in "fc" and (rtol or atol):
+                        np.testing.assert_allclose(
+                            va, vb, rtol=rtol, atol=atol, err_msg=col)
+                    elif not np.array_equal(va, vb):
+                        raise AssertionError(col)
+            except AssertionError as e:
+                rec["mismatched"].append(f"{surface}/{year}: {e}")
+    rec["ok"] = not (rec["mismatched"] or rec["year_mismatch"])
+    return rec
+
+
+def _checkpoint_bitexact(ckpt_a: str, ckpt_b: str, year: int,
+                         n_agents: int) -> bool:
+    """Whether two checkpoint directories hold bit-identical carries at
+    ``year``.  Restored through a host-array template (the same
+    topology-free path every elastic resume uses), so a step written by
+    a P=4 gang compares directly against any other layout's."""
+    import jax
+
+    from dgen_tpu.io import checkpoint as ckpt
+
+    def raw(d):
+        _, carry = ckpt.restore_year(d, n_agents, int(year))
+        return [np.asarray(x) for x in jax.tree.leaves(carry)]
+
+    la, lb = raw(ckpt_a), raw(ckpt_b)
+    return len(la) == len(lb) and all(
+        np.array_equal(a, b) for a, b in zip(la, lb)
+    )
+
+
+def _padded_agents(run_dir: str) -> Optional[int]:
+    """The padded global table size a gang run stamped into its meta."""
+    import json
+
+    try:
+        with open(os.path.join(run_dir, "meta.json")) as f:
+            return int(json.load(f)["gang"]["n_agents_padded"])
+    except (OSError, KeyError, ValueError, TypeError):
+        return None
+
+
+def _gang(
+    run_dir: str,
+    config: GangConfig,
+    years: List[int],
+    worker_env: Dict[str, str],
+    env_for=None,
+    gang_dir: Optional[str] = None,
+    seed: int = 0,
+):
+    return GangSupervisor(
+        run_dir, years, config=config,
+        policy=RetryPolicy(backoff_base_s=0.05),
+        env_for=env_for, worker_env=worker_env, gang_dir=gang_dir,
+        seed=seed,
+    )
+
+
+def run_gang_drill(
+    root: str,
+    *,
+    processes: int = 4,
+    shrink_to: int = 2,
+    total_devices: Optional[int] = None,
+    agents: int = 96,
+    end_year: int = 2018,
+    sizing_iters: int = 6,
+    stall: bool = True,
+    stall_timeout_s: float = 25.0,
+) -> Dict[str, object]:
+    """Run the gang fault matrix under ``root`` and return the drill
+    record (``ok`` plus per-round restarts/recovery walls — the bench
+    payload shape)."""
+    total = total_devices or processes
+    scen = ScenarioConfig(
+        name="gang", start_year=2014, end_year=end_year, anchor_years=(),
+    )
+    years = [int(y) for y in scen.model_years]
+    worker_env = {
+        "DGEN_AGENTS": str(agents),
+        "DGEN_END_YEAR": str(end_year),
+        "DGEN_GANG_SIZING_ITERS": str(sizing_iters),
+    }
+    base_cfg = GangConfig(
+        n_processes=processes, total_devices=total,
+        stall_timeout_s=120.0, max_restarts=3, restart_window_s=600.0,
+    )
+    rounds: Dict[str, dict] = {}
+    ok = True
+
+    def _round(name: str, run_dir: str, report, *, compare: bool = True,
+               want_restarts: int = 0, t0: float = 0.0,
+               rtol: float = 0.0, atol: float = 0.0) -> dict:
+        verify_ok = all(r.ok for r in verify_run_dir(run_dir))
+        cmp_rec = (
+            compare_gang_run_dirs(
+                os.path.join(root, "baseline"), run_dir,
+                rtol=rtol, atol=atol)
+            if compare else {"ok": True, "compared": 0}
+        )
+        rec = {
+            "restarts": report.restarts,
+            "recovery_wall_s": round(report.recovery_wall_s, 3),
+            "attempts": [
+                {"outcome": a.outcome, "reason": a.reason,
+                 "worker": a.worker, "exit_code": a.exit_code}
+                for a in report.attempts
+            ],
+            "shrinks": report.shrinks,
+            "completed_through": report.completed_through,
+            "parquet": {
+                "compared": cmp_rec.get("compared"),
+                "mismatched": cmp_rec.get("mismatched", []),
+                "row_compared_years": cmp_rec.get(
+                    "row_compared_years", []),
+            },
+            "verify_ok": verify_ok,
+            "drill_wall_s": round(time.perf_counter() - t0, 3),
+            "ok": bool(
+                report.succeeded and verify_ok and cmp_rec["ok"]
+                and report.restarts >= want_restarts
+            ),
+        }
+        logger.info("gang drill %s: %s (restarts=%d)", name,
+                    "ok" if rec["ok"] else "FAILED", report.restarts)
+        return rec
+
+    # --- baseline: clean P-process gang ---
+    t0 = time.perf_counter()
+    base_dir = os.path.join(root, "baseline")
+    rep = _gang(base_dir, base_cfg, years, worker_env).run()
+    rounds["baseline"] = _round(
+        "baseline", base_dir, rep, compare=False, t0=t0)
+    rounds["baseline"]["ok"] = bool(
+        rounds["baseline"]["ok"] and rep.restarts == 0
+        and not rep.preempted
+    )
+    ok = ok and rounds["baseline"]["ok"]
+
+    # --- kill: one worker SIGKILLed mid-year ---
+    t0 = time.perf_counter()
+    kill_dir = os.path.join(root, "kill")
+    kill_worker = min(2, processes - 1)
+
+    def kill_env(i: int, attempt: int):
+        if i == kill_worker and attempt == 0:
+            return {"DGEN_TPU_FAULTS": "gang_worker_kill@2:kill"}
+        return None
+
+    rep = _gang(kill_dir, base_cfg, years, worker_env,
+                env_for=kill_env, seed=1).run()
+    rounds["kill"] = _round(
+        "kill", kill_dir, rep, want_restarts=1, t0=t0)
+    ok = ok and rounds["kill"]["ok"]
+
+    # --- stall: one worker hangs; only heartbeat staleness catches it
+    # (the stall is armed at the 4th heartbeat — after the steady-state
+    # compile — so it needs >= 3 model years) ---
+    if stall and len(years) >= 3:
+        t0 = time.perf_counter()
+        stall_dir = os.path.join(root, "stall")
+        stall_cfg = GangConfig(
+            n_processes=processes, total_devices=total,
+            stall_timeout_s=stall_timeout_s,
+            max_restarts=3, restart_window_s=600.0,
+        )
+
+        def stall_env(i: int, attempt: int):
+            if i == min(1, processes - 1) and attempt == 0:
+                return {
+                    "DGEN_TPU_FAULTS": "gang_heartbeat_stall@4:hang",
+                    "DGEN_TPU_FAULT_HANG_S": "600",
+                }
+            return None
+
+        rep = _gang(stall_dir, stall_cfg, years, worker_env,
+                    env_for=stall_env, seed=2).run()
+        rounds["stall"] = _round(
+            "stall", stall_dir, rep, want_restarts=1, t0=t0)
+        stalled = any(
+            a.reason == "heartbeat_stall" for a in rep.attempts)
+        rounds["stall"]["ok"] = bool(rounds["stall"]["ok"] and stalled)
+        ok = ok and rounds["stall"]["ok"]
+
+    # --- elastic: synchronized stop after year 1, resumed at P' < P
+    # over the same total device count ---
+    if shrink_to:
+        t0 = time.perf_counter()
+        el_dir = os.path.join(root, "elastic")
+
+        def stop_env(i: int, attempt: int):
+            # worker 0 ONLY: the others must learn of the stop via the
+            # cross-process barrier, proving the synchronized
+            # emergency-checkpoint contract
+            if i == 0 and attempt == 0:
+                return {"DGEN_GANG_STOP_AFTER": str(years[0])}
+            return None
+
+        rep_a = _gang(el_dir, base_cfg, years, worker_env,
+                      env_for=stop_env, seed=3).run()
+        shrunk_cfg = GangConfig(
+            n_processes=shrink_to, total_devices=total,
+            stall_timeout_s=120.0, max_restarts=3,
+            restart_window_s=600.0,
+        )
+        rep_b = _gang(el_dir, shrunk_cfg, years, worker_env,
+                      seed=4).run()
+        # the carry the P' gang resumed FROM must be bit-identical to
+        # the uninterrupted baseline's checkpoint at the same year —
+        # the restore is exact; only years recomputed on the changed
+        # process layout carry the f32 re-association envelope
+        n_padded = _padded_agents(el_dir)
+        restore_exact = n_padded is not None and _checkpoint_bitexact(
+            os.path.join(root, "baseline", "checkpoints"),
+            os.path.join(el_dir, "checkpoints"),
+            years[0], n_padded,
+        )
+        rounds["elastic"] = _round(
+            "elastic", el_dir, rep_b, t0=t0,
+            rtol=ELASTIC_RTOL, atol=ELASTIC_ATOL,
+        )
+        rounds["elastic"]["stopped_through"] = rep_a.completed_through
+        rounds["elastic"]["restore_bitexact"] = restore_exact
+        rounds["elastic"]["ok"] = bool(
+            rounds["elastic"]["ok"]
+            and rep_a.preempted
+            and rep_a.completed_through == years[0]
+            and not rep_b.preempted
+            and restore_exact
+            and rounds["elastic"]["parquet"]["row_compared_years"]
+        )
+        ok = ok and rounds["elastic"]["ok"]
+
+    return {
+        "ok": ok,
+        "processes": processes,
+        "shrink_to": shrink_to,
+        "total_devices": total,
+        "agents": agents,
+        "years": years,
+        "restarts_total": sum(r["restarts"] for r in rounds.values()),
+        "recovery_wall_s_total": round(
+            sum(r["recovery_wall_s"] for r in rounds.values()), 3),
+        "rounds": rounds,
+    }

@@ -1,0 +1,243 @@
+"""One gang worker: a jax.distributed process of a supervised
+multi-host simulation run (``python -m dgen_tpu.resilience.gangworker``).
+
+Launched only by the :class:`~dgen_tpu.resilience.gang.GangSupervisor`
+(or an operator reproducing its env contract — see the gang module
+docstring).  Per process it:
+
+* pins the platform and brings up ``jax.distributed`` via the standard
+  multi-host env (:func:`dgen_tpu.parallel.launch.initialize_multihost`
+  — ``DGEN_COORDINATOR`` / ``DGEN_NUM_PROCESSES`` /
+  ``DGEN_PROCESS_ID``);
+* builds the (deterministic, identical on every process) synthetic
+  population and a global mesh over every device of every process;
+* resumes from the supervisor-provided manifest frontier: the newest
+  checkpoint that restores UNDER THIS TOPOLOGY at or below it
+  (:func:`dgen_tpu.parallel.elastic.resume_year_for` — this is what
+  makes a P -> P' relaunch elastic);
+* exports its OWN addressable shard rows per year, recorded in its
+  per-process shard ledger
+  (:class:`~dgen_tpu.resilience.manifest.RunManifest` with
+  ``shard=process_id``) — completeness is decided coordinator-side by
+  the :class:`~dgen_tpu.resilience.manifest.GangManifest` merge;
+* heartbeats after every completed year (the supervisor's stall
+  detector reads freshness off the file);
+* on SIGTERM runs the **synchronized emergency checkpoint barrier**
+  (:class:`StopFlag`): a tiny cross-process all-gather at every year
+  boundary makes all P workers agree on the save year, so every shard
+  exports and checkpoints through the same year before exit.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+from dgen_tpu.resilience.faults import fault_point
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else default
+
+
+class StopFlag:
+    """The synchronized stop barrier.  A local stop request (SIGTERM
+    from the supervisor, or the deterministic ``DGEN_GANG_STOP_AFTER``
+    drill knob) becomes a GANG-WIDE stop via a tiny cross-process
+    all-gather evaluated once per year by every worker — so all P
+    processes agree on the same save year, even when only one of them
+    received the signal."""
+
+    def __init__(self, stop_after: int | None = None) -> None:
+        self.stop_after = stop_after
+        self.preempted = False
+        self._sigterm = False
+
+    def install(self) -> "StopFlag":
+        signal.signal(signal.SIGTERM, self._on_sigterm)
+        return self
+
+    def _on_sigterm(self, *_args) -> None:
+        self._sigterm = True
+
+    def local(self, year: int) -> bool:
+        return self._sigterm or (
+            self.stop_after is not None and year >= self.stop_after
+        )
+
+    def should_stop(self, year: int, year_idx: int) -> bool:
+        """``Simulation.run``'s per-year hook: called by every process
+        after the year's exports and checkpoint save were issued.
+        Contains a collective — every process must call it once per
+        executed year (the run loop guarantees that)."""
+        # resilience drill hook: the barrier collective failing (a
+        # worker death between the year step and the barrier surfaces
+        # here as a gang death; the supervisor relaunches)
+        fault_point("gang_barrier")
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if self.local(year) else 0], np.int32)
+        )
+        stop = bool(np.sum(np.asarray(flags)) > 0)
+        if stop:
+            self.preempted = True
+        return stop
+
+
+def main() -> int:
+    from dgen_tpu.parallel.launch import (
+        initialize_multihost,
+        pin_platform_from_env,
+    )
+    from dgen_tpu.resilience.gang import (
+        done_path,
+        heartbeat_path,
+        write_heartbeat,
+    )
+
+    from dgen_tpu.resilience import faults
+
+    # per-worker fault arming (drills set DGEN_TPU_FAULTS on chosen
+    # workers/incarnations through the supervisor's env_for)
+    faults.install_from_env()
+
+    # the SIGTERM flag must be live BEFORE the multi-second distributed
+    # bring-up/compile: the supervisor forwards a pending stop within
+    # one poll of spawning, and the default disposition would kill a
+    # booting worker instead of letting it reach the first stop barrier
+    stop = StopFlag(
+        stop_after=(_env_int("DGEN_GANG_STOP_AFTER", 0) or None),
+    ).install()
+
+    gang_dir = os.environ["DGEN_GANG_DIR"]
+    run_dir = os.environ["DGEN_RUN_DIR"]
+    index = _env_int("DGEN_PROCESS_ID", 0)
+    hb_path = heartbeat_path(gang_dir, index)
+    # boot heartbeat (no year yet): the supervisor's boot-timeout
+    # grace runs until the first YEAR heartbeat below
+    write_heartbeat(hb_path, pid=os.getpid(), phase="boot")
+
+    pin_platform_from_env()
+    if not initialize_multihost():
+        raise ValueError(
+            "gangworker requires the multi-host env (DGEN_COORDINATOR, "
+            "DGEN_NUM_PROCESSES, DGEN_PROCESS_ID) — it is launched by "
+            "resilience.gang.GangSupervisor, not by hand"
+        )
+
+    import jax
+
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.io.export import RunExporter
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.simulation import Simulation
+    from dgen_tpu.parallel import elastic
+    from dgen_tpu.parallel.mesh import make_mesh
+    from dgen_tpu.resilience.manifest import RunManifest
+
+    n_proc = jax.process_count()
+    assert index == jax.process_index()
+
+    # deterministic, identical world on every process: the table is a
+    # pure function of the env knobs, so global-array placement can
+    # slice each process's shards out of the same host copy
+    states = [
+        s for s in os.environ.get("DGEN_GANG_STATES", "DE,CA").split(",")
+        if s
+    ]
+    cfg = ScenarioConfig(
+        name=os.environ.get("DGEN_GANG_NAME", "gang"),
+        start_year=_env_int("DGEN_GANG_START_YEAR", 2014),
+        end_year=_env_int("DGEN_END_YEAR", 2016),
+        anchor_years=(),
+    )
+    pop = synth.generate_population(
+        _env_int("DGEN_AGENTS", 96), states=states,
+        seed=_env_int("DGEN_GANG_SEED", 11), pad_multiple=64,
+    )
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+    )
+    rc = RunConfig.from_env(
+        sizing_iters=_env_int("DGEN_GANG_SIZING_ITERS", 6),
+    )
+    mesh = make_mesh() if len(jax.devices()) > 1 else None
+    sim = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc, mesh=mesh,
+    )
+
+    manifest = RunManifest(run_dir, shard=index, n_processes=n_proc)
+    exporter = RunExporter(
+        run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask,
+        manifest=manifest,
+        # topology-invariant artifacts: multi-process shard writes are
+        # always full f32, so a P'=1 elastic resume must not suddenly
+        # int16-quantize its exports (the shards could then never be
+        # compared — or resumed — against the P-process years')
+        compact=False,
+        meta={"gang": {
+            "n_processes": n_proc, "process": index,
+            # the PADDED global table size — what a later (possibly
+            # different-topology) restore needs to build its template
+            "n_agents_padded": int(sim.table.n_agents),
+        }},
+    )
+
+    def callback(year: int, year_idx: int, outs) -> None:
+        # resilience drill hook: a ``kill`` here is a worker dying
+        # mid-year with collectives in flight — the supervisor must
+        # tear the whole gang down and relaunch from the frontier
+        fault_point("gang_worker_kill")
+        exporter(year, year_idx, outs)
+        write_heartbeat(
+            hb_path, pid=os.getpid(), year=year, year_idx=year_idx,
+        )
+
+    ckpt_dir = os.environ.get(
+        "DGEN_GANG_CKPT_DIR", os.path.join(run_dir, "checkpoints"))
+    raw_frontier = os.environ.get("DGEN_GANG_FRONTIER", "").strip()
+    frontier = int(raw_frontier) if raw_frontier else None
+    resume_year = elastic.resume_year_for(
+        ckpt_dir, sim.table.n_agents, frontier, mesh=mesh,
+    ) if os.path.isdir(ckpt_dir) else None
+    if resume_year is not None:
+        logger.info(
+            "gang worker %d/%d: elastic resume after year %d "
+            "(frontier %s)", index, n_proc, resume_year, frontier,
+        )
+
+    res = sim.run(
+        callback=callback, collect=False, checkpoint_dir=ckpt_dir,
+        resume=resume_year is not None, resume_year=resume_year,
+        should_stop=stop.should_stop,
+    )
+
+    from dgen_tpu.resilience.atomic import atomic_write_json
+
+    atomic_write_json(done_path(gang_dir, index), {
+        "process": index,
+        "n_processes": n_proc,
+        "years_run": [int(y) for y in res.years],
+        "completed_through": (
+            int(res.years[-1]) if res.years
+            else (int(resume_year) if resume_year is not None else None)
+        ),
+        "preempted": stop.preempted,
+    })
+    print(
+        f"gang worker {index}/{n_proc}: "
+        f"{len(res.years)} years -> {run_dir}"
+        + (" (preempted)" if stop.preempted else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

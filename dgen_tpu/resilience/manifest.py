@@ -27,6 +27,16 @@ once orbax's own commit protocol has made the steps durable — mid-run
 the checkpoint directory's committed steps are themselves the source
 of truth, so a crash loses no recoverability by not having stamped
 them here yet.
+
+Multi-process (gang) runs keep crash consistency WITHOUT cross-host
+coordination on the write path: every process owns a **shard ledger**
+(``manifest-p<i>.json``, a :class:`RunManifest` with ``shard=i``)
+covering only the artifacts it wrote, and the coordinator-side
+:class:`GangManifest` merges them read-side — a year counts complete
+only when EVERY process of that year's writing epoch marked it
+complete (the host-local-shards-merged-by-a-manifest design).  The
+gang supervisor's resume frontier (:meth:`GangManifest.frontier`) is
+the merged ``complete_through``.
 """
 
 from __future__ import annotations
@@ -35,12 +45,49 @@ import dataclasses
 import hashlib
 import json
 import os
+import re
 from typing import Dict, List, Optional, Sequence
 
 from dgen_tpu.resilience.atomic import atomic_write_json
 
 MANIFEST_NAME = "manifest.json"
+#: coordinator-side ledger of a gang run (checkpoint hashes + notes;
+#: the per-year artifact truth stays in the per-process shard ledgers)
+GANG_MANIFEST_NAME = "manifest-gang.json"
+_SHARD_RE = re.compile(r"^manifest-p(\d+)\.json$")
 _VERSION = 1
+
+
+def shard_manifest_name(shard: int) -> str:
+    """Per-process shard ledger filename of a gang run."""
+    return f"manifest-p{int(shard)}.json"
+
+
+def _part_year(name: str) -> Optional[int]:
+    """Model year of a ``year=<Y>[-p<i>].parquet`` (or ``.tmp``)
+    surface file; None for anything else."""
+    if not name.startswith("year="):
+        return None
+    tail = name[len("year="):]
+    digits = ""
+    for ch in tail:
+        if ch.isdigit():
+            digits += ch
+        else:
+            break
+    return int(digits) if digits else None
+
+
+def discover_shards(run_dir: str) -> List[int]:
+    """Process indices with a shard ledger under ``run_dir``."""
+    if not os.path.isdir(run_dir):
+        return []
+    out = []
+    for name in os.listdir(run_dir):
+        m = _SHARD_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
 
 
 def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
@@ -112,11 +159,22 @@ class RunManifest:
 
     Loading an existing ``manifest.json`` resumes its ledger — a
     re-entered run keeps the completed years' entries and overwrites
-    the years it re-exports."""
+    the years it re-exports.
 
-    def __init__(self, run_dir: str) -> None:
+    ``shard``/``n_processes`` turn this into a gang run's per-process
+    shard ledger (``manifest-p<shard>.json``): the same recording
+    protocol over only this process's artifacts, with each completed
+    year stamped with the gang size that wrote it so the coordinator
+    merge (:class:`GangManifest`) knows which peers to demand."""
+
+    def __init__(self, run_dir: str, shard: Optional[int] = None,
+                 n_processes: Optional[int] = None) -> None:
         self.run_dir = run_dir
-        self.path = os.path.join(run_dir, MANIFEST_NAME)
+        self.shard = shard
+        self.n_processes = n_processes
+        name = (MANIFEST_NAME if shard is None
+                else shard_manifest_name(shard))
+        self.path = os.path.join(run_dir, name)
         self._years: Dict[int, dict] = {}
         self._checkpoints: Dict[int, dict] = {}
         self._run_artifacts: Dict[str, dict] = {}
@@ -164,10 +222,16 @@ class RunManifest:
 
     def mark_year_complete(self, year: int) -> None:
         """Declare every surface of ``year`` recorded, and publish the
-        ledger (one atomic write per year)."""
-        self._years.setdefault(
+        ledger (one atomic write per year).  Shard ledgers also stamp
+        the gang size that wrote the year — an elastic P -> P' resume
+        re-exports later years at P', and the merge must know each
+        year's own epoch."""
+        rec = self._years.setdefault(
             int(year), {"complete": False, "artifacts": {}}
-        )["complete"] = True
+        )
+        rec["complete"] = True
+        if self.n_processes is not None:
+            rec["n_processes"] = int(self.n_processes)
         self.flush()
 
     def record_checkpoints(self, ckpt_dir: str,
@@ -201,6 +265,8 @@ class RunManifest:
             self.path,
             {
                 "version": _VERSION,
+                **({"shard": int(self.shard)}
+                   if self.shard is not None else {}),
                 "years": {
                     str(y): self._years[y] for y in sorted(self._years)
                 },
@@ -311,25 +377,262 @@ class RunManifest:
         return rep
 
 
+class GangManifest:
+    """Coordinator-side merged view over a gang run's per-process
+    shard ledgers (module docstring).
+
+    The write path stays embarrassingly parallel — every process only
+    ever touches its own ``manifest-p<i>.json`` — and the merge happens
+    read-side, on whatever host asks: a year is complete only when the
+    ledgers of ALL ``n_processes`` recorded for that year (its writing
+    epoch) mark it complete and its artifacts verify.  Checkpoint tree
+    hashes and operational notes live in a separate coordinator ledger
+    (``manifest-gang.json``), written by the gang supervisor after the
+    run — never contended with the workers."""
+
+    def __init__(self, run_dir: str) -> None:
+        self.run_dir = run_dir
+        self.path = os.path.join(run_dir, GANG_MANIFEST_NAME)
+        self.shards: Dict[int, RunManifest] = {
+            i: RunManifest(run_dir, shard=i)
+            for i in discover_shards(run_dir)
+        }
+        self._checkpoints: Dict[int, dict] = {}
+        self.notes: List[str] = []
+        if os.path.isfile(self.path):
+            try:
+                with open(self.path) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                doc = {}
+            for y, rec in (doc.get("checkpoints") or {}).items():
+                self._checkpoints[int(y)] = rec
+            self.notes = list(doc.get("notes") or [])
+
+    # -- merged queries -------------------------------------------------
+
+    def _year_epoch(self, year: int) -> Optional[tuple[int, List[int]]]:
+        """(n_processes, shard indices holding the year) of ``year``'s
+        writing epoch, or None when no shard recorded it / the epoch
+        stamps disagree (a torn mix of gang sizes is not complete)."""
+        holders: List[int] = []
+        epochs = set()
+        for i, m in self.shards.items():
+            rec = m._years.get(int(year))
+            if rec is None:
+                continue
+            holders.append(i)
+            epochs.add(int(rec.get("n_processes") or 0))
+        if not holders or len(epochs) != 1:
+            return None
+        n = epochs.pop()
+        return (n, holders) if n > 0 else None
+
+    def _year_complete(self, year: int, deep: bool = True) -> bool:
+        epoch = self._year_epoch(year)
+        if epoch is None:
+            return False
+        n, holders = epoch
+        if sorted(holders) != list(range(n)):
+            return False   # a peer's shard never landed
+        for i in range(n):
+            m = self.shards[i]
+            rec = m._years[int(year)]
+            if not rec.get("complete"):
+                return False
+            if deep and not m._year_ok(int(year)):
+                return False
+        return True
+
+    def frontier(self, years: Sequence[int],
+                 deep: bool = True) -> Optional[int]:
+        """The gang resume frontier: the latest model year through
+        which EVERY process's exports are durably, verifiably on disk
+        (merged ``complete_through``).  None = restart from scratch."""
+        out: Optional[int] = None
+        for y in years:
+            if not self._year_complete(int(y), deep=deep):
+                break
+            out = int(y)
+        return out
+
+    def complete_years(self, deep: bool = False) -> List[int]:
+        ys = sorted({
+            y for m in self.shards.values() for y in m._years
+        })
+        return [y for y in ys if self._year_complete(y, deep=deep)]
+
+    # -- coordinator recording ------------------------------------------
+
+    def record_checkpoints(self, ckpt_dir: str,
+                           years: Sequence[int]) -> None:
+        """Post-run, coordinator-side: hash each committed checkpoint
+        step's tree (the collective orbax saves every process
+        contributed shards to) into the coordinator ledger."""
+        for y in years:
+            step_dir = os.path.join(ckpt_dir, str(int(y)))
+            if not os.path.isdir(step_dir):
+                continue
+            digest, nbytes = _hash_tree(step_dir)
+            self._checkpoints[int(y)] = {
+                "dir": os.path.relpath(step_dir, self.run_dir)
+                if step_dir.startswith(self.run_dir) else step_dir,
+                "sha256": digest,
+                "bytes": nbytes,
+            }
+        self.flush()
+
+    def note(self, msg: str) -> None:
+        self.notes.append(msg)
+        self.flush()
+
+    def prune_after(self, frontier: Optional[int]) -> List[str]:
+        """Delete every gang artifact of years BEYOND the resume
+        frontier — part files on disk (any epoch's, ledgered or not)
+        and the shard-ledger records pointing at them.  The supervisor
+        calls this before a relaunch so the re-export (possibly at a
+        DIFFERENT gang size) starts clean: a dead P=4 epoch's stale
+        ``-p2``/``-p3`` parts would otherwise double rows under a
+        P'=2 re-export's concatenation and wedge the merged
+        completeness check on mixed epoch stamps forever.  ``frontier``
+        None prunes everything (restart from scratch).  Returns the
+        removed relpaths."""
+        removed: List[str] = []
+
+        def _rm(rel: str) -> None:
+            try:
+                os.remove(os.path.join(self.run_dir, rel))
+                removed.append(rel)
+            except OSError:
+                pass   # already gone / racing writer: the sweep is
+                       # best-effort, the atomic re-export wins anyway
+
+        for m in self.shards.values():
+            drop = [
+                y for y in m._years
+                if frontier is None or y > int(frontier)
+            ]
+            for y in drop:
+                for rel in m._years[y]["artifacts"]:
+                    _rm(rel)
+                del m._years[y]
+            if drop:
+                m.flush()
+        # unledgered leftovers (a writer killed between rename and
+        # record) and stale tmp siblings of the pruned years
+        for d in SURFACE_DIRS:
+            root = os.path.join(self.run_dir, d)
+            if not os.path.isdir(root):
+                continue
+            for name in sorted(os.listdir(root)):
+                year = _part_year(name)
+                if year is None:
+                    continue
+                if frontier is None or year > int(frontier):
+                    _rm(os.path.join(d, name))
+        return removed
+
+    def flush(self) -> None:
+        os.makedirs(self.run_dir, exist_ok=True)
+        atomic_write_json(
+            self.path,
+            {
+                "version": _VERSION,
+                "checkpoints": {
+                    str(y): self._checkpoints[y]
+                    for y in sorted(self._checkpoints)
+                },
+                "notes": self.notes,
+            },
+            indent=2,
+        )
+
+    # -- audit ----------------------------------------------------------
+
+    def verify(self, deep: bool = True) -> VerifyReport:
+        """One merged audit over every shard ledger plus the
+        coordinator's checkpoint entries: per-shard missing/corrupt
+        artifacts, merged years-complete, the unrecorded/stale-tmp
+        sweep against the UNION of recorded artifacts (a peer's shard
+        parts are not 'unrecorded' just because this ledger didn't
+        write them)."""
+        rep = VerifyReport(run_dir=self.run_dir)
+        recorded = set()
+        bad_rels = set()
+        for i in sorted(self.shards):
+            # per-shard unrecorded/stale sweeps are discarded: a peer's
+            # parts are recorded in the PEER's ledger, so only the
+            # union sweep below is meaningful
+            sub = self.shards[i].verify(deep=deep)
+            rep.missing.extend(sub.missing)
+            rep.corrupt.extend(sub.corrupt)
+            bad_rels.update(sub.missing)
+            bad_rels.update(sub.corrupt)
+        # union of recorded artifacts across shards, for the sweep
+        for m in self.shards.values():
+            recorded.update(m._run_artifacts)
+            for y in m._years:
+                recorded.update(m._years[y]["artifacts"])
+        # completeness reuses the per-shard verify verdicts above
+        # (every artifact was already existence/size/hash-checked there
+        # — a second deep pass would re-hash the whole directory)
+        rep.years_complete = [
+            y for y in self.complete_years(deep=False)
+            if not any(
+                rel in bad_rels
+                for m in self.shards.values()
+                for rel in m._years.get(y, {}).get("artifacts", {})
+            )
+        ]
+        for y, meta in self._checkpoints.items():
+            step_dir = os.path.join(self.run_dir, meta["dir"]) \
+                if not os.path.isabs(meta["dir"]) else meta["dir"]
+            if not os.path.isdir(step_dir):
+                rep.bad_checkpoints.append(y)
+                continue
+            if deep:
+                digest, _ = _hash_tree(step_dir)
+                if digest != meta["sha256"]:
+                    rep.bad_checkpoints.append(y)
+        rep.unrecorded = []
+        rep.stale_tmp = []
+        for d in SURFACE_DIRS:
+            root = os.path.join(self.run_dir, d)
+            if not os.path.isdir(root):
+                continue
+            for name in sorted(os.listdir(root)):
+                rel = os.path.join(d, name)
+                if name.endswith(".tmp"):
+                    rep.stale_tmp.append(rel)
+                elif name.endswith(".parquet") and rel not in recorded:
+                    rep.unrecorded.append(rel)
+        return rep
+
+
 def verify_run_dir(run_dir: str, deep: bool = True) -> List[VerifyReport]:
-    """Audit a run directory; recurses into per-scenario
-    subdirectories (a sweep export is one manifest per scenario
-    directory).  Raises FileNotFoundError when no manifest exists
-    anywhere under ``run_dir``."""
+    """Audit a run directory; gang runs (per-process shard ledgers,
+    no single ``manifest.json``) get one MERGED report, and sweep runs
+    recurse into per-scenario subdirectories.  Raises FileNotFoundError
+    when no manifest exists anywhere under ``run_dir``."""
     reports: List[VerifyReport] = []
     if os.path.isfile(os.path.join(run_dir, MANIFEST_NAME)):
         reports.append(RunManifest(run_dir).verify(deep=deep))
+    elif discover_shards(run_dir):
+        reports.append(GangManifest(run_dir).verify(deep=deep))
     else:
         for name in sorted(os.listdir(run_dir)):
             sub = os.path.join(run_dir, name)
-            if os.path.isdir(sub) and os.path.isfile(
-                os.path.join(sub, MANIFEST_NAME)
-            ):
+            if not os.path.isdir(sub):
+                continue
+            if os.path.isfile(os.path.join(sub, MANIFEST_NAME)):
                 reports.append(RunManifest(sub).verify(deep=deep))
+            elif discover_shards(sub):
+                reports.append(GangManifest(sub).verify(deep=deep))
     if not reports:
         raise FileNotFoundError(
-            f"no {MANIFEST_NAME} under {run_dir} (not a manifested run "
-            "directory — re-run under the resilience supervisor or "
-            "pass an exporter a RunManifest)"
+            f"no {MANIFEST_NAME} (or manifest-p*.json shard ledgers) "
+            f"under {run_dir} (not a manifested run directory — re-run "
+            "under the resilience supervisor or pass an exporter a "
+            "RunManifest)"
         )
     return reports

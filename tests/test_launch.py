@@ -19,7 +19,10 @@ from dgen_tpu.parallel.launch import (
     shard_states_from_env,
 )
 
-pytestmark = pytest.mark.slow
+# the subprocess launch tests are multi-minute (each boots fresh jax
+# processes) and carry the slow mark individually; the pure-unit tests
+# below run in tier-1
+slow = pytest.mark.slow
 
 
 def test_bin_states_size_ordering():
@@ -52,6 +55,29 @@ def test_initialize_multihost_noop_without_coordinator(monkeypatch):
     assert initialize_multihost() is False
 
 
+def test_initialize_multihost_names_missing_env_var(monkeypatch):
+    """A coordinator with no peer-count/rank env must fail with a
+    ValueError naming the missing variable (not a bare KeyError) —
+    operators debugging a half-configured launch read the message, not
+    the traceback."""
+    monkeypatch.setenv("DGEN_COORDINATOR", "127.0.0.1:1234")
+    monkeypatch.delenv("DGEN_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("DGEN_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="DGEN_NUM_PROCESSES"):
+        initialize_multihost()
+    monkeypatch.setenv("DGEN_NUM_PROCESSES", "2")
+    with pytest.raises(ValueError, match="DGEN_PROCESS_ID"):
+        initialize_multihost()
+    # a non-integer value gets the same friendly treatment
+    monkeypatch.setenv("DGEN_PROCESS_ID", "zero")
+    with pytest.raises(ValueError, match="DGEN_PROCESS_ID"):
+        initialize_multihost()
+    # empty string counts as missing, not as int("") noise
+    monkeypatch.setenv("DGEN_PROCESS_ID", "")
+    with pytest.raises(ValueError, match="DGEN_PROCESS_ID"):
+        initialize_multihost()
+
+
 def test_federal_itc_schedule_values():
     years = [2014, 2020, 2024, 2033, 2034, 2036]
     sch = federal_itc_schedule(years)
@@ -64,6 +90,7 @@ def test_federal_itc_schedule_values():
     np.testing.assert_allclose(sch[5], [0.0, 0.10, 0.10])
 
 
+@slow
 def test_distributed_run_persists_and_resumes(tmp_path):
     """A jax.distributed-initialized mesh run must write checkpoints
     plus all three parquet surfaces, and resume across a process
@@ -158,6 +185,7 @@ def test_distributed_run_persists_and_resumes(tmp_path):
     assert len(hourly["state"].unique()) > 0
 
 
+@slow
 def test_two_process_distributed_run_persists_shards(tmp_path):
     """TRUE multi-process run: two jax.distributed processes (4 CPU
     devices each, gloo collectives) over one 8-device global mesh,
@@ -296,6 +324,7 @@ def test_two_process_distributed_run_persists_shards(tmp_path):
     )
 
 
+@slow
 def test_launch_main_executes_shard_commands(tmp_path):
     """The flagship L7 entrypoint (``python -m dgen_tpu.parallel.launch``)
     must actually run: two single-process shards launched EXACTLY as
@@ -347,6 +376,7 @@ def test_launch_main_executes_shard_commands(tmp_path):
         assert ckpt.latest_year(os.path.join(run_dir, "ckpt")) == 2016
 
 
+@slow
 def test_launch_main_two_process_coordinator(tmp_path):
     """``main()`` through the DGEN_COORDINATOR/DGEN_NUM_PROCESSES env
     contract: two real processes bring up jax.distributed (gloo), run
@@ -417,6 +447,7 @@ def test_launch_main_two_process_coordinator(tmp_path):
     assert len(ids0 | ids1) == 96
 
 
+@slow
 def test_run_with_recovery_resumes_after_crash(tmp_path):
     """A mid-run crash resumes from the last checkpoint on retry
     (the maxRetryCount analogue, but checkpoint-granular)."""

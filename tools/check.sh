@@ -70,6 +70,17 @@ if [ "$mode" != "--test-only" ]; then
     echo "== serve fleet drill (python -m dgen_tpu.resilience drill --serve-fleet) =="
     JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --serve-fleet \
         --replicas 2 --agents 64 --requests 60 >/tmp/_fleet.json || rc=1
+    # gang smoke drill (docs/resilience.md "Gang runbook"): a
+    # 2-process jax.distributed CPU/gloo gang with worker 1 SIGKILLed
+    # mid-year — the supervisor must tear the whole gang down, relaunch
+    # from the merged shard-ledger frontier, and finish with parquet
+    # shards byte-identical to an uninterrupted baseline and a clean
+    # merged-manifest verify (the full P=4 -> P'=2 elastic drill runs
+    # in the slow tier / tests/test_gang.py)
+    echo "== gang drill smoke (python -m dgen_tpu.resilience drill --gang) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --gang \
+        --gang-processes 2 --gang-shrink 0 --no-gang-stall \
+        --agents 48 --end-year 2016 >/tmp/_gang.json || rc=1
 fi
 
 if [ "$mode" != "--lint-only" ]; then

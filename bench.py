@@ -862,6 +862,24 @@ def main() -> None:
                 }
                 for k, v in _pb.get("entries", {}).items()
             },
+            # the committed J7 collective fingerprints (mesh tier,
+            # docs/lint.md J7-J10): per-entry collective op counts +
+            # estimated comm bytes per mesh shape, so a MULTICHIP wall
+            # regression can be correlated with — or ruled out
+            # against — a static comm-cost change (e.g. a new
+            # all-gather) without compiling anything here
+            "mesh": {
+                k: {
+                    "collectives": {
+                        kind: c.get("count")
+                        for kind, c in v.get("collectives", {}).items()
+                    },
+                    "comm_bytes": v.get("comm_bytes"),
+                    "peak_bytes": v.get("peak_bytes"),
+                    "program_hash": v.get("program_hash"),
+                }
+                for k, v in _pb.get("mesh", {}).items()
+            },
         }
     except (OSError, ValueError) as e:
         payload["prog_cost"] = {"error": str(e)[:200]}

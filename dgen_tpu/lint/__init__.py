@@ -18,7 +18,8 @@ import-light)::
 
 :mod:`dgen_tpu.lint.prog` traces + lowers every registered jitted
 entry point over the static-config grid on CPU (no devices, no data)
-and runs rules J0-J6 over the jaxprs/StableHLO, including the J6
+and runs rules J0-J10 over the jaxprs/StableHLO (``--mesh`` adds
+the multi-device J7-J10 tier), including the J6
 cost-fingerprint gate against ``tools/prog_baseline.json``.
 
 Runtime half: :class:`dgen_tpu.lint.guard.RetraceGuard` counts fresh

@@ -5,7 +5,7 @@ error):
 
 * default — the AST linter (rules L1-L11) over source paths; no jax
   import, safe anywhere.
-* ``--programs`` — the jaxpr/HLO program auditor (rules J0-J6,
+* ``--programs`` — the jaxpr/HLO program auditor (rules J0-J10,
   :mod:`dgen_tpu.lint.prog`): traces and lowers every registered
   jitted entry point over the static-config grid on the CPU backend
   (``JAX_PLATFORMS`` defaults to cpu for the audit; no devices, no
@@ -49,16 +49,84 @@ def _findings_out(findings, as_json: bool, label: str) -> int:
     return 1 if findings else 0
 
 
+def _parse_mesh_shapes(arg):
+    if not arg:
+        return None
+    from dgen_tpu.parallel.mesh import parse_mesh_shape
+
+    return [parse_mesh_shape(s) for s in arg.split(",") if s.strip()]
+
+
+def _force_mesh_devices(shapes) -> None:
+    """Request enough virtual CPU devices for the mesh grid BEFORE the
+    backend initializes (the whole audit is trace/lower/compile — no
+    execution — so virtual devices are all it ever needs)."""
+    from dgen_tpu.lint.prog.registry import MESH_GRID_DEFAULT
+    from dgen_tpu.utils import compat
+
+    grid = shapes or list(MESH_GRID_DEFAULT)
+    need = max(int(h) * int(d) for h, d in grid)
+    compat.set_cpu_device_count(max(need, 1))
+
+
+def _advisory_banner(note: str) -> None:
+    """A downgraded cost gate must be LOUD: an operator (or a CI log
+    reader) who misses it ships unreviewed cost changes."""
+    for line in (
+        "*" * 66,
+        "*** COST GATES (J6/J7/J10) DOWNGRADED TO ADVISORY — NOT ENFORCED",
+        f"*** {note}",
+        "*** re-seed on purpose with:",
+        "***     python -m dgen_tpu.lint --programs --update-baselines",
+        "***     (add --mesh for the J7/J10 mesh section)",
+        "*" * 66,
+    ):
+        print(f"dgenlint-prog: {line}", file=sys.stderr)
+
+
 def _run_programs(args) -> int:
     # the auditor only ever needs to TRACE — never run — so default to
     # the CPU backend unless the operator pinned one explicitly (a TPU
     # bring-up just to parse programs wastes minutes and a chip)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        mesh_shapes = _parse_mesh_shapes(args.mesh_shapes)
+    except ValueError as e:
+        print(f"dgenlint: {e}", file=sys.stderr)
+        return 2
+    if mesh_shapes and not (args.mesh or args.explain):
+        # an explicitly requested mesh grid must never be a silent
+        # no-op (the operator would believe the shapes were audited)
+        print(
+            "dgenlint: --mesh-shapes requires --mesh (or --explain)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.hbm_gb is not None and not args.mesh:
+        # same principle for the J9 budget: without the mesh tier the
+        # memory gate never runs, and a silent exit-0 would read as
+        # "the footprint was gated at this budget"
+        print("dgenlint: --hbm-gb requires --mesh", file=sys.stderr)
+        return 2
+    if args.mesh or args.explain:
+        _force_mesh_devices(mesh_shapes)
     from dgen_tpu.lint import prog
 
     if args.list_programs:
         for name in prog.entry_names():
             print(name)
+        return 0
+    if args.explain:
+        try:
+            # an explicit --mesh-shapes implies the mesh view (the
+            # guard above lets it through without --mesh)
+            print(prog.explain_entry(
+                args.explain, mesh=args.mesh or bool(mesh_shapes),
+                mesh_shapes=mesh_shapes,
+            ))
+        except ValueError as e:
+            print(f"dgenlint: {e}", file=sys.stderr)
+            return 2
         return 0
     entries = None
     if args.entries:
@@ -74,6 +142,9 @@ def _run_programs(args) -> int:
             baseline_path=args.baseline,
             update_baselines=args.update_baselines,
             tolerance=args.tolerance,
+            mesh=args.mesh,
+            mesh_shapes=mesh_shapes,
+            hbm_budget_gb=args.hbm_gb,
         )
     except ValueError as e:
         print(f"dgenlint: {e}", file=sys.stderr)
@@ -98,13 +169,33 @@ def _run_programs(args) -> int:
             + (f", {e['failed']} FAILED" if e["failed"] else ""),
             file=sys.stderr,
         )
+    for spec_id, m in sorted((report.get("mesh") or {}).items()):
+        colls = ", ".join(
+            f"{k} x{v}" for k, v in sorted(m["collectives"].items())
+        ) or "no collectives"
+        peak = m.get("peak_bytes")
+        print(
+            f"dgenlint-prog: [mesh] {spec_id}: {colls} "
+            f"(~{m['comm_bytes']} comm B"
+            + (f", peak {peak / 2**20:.1f} MiB/device" if peak else "")
+            + ")",
+            file=sys.stderr,
+        )
     j6 = report.get("j6") or {}
-    if j6.get("note"):
-        print(f"dgenlint-prog: {j6['note']}", file=sys.stderr)
+    j7 = report.get("j7") or {}
+    # a downgraded gate (jax/platform/spec mismatch vs the committed
+    # baseline, or no baseline at all) must be impossible to miss in a
+    # check.sh or CI log — keyed on the structured status flag, not
+    # the note's wording
+    if j6.get("downgraded") or j7.get("downgraded"):
+        _advisory_banner(j6.get("note") or j7.get("note") or "")
     if j6.get("updated"):
         print(
             f"dgenlint-prog: baseline written to {j6['updated']} "
-            f"({len(j6['entries'])} entries)",
+            f"({len(j6['entries'])} entries"
+            + (f", {len(j6.get('mesh_entries') or [])} mesh entries"
+               if j6.get("mesh_entries") else "")
+            + ")",
             file=sys.stderr,
         )
     return _findings_out(findings, False, "dgenlint-prog")
@@ -137,7 +228,8 @@ def main(argv=None) -> int:
     prog_group.add_argument(
         "--programs", action="store_true",
         help="audit the lowered jaxpr/StableHLO of every registered "
-             "jitted entry point (rules J0-J6) instead of linting "
+             "jitted entry point (rules J0-J6; --mesh adds J7-J10) "
+             "instead of linting "
              "source",
     )
     prog_group.add_argument(
@@ -153,6 +245,28 @@ def main(argv=None) -> int:
     prog_group.add_argument(
         "--list-programs", action="store_true",
         help="print the registered entry names, then exit",
+    )
+    prog_group.add_argument(
+        "--mesh", action="store_true",
+        help="additionally lower every entry under the multi-device "
+             "CPU mesh grid (1x8 + 2x4 hosts-x-devices by default) "
+             "with production shardings and enforce J7-J10",
+    )
+    prog_group.add_argument(
+        "--mesh-shapes", metavar="SHAPES",
+        help="comma-separated HxD mesh shapes for --mesh "
+             "(e.g. 1x8,2x4); custom shapes gate without the "
+             "stale-entry sweep",
+    )
+    prog_group.add_argument(
+        "--hbm-gb", type=float, default=None,
+        help="J9 per-device memory budget in GiB (default 16)",
+    )
+    prog_group.add_argument(
+        "--explain", metavar="ENTRY",
+        help="dump one entry's jaxpr, sharded HLO excerpt, collective "
+             "table and per-device memory estimate, then exit "
+             "(accepts entry or entry@variant; combine with --mesh)",
     )
     prog_group.add_argument(
         "--baseline", metavar="PATH",
@@ -180,8 +294,18 @@ def main(argv=None) -> int:
             print(f"{rule_id}  {summary}  (--programs)")
         return 0
 
-    if args.programs or args.list_programs:
+    if args.programs or args.list_programs or args.explain:
         return _run_programs(args)
+    if args.mesh or args.mesh_shapes or args.hbm_gb is not None:
+        # program-auditor flags without --programs must not silently
+        # fall through to the source linter (the operator would read
+        # its 'clean' as the mesh audit passing)
+        print(
+            "dgenlint: --mesh/--mesh-shapes/--hbm-gb require "
+            "--programs",
+            file=sys.stderr,
+        )
+        return 2
 
     select = None
     if args.select:

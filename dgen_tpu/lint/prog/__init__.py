@@ -24,6 +24,25 @@ J-rules run over the resulting jaxprs/StableHLO:
       with a tolerance gate — a perf-regression gate with zero timing
       noise)
 
+``--mesh`` adds the MULTI-DEVICE tier (``dgen_tpu.lint.prog.
+meshaudit``): every entry is additionally lowered under forced
+multi-device CPU meshes — the 1-D 1x8 agent mesh and the 2-D 2x4
+hosts x devices grid — with the production shardings applied
+(``parallel.mesh.agent_spec`` via the real ``Simulation.__init__``
+placement), compiled (still CPU, still no execution), and gated:
+
+  J7  collective fingerprints (all-reduce/all-gather/... counts +
+      estimated comm bytes vs the committed baseline; a new all-gather
+      on the hot path fails with the op and operand shape named)
+  J8  sharding propagation (agent-axis arrays must stay partitioned:
+      global-shaped tensors inside the per-device program and
+      replicated [N]-leading outputs are flagged)
+  J9  static per-device memory (compiled.memory_analysis vs the HBM
+      budget, cross-checked against the sweep planner's
+      _per_agent_step_bytes model)
+  J10 per-mesh-shape program hashes (topology-sensitive changes land
+      as reviewable baseline diffs)
+
 Unlike the static L-half, this package imports jax (it must trace);
 ``dgen_tpu.lint`` itself stays import-light and pulls it lazily.
 """
@@ -36,8 +55,12 @@ from dgen_tpu.lint.core import Finding
 from dgen_tpu.lint.prog import baseline as baseline_mod
 from dgen_tpu.lint.prog.jrules import PROGRAM_RULES, run_program_rules
 from dgen_tpu.lint.prog.registry import (
+    MESH_GRID_DEFAULT,
+    MESH_GRID_FAST,
+    build_mesh_registry,
     build_registry,
     entry_names,
+    mesh_label,
     select_entries,
 )
 from dgen_tpu.lint.prog.spec import (  # noqa: F401  (public API)
@@ -50,10 +73,14 @@ from dgen_tpu.lint.prog.spec import (  # noqa: F401  (public API)
 )
 
 __all__ = [
-    "PROGRAM_RULES", "ProgramAudit", "ProgramSpec", "Bound",
-    "audit_programs", "build_registry", "entry_names", "lower_spec",
-    "run_program_rules",
+    "MESH_GRID_DEFAULT", "MESH_GRID_FAST", "PROGRAM_RULES",
+    "ProgramAudit", "ProgramSpec", "Bound", "audit_programs",
+    "build_mesh_registry", "build_registry", "entry_names",
+    "explain_entry", "lower_spec", "mesh_label", "run_program_rules",
 ]
+
+#: rules applied by the baseline module, not run_program_rules
+_BASELINE_RULES = ("J6", "J7", "J10")
 
 
 def audit_programs(
@@ -64,16 +91,22 @@ def audit_programs(
     update_baselines: bool = False,
     with_cost: bool = True,
     tolerance: Optional[float] = None,
+    mesh: bool = False,
+    mesh_shapes: Optional[List[tuple]] = None,
+    hbm_budget_gb: Optional[float] = None,
 ) -> Tuple[List[Finding], dict]:
     """Audit the entry-point registry; returns (findings, report).
 
     ``entries``: subset of registry entry names (default: all).
     ``grid="fast"``: base grid points only (test tier).
     ``select``: subset of J-rule ids. ``with_cost=False`` skips the
-    compile step entirely (J6 reports nothing). The report carries the
-    per-spec fingerprints, predicted compile-group counts, the J6
-    status and — with ``update_baselines`` — the freshly written
-    baseline document.
+    compile step entirely (J6 reports nothing). ``mesh``: additionally
+    lower every entry under the forced multi-device CPU mesh grid
+    (``mesh_shapes`` or the registry default) with production shardings
+    applied and enforce J7-J10 (``hbm_budget_gb`` feeds the J9 gate).
+    The report carries the per-spec fingerprints, predicted
+    compile-group counts, the J6 (and mesh-tier J7) status and — with
+    ``update_baselines`` — the freshly written baseline document.
     """
     from dgen_tpu.utils import compilecache
 
@@ -87,21 +120,72 @@ def audit_programs(
             "--update-baselines requires the J6 rule: drop --select, "
             "include J6 in it, and keep cost analysis enabled"
         )
+    mesh_only = {"J7", "J8", "J9", "J10"} & set(select or ())
+    if mesh_only and not mesh:
+        # an explicitly selected mesh rule must never be a silent
+        # no-op (the operator would believe the sharding was audited)
+        raise ValueError(
+            f"--select {','.join(sorted(mesh_only))} requires --mesh "
+            "(the mesh tier is what those rules run over)"
+        )
+    mesh_specs: List[ProgramSpec] = []
+    if mesh:
+        shapes = [tuple(s) for s in mesh_shapes] if mesh_shapes else None
+        mesh_specs = build_mesh_registry(shapes, grid=grid)
+        if entries:
+            # subset by entry name (an entry with no mesh variant —
+            # e.g. import_sums_pair — simply contributes nothing here),
+            # keeping J5 cross-references resolvable, identity-only
+            import dataclasses as _dc
+
+            chosen = [s for s in mesh_specs if s.entry in entries]
+            ids = {s.spec_id for s in chosen}
+            for s in mesh_specs:
+                if any(
+                    c.expect_same_as == s.spec_id
+                    and s.spec_id not in ids
+                    for c in chosen
+                ):
+                    # fingerprint-identity only: no mesh analysis, no
+                    # gate, no baseline merge for a pulled-in spec
+                    chosen.append(_dc.replace(
+                        s, expect_same_as=None, mesh_shape=None,
+                    ))
+                    ids.add(s.spec_id)
+            mesh_specs = chosen
     audits = [lower_spec(s, with_cost=run_j6) for s in specs]
+    mesh_audits = [lower_spec(s) for s in mesh_specs]
+    budget_bytes = (
+        int(hbm_budget_gb * 1024**3) if hbm_budget_gb else None
+    )
     findings = run_program_rules(
-        audits,
+        audits + mesh_audits,
         select=None if select is None
-        else [r for r in select if r != "J6"],
+        else [r for r in select if r not in _BASELINE_RULES],
+        j9_budget_bytes=budget_bytes,
     )
 
     report: dict = {
         "grid": grid,
-        "n_programs": len(audits),
+        "n_programs": len(audits) + len(mesh_audits),
         "entries": {},
         "j6": None,
+        "mesh": None,
+        "j7": None,
     }
+    if mesh:
+        report["mesh"] = {
+            a.spec.spec_id: {
+                "shape": list(a.mesh.shape),
+                "collectives": a.mesh.counts,
+                "comm_bytes": a.mesh.comm_bytes,
+                "peak_bytes": a.mesh.peak_bytes,
+                "model_bytes": a.mesh.model_bytes,
+            }
+            for a in mesh_audits if a.mesh is not None
+        }
     by_entry: dict = {}
-    for a in audits:
+    for a in audits + mesh_audits:
         e = by_entry.setdefault(
             a.spec.entry, {"variants": 0, "programs": set(), "failed": 0}
         )
@@ -120,11 +204,35 @@ def audit_programs(
             "failed": e["failed"],
         }
 
+    path = baseline_path or baseline_mod.default_baseline_path()
+    # an --entries subset must neither report the deselected
+    # programs as stale nor delete them from the committed file
+    partial = bool(entries)
+    # the mesh stale sweep additionally requires the DEFAULT shape
+    # grid: a fast-tier or custom-shape run produces a subset of the
+    # committed mesh keys, which is not staleness
+    mesh_partial = (
+        partial or mesh_shapes is not None or grid != "default"
+    )
+    run_mesh_gate = mesh and (
+        select is None or bool({"J7", "J10"} & set(select))
+    )
+    # ONE read of the committed baseline for both gates; an unreadable
+    # file must name itself and the repair command, not die as a bare
+    # JSON parse error deep in the gate
+    baseline_doc = None
+    if (run_j6 or run_mesh_gate) and not update_baselines:
+        try:
+            baseline_doc = baseline_mod.load_baseline(path)
+        except (OSError, ValueError) as e:
+            raise ValueError(
+                f"unreadable baseline {path} ({e}) — re-seed it with "
+                "`python -m dgen_tpu.lint --programs --mesh "
+                "--update-baselines`"
+            ) from e
+    # update_baselines implies run_j6 (enforced above), so this branch
+    # also covers every baseline write
     if run_j6:
-        path = baseline_path or baseline_mod.default_baseline_path()
-        # an --entries subset must neither report the deselected
-        # programs as stale nor delete them from the committed file
-        partial = bool(entries)
         if update_baselines:
             doc = baseline_mod.update_baseline(
                 path, audits,
@@ -133,19 +241,129 @@ def audit_programs(
                     else baseline_mod.DEFAULT_TOLERANCE
                 ),
                 partial=partial,
+                mesh_audits=mesh_audits if mesh else None,
+                mesh_partial=mesh_partial,
             )
             report["j6"] = {
                 "updated": path,
                 "entries": sorted(doc["entries"]),
                 "fingerprints": doc["entries"],
+                "mesh_entries": sorted(doc.get("mesh", {})),
                 "note": None,
             }
         else:
             j6_findings, status = baseline_mod.compare_to_baseline(
-                audits, baseline_mod.load_baseline(path),
+                audits, baseline_doc,
                 tolerance=tolerance, partial=partial,
             )
             findings.extend(j6_findings)
             report["j6"] = status
+    if run_mesh_gate and not update_baselines:
+        j7_findings, j7_status = baseline_mod.compare_mesh_to_baseline(
+            mesh_audits, baseline_doc,
+            tolerance=tolerance, partial=mesh_partial,
+        )
+        if select is not None:
+            j7_findings = [
+                f for f in j7_findings if f.rule in select
+            ]
+        findings.extend(j7_findings)
+        report["j7"] = j7_status
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings, report
+
+
+def _clip(text: str, n: int) -> str:
+    lines = text.splitlines()
+    if len(lines) <= n:
+        return text
+    return "\n".join(lines[:n]) + f"\n... ({len(lines) - n} more lines)"
+
+
+def explain_entry(
+    name: str,
+    mesh: bool = False,
+    mesh_shapes: Optional[List[tuple]] = None,
+    max_lines: int = 80,
+) -> str:
+    """The ``--explain`` dump for one registry entry: its jaxpr, a
+    sharded-StableHLO excerpt, the collective table and the per-device
+    memory estimate — the debugging view for a J6/J7/J10 baseline diff
+    (``name`` is an entry name or a full ``entry@variant`` spec id).
+    """
+    specs = list(build_registry("fast"))
+    if "@" in name:
+        # a full spec id may name a default-grid variant (the id a
+        # J-finding prints); pull the full grid in so copying an id
+        # out of a finding always resolves
+        have = {s.spec_id for s in specs}
+        specs += [
+            s for s in build_registry("default")
+            if s.spec_id not in have
+        ]
+    if mesh:
+        shapes = [tuple(s) for s in mesh_shapes] if mesh_shapes else None
+        specs += build_mesh_registry(shapes)
+    if "@" in name:
+        chosen = [s for s in specs if s.spec_id == name]
+    else:
+        chosen = [s for s in specs if s.entry == name]
+    if not chosen:
+        known = sorted({s.entry for s in specs})
+        raise ValueError(
+            f"unknown entry '{name}' (known: {', '.join(known)}; "
+            "add --mesh for the meshNxM variants)"
+        )
+    out: List[str] = []
+    for spec in chosen:
+        audit = lower_spec(spec, with_cost=spec.cost, keep_text=True)
+        out.append(f"===== {spec.spec_id} =====")
+        if audit.error:
+            out.append(f"FAILED TO LOWER: {audit.error}")
+            continue
+        out.append(f"program fingerprint: {audit.fingerprint}")
+        if audit.steady_fingerprint is not None:
+            same = audit.steady_fingerprint == audit.fingerprint
+            out.append(
+                "steady-state probe: "
+                + ("identical program" if same
+                   else f"DIFFERENT program ({audit.steady_fingerprint})")
+            )
+        out.append(f"captured constants: {audit.const_bytes} bytes")
+        if audit.cost_analysis:
+            ca = audit.cost_analysis
+            out.append(
+                f"cost: flops={ca['flops']:.6g} "
+                f"bytes_accessed={ca['bytes_accessed']:.6g}"
+            )
+        if audit.mesh is not None:
+            from dgen_tpu.lint.prog.meshaudit import collective_table
+
+            info = audit.mesh
+            out.append(
+                f"mesh {info.shape[0]}x{info.shape[1]} "
+                f"({info.n_devices} devices, global N={info.global_n})"
+            )
+            out.append("collectives:")
+            out.extend("  " + ln for ln in collective_table(info))
+            mem = info.memory
+            out.append(
+                "per-device memory: "
+                f"arg={mem.get('argument')} temp={mem.get('temp')} "
+                f"out={mem.get('output')} (peak~{info.peak_bytes} B"
+                + (f", planner model {info.model_bytes} B"
+                   if info.model_bytes else "")
+                + (", aval estimate" if mem.get("estimated") else "")
+                + ")"
+            )
+            if info.replicated_global:
+                out.append("global-shaped per-device tensors (J8):")
+                out.extend(
+                    f"  {tok} ({nb} B): {line}"
+                    for tok, line, nb in info.replicated_global
+                )
+        out.append("--- jaxpr ---")
+        out.append(_clip(str(audit.jaxpr), max_lines))
+        out.append("--- StableHLO (sharded) ---")
+        out.append(_clip(audit.hlo_text or "", max_lines))
+    return "\n".join(out)

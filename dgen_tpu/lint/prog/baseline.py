@@ -39,6 +39,11 @@ CONST_BYTES_SLACK = 64 * 1024
 #: transcendentals are recorded but informational
 GATED_METRICS = ("flops", "bytes_accessed")
 
+#: mesh-tier re-seed command quoted in J7/J10 findings
+MESH_RESEED = (
+    "python -m dgen_tpu.lint --programs --mesh --update-baselines"
+)
+
 
 def default_baseline_path() -> str:
     """``tools/prog_baseline.json`` at the repo root (next to the
@@ -101,6 +106,15 @@ def baseline_applicable(baseline: Optional[dict]) -> Tuple[bool, str]:
     return True, ""
 
 
+def _rel_drift(old: float, new: float) -> float:
+    """Relative drift with the zero-baseline edge handled once for
+    every gated metric (J6 flops/bytes AND J7 counts/comm bytes):
+    0 -> 0 is no drift, 0 -> anything is 100% growth."""
+    if old:
+        return (new - old) / old
+    return 0.0 if not new else 1.0
+
+
 def compare_to_baseline(
     audits: List[ProgramAudit],
     baseline: Optional[dict],
@@ -120,10 +134,14 @@ def compare_to_baseline(
         "fingerprints": current,
         "deltas": {},
         "note": None,
+        # structured downgrade marker — the CLI's loud advisory banner
+        # keys on THIS, never on the note's wording
+        "downgraded": False,
     }
     ok, why = baseline_applicable(baseline)
     if not ok:
         status["note"] = f"J6 gate skipped: {why}"
+        status["downgraded"] = True
         return [], status
 
     tol = tolerance if tolerance is not None \
@@ -150,7 +168,7 @@ def compare_to_baseline(
         for metric in GATED_METRICS:
             old = float(base.get(metric, 0.0))
             new = float(cur[metric])
-            rel = (new - old) / old if old else (0.0 if not new else 1.0)
+            rel = _rel_drift(old, new)
             deltas[metric] = round(rel, 6)
             if abs(rel) > tol:
                 direction = "grew" if rel > 0 else "shrank"
@@ -194,11 +212,164 @@ def compare_to_baseline(
     return findings, status
 
 
+def collect_mesh_fingerprints(
+    audits: List[ProgramAudit],
+) -> Dict[str, dict]:
+    """The J7/J10 fingerprints of the mesh-tier audits, keyed by spec
+    id (``entry@meshHxD``): loc-stripped sharded-program hash,
+    per-collective counts + estimated comm bytes, and the per-device
+    peak (informational — J9 gates it against the budget, not the
+    baseline)."""
+    out: Dict[str, dict] = {}
+    for a in audits:
+        if a.mesh is None or a.error:
+            continue
+        info = a.mesh
+        out[a.spec.spec_id] = {
+            "mesh_shape": list(info.shape),
+            "program_hash": a.fingerprint,
+            "collectives": {
+                kind: {
+                    "count": info.counts[kind],
+                    "comm_bytes": info.comm_bytes_by_kind[kind],
+                }
+                for kind in sorted(info.counts)
+            },
+            "comm_bytes": info.comm_bytes,
+            "peak_bytes": info.peak_bytes,
+        }
+    return out
+
+
+def compare_mesh_to_baseline(
+    audits: List[ProgramAudit],
+    baseline: Optional[dict],
+    tolerance: Optional[float] = None,
+    partial: bool = False,
+) -> Tuple[List[Finding], dict]:
+    """The J7 (collective fingerprint) + J10 (per-mesh-shape program
+    hash) gates over the mesh-tier audits. Same environment contract
+    as J6: inapplicable baselines downgrade to an advisory note.
+
+    J7 fails on any NEW collective kind (the offending op and its
+    operand shape named), on a collective kind that vanished (lock the
+    improvement in), and on count / comm-byte drift beyond the
+    tolerance. J10 fails on any sharded-program hash change — a
+    topology-sensitive program change must land as a reviewable
+    baseline diff.
+    """
+    current = collect_mesh_fingerprints(audits)
+    status: dict = {
+        "environment": _environment(),
+        "fingerprints": current,
+        "note": None,
+        "downgraded": False,
+    }
+    ok, why = baseline_applicable(baseline)
+    if not ok:
+        status["note"] = f"J7/J10 gate skipped: {why}"
+        status["downgraded"] = True
+        return [], status
+    tol = tolerance if tolerance is not None \
+        else float(baseline.get("tolerance", DEFAULT_TOLERANCE))
+    status["tolerance"] = tol
+    entries = baseline.get("mesh", {})
+    by_id = {a.spec.spec_id: a for a in audits if a.mesh is not None}
+    findings: List[Finding] = []
+
+    def emit(rule: str, spec_id: str, msg: str) -> None:
+        a = by_id.get(spec_id)
+        path, line = a.spec.anchor if a else ("<unknown>", 0)
+        findings.append(Finding(rule, path, line, f"[{spec_id}] {msg}"))
+
+    for spec_id, cur in sorted(current.items()):
+        base = entries.get(spec_id)
+        if base is None:
+            emit("J7", spec_id, (
+                "no committed mesh baseline for this entry — run "
+                f"`{MESH_RESEED}` and commit tools/prog_baseline.json"
+            ))
+            continue
+        if cur["program_hash"] != base.get("program_hash"):
+            emit("J10", spec_id, (
+                "sharded program fingerprint changed for mesh shape "
+                f"{'x'.join(str(s) for s in cur['mesh_shape'])} — a "
+                "topology-sensitive program change must land as an "
+                "explicit baseline diff (review the collective/memory "
+                f"deltas, then `{MESH_RESEED}`)"
+            ))
+        base_coll = base.get("collectives", {})
+        info = by_id[spec_id].mesh
+        for kind in sorted(set(cur["collectives"]) | set(base_coll)):
+            c = cur["collectives"].get(kind)
+            b = base_coll.get(kind)
+            if b is None:
+                ops = [
+                    x for x in info.collectives if x.kind == kind
+                ]
+                shapes = "; ".join(
+                    f"{' '.join(o.result_shapes)} <- "
+                    f"{', '.join(o.operand_shapes[:3])}"
+                    for o in ops[:3]
+                )
+                emit("J7", spec_id, (
+                    f"NEW collective `{kind}` x{c['count']} on the hot "
+                    f"path (~{c['comm_bytes']} comm bytes): {shapes} — "
+                    "an op gathered/resharded data that previously "
+                    "stayed device-local; if intended, re-baseline "
+                    f"with `{MESH_RESEED}`"
+                ))
+                continue
+            if c is None:
+                emit("J7", spec_id, (
+                    f"collective `{kind}` (was x{b.get('count')}) no "
+                    "longer appears — lock the comms improvement in "
+                    f"with `{MESH_RESEED}` so a regression back cannot "
+                    "pass unnoticed"
+                ))
+                continue
+            for metric in ("count", "comm_bytes"):
+                old = float(b.get(metric, 0.0))
+                new = float(c[metric])
+                rel = _rel_drift(old, new)
+                if abs(rel) > tol:
+                    direction = "grew" if rel > 0 else "shrank"
+                    emit("J7", spec_id, (
+                        f"`{kind}` {metric} {direction} "
+                        f"{abs(rel) * 100:.1f}% ({old:.6g} -> "
+                        f"{new:.6g}, tolerance {tol * 100:.1f}%) — "
+                        "static comm cost is a zero-noise regression "
+                        "gate; re-baseline if the change is intended"
+                    ))
+
+    if not partial:
+        produced = {a.spec.spec_id for a in audits}
+        # keys recorded for mesh shapes OUTSIDE the audited grid are a
+        # deliberately merged custom-shape seed (--mesh-shapes ...
+        # --update-baselines), not staleness — flagging them would
+        # wedge every default run after a documented custom seed
+        audited_shapes = {
+            tuple(c["mesh_shape"]) for c in current.values()
+        }
+        for spec_id in sorted(set(entries) - set(current) - produced):
+            shape = entries[spec_id].get("mesh_shape")
+            if shape is not None and tuple(shape) not in audited_shapes:
+                continue
+            emit("J7", spec_id, (
+                "mesh baseline entry no longer produced by the mesh "
+                f"registry — remove it via `{MESH_RESEED}` so the "
+                "baseline stays in lockstep with the audited grid"
+            ))
+    return findings, status
+
+
 def update_baseline(
     path: str,
     audits: List[ProgramAudit],
     tolerance: float = DEFAULT_TOLERANCE,
     partial: bool = False,
+    mesh_audits: Optional[List[ProgramAudit]] = None,
+    mesh_partial: bool = False,
 ) -> dict:
     """Rewrite the baseline from the current audits (atomic publish:
     a killed writer cannot truncate the committed gate).
@@ -208,24 +379,74 @@ def update_baseline(
     delete the committed gate for every other program. Refused when
     the existing baseline was recorded under a different environment
     (the untouched entries would be incomparable with the fresh ones).
+
+    ``mesh_audits``: the mesh-tier audits (``--mesh`` ran) — their
+    J7/J10 fingerprints land in the document's ``mesh`` section (merged
+    under ``partial``, replaced otherwise). When the mesh tier did NOT
+    run, an existing comparable ``mesh`` section is carried over
+    verbatim — a cost-only refresh must not delete the committed
+    collective gates (an incomparable one is dropped: stale-environment
+    hashes would gate wrongly under the new triple).
     """
     from dgen_tpu.resilience.atomic import atomic_write_json
 
     entries = collect_fingerprints(audits)
-    if partial:
+    try:
         existing = load_baseline(path)
-        if existing is not None:
-            ok, why = baseline_applicable(existing)
-            if not ok:
+    except (OSError, ValueError) as e:
+        # a truncated/conflict-markered baseline must not break the
+        # documented repair command: a FULL update re-seeds over it; a
+        # partial update cannot (there is nothing valid to merge into)
+        if partial:
+            raise ValueError(
+                f"cannot merge a partial update into an unreadable "
+                f"baseline ({e}); run a full --update-baselines"
+            ) from e
+        existing = None
+    existing_ok = False
+    if existing is not None:
+        existing_ok, why = baseline_applicable(existing)
+        if partial and not existing_ok:
+            raise ValueError(
+                "refusing a partial baseline update: " + why
+            )
+    if partial and existing is not None:
+        entries = dict(existing.get("entries", {}), **entries)
+
+    mesh: Dict[str, dict] = {}
+    if mesh_audits is not None:
+        mesh = collect_mesh_fingerprints(mesh_audits)
+        if mesh_partial and existing is not None:
+            if not existing_ok and existing.get("mesh"):
                 raise ValueError(
-                    "refusing a partial baseline update: " + why
+                    "refusing a partial mesh-baseline update: " + why
                 )
-            entries = dict(existing.get("entries", {}), **entries)
+            mesh = dict(existing.get("mesh", {}), **mesh)
+        elif existing_ok:
+            # a FULL re-seed replaces the audited grid's keys but
+            # preserves deliberately seeded custom-shape gates
+            # (--mesh-shapes ... --update-baselines merges them in;
+            # the compare-side stale sweep exempts them for the same
+            # reason) — only keys in the freshly audited shapes are
+            # authoritative here
+            shapes = {tuple(v["mesh_shape"]) for v in mesh.values()}
+            for k, v in existing.get("mesh", {}).items():
+                sh = v.get("mesh_shape")
+                if (
+                    sh is not None and tuple(sh) not in shapes
+                    and k not in mesh
+                ):
+                    mesh[k] = v
+    elif existing_ok:
+        mesh = dict(existing.get("mesh", {}))
+
     doc = dict(
         _environment(),
         tolerance=tolerance,
         entries=entries,
     )
+    if mesh:
+        doc["mesh"] = mesh
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     atomic_write_json(path, doc, indent=1, sort_keys=True)
     return doc

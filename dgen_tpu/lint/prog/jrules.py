@@ -191,13 +191,100 @@ def rule_j5(
             )
 
 
+#: J9: the per-device HBM budget the static memory gate defaults to
+#: when the caller passes none (v5e/v6e-class; override with
+#: ``--hbm-gb``)
+J9_DEFAULT_BUDGET_BYTES = 16 * 1024**3
+#: J9: how far the compiler's measured temp bytes may exceed the sweep
+#: planner's ``_per_agent_step_bytes`` prediction before the model is
+#: declared broken — mirrors Simulation._hbm_check's modeled-vs-actual
+#: warning threshold (an under-counting model means auto_agent_chunk /
+#: plan_sweep budget chunks that OOM at national scale)
+J9_MODEL_SLACK = 3.0
+
+
+def rule_j8(audit: ProgramAudit) -> Iterable[str]:
+    """Sharding propagation: the agent axis must stay partitioned
+    end-to-end. The compiled per-device module's shapes are per-shard,
+    so any tensor materialized at the GLOBAL agent count was gathered
+    or replicated — a silently all-gathered ``[N, 8760]`` stream turns
+    the pod-scale table into per-device HBM copies — and an
+    ``[N]``-leading output that comes back fully replicated lost its
+    placement on the way out."""
+    info = audit.mesh
+    if info is None:
+        return
+    for tok, line, nbytes in info.replicated_global:
+        yield (
+            f"global-shaped tensor {tok} ({nbytes / 1024:.0f} KiB) "
+            f"materialized UNSHARDED in the per-device program "
+            f"(defining op: `{line.split('=')[0].strip()} = ...`) — an "
+            "agent-axis array was gathered/replicated instead of "
+            "staying partitioned (check with_sharding_constraint specs "
+            "and parallel.mesh.agent_spec usage)"
+        )
+    for desc in info.outputs_unsharded:
+        yield (
+            f"[N]-leading output {desc} is fully REPLICATED in the "
+            "compiled output shardings — agent-axis results must come "
+            "back partitioned (a replicated output implies a gather "
+            "every step and breaks multi-host addressability)"
+        )
+
+
+def rule_j9(
+    audit: ProgramAudit, budget_bytes: Optional[int] = None
+) -> Iterable[str]:
+    """Static per-device memory gate: argument + temp + output bytes of
+    the compiled per-device program against the HBM budget, plus the
+    planner cross-check — the compiler's own temp measurement validates
+    ``_per_agent_step_bytes`` (the model auto_agent_chunk and
+    plan_sweep budget with) BEFORE a pod run is launched."""
+    info = audit.mesh
+    if info is None:
+        return
+    budget = budget_bytes or J9_DEFAULT_BUDGET_BYTES
+    peak = info.peak_bytes
+    if peak is not None and peak > budget:
+        mem = info.memory
+        bound_note = (
+            "; a LOWER BOUND — the backend exposes no memory_analysis, "
+            "so this is the aval x sharding estimate without temps"
+            if info.peak_is_lower_bound else ""
+        )
+        yield (
+            f"per-device memory {peak / 2**20:.1f} MiB (arg "
+            f"{(mem.get('argument') or 0) / 2**20:.1f} + temp "
+            f"{(mem.get('temp') or 0) / 2**20:.1f} + out "
+            f"{(mem.get('output') or 0) / 2**20:.1f}) exceeds the "
+            f"{budget / 2**30:.1f} GiB HBM budget{bound_note} — shrink "
+            "agent_chunk / shard wider before launching this on "
+            "hardware"
+        )
+    temp = info.memory.get("temp")
+    if (
+        info.model_bytes and temp
+        and temp > info.model_bytes * J9_MODEL_SLACK
+    ):
+        yield (
+            f"compiled temp bytes {temp} are "
+            f"{temp / info.model_bytes:.1f}x the sweep planner's "
+            f"_per_agent_step_bytes prediction ({info.model_bytes}) — "
+            "the HBM footprint model under-counts this configuration, "
+            "so auto_agent_chunk/plan_sweep would budget chunks that "
+            "OOM at national scale (update the model's envelope "
+            "constants in models/simulation.py)"
+        )
+
+
 #: rule id -> (summary, per-audit impl); J5 takes the cross-audit map,
-#: J6 lives in dgen_tpu.lint.prog.baseline (it needs the baseline
-#: file). Summaries come from the jax-free id table
-#: (dgen_tpu.lint.prog_ids) so `--list-rules` needn't import jax.
+#: J9 takes the budget, J6/J7/J10 live in dgen_tpu.lint.prog.baseline
+#: (they need the baseline file). Summaries come from the jax-free id
+#: table (dgen_tpu.lint.prog_ids) so `--list-rules` needn't import jax.
 _IMPLS = {
     "J0": None, "J1": rule_j1, "J2": rule_j2, "J3": rule_j3,
-    "J4": rule_j4, "J5": rule_j5, "J6": None,
+    "J4": rule_j4, "J5": rule_j5, "J6": None, "J7": None,
+    "J8": rule_j8, "J9": rule_j9, "J10": None,
 }
 PROGRAM_RULES: Dict[str, Tuple[str, object]] = {
     rule_id: (summary, _IMPLS[rule_id])
@@ -221,11 +308,15 @@ def _suppressed(
 def run_program_rules(
     audits: List[ProgramAudit],
     select: Optional[Iterable[str]] = None,
+    j9_budget_bytes: Optional[int] = None,
 ) -> List[Finding]:
-    """J0-J5 over a set of audits (J6 is applied by the baseline
-    module, which owns the comparison): suppression comments at each
-    entry's anchor line are honored, L-rule style. Findings are
-    prefixed with the ``entry@variant`` they were observed in."""
+    """J0-J5 + the per-audit mesh rules J8/J9 over a set of audits
+    (J6/J7/J10 are applied by the baseline module, which owns the
+    comparisons): suppression comments at each entry's anchor line are
+    honored, L-rule style. Findings are prefixed with the
+    ``entry@variant`` they were observed in. ``j9_budget_bytes``: the
+    per-device HBM budget the J9 gate uses (default
+    :data:`J9_DEFAULT_BUDGET_BYTES`)."""
     chosen = set(select) if select is not None else set(PROGRAM_RULES)
     unknown = chosen - set(PROGRAM_RULES)
     if unknown:
@@ -251,7 +342,7 @@ def run_program_rules(
                     "point or its abstract-spec builder is broken"
                 ))
             continue
-        for rule in ("J1", "J2", "J3", "J4"):
+        for rule in ("J1", "J2", "J3", "J4", "J8"):
             if rule not in chosen:
                 continue
             _summary, impl = PROGRAM_RULES[rule]
@@ -260,5 +351,8 @@ def run_program_rules(
         if "J5" in chosen:
             for msg in rule_j5(audit, by_id):
                 emit("J5", audit, msg)
+        if "J9" in chosen:
+            for msg in rule_j9(audit, budget_bytes=j9_budget_bytes):
+                emit("J9", audit, msg)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings

@@ -1,0 +1,392 @@
+"""Mesh-tier program introspection: the sharded-program artifacts
+rules J7-J10 inspect.
+
+The base auditor lowers every entry point single-device; this module
+owns the extra analysis of the MESH tier (``--programs --mesh``): each
+entry is lowered under forced multi-device CPU meshes with the
+production shardings applied (``parallel.mesh.make_mesh`` +
+``parallel.mesh.agent_spec`` — the same placement path
+``Simulation.__init__`` runs), compiled (CPU, never executed), and the
+compiled **per-device** HLO is parsed for:
+
+* the collective fingerprint (J7): every all-reduce / all-gather /
+  reduce-scatter / collective-permute / all-to-all with result and
+  operand shapes, plus a deterministic comm-byte estimate;
+* sharding propagation (J8): tensors materialized at GLOBAL agent-axis
+  shape inside the per-device program (the partitioned module's shapes
+  are per-shard, so a full-``[N, ...]`` tensor IS a replication /
+  gather), and output leaves that lost their agent sharding;
+* the per-device memory footprint (J9):
+  ``compiled.memory_analysis()`` where the backend exposes it, an
+  aval x sharding estimate where it does not.
+
+Comm-byte convention (deterministic, ring-algorithm shaped — the gate
+compares against a committed baseline, so only determinism matters,
+not absolute calibration):
+
+==================== =============================================
+all-gather           result_bytes * (G-1)/G
+reduce-scatter       result_bytes * (G-1)
+all-reduce           2 * result_bytes * (G-1)/G
+collective-permute   result_bytes
+all-to-all           result_bytes * (G-1)/G
+==================== =============================================
+
+with G the collective's replica-group size (parsed from the HLO's
+``replica_groups``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: collective HLO opcodes fingerprinted by J7 (async ``-start`` halves
+#: are folded into their base opcode; ``-done`` is bookkeeping)
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+#: J8 floor: a global-shaped agent-axis tensor smaller than this inside
+#: the per-device program is tolerated — tiny [N] vectors are
+#: legitimately gathered for whole-table host-order operations (the
+#: integer battery-adopter allocation sorts the full table), and those
+#: gathers are J7's (fingerprinted) business. A [N, 8760] stream or a
+#: bank at global shape is orders of magnitude past this at audit
+#: scale.
+J8_MIN_TENSOR_BYTES = 16 * 1024
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: one typed shape token, e.g. ``f32[64,8760]`` (layout suffix ``{1,0}``
+#: optional); ``f32[]`` is a scalar
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
+
+_COLLECTIVE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[\w.-]+\s*=\s*(?P<result>\([^)]*\)|[^\s]+)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")"
+    r"(?P<suffix>-start|-done)?[\w.-]*\((?P<operands>[^)]*)\)",
+    re.MULTILINE,
+)
+
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+
+
+def _shape_bytes(dtype: str, dims: str) -> Tuple[Tuple[int, ...], int]:
+    shape = tuple(int(d) for d in dims.split(",") if d)
+    n = int(np.prod(shape)) if shape else 1
+    return shape, n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _shapes_in(text: str) -> List[Tuple[str, Tuple[int, ...], int]]:
+    """(token, shape, nbytes) for every typed shape token in ``text``."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        shape, nbytes = _shape_bytes(m.group(1), m.group(2))
+        out.append((f"{m.group(1)}[{m.group(2)}]", shape, nbytes))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    """One collective op in the compiled per-device program."""
+
+    kind: str
+    result_shapes: Tuple[str, ...]
+    operand_shapes: Tuple[str, ...]
+    result_bytes: int
+    group_size: int
+    comm_bytes: int
+
+
+@dataclasses.dataclass
+class MeshInfo:
+    """Everything the mesh-tier rules read off one compiled program."""
+
+    shape: Tuple[int, int]                   # (hosts, devices)
+    n_devices: int
+    global_n: int                            # padded global agent count
+    collectives: List[Collective]
+    #: J8: (shape token, HLO line excerpt, nbytes) of global-agent-axis
+    #: tensors materialized inside the per-device program
+    replicated_global: List[Tuple[str, str, int]]
+    #: J8: descriptions of [N]-leading OUTPUT leaves that came back
+    #: fully replicated
+    outputs_unsharded: List[str]
+    #: per-device bytes: temp / argument / output (+ "estimated" flag
+    #: when memory_analysis was unavailable and avals were summed)
+    memory: Dict[str, Optional[int]]
+    #: the planner's _per_agent_step_bytes prediction for this entry's
+    #: per-device working set (None where the model does not apply)
+    model_bytes: Optional[int] = None
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self.collectives:
+            out[c.kind] = out.get(c.kind, 0) + 1
+        return out
+
+    @property
+    def comm_bytes(self) -> int:
+        return sum(c.comm_bytes for c in self.collectives)
+
+    @property
+    def comm_bytes_by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self.collectives:
+            out[c.kind] = out.get(c.kind, 0) + c.comm_bytes
+        return out
+
+    @property
+    def peak_bytes(self) -> Optional[int]:
+        """Per-device peak: the sum of whatever byte accounting is
+        available. When ``memory_analysis`` is absent (aval-estimate
+        fallback: temp unknown) this is a LOWER BOUND — still gated by
+        J9, since a lower bound over budget is over budget."""
+        parts = [
+            self.memory.get(k)
+            for k in ("temp", "argument", "output")
+        ]
+        known = [p for p in parts if p is not None]
+        if not known:
+            return None
+        return sum(known)
+
+    @property
+    def peak_is_lower_bound(self) -> bool:
+        return any(
+            self.memory.get(k) is None
+            for k in ("temp", "argument", "output")
+        )
+
+
+def _comm_bytes(kind: str, result_bytes: int, g: int) -> int:
+    if g <= 1:
+        return 0
+    if kind == "all-reduce":
+        return int(2 * result_bytes * (g - 1) / g)
+    if kind == "reduce-scatter":
+        return int(result_bytes * (g - 1))
+    if kind == "collective-permute":
+        return int(result_bytes)
+    # all-gather / all-to-all
+    return int(result_bytes * (g - 1) / g)
+
+
+def parse_collectives(hlo_text: str, n_devices: int) -> List[Collective]:
+    """Every collective in a compiled HLO module, with shapes and the
+    deterministic comm-byte estimate. ``-done`` halves of async pairs
+    are skipped (the ``-start`` op carries the shapes)."""
+    out: List[Collective] = []
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        if m.group("suffix") == "-done":
+            continue
+        res = _shapes_in(m.group("result"))
+        ops = _shapes_in(m.group("operands"))
+        line = hlo_text[m.start():hlo_text.find("\n", m.start())]
+        g = n_devices
+        gm = _GROUPS_IOTA_RE.search(line)
+        if gm:
+            g = int(gm.group(2))
+        else:
+            gm = _GROUPS_LIST_RE.search(line)
+            if gm:
+                g = len([t for t in gm.group(1).split(",") if t.strip()])
+        result_bytes = sum(nb for _, _, nb in res)
+        out.append(Collective(
+            kind=m.group("kind"),
+            result_shapes=tuple(tok for tok, _, _ in res),
+            operand_shapes=tuple(tok for tok, _, _ in ops),
+            result_bytes=result_bytes,
+            group_size=g,
+            comm_bytes=_comm_bytes(m.group("kind"), result_bytes, g),
+        ))
+    return out
+
+
+def scan_replicated_global(
+    hlo_text: str, global_n: int,
+    min_bytes: int = J8_MIN_TENSOR_BYTES,
+) -> List[Tuple[str, str, int]]:
+    """Global-agent-axis tensors materialized in the PER-DEVICE
+    program: the partitioned module's shapes are per-shard, so any
+    tensor whose leading dim equals the global padded agent count (and
+    which is big enough to matter, see :data:`J8_MIN_TENSOR_BYTES`)
+    was gathered or replicated. Returns (shape token, defining line
+    excerpt, nbytes), deduplicated by shape."""
+    found: Dict[str, Tuple[str, str, int]] = {}
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        # only defining instructions ("%name = type op(...)"): shape
+        # tokens in operand lists repeat their definition
+        if not (s.startswith("%") or s.startswith("ROOT")):
+            continue
+        eq = s.find(" = ")
+        if eq < 0:
+            continue
+        rhs = s[eq + 3:]
+        paren = rhs.find("(")
+        head = rhs if paren < 0 else (
+            rhs[:rhs.find("(", paren + 1)] if rhs.startswith("(")
+            else rhs[:paren + 1]
+        )
+        for tok, shape, nbytes in _shapes_in(head):
+            # the agent dim leads ([N, ...]) except under a batched
+            # scenario axis ([S, N, ...] — the sweep's vmap layout)
+            hit = bool(shape) and (
+                shape[0] == global_n
+                or (len(shape) >= 3 and shape[1] == global_n)
+            )
+            if not hit or nbytes < min_bytes:
+                continue
+            if tok not in found:
+                found[tok] = (tok, s[:160], nbytes)
+    return sorted(found.values(), key=lambda t: -t[2])
+
+
+def _is_replicated(sharding) -> Optional[bool]:
+    try:
+        return bool(sharding.is_fully_replicated)
+    except Exception:  # noqa: BLE001 — backend-specific sharding types
+        return None
+
+
+def scan_output_shardings(
+    out_avals, out_shardings, global_n: int,
+) -> List[str]:
+    """[N]-leading output leaves whose compiled sharding is fully
+    replicated — state that stayed agent-sharded all run would come
+    back replicated only through a (wasteful) gather."""
+    import jax
+
+    flat_sh = jax.tree.leaves(out_shardings)
+    bad: List[str] = []
+    if len(flat_sh) != len(out_avals):
+        return bad
+    for aval, sh in zip(out_avals, flat_sh):
+        shape = tuple(getattr(aval, "shape", ()) or ())
+        if not shape or shape[0] != global_n or 0 in shape:
+            # zero-element leaves (keep_hourly=False placeholders) are
+            # trivially replicated — there is nothing to shard
+            continue
+        if _is_replicated(sh):
+            bad.append(
+                f"{getattr(aval, 'dtype', '?')}{list(shape)}"
+            )
+    return bad
+
+
+def read_memory_analysis(compiled) -> Dict[str, Optional[int]]:
+    """Per-device byte accounting from ``compiled.memory_analysis()``,
+    or ``{"available": False}`` when the backend exposes none."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — optional backend surface
+        ma = None
+    if ma is None:
+        return {"available": False, "temp": None, "argument": None,
+                "output": None}
+    def _get(name):
+        v = getattr(ma, name, None)
+        return int(v) if v is not None else None
+    return {
+        "available": True,
+        "temp": _get("temp_size_in_bytes"),
+        "argument": _get("argument_size_in_bytes"),
+        "output": _get("output_size_in_bytes"),
+    }
+
+
+def estimate_memory_from_avals(
+    in_avals, in_shardings, out_avals, n_devices: int,
+) -> Dict[str, Optional[int]]:
+    """Aval x sharding fallback for backends without
+    ``memory_analysis``: per-device argument/output residency (sharded
+    leaves divided by their shard count, replicated leaves full size);
+    temp stays unknown."""
+    import jax
+
+    def _local_bytes(aval, sharding) -> int:
+        shape = tuple(getattr(aval, "shape", ()) or ())
+        nbytes = int(np.prod(shape)) if shape else 1
+        nbytes *= np.dtype(getattr(aval, "dtype", np.float32)).itemsize
+        rep = _is_replicated(sharding) if sharding is not None else True
+        return nbytes if rep else max(nbytes // max(n_devices, 1), 1)
+
+    flat_in_sh = jax.tree.leaves(in_shardings) if in_shardings else []
+    arg = 0
+    for i, aval in enumerate(in_avals):
+        sh = flat_in_sh[i] if i < len(flat_in_sh) else None
+        arg += _local_bytes(aval, sh)
+    out = sum(_local_bytes(a, None) for a in out_avals)
+    return {"available": False, "estimated": True, "temp": None,
+            "argument": int(arg), "output": int(out)}
+
+
+def analyze_mesh_program(
+    compiled,
+    jaxpr,
+    *,
+    shape: Tuple[int, int],
+    global_n: int,
+    model_bytes: Optional[int] = None,
+) -> MeshInfo:
+    """Build the :class:`MeshInfo` for one compiled mesh-tier program:
+    parse collectives and global-shape leaks out of the per-device HLO,
+    read the memory analysis (aval-estimate fallback), and check the
+    output shardings."""
+    n_devices = int(shape[0]) * int(shape[1])
+    text = compiled.as_text()
+    memory = read_memory_analysis(compiled)
+    out_avals = list(jaxpr.out_avals)
+    if not memory.get("available"):
+        try:
+            in_sh = compiled.input_shardings
+        except Exception:  # noqa: BLE001
+            in_sh = None
+        memory = estimate_memory_from_avals(
+            list(jaxpr.in_avals), in_sh, out_avals, n_devices,
+        )
+    try:
+        out_sh = compiled.output_shardings
+    except Exception:  # noqa: BLE001
+        out_sh = None
+    outputs_unsharded = (
+        scan_output_shardings(out_avals, out_sh, global_n)
+        if out_sh is not None else []
+    )
+    return MeshInfo(
+        shape=(int(shape[0]), int(shape[1])),
+        n_devices=n_devices,
+        global_n=global_n,
+        collectives=parse_collectives(text, n_devices),
+        replicated_global=scan_replicated_global(text, global_n),
+        outputs_unsharded=outputs_unsharded,
+        memory=memory,
+        model_bytes=model_bytes,
+    )
+
+
+def collective_table(info: MeshInfo) -> List[str]:
+    """Human-readable per-collective lines (the ``--explain`` view)."""
+    lines = []
+    for c in info.collectives:
+        lines.append(
+            f"{c.kind:<20} {' '.join(c.result_shapes) or '()':<24} "
+            f"group={c.group_size}  ~{c.comm_bytes} B"
+        )
+    if not lines:
+        lines.append("(no collectives)")
+    return lines

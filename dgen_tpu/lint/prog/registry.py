@@ -48,6 +48,7 @@ from dgen_tpu.lint.prog.spec import (
     AUDIT_CHUNK,
     AUDIT_ECON_YEARS,
     AUDIT_END_YEAR,
+    AUDIT_MESH_CHUNK,
     AUDIT_N_AGENTS,
     AUDIT_QUERY_BUCKET,
     AUDIT_SIZING_ITERS,
@@ -58,48 +59,78 @@ from dgen_tpu.lint.prog.spec import (
     anchor_for,
 )
 
+#: the mesh-audit grid (``--programs --mesh``): the production 1-D
+#: agent mesh over 8 virtual CPU devices plus the 2-D hosts x devices
+#: grid (SNIPPETS.md [1]/[3] placement) — ≥ 2 shapes incl. one 2-D, the
+#: pre-silicon vocabulary the pod-scale runs compile under
+MESH_GRID_DEFAULT = ((1, 8), (2, 4))
+#: test-tier mesh grid: the one 2-D shape only (tier-1 budget) — a
+#: DEFAULT-grid shape, so the fast tier gates against the same
+#: committed mesh baseline keys the full grid does
+MESH_GRID_FAST = ((2, 4),)
+
 # -- tiny worlds (memoized per compile-relevant flag set) -------------------
 
 _WORLDS: Dict[tuple, object] = {}
 
 
-def _world(daylight: bool = False, bf16: bool = False, chunk: int = 0):
-    """A built Simulation over the fixed tiny synthetic population.
+def _build_world(daylight: bool, bf16: bool, chunk: int,
+                 mesh_shape: Optional[tuple]):
+    """ONE construction path for every audit world — single-device
+    grid AND mesh tier — over the fixed tiny synthetic population, so
+    the two tiers cannot silently audit divergent worlds. Simulation's
+    __init__ is where the daylight layout, bank dtype conversion,
+    padding, placement and the static run flags are decided, so going
+    through it keeps the audited programs on the production path.
 
-    One world per (daylight, bf16, chunk): Simulation's __init__ is
-    where the daylight layout, bank dtype conversion, padding and the
-    static run flags are decided, so reusing it keeps the audited
-    programs on the production construction path.
+    Mesh worlds turn ``partition_by_state`` off: the state->device bin
+    packing is a host-side row permutation (the compiled program is
+    identical), and with only :data:`AUDIT_STATES` states it would
+    leave most of an 8-device mesh empty — even row sharding keeps
+    every audited shard populated.
     """
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.io import synth
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.simulation import Simulation
+
+    cfg = ScenarioConfig(
+        name="prog-audit" + ("-mesh" if mesh_shape else ""),
+        start_year=2014, end_year=AUDIT_END_YEAR,
+    )
+    pop = synth.generate_population(
+        AUDIT_N_AGENTS, states=list(AUDIT_STATES), seed=7,
+        pad_multiple=32,
+    )
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+        overrides={
+            "attachment_rate": jnp.full((pop.table.n_groups,), 0.4)
+        },
+    )
+    rc = RunConfig(
+        sizing_iters=AUDIT_SIZING_ITERS, agent_chunk=chunk,
+        agent_pad_multiple=32, daylight_compact=daylight,
+        bf16_banks=bf16,
+        partition_by_state=mesh_shape is None,
+    )
+    mesh = None
+    if mesh_shape is not None:
+        from dgen_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(shape=mesh_shape)
+    return Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc,
+        econ_years=AUDIT_ECON_YEARS, mesh=mesh,
+    )
+
+
+def _world(daylight: bool = False, bf16: bool = False, chunk: int = 0):
+    """The memoized single-device audit world per (daylight, bf16,
+    chunk) grid point."""
     key = (daylight, bf16, chunk)
     if key not in _WORLDS:
-        from dgen_tpu.config import RunConfig, ScenarioConfig
-        from dgen_tpu.io import synth
-        from dgen_tpu.models import scenario as scen
-        from dgen_tpu.models.simulation import Simulation
-
-        cfg = ScenarioConfig(
-            name="prog-audit", start_year=2014, end_year=AUDIT_END_YEAR,
-        )
-        pop = synth.generate_population(
-            AUDIT_N_AGENTS, states=list(AUDIT_STATES), seed=7,
-            pad_multiple=32,
-        )
-        inputs = scen.uniform_inputs(
-            cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
-            overrides={
-                "attachment_rate": jnp.full((pop.table.n_groups,), 0.4)
-            },
-        )
-        rc = RunConfig(
-            sizing_iters=AUDIT_SIZING_ITERS, agent_chunk=chunk,
-            agent_pad_multiple=32, daylight_compact=daylight,
-            bf16_banks=bf16,
-        )
-        _WORLDS[key] = Simulation(
-            pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc,
-            econ_years=AUDIT_ECON_YEARS,
-        )
+        _WORLDS[key] = _build_world(daylight, bf16, chunk, None)
     return _WORLDS[key]
 
 
@@ -108,12 +139,16 @@ def _yi(i: int):
 
 
 # -- per-entry bound builders ----------------------------------------------
+#
+# Each entry has ONE body parameterized on the built world (`sim`), so
+# the single-device grid and the mesh tier lower the SAME construction
+# path — a kwarg added to a base builder cannot silently miss its mesh
+# twin (the audit/production-drift these builders exist to prevent).
 
-def _year_step_bound(daylight, bf16, net_billing, first_year,
-                     year: int, chunk: int = 0) -> Bound:
+def _year_step_bound_for(sim, net_billing, first_year,
+                         year: int) -> Bound:
     from dgen_tpu.models.simulation import SimCarry, year_step
 
-    sim = _world(daylight, bf16, chunk)
     kwargs = sim.step_kwargs(first_year)
     kwargs["net_billing"] = net_billing
     carry = SimCarry.zeros(sim.table.n_agents)
@@ -125,12 +160,18 @@ def _year_step_bound(daylight, bf16, net_billing, first_year,
     )
 
 
-def _sweep_bound(net_billing, bf16, first_year, year: int) -> Bound:
+def _year_step_bound(daylight, bf16, net_billing, first_year,
+                     year: int, chunk: int = 0) -> Bound:
+    return _year_step_bound_for(
+        _world(daylight, bf16, chunk), net_billing, first_year, year
+    )
+
+
+def _sweep_bound_for(sim, net_billing, first_year, year: int) -> Bound:
     from dgen_tpu.models.scenario import stack_scenarios
     from dgen_tpu.models.simulation import SimCarry
     from dgen_tpu.sweep.driver import sweep_year_step
 
-    sim = _world(False, bf16)
     members = [
         sim.inputs,
         dataclasses.replace(
@@ -139,7 +180,9 @@ def _sweep_bound(net_billing, bf16, first_year, year: int) -> Bound:
     ][:AUDIT_SWEEP_S]
     inputs_s = stack_scenarios(members).inputs
     # the sweep driver's group kwargs: step_kwargs + per-group
-    # net-billing override, mesh dropped inside the vmapped body
+    # net-billing override, mesh dropped inside the vmapped body (the
+    # TABLE operands keep the world's placement, so under a mesh world
+    # GSPMD propagation is what the mesh tier audits)
     kwargs = sim.step_kwargs(first_year)
     kwargs["net_billing"] = net_billing
     kwargs["mesh"] = None
@@ -155,7 +198,13 @@ def _sweep_bound(net_billing, bf16, first_year, year: int) -> Bound:
     )
 
 
-def _sweep_loop_bound(year: int) -> Bound:
+def _sweep_bound(net_billing, bf16, first_year, year: int) -> Bound:
+    return _sweep_bound_for(
+        _world(False, bf16), net_billing, first_year, year
+    )
+
+
+def _sweep_loop_bound_for(sim, year: int) -> Bound:
     """Loop-mode sweep: the sweep driver runs each scenario through a
     :meth:`Simulation.with_inputs` sibling of the base sim — build the
     bound through that REAL path so a drift in how siblings construct
@@ -163,7 +212,6 @@ def _sweep_loop_bound(year: int) -> Bound:
     a different program here and fails the identity check."""
     from dgen_tpu.models.simulation import SimCarry, year_step
 
-    sim = _world(False, False)
     variant = dataclasses.replace(
         sim.inputs, itc_fraction=sim.inputs.itc_fraction * 0.8
     )
@@ -178,10 +226,13 @@ def _sweep_loop_bound(year: int) -> Bound:
     )
 
 
-def _serve_bound(daylight, year: int) -> Bound:
+def _sweep_loop_bound(year: int) -> Bound:
+    return _sweep_loop_bound_for(_world(False, False), year)
+
+
+def _serve_bound_for(sim, year: int) -> Bound:
     from dgen_tpu.serve.engine import query_program, query_static_kwargs
 
-    sim = _world(daylight, False)
     # the ServeEngine static set, via the SAME constructor the engine
     # uses — an engine-side change to the set changes the audited
     # program here, not just production
@@ -195,7 +246,11 @@ def _serve_bound(daylight, year: int) -> Bound:
     )
 
 
-def _size_agents_bound(net_billing, daylight, bf16) -> Bound:
+def _serve_bound(daylight, year: int) -> Bound:
+    return _serve_bound_for(_world(daylight, False), year)
+
+
+def _size_agents_bound_for(sim, net_billing) -> Bound:
     from dgen_tpu.models.scenario import apply_year
     from dgen_tpu.models.simulation import (
         build_econ_inputs,
@@ -204,7 +259,6 @@ def _size_agents_bound(net_billing, daylight, bf16) -> Bound:
     )
     from dgen_tpu.ops import sizing as sizing_ops
 
-    sim = _world(daylight, bf16)
     # the envs build runs eagerly on tiny arrays — host-side spec
     # construction, not part of the audited program
     ya = apply_year(sim.table, sim.inputs, _yi(0))
@@ -218,9 +272,13 @@ def _size_agents_bound(net_billing, daylight, bf16) -> Bound:
         sizing_ops.size_agents,
         n_periods=sim.tariffs.max_periods, n_years=sim.econ_years,
         n_iters=AUDIT_SIZING_ITERS, keep_hourly=False, impl="xla",
-        net_billing=net_billing, daylight=sim._daylight,
+        net_billing=net_billing, daylight=sim._daylight, mesh=sim.mesh,
     ))
     return Bound(fn=fn, args=(envs,), kwargs={})
+
+
+def _size_agents_bound(net_billing, daylight, bf16) -> Bound:
+    return _size_agents_bound_for(_world(daylight, bf16), net_billing)
 
 
 def _kernel_arrays(bf16: bool):
@@ -432,6 +490,208 @@ def build_registry(grid: str = "default") -> List[ProgramSpec]:
         build=_bucket_sums_bound,
         anchor=anchor_for(billpallas.bucket_sums), cost=True,
     ))
+    return specs
+
+
+# -- the mesh tier (rules J7-J10) -------------------------------------------
+
+_MESH_WORLDS: Dict[tuple, object] = {}
+
+
+def _mesh_world(shape: tuple, chunk: int = 0):
+    """The memoized mesh-tier audit world per (shape, chunk): the SAME
+    :func:`_build_world` construction as the single-device grid, placed
+    on a forced multi-device CPU mesh via the production placement path
+    (``Simulation.__init__`` + ``parallel.mesh.agent_spec``)."""
+    key = (tuple(shape), chunk)
+    if key not in _MESH_WORLDS:
+        _MESH_WORLDS[key] = _build_world(
+            False, False, chunk, tuple(shape)
+        )
+    return _MESH_WORLDS[key]
+
+
+def _mesh_model_bytes(shape: tuple, chunk: int) -> int:
+    """The sweep planner's per-device working-set prediction for this
+    world — the J9 cross-check anchor (``_per_agent_step_bytes`` is the
+    SAME model ``auto_agent_chunk`` and ``plan_sweep`` budget with).
+    Lazy (resolved at lower time via the spec's model_bytes thunk): the
+    world is memoized and shared with the entry's bound builder, so
+    entries an ``--entries`` subset never audits never build one."""
+    from dgen_tpu.models.simulation import _per_agent_step_bytes
+
+    sim = _mesh_world(shape, chunk)
+    n_dev = int(sim.mesh.devices.size)
+    n_local = sim.table.n_agents // n_dev
+    rows = chunk if chunk and n_local > chunk else n_local
+    per_agent = _per_agent_step_bytes(
+        sizing_iters=sim.run_config.sizing_iters,
+        econ_years=sim.econ_years,
+        with_hourly=sim.with_hourly,
+        net_billing=True,
+        rate_switch=sim._rate_switch,
+        bank_bf16=sim.run_config.bf16_banks,
+    )
+    return rows * per_agent + n_local * 50 * 4
+
+
+# mesh-tier wrappers: the SAME bound bodies over a mesh world at the
+# audited base point (net_billing=True, steady year)
+
+def _mesh_year_step_bound(shape, year: int, chunk: int = 0) -> Bound:
+    return _year_step_bound_for(
+        _mesh_world(shape, chunk), True, False, year
+    )
+
+
+def _mesh_sweep_bound(shape, year: int) -> Bound:
+    return _sweep_bound_for(_mesh_world(shape), True, False, year)
+
+
+def _mesh_sweep_loop_bound(shape, year: int) -> Bound:
+    return _sweep_loop_bound_for(_mesh_world(shape), year)
+
+
+def _mesh_serve_bound(shape, year: int) -> Bound:
+    return _serve_bound_for(_mesh_world(shape), year)
+
+
+def _mesh_size_agents_bound(shape) -> Bound:
+    return _size_agents_bound_for(_mesh_world(shape), True)
+
+
+def _mesh_kernel_bound(shape, entry: str) -> Bound:
+    from jax.sharding import NamedSharding
+
+    from dgen_tpu.ops import billpallas
+    from dgen_tpu.parallel.mesh import agent_spec, make_mesh
+
+    mesh = make_mesh(shape=shape)
+    load, gen, sell, bucket, scales = _kernel_arrays(False)
+
+    def place(x):
+        return jax.device_put(
+            x, NamedSharding(mesh, agent_spec(mesh, x.ndim))
+        )
+
+    load, gen, sell, bucket, scales = map(
+        place, (load, gen, sell, bucket, scales)
+    )
+    if entry == "import_sums":
+        return Bound(
+            fn=billpallas.import_sums,
+            args=(load, gen, sell, bucket, scales),
+            kwargs=dict(n_buckets=24, impl="xla", bf16=False, mesh=mesh,
+                        layout=None),
+        )
+    return Bound(
+        fn=billpallas.bucket_sums,
+        args=(load, gen, sell, bucket, scales),
+        kwargs=dict(n_buckets=24, impl="xla", mesh=mesh),
+    )
+
+
+def mesh_label(shape: tuple) -> str:
+    return f"mesh{int(shape[0])}x{int(shape[1])}"
+
+
+def build_mesh_registry(
+    shapes: Optional[List[tuple]] = None,
+    grid: str = "default",
+) -> List[ProgramSpec]:
+    """The mesh-tier specs: every entry point lowered at its base
+    static-config point under each (hosts, devices) grid in ``shapes``
+    (default: :data:`MESH_GRID_DEFAULT`, or :data:`MESH_GRID_FAST`
+    under ``grid="fast"``), compiled, and routed through J7-J10.
+
+    Raises ValueError when the running backend exposes fewer devices
+    than the widest shape needs (the CLI forces the virtual CPU device
+    count before the backend initializes; see ``--programs --mesh``).
+    """
+    if shapes is None:
+        shapes = list(
+            MESH_GRID_DEFAULT if grid == "default" else MESH_GRID_FAST
+        )
+    from dgen_tpu.models.simulation import year_step
+    from dgen_tpu.ops import billpallas
+    from dgen_tpu.ops.sizing import size_agents
+    from dgen_tpu.serve.engine import query_program
+    from dgen_tpu.sweep.driver import sweep_year_step
+
+    need = max(int(s[0]) * int(s[1]) for s in shapes)
+    n_dev = len(jax.devices())
+    if n_dev < need:
+        raise ValueError(
+            f"mesh audit needs {need} devices but the backend exposes "
+            f"{n_dev} — run via `python -m dgen_tpu.lint --programs "
+            "--mesh` (which forces the virtual CPU device count before "
+            "jax initializes), or set it up-front with "
+            "utils.compat.set_cpu_device_count"
+        )
+
+    ys_anchor = anchor_for(year_step)
+    specs: List[ProgramSpec] = []
+    for shape in shapes:
+        lab = mesh_label(shape)
+        n_agents = AUDIT_N_AGENTS    # pad multiple 32 divides the grid
+        whole = partial(_mesh_model_bytes, tuple(shape), 0)
+        chunked = partial(
+            _mesh_model_bytes, tuple(shape), AUDIT_MESH_CHUNK
+        )
+        specs.append(ProgramSpec(
+            entry="year_step", variant=lab,
+            build=partial(_mesh_year_step_bound, tuple(shape), 1),
+            steady=partial(_mesh_year_step_bound, tuple(shape), 2),
+            anchor=ys_anchor, donate_args=(4,),
+            mesh_shape=tuple(shape), global_n=n_agents,
+            model_bytes=whole,
+        ))
+        specs.append(ProgramSpec(
+            entry="year_step_chunked", variant=lab,
+            build=partial(
+                _mesh_year_step_bound, tuple(shape), 1, AUDIT_MESH_CHUNK
+            ),
+            anchor=ys_anchor, donate_args=(4,),
+            mesh_shape=tuple(shape), global_n=n_agents,
+            model_bytes=chunked,
+        ))
+        specs.append(ProgramSpec(
+            entry="sweep_year_step", variant=lab,
+            build=partial(_mesh_sweep_bound, tuple(shape), 1),
+            anchor=anchor_for(sweep_year_step), donate_args=(4,),
+            mesh_shape=tuple(shape), global_n=n_agents,
+        ))
+        specs.append(ProgramSpec(
+            entry="sweep_loop", variant=lab,
+            build=partial(_mesh_sweep_loop_bound, tuple(shape), 1),
+            anchor=anchor_for(sweep_year_step), donate_args=(4,),
+            mesh_shape=tuple(shape), global_n=n_agents,
+            expect_same_as=f"year_step@{lab}",
+        ))
+        specs.append(ProgramSpec(
+            entry="serve_query", variant=lab,
+            build=partial(_mesh_serve_bound, tuple(shape), 0),
+            anchor=anchor_for(query_program),
+            mesh_shape=tuple(shape), global_n=n_agents,
+        ))
+        specs.append(ProgramSpec(
+            entry="size_agents", variant=lab,
+            build=partial(_mesh_size_agents_bound, tuple(shape)),
+            anchor=anchor_for(size_agents),
+            mesh_shape=tuple(shape), global_n=n_agents,
+        ))
+        specs.append(ProgramSpec(
+            entry="import_sums", variant=lab,
+            build=partial(_mesh_kernel_bound, tuple(shape), "import_sums"),
+            anchor=anchor_for(billpallas.import_sums),
+            mesh_shape=tuple(shape), global_n=8,
+        ))
+        specs.append(ProgramSpec(
+            entry="bucket_sums", variant=lab,
+            build=partial(_mesh_kernel_bound, tuple(shape), "bucket_sums"),
+            anchor=anchor_for(billpallas.bucket_sums),
+            mesh_shape=tuple(shape), global_n=8,
+        ))
     return specs
 
 

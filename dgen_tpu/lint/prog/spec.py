@@ -45,6 +45,9 @@ AUDIT_SIZING_ITERS = 4
 AUDIT_CHUNK = 16               # streaming-scan variant: 64 agents / 16
 AUDIT_QUERY_BUCKET = 4         # serve bucket width audited
 AUDIT_SWEEP_S = 2              # scenario-axis width audited
+#: streaming chunk of the MESH-tier chunked variant: 64 agents over 8
+#: devices is 8 local rows, so chunk 4 engages a real 2-step scan
+AUDIT_MESH_CHUNK = 4
 
 #: J1 default ceiling for any single constant captured into a program
 #: at audit scale. The sanctioned shared constants (month one-hots,
@@ -94,6 +97,20 @@ class ProgramSpec:
     expect_same_as: Optional[str] = None
     cost: bool = False
     max_const_bytes: int = MAX_CONST_BYTES
+    #: mesh-tier specs (``--programs --mesh``): the (hosts, devices)
+    #: grid this spec lowers under — the bound's world is built over
+    #: ``parallel.mesh.make_mesh(shape=...)`` with production placement.
+    #: Non-None routes the spec through compile + J7-J10 analysis.
+    mesh_shape: Optional[Tuple[int, int]] = None
+    #: padded GLOBAL agent count of the spec's world (J8 scans the
+    #: per-device HLO for tensors materialized at this leading dim)
+    global_n: int = 0
+    #: the sweep planner's ``_per_agent_step_bytes`` prediction of this
+    #: entry's per-device step working set (J9 cross-checks it against
+    #: ``compiled.memory_analysis()``; None = model does not apply).
+    #: May be a zero-arg callable so registry construction stays lazy
+    #: (resolved at lower time, alongside the world the builder makes).
+    model_bytes: Optional[Any] = None
 
     @property
     def spec_id(self) -> str:
@@ -113,6 +130,12 @@ class ProgramAudit:
     oversized_consts: List[Tuple[tuple, str, int]]   # (shape, dtype, nbytes)
     cost_analysis: Optional[Dict[str, float]]        # cost entries only
     error: Optional[str] = None    # build/lower failure (itself a finding)
+    #: mesh-tier analysis (meshaudit.MeshInfo) — J7-J10 inputs; None on
+    #: single-device audits and on identity-only mesh cross-checks
+    mesh: Optional[Any] = None
+    #: the lowered StableHLO text — kept only when lower_spec ran with
+    #: ``keep_text`` (the --explain path), else None (big programs)
+    hlo_text: Optional[str] = None
 
 
 def anchor_for(fn: Any) -> Tuple[str, int]:
@@ -182,9 +205,14 @@ def _const_nbytes(c) -> int:
         return 0
 
 
-def lower_spec(spec: ProgramSpec, with_cost: bool = False) -> ProgramAudit:
+def lower_spec(
+    spec: ProgramSpec, with_cost: bool = False, keep_text: bool = False,
+) -> ProgramAudit:
     """Trace + lower one spec (and its steady probe); compile only when
-    ``with_cost`` and the spec is a cost entry. Never executes."""
+    ``with_cost`` and the spec is a cost entry, or when the spec is a
+    mesh-tier entry (J7-J9 read the compiled per-device program).
+    ``keep_text`` retains the StableHLO text on the audit (--explain).
+    Never executes."""
     try:
         bound = spec.build()
         traced = bound.fn.trace(*bound.args, **bound.kwargs)
@@ -216,11 +244,27 @@ def lower_spec(spec: ProgramSpec, with_cost: bool = False) -> ProgramAudit:
                 "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
                 "transcendentals": float(ca.get("transcendentals", 0.0)),
             }
+        mesh_info = None
+        if spec.mesh_shape is not None and spec.expect_same_as is None:
+            # identity-only mesh cross-checks (expect_same_as) are J5's
+            # business and skip the compile; everything else in the mesh
+            # tier compiles so J7-J9 can read the per-device program
+            from dgen_tpu.lint.prog.meshaudit import analyze_mesh_program
+
+            model = spec.model_bytes
+            if callable(model):
+                model = model()
+            mesh_info = analyze_mesh_program(
+                lowered.compile(), closed,
+                shape=spec.mesh_shape, global_n=spec.global_n,
+                model_bytes=model,
+            )
         return ProgramAudit(
             spec=spec, jaxpr=closed, args_info=lowered.args_info,
             fingerprint=fp, steady_fingerprint=steady_fp,
             const_bytes=total, oversized_consts=oversized,
-            cost_analysis=cost,
+            cost_analysis=cost, mesh=mesh_info,
+            hlo_text=text if keep_text else None,
         )
     except Exception as e:  # noqa: BLE001 — a spec that cannot even
         # lower is itself a finding (J0), not an auditor crash

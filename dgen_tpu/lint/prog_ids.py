@@ -18,4 +18,8 @@ PROGRAM_RULE_SUMMARIES: Dict[str, str] = {
     "J4": "carry donation verification (input_output_aliases)",
     "J5": "compile-group fingerprint invariants",
     "J6": "cost-fingerprint regression gate (baseline JSON)",
+    "J7": "collective-communication fingerprint gate (mesh tier)",
+    "J8": "sharding propagation: agent axis must stay partitioned",
+    "J9": "static per-device memory vs HBM budget + planner model",
+    "J10": "per-mesh-shape program fingerprint identity (baseline)",
 }

@@ -57,7 +57,7 @@ from dgen_tpu.ops import bill as bill_ops
 from dgen_tpu.ops import dispatch as dispatch_ops
 from dgen_tpu.ops import sizing as sizing_ops
 from dgen_tpu.ops.tariff import NET_BILLING, TariffBank
-from dgen_tpu.parallel.mesh import AGENT_AXIS
+from dgen_tpu.parallel.mesh import agent_spec
 from dgen_tpu.resilience.faults import fault_point
 from dgen_tpu.utils import timing
 from dgen_tpu.utils.logging import get_logger
@@ -499,8 +499,10 @@ def _from_chunks(y: jax.Array, d: int, K: int) -> jax.Array:
 
 
 def _constrain_chunked(mesh: Mesh, a: jax.Array) -> jax.Array:
-    """Pin a [K, C, ...] chunked leaf to P(None, AGENT_AXIS, ...)."""
-    spec = P(None, AGENT_AXIS, *([None] * (a.ndim - 2)))
+    """Pin a [K, C, ...] chunked leaf to P(None, <agent axes>, ...) —
+    dim 1 (the per-chunk agent rows) shards over every mesh axis
+    (hosts x devices grids included, parallel.mesh.agent_spec)."""
+    spec = agent_spec(mesh, a.ndim, axis=1)
     return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
 
 
@@ -760,6 +762,22 @@ def year_step_impl(
         avoided_co2_t=kw_cum * res.naep * carbon_t,
         state_hourly_net_mw=state_hourly,
     )
+    if mesh is not None:
+        # pin every [N]-leading result back to the agent sharding: the
+        # integer battery allocation sorts the WHOLE table, and GSPMD
+        # would otherwise leave everything downstream of that sort
+        # replicated — N live copies of per-agent state per device and
+        # non-addressable rows under multi-host (dgenlint J8)
+        n = table.n_agents
+
+        def _pin(x):
+            if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == n:
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, agent_spec(mesh, x.ndim))
+                )
+            return x
+
+        new_carry, outputs = jax.tree.map(_pin, (new_carry, outputs))
     return new_carry, outputs
 
 
@@ -1045,7 +1063,7 @@ class Simulation:
         # before chunking, because the HBM chunk model depends on them.
 
         if mesh is not None:
-            shard = NamedSharding(mesh, P(AGENT_AXIS))
+            shard = NamedSharding(mesh, agent_spec(mesh))
             repl = NamedSharding(mesh, P())
 
             def put(x, sharding):
@@ -1068,10 +1086,7 @@ class Simulation:
                     x.shape[0] == table.n_agents
                 ):
                     return put(
-                        x,
-                        NamedSharding(
-                            mesh, P(AGENT_AXIS, *([None] * (x.ndim - 1)))
-                        ),
+                        x, NamedSharding(mesh, agent_spec(mesh, x.ndim)),
                     )
                 return put(x, repl)
 

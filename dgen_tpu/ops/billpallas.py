@@ -87,11 +87,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jax.sharding import PartitionSpec
-
 from dgen_tpu.ops.bill import tiered_charge
 from dgen_tpu.ops.tariff import HOURS, MONTHS, NET_BILLING, hour_month_map
-from dgen_tpu.parallel.mesh import AGENT_AXIS
+from dgen_tpu.parallel.mesh import agent_spec
 
 H_PAD = 8832          # 8760 rounded up to 69 * 128 lanes
 B_PAD = 128           # bucket axis = MXU-friendly output width
@@ -806,7 +804,10 @@ def _maybe_shard_agents(fn, mesh, n_out: int, n_in: int = 5):
         return fn
     from dgen_tpu.utils import compat
 
-    spec = PartitionSpec(AGENT_AXIS)
+    # the agent dim shards over EVERY mesh axis (hosts x devices grids
+    # included) — a single-axis spec here would replicate the inputs
+    # across host rows and GSPMD would all-gather them back (J8)
+    spec = agent_spec(mesh)
     # check_vma=False: pallas_call's out_shape ShapeDtypeStructs carry no
     # varying-manual-axes info, so the default vma check rejects the
     # kernel at trace time

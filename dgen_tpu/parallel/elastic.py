@@ -27,9 +27,9 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, NamedSharding
 
-from dgen_tpu.parallel.mesh import AGENT_AXIS
+from dgen_tpu.parallel.mesh import agent_spec
 from dgen_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -40,7 +40,7 @@ def carry_sharding(mesh: Optional[Mesh]) -> Optional[NamedSharding]:
     ``mesh`` (None = single-device host restore)."""
     if mesh is None:
         return None
-    return NamedSharding(mesh, PartitionSpec(AGENT_AXIS))
+    return NamedSharding(mesh, agent_spec(mesh))
 
 
 def validate_topology(n_agents: int, mesh: Optional[Mesh]) -> None:

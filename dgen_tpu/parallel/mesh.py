@@ -1,27 +1,115 @@
 """Device mesh construction.
 
-One 1-D mesh axis ("agents") carries all data parallelism: the agent
-population is embarrassingly parallel within a year (SURVEY.md §2.6) and
-the only cross-agent communication is small state x sector reductions,
-so a single axis with psum collectives over ICI is the whole comms
-design. Multi-slice (DCN) national runs reuse the same axis — XLA routes
-the (tiny) psums appropriately.
+The default mesh is 1-D: one "agents" axis carries all data
+parallelism — the agent population is embarrassingly parallel within a
+year (SURVEY.md §2.6) and the only cross-agent communication is small
+state x sector reductions, so a single axis with psum collectives over
+ICI is the whole comms design.
+
+Pod-scale national runs use a true 2-D **hosts x devices** grid (the
+SNIPPETS.md [1]/[3] NamedSharding placement pattern): the agent axis
+then spans BOTH mesh axes — row-major, so a (1, D) grid is placement-
+identical to the 1-D mesh — and DCN carries the host-axis slice of the
+(tiny) reductions while ICI carries the device-axis slice. Everything
+that builds an agent-axis PartitionSpec goes through
+:func:`agent_spec`/:func:`agent_axes` so a 2-D mesh shards over both
+axes instead of silently replicating across host rows.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec
 
 AGENT_AXIS = "agents"
+HOST_AXIS = "hosts"
 
 
 def make_mesh(n_devices: Optional[int] = None,
-              devices: Optional[Sequence] = None) -> Mesh:
+              devices: Optional[Sequence] = None,
+              shape: Optional[Tuple[int, int]] = None) -> Mesh:
+    """Build the run mesh.
+
+    ``shape``: optional (hosts, devices) grid. ``(1, D)`` (or None)
+    builds the 1-D agent mesh over D devices; ``(H, D)`` with H > 1
+    builds the 2-D hosts x devices mesh whose axes are
+    ``(HOST_AXIS, AGENT_AXIS)`` and whose device order is row-major —
+    so the agent-axis placement (which spans both axes, see
+    :func:`agent_spec`) assigns devices identically to the flat 1-D
+    mesh and only the collective GROUPING is topology-aware.
+    """
     devs = list(devices if devices is not None else jax.devices())
+    if shape is not None:
+        h, d = int(shape[0]), int(shape[1])
+        need = h * d
+        if len(devs) < need:
+            raise ValueError(
+                f"mesh shape {h}x{d} needs {need} devices, "
+                f"{len(devs)} available"
+            )
+        devs = devs[:need]
+        if h > 1:
+            return Mesh(
+                np.asarray(devs).reshape(h, d), (HOST_AXIS, AGENT_AXIS)
+            )
+        return Mesh(np.asarray(devs), (AGENT_AXIS,))
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (AGENT_AXIS,))
+
+
+def agent_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh axis names the agent dimension shards over — ALL of
+    them: every axis of a dgen mesh carries agents (a hosts x devices
+    grid shards the table over both; nothing else is ever mesh-sharded
+    — banks/inputs ride replicated)."""
+    return tuple(mesh.axis_names)
+
+
+def agent_spec(mesh: Mesh, ndim: int = 1, axis: int = 0) -> PartitionSpec:
+    """PartitionSpec sharding dimension ``axis`` of an ``ndim``-rank
+    array over the mesh's agent axes, everything else replicated.
+
+    One constructor for every agent-axis placement in the tree
+    (Simulation placement, the chunked-scan constraint, the shard_map
+    kernel specs, elastic restore) so a 2-D mesh cannot be half-adopted:
+    P("agents") on a hosts x devices grid would shard 4-ways and
+    REPLICATE across host rows — exactly the regression the mesh
+    auditor (docs/lint.md J8) exists to catch.
+    """
+    names = agent_axes(mesh)
+    entry: Union[str, Tuple[str, ...]] = (
+        names[0] if len(names) == 1 else names
+    )
+    dims = [None] * ndim
+    dims[axis] = entry
+    return PartitionSpec(*dims)
+
+
+def mesh_shape_of(mesh: Mesh) -> Tuple[int, int]:
+    """(hosts, devices) shape of a run mesh (1-D meshes report
+    hosts=1)."""
+    ax = dict(mesh.shape)
+    return (int(ax.get(HOST_AXIS, 1)), int(ax[AGENT_AXIS]))
+
+
+def parse_mesh_shape(label: str) -> Tuple[int, int]:
+    """'HxD' -> (H, D), e.g. '1x8' or '2x4' (the mesh-audit grid
+    vocabulary, docs/lint.md)."""
+    parts = label.lower().split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"bad mesh shape '{label}' (expected HxD, e.g. 2x4)"
+        )
+    try:
+        h, d = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"bad mesh shape '{label}' (expected HxD, e.g. 2x4)"
+        ) from None
+    if h < 1 or d < 1:
+        raise ValueError(f"bad mesh shape '{label}' (axes must be >= 1)")
+    return h, d

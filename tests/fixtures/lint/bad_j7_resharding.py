@@ -1,0 +1,70 @@
+"""J7/J8 fixture: a deliberate resharding that all-gathers an
+agent-sharded ``[N, 8760]`` stream.
+
+The clean twin keeps the stream partitioned end-to-end (per-shard
+reduction + the small cross-device sum); the bad twin pins the stream
+replicated mid-program — GSPMD must materialize the FULL global array
+on every device, which shows up in the compiled per-device HLO as an
+``all-gather`` whose result is global-shaped: exactly the "silently
+all-gathers a [N, 8760] profile bank" regression the mesh tier exists
+to catch (J7 names the new collective and its operand shape; J8 flags
+the global-shaped tensor).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+N, H = 64, 8760
+
+
+def _gathered_step_fn(replicated_sharding):
+    @jax.jit
+    def gathered_step(stream, scale):
+        # the deliberate resharding: constrain the sharded stream to be
+        # REPLICATED before reducing — forces an all-gather of the
+        # whole [N, 8760] array onto every device
+        full = jax.lax.with_sharding_constraint(
+            stream, replicated_sharding
+        )
+        return jnp.sum(full * scale, axis=1)
+
+    return gathered_step
+
+
+@jax.jit
+def sharded_step(stream, scale):
+    # per-agent reduction commutes with the agent sharding: no
+    # collective is needed until (and unless) someone sums over agents
+    return jnp.sum(stream * scale, axis=1)
+
+
+def specs(shape=(1, 2)):
+    """(flagged spec, clean spec) — mesh-tier ProgramSpecs over a
+    ``shape`` CPU mesh (the test environment's virtual devices)."""
+    from dgen_tpu.lint.prog import Bound, ProgramSpec, anchor_for
+    from dgen_tpu.parallel.mesh import agent_spec, make_mesh
+
+    mesh = make_mesh(shape=shape)
+    stream = jax.device_put(
+        jnp.ones((N, H), dtype=jnp.float32),
+        NamedSharding(mesh, agent_spec(mesh, 2)),
+    )
+    scale = jax.device_put(
+        jnp.float32(0.5), NamedSharding(mesh, P())
+    )
+    gathered = _gathered_step_fn(NamedSharding(mesh, P()))
+    return (
+        ProgramSpec(
+            entry="fixture_j7_resharded", variant="",
+            build=lambda: Bound(gathered, (stream, scale), {}),
+            anchor=anchor_for(gathered),
+            mesh_shape=tuple(shape), global_n=N,
+        ),
+        ProgramSpec(
+            entry="fixture_j7_sharded", variant="",
+            build=lambda: Bound(sharded_step, (stream, scale), {}),
+            anchor=anchor_for(sharded_step),
+            mesh_shape=tuple(shape), global_n=N,
+        ),
+    )

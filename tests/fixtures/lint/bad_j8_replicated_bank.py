@@ -1,0 +1,50 @@
+"""J8 fixture: an agent-axis bank placed REPLICATED instead of
+sharded.
+
+Upstream placement (Simulation.__init__ via parallel.mesh.agent_spec)
+shards every ``[N, ...]`` leaf; a call site that re-places (or never
+places) the bank hands every device a full copy — the per-device HLO
+then carries the bank parameter at GLOBAL shape, which is how J8 sees
+it without any runtime. The clean twin places the same bank sharded.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+N, H = 64, 8760
+
+
+@jax.jit
+def bank_dot(bank, weights):
+    return bank @ weights
+
+
+def specs(shape=(1, 2)):
+    """(flagged spec, clean spec)."""
+    from dgen_tpu.lint.prog import Bound, ProgramSpec, anchor_for
+    from dgen_tpu.parallel.mesh import agent_spec, make_mesh
+
+    mesh = make_mesh(shape=shape)
+    bank = jnp.ones((N, H), dtype=jnp.float32)
+    weights = jax.device_put(
+        jnp.ones((H,), dtype=jnp.float32), NamedSharding(mesh, P())
+    )
+    replicated = jax.device_put(bank, NamedSharding(mesh, P()))
+    sharded = jax.device_put(
+        bank, NamedSharding(mesh, agent_spec(mesh, 2))
+    )
+    return (
+        ProgramSpec(
+            entry="fixture_j8_replicated_bank", variant="",
+            build=lambda: Bound(bank_dot, (replicated, weights), {}),
+            anchor=anchor_for(bank_dot),
+            mesh_shape=tuple(shape), global_n=N,
+        ),
+        ProgramSpec(
+            entry="fixture_j8_sharded_bank", variant="",
+            build=lambda: Bound(bank_dot, (sharded, weights), {}),
+            anchor=anchor_for(bank_dot),
+            mesh_shape=tuple(shape), global_n=N,
+        ),
+    )

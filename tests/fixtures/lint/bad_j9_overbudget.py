@@ -1,0 +1,47 @@
+"""J9 fixture: a per-device working set that blows a (tiny) HBM
+budget, plus a planner-model mismatch.
+
+The program materializes a few full-width ``[N, H]`` temporaries per
+device; gated against a deliberately small budget the J9 memory gate
+must fail BEFORE any hardware run would OOM. The same spec carries a
+(deliberately tiny) ``model_bytes`` so the planner cross-check — the
+compiler's measured temp bytes vs ``_per_agent_step_bytes``-style
+prediction — fires too.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+N, H = 64, 8760
+
+
+@jax.jit
+def wide_step(stream):
+    # several live [N, H] temporaries (the pointwise chain fuses, the
+    # transposed matmul operands do not)
+    a = jnp.cumsum(stream, axis=1)
+    b = jnp.cumsum(stream[:, ::-1], axis=1)
+    return a @ b.T
+
+
+def specs(shape=(1, 2), model_bytes=1024):
+    """One over-budget mesh-tier spec (``model_bytes`` tiny so the
+    planner cross-check fires alongside the budget gate)."""
+    from dgen_tpu.lint.prog import Bound, ProgramSpec, anchor_for
+    from dgen_tpu.parallel.mesh import agent_spec, make_mesh
+
+    mesh = make_mesh(shape=shape)
+    stream = jax.device_put(
+        jnp.ones((N, H), dtype=jnp.float32),
+        NamedSharding(mesh, agent_spec(mesh, 2)),
+    )
+    return (
+        ProgramSpec(
+            entry="fixture_j9_overbudget", variant="",
+            build=lambda: Bound(wide_step, (stream,), {}),
+            anchor=anchor_for(wide_step),
+            mesh_shape=tuple(shape), global_n=N,
+            model_bytes=model_bytes,
+        ),
+    )

@@ -1,0 +1,409 @@
+"""dgenlint-mesh tests (rules J7-J10): the injected-resharding drill
+(a deliberate all-gather of a [N, 8760] stream fails J7/J8 with the
+offending op named), the replicated-bank and over-budget fixtures, the
+J10 per-mesh-shape fingerprint gate, baseline merge semantics for the
+``mesh`` section, the 2-D hosts x devices mesh helpers (placement
+identity + execution parity), and — the enforcement contract — the
+repo-clean fast-tier mesh audit that check.sh/CI gate at full depth."""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.lint import prog
+from dgen_tpu.lint.prog import baseline as baseline_mod
+from dgen_tpu.lint.prog import lower_spec, run_program_rules
+from dgen_tpu.parallel import mesh as mesh_mod
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "lint"
+)
+
+
+def _fixture(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(FIXTURES, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# parallel.mesh: the 2-D hosts x devices grid
+# ---------------------------------------------------------------------------
+
+def test_parse_mesh_shape():
+    assert mesh_mod.parse_mesh_shape("1x8") == (1, 8)
+    assert mesh_mod.parse_mesh_shape("2x4") == (2, 4)
+    with pytest.raises(ValueError, match="bad mesh shape"):
+        mesh_mod.parse_mesh_shape("8")
+    with pytest.raises(ValueError, match="bad mesh shape"):
+        mesh_mod.parse_mesh_shape("2x0")
+
+
+def test_make_mesh_shapes_and_agent_spec():
+    m1 = mesh_mod.make_mesh(shape=(1, 8))
+    m2 = mesh_mod.make_mesh(shape=(2, 4))
+    assert m1.axis_names == (mesh_mod.AGENT_AXIS,)
+    assert m2.axis_names == (mesh_mod.HOST_AXIS, mesh_mod.AGENT_AXIS)
+    assert mesh_mod.mesh_shape_of(m1) == (1, 8)
+    assert mesh_mod.mesh_shape_of(m2) == (2, 4)
+    # the agent dim spans BOTH axes of a 2-D grid
+    s2 = mesh_mod.agent_spec(m2, ndim=2)
+    assert s2[0] == (mesh_mod.HOST_AXIS, mesh_mod.AGENT_AXIS)
+    # row-major device order: placement is identical to the 1-D mesh
+    assert [d.id for d in m2.devices.flat] == [
+        d.id for d in m1.devices.flat
+    ]
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        mesh_mod.make_mesh(shape=(2, 8))
+
+
+def test_2d_mesh_execution_parity():
+    """A sharded computation over the 2-D grid executes and matches the
+    single-device result — the 2-D mesh is a real run topology, not
+    just an audit artifact."""
+    from jax.sharding import NamedSharding
+
+    x = np.arange(64 * 16, dtype=np.float32).reshape(64, 16)
+    ref = x.sum(axis=1) * 2.0
+
+    @jax.jit
+    def f(a):
+        return a.sum(axis=1) * 2.0
+
+    for shape in ((1, 8), (2, 4)):
+        mesh = mesh_mod.make_mesh(shape=shape)
+        xs = jax.device_put(
+            x, NamedSharding(mesh, mesh_mod.agent_spec(mesh, 2))
+        )
+        np.testing.assert_allclose(np.asarray(f(xs)), ref, rtol=1e-6)
+
+
+def test_year_step_runs_on_2d_mesh():
+    """One REAL year step executes over the 2x4 hosts x devices mesh
+    and matches the meshless program at f32 re-association tolerance
+    (the audited topology actually runs)."""
+    from dgen_tpu.lint.prog.registry import _mesh_world, _world
+    from dgen_tpu.models.simulation import SimCarry, year_step
+
+    def step(sim):
+        kw = sim.step_kwargs(False)
+        kw["net_billing"] = True
+        carry = SimCarry.zeros(sim.table.n_agents)
+        _, out = year_step(
+            sim.table, sim.profiles, sim.tariffs, sim.inputs, carry,
+            jnp.asarray(1, jnp.int32), **kw
+        )
+        return np.asarray(out.npv), np.asarray(out.system_kw)
+
+    npv_ref, kw_ref = step(_world(False, False))
+    npv_2d, kw_2d = step(_mesh_world((2, 4)))
+    np.testing.assert_allclose(npv_2d, npv_ref, rtol=2e-5, atol=1e-4)
+    np.testing.assert_allclose(kw_2d, kw_ref, rtol=2e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# J7/J8 — the injected-resharding drill
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def resharded_audits():
+    bad, clean = _fixture("bad_j7_resharding").specs()
+    return lower_spec(bad), lower_spec(clean)
+
+
+def test_j8_injected_allgather_flagged(resharded_audits):
+    bad, clean = resharded_audits
+    assert bad.error is None and clean.error is None
+    findings = run_program_rules([bad])
+    assert "J8" in rules_of(findings)
+    msgs = " ".join(f.message for f in findings)
+    assert "f32[64,8760]" in msgs        # the offending global tensor
+    assert run_program_rules([clean]) == []
+
+
+def test_j7_new_collective_fails_gate(resharded_audits):
+    """The acceptance-criterion drill: against a mesh baseline recorded
+    BEFORE the resharding (no all-gather), the gate must fail and name
+    the new op with its operand shape."""
+    bad, _clean = resharded_audits
+    doc = {
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "spec": prog.AUDIT_SPEC_VERSION,
+        "tolerance": 0.02,
+        "entries": {},
+        "mesh": {
+            bad.spec.spec_id: {
+                "mesh_shape": [1, 2],
+                "program_hash": bad.fingerprint,   # hash unchanged
+                "collectives": {},                 # ...but no gathers
+                "comm_bytes": 0,
+                "peak_bytes": 1,
+            },
+        },
+    }
+    findings, status = baseline_mod.compare_mesh_to_baseline([bad], doc)
+    j7 = [f for f in findings if f.rule == "J7"]
+    assert j7, findings
+    msgs = " ".join(f.message for f in j7)
+    assert "NEW collective" in msgs and "all-gather" in msgs
+    assert "f32[64,8760]" in msgs        # operand/result shape named
+    assert status["note"] is None
+
+
+def test_j7_comm_drift_and_vanished_collective(resharded_audits):
+    bad, _clean = resharded_audits
+    fp = baseline_mod.collect_mesh_fingerprints([bad])
+    doc = {
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "spec": prog.AUDIT_SPEC_VERSION,
+        "tolerance": 0.02,
+        "entries": {},
+        "mesh": fp,
+    }
+    # faithful baseline: clean
+    assert baseline_mod.compare_mesh_to_baseline([bad], doc)[0] == []
+    # double the recorded comm bytes -> "shrank" drift fires
+    doc2 = json.loads(json.dumps(doc))
+    for e in doc2["mesh"].values():
+        for c in e["collectives"].values():
+            c["comm_bytes"] *= 2
+    findings, _ = baseline_mod.compare_mesh_to_baseline([bad], doc2)
+    assert any("shrank" in f.message for f in findings)
+    # a recorded collective kind the program no longer emits
+    doc3 = json.loads(json.dumps(doc))
+    for e in doc3["mesh"].values():
+        e["collectives"]["collective-permute"] = {
+            "count": 2, "comm_bytes": 512,
+        }
+    findings, _ = baseline_mod.compare_mesh_to_baseline([bad], doc3)
+    assert any("no longer appears" in f.message for f in findings)
+
+
+def test_j10_hash_change_fails_gate(resharded_audits):
+    bad, _clean = resharded_audits
+    fp = baseline_mod.collect_mesh_fingerprints([bad])
+    for e in fp.values():
+        e["program_hash"] = "not-the-hash"
+    doc = {
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "spec": prog.AUDIT_SPEC_VERSION,
+        "tolerance": 0.02, "entries": {}, "mesh": fp,
+    }
+    findings, _ = baseline_mod.compare_mesh_to_baseline([bad], doc)
+    j10 = [f for f in findings if f.rule == "J10"]
+    assert j10 and "fingerprint changed" in j10[0].message
+
+
+def test_j7_gate_skips_on_environment_mismatch(resharded_audits):
+    bad, _clean = resharded_audits
+    doc = {
+        "jax": "0.0.0-not-this-one",
+        "platform": jax.default_backend(),
+        "spec": prog.AUDIT_SPEC_VERSION,
+        "tolerance": 0.02, "entries": {}, "mesh": {},
+    }
+    findings, status = baseline_mod.compare_mesh_to_baseline([bad], doc)
+    assert findings == []
+    assert "skipped" in status["note"]
+
+
+# ---------------------------------------------------------------------------
+# J8 — replicated bank
+# ---------------------------------------------------------------------------
+
+def test_j8_replicated_bank_flagged():
+    bad, clean = _fixture("bad_j8_replicated_bank").specs()
+    findings = run_program_rules([lower_spec(bad)])
+    assert "J8" in rules_of(findings)
+    assert any("UNSHARDED" in f.message for f in findings)
+    clean_findings = run_program_rules([lower_spec(clean)])
+    assert [f for f in clean_findings if f.rule == "J8"] == []
+
+
+# ---------------------------------------------------------------------------
+# J9 — static per-device memory gate
+# ---------------------------------------------------------------------------
+
+def test_j9_overbudget_and_model_mismatch():
+    (spec,) = _fixture("bad_j9_overbudget").specs()
+    audit = lower_spec(spec)
+    assert audit.error is None
+    findings = run_program_rules([audit], j9_budget_bytes=1 << 20)
+    j9 = [f for f in findings if f.rule == "J9"]
+    msgs = " ".join(f.message for f in j9)
+    assert "exceeds the" in msgs          # budget gate
+    assert "under-counts" in msgs         # planner cross-check
+    # a realistic budget keeps the budget gate quiet; the tiny
+    # model_bytes still trips the cross-check
+    findings = run_program_rules([audit], j9_budget_bytes=16 << 30)
+    msgs = " ".join(f.message for f in findings if f.rule == "J9")
+    assert "exceeds the" not in msgs
+
+
+def test_j9_gates_on_aval_estimate_lower_bound():
+    """Backends without memory_analysis still gate: the aval x
+    sharding estimate (temp unknown) is a LOWER BOUND, and a lower
+    bound over budget is over budget."""
+    from dgen_tpu.lint.prog.meshaudit import MeshInfo
+    from dgen_tpu.lint.prog.spec import ProgramAudit
+
+    (spec,) = _fixture("bad_j9_overbudget").specs()
+    audit = lower_spec(spec)
+    est = MeshInfo(
+        shape=audit.mesh.shape, n_devices=audit.mesh.n_devices,
+        global_n=audit.mesh.global_n, collectives=[],
+        replicated_global=[], outputs_unsharded=[],
+        memory={"available": False, "estimated": True, "temp": None,
+                "argument": 4 << 20, "output": 1 << 20},
+    )
+    assert est.peak_bytes == 5 << 20 and est.peak_is_lower_bound
+    doctored = ProgramAudit(
+        spec=audit.spec, jaxpr=audit.jaxpr, args_info=audit.args_info,
+        fingerprint=audit.fingerprint, steady_fingerprint=None,
+        const_bytes=0, oversized_consts=[], cost_analysis=None,
+        mesh=est,
+    )
+    findings = run_program_rules(
+        [doctored], select=["J9"], j9_budget_bytes=1 << 20
+    )
+    assert findings and "LOWER BOUND" in findings[0].message
+
+
+def test_j7_stale_sweep_ignores_custom_shape_seeds(resharded_audits):
+    """A deliberately merged custom-shape seed (--mesh-shapes ...
+    --update-baselines) must not read as staleness on the next
+    default-grid run; a same-shape ghost key still does."""
+    bad, _clean = resharded_audits
+    fp = baseline_mod.collect_mesh_fingerprints([bad])
+    fp["ghost@mesh4x2"] = {
+        "mesh_shape": [4, 2], "program_hash": "x",
+        "collectives": {}, "comm_bytes": 0, "peak_bytes": 1,
+    }
+    fp["ghost@mesh1x2"] = {
+        "mesh_shape": [1, 2], "program_hash": "x",
+        "collectives": {}, "comm_bytes": 0, "peak_bytes": 1,
+    }
+    doc = {
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "spec": prog.AUDIT_SPEC_VERSION,
+        "tolerance": 0.02, "entries": {}, "mesh": fp,
+    }
+    findings, _ = baseline_mod.compare_mesh_to_baseline([bad], doc)
+    msgs = [f.message for f in findings]
+    assert not any("mesh4x2" in m for m in msgs)   # custom seed kept
+    assert any("ghost@mesh1x2" in m for m in msgs)  # real staleness
+
+
+def test_j9_real_year_step_within_model_envelope():
+    """The planner's _per_agent_step_bytes prediction holds for the
+    real mesh-tier year step (the cross-check that validates
+    auto_agent_chunk's budget math against the compiler)."""
+    from dgen_tpu.lint.prog.registry import build_mesh_registry
+
+    spec = next(
+        s for s in build_mesh_registry(grid="fast")
+        if s.entry == "year_step"
+    )
+    audit = lower_spec(spec)
+    assert audit.error is None and audit.mesh is not None
+    assert run_program_rules([audit], select=["J9"]) == []
+    temp = audit.mesh.memory.get("temp")
+    assert temp and audit.mesh.model_bytes
+    # the compiler's measured temp stays inside the modeled envelope
+    assert temp <= audit.mesh.model_bytes * 3.0
+
+
+# ---------------------------------------------------------------------------
+# baseline mesh-section merge semantics
+# ---------------------------------------------------------------------------
+
+def test_update_baseline_preserves_mesh_section(tmp_path,
+                                                resharded_audits):
+    bad, clean = resharded_audits
+    path = str(tmp_path / "prog_baseline.json")
+    # seed: entries (none cost-marked here) + mesh section
+    baseline_mod.update_baseline(path, [], mesh_audits=[bad])
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert bad.spec.spec_id in doc["mesh"]
+    # a cost-only refresh (mesh tier did not run) must carry the mesh
+    # section over verbatim
+    baseline_mod.update_baseline(path, [])
+    with open(path, encoding="utf-8") as f:
+        doc2 = json.load(f)
+    assert doc2["mesh"] == doc["mesh"]
+    # a partial mesh refresh merges instead of replacing
+    baseline_mod.update_baseline(
+        path, [], mesh_audits=[clean], mesh_partial=True,
+    )
+    with open(path, encoding="utf-8") as f:
+        doc3 = json.load(f)
+    assert bad.spec.spec_id in doc3["mesh"]
+    assert clean.spec.spec_id in doc3["mesh"]
+    # a FULL mesh refresh replaces same-shape keys but preserves
+    # deliberately seeded custom-shape gates (foreign mesh_shape)
+    with open(path, encoding="utf-8") as f:
+        doc_c = json.load(f)
+    doc_c["mesh"]["custom@mesh4x2"] = {
+        "mesh_shape": [4, 2], "program_hash": "x",
+        "collectives": {}, "comm_bytes": 0, "peak_bytes": 1,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc_c, f)
+    baseline_mod.update_baseline(path, [], mesh_audits=[clean])
+    with open(path, encoding="utf-8") as f:
+        doc4 = json.load(f)
+    assert bad.spec.spec_id not in doc4["mesh"]        # same shape: replaced
+    assert "custom@mesh4x2" in doc4["mesh"]            # custom seed kept
+
+
+# ---------------------------------------------------------------------------
+# the enforcement contract: the mesh registry audits green
+# ---------------------------------------------------------------------------
+
+def test_mesh_registry_audits_green_fast():
+    """The fast-tier mesh grid (the 2x4 hosts x devices shape) lowers,
+    compiles, and passes J7-J10 against the committed baseline — the
+    invariant `tools/check.sh` and CI gate at full grid depth."""
+    findings, report = prog.audit_programs(
+        grid="fast", with_cost=False, mesh=True,
+    )
+    assert findings == [], "\n".join(str(f) for f in findings)
+    mesh_ids = set(report["mesh"])
+    assert {
+        "year_step@mesh2x4", "year_step_chunked@mesh2x4",
+        "sweep_year_step@mesh2x4", "serve_query@mesh2x4",
+        "size_agents@mesh2x4", "import_sums@mesh2x4",
+        "bucket_sums@mesh2x4",
+    } <= mesh_ids
+    # the agent table stays sharded: the year step's comm stays in the
+    # small reduction/gather class, no [N, 8760]-scale collective
+    ys = report["mesh"]["year_step@mesh2x4"]
+    assert ys["comm_bytes"] < 64 * 1024
+    assert ys["peak_bytes"] and ys["peak_bytes"] < 64 * 2**20
+
+
+@pytest.mark.slow
+def test_mesh_registry_full_grid():
+    """Full mesh grid (1x8 + the 2-D 2x4) with the committed baseline
+    gate — every entry under >= 2 mesh shapes, J7-J10 enforced."""
+    findings, report = prog.audit_programs(mesh=True)
+    assert findings == [], "\n".join(str(f) for f in findings)
+    shapes = {tuple(m["shape"]) for m in report["mesh"].values()}
+    assert {(1, 8), (2, 4)} <= shapes

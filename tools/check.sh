@@ -20,9 +20,15 @@ if [ "$mode" != "--test-only" ]; then
     # style baseline: pyflakes + import order only (see [tool.ruff] in
     # pyproject.toml); advisory if ruff is absent. Lives in the LINT
     # block — `--lint-only` (the CI fast tier's gate) must not skip it.
+    # The version is PINNED (pyproject [dev] + CI install the same
+    # exact release) so a local pass cannot disagree with CI.
     if command -v ruff >/dev/null 2>&1; then
         echo "== ruff (pyflakes + import order) =="
         ruff check dgen_tpu tests tools || rc=1
+    else
+        echo "== ruff: not installed — SKIPPED (advisory). CI enforces" \
+             "the pinned release (pip install 'ruff==0.8.4', see" \
+             "pyproject [dev]) =="
     fi
     # the sweep subsystem is inside the default lint root already; an
     # explicit pass keeps it gated even if the default root narrows
@@ -51,9 +57,13 @@ if [ "$mode" != "--test-only" ]; then
     # jitted entry point traced + lowered over the static-config grid
     # on the CPU backend (no devices, no data) — rules J0-J5 over the
     # jaxprs/StableHLO plus the J6 cost-fingerprint gate against
-    # tools/prog_baseline.json
-    echo "== dgenlint-prog (python -m dgen_tpu.lint --programs) =="
-    JAX_PLATFORMS=cpu python -m dgen_tpu.lint --programs || rc=1
+    # tools/prog_baseline.json. --mesh adds the multi-device tier:
+    # every entry lowered under the 1x8 and 2x4 hosts-x-devices CPU
+    # meshes with production shardings, gated by J7 (collective
+    # fingerprints), J8 (sharding propagation), J9 (per-device memory
+    # vs HBM budget) and J10 (per-mesh-shape program hashes)
+    echo "== dgenlint-prog (python -m dgen_tpu.lint --programs --mesh) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.lint --programs --mesh || rc=1
     # supervisor smoke drill (docs/resilience.md): one injected
     # mid-run failure + one injected checkpoint-save failure must be
     # retried/resumed with bit-exact artifacts and a verifying

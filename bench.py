@@ -52,6 +52,16 @@ Knobs (env):
                           flag is stamped into the payload
   DGEN_TPU_BENCH_BF16     run with RunConfig.bf16_banks=1 (bf16 profile
                           banks; larger auto chunks at fixed HBM)
+  DGEN_TPU_BENCH_QUANT    run with RunConfig.quant_banks=1 (int8
+                          load/gen streams + per-row f32 scales) and
+                          stamp a baseline-vs-variant step-wall A/B
+                          ("kernel_ab") into the payload
+  DGEN_TPU_BENCH_PACK     run with RunConfig.pack_once=1 (one stream
+                          repack per sizing call instead of one per
+                          engine call); joins the same kernel_ab A/B
+  DGEN_TPU_BENCH_STREAM   run with RunConfig.stream_segments=1 (the
+                          double-buffered month-segment kernels; TPU
+                          only — the XLA twin runs elsewhere)
   DGEN_TPU_BENCH_SWEEP    <S>: also run an S-way identical-scenario
                           sweep (dgen_tpu.sweep) vs one single run and
                           stamp S, per-scenario wall, bank-bytes-shared
@@ -123,6 +133,12 @@ _BENCH_DAYLIGHT = os.environ.get(
     "DGEN_TPU_BENCH_DAYLIGHT", "") not in ("", "0", "false")
 _BENCH_BF16 = os.environ.get(
     "DGEN_TPU_BENCH_BF16", "") not in ("", "0", "false")
+_BENCH_QUANT = os.environ.get(
+    "DGEN_TPU_BENCH_QUANT", "") not in ("", "0", "false")
+_BENCH_PACK = os.environ.get(
+    "DGEN_TPU_BENCH_PACK", "") not in ("", "0", "false")
+_BENCH_STREAM = os.environ.get(
+    "DGEN_TPU_BENCH_STREAM", "") not in ("", "0", "false")
 _BENCH_ASYNC = os.environ.get(
     "DGEN_TPU_BENCH_ASYNC", "") not in ("", "0", "false")
 _BENCH_FAULTS = os.environ.get(
@@ -141,7 +157,8 @@ if _BENCH_GANG in ("0", "false"):
 
 def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
            agent_chunk: int = 0, with_hourly: bool = False,
-           binding_nem_caps: bool = False, seed: int = 42):
+           binding_nem_caps: bool = False, seed: int = 42,
+           flags: dict | None = None):
     from dgen_tpu.config import RunConfig, ScenarioConfig
     from dgen_tpu.io import synth
     from dgen_tpu.models import scenario as scen
@@ -168,7 +185,11 @@ def _build(n_agents: int, end_year: int, sizing_iters: int = 10,
         pop.table, pop.profiles, pop.tariffs, inputs, cfg,
         RunConfig(
             sizing_iters=sizing_iters, agent_chunk=agent_chunk,
-            daylight_compact=_BENCH_DAYLIGHT, bf16_banks=_BENCH_BF16,
+            **{**dict(
+                daylight_compact=_BENCH_DAYLIGHT, bf16_banks=_BENCH_BF16,
+                quant_banks=_BENCH_QUANT, pack_once=_BENCH_PACK,
+                stream_segments=_BENCH_STREAM,
+            ), **(flags or {})},
         ),
         with_hourly=with_hourly,
     )
@@ -825,6 +846,9 @@ def main() -> None:
         "full_run": None,
         "daylight_compact": _BENCH_DAYLIGHT,
         "bf16_banks": _BENCH_BF16,
+        "quant_banks": _BENCH_QUANT,
+        "pack_once": _BENCH_PACK,
+        "stream_segments": _BENCH_STREAM,
         # the session's resolved async host-IO default (the kill
         # switch DGEN_TPU_ASYNC_IO applies to every run below); the
         # dedicated A/B block lands under "async_io" when
@@ -858,6 +882,7 @@ def main() -> None:
                 k: {
                     "flops": v.get("flops"),
                     "bytes_accessed": v.get("bytes_accessed"),
+                    "input_bytes": v.get("input_bytes"),
                     "program_hash": v.get("program_hash"),
                 }
                 for k, v in _pb.get("entries", {}).items()
@@ -1153,6 +1178,40 @@ def main() -> None:
                 del sim_c, pop_c
             except Exception as e:  # noqa: BLE001
                 config_points[key] = {"failed": str(e)[:200]}
+
+    # --- roofline kernel A/B (DGEN_TPU_BENCH_QUANT / _PACK / _STREAM):
+    # before/after year-step walls for the ISSUE-12 kernel paths, same
+    # population and seed, flags forced OFF for the baseline leg so
+    # the A/B is attributable regardless of the session's global
+    # knobs. The committed static-cost side of the same story rides
+    # prog_cost (input_bytes per entry; docs/perf.md).
+    if _BENCH_QUANT or _BENCH_PACK or _BENCH_STREAM:
+        if spendable(2 * point_est):
+            try:
+                off = dict(quant_banks=False, pack_once=False,
+                           stream_segments=False, daylight_compact=False,
+                           bf16_banks=False)
+                sim_b, _p0 = _build(n_agents, 2022, flags=off)
+                base_dt = _time_steps(sim_b)
+                del sim_b, _p0
+                sim_v, _p1 = _build(n_agents, 2022)
+                var_dt = _time_steps(sim_v)
+                del sim_v, _p1
+                payload["kernel_ab"] = {
+                    "agents": n_agents,
+                    "quant_banks": _BENCH_QUANT,
+                    "pack_once": _BENCH_PACK,
+                    "stream_segments": _BENCH_STREAM,
+                    "daylight_compact": _BENCH_DAYLIGHT,
+                    "bf16_banks": _BENCH_BF16,
+                    "baseline_sec_per_year_step": round(base_dt, 4),
+                    "variant_sec_per_year_step": round(var_dt, 4),
+                    "speedup_x": round(base_dt / max(var_dt, 1e-9), 3),
+                }
+            except Exception as e:  # noqa: BLE001
+                payload["kernel_ab"] = {"failed": str(e)[:200]}
+        else:
+            skipped["kernel_ab"] = "budget"
 
     # --- S-way identical-scenario sweep A/B (DGEN_TPU_BENCH_SWEEP=<S>):
     # captures the amortization win of one bank upload + one compile

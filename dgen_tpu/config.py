@@ -118,6 +118,32 @@ class RunConfig:
     #: docs/perf.md for the measured golden-run envelope. Off by
     #: default. Env: DGEN_TPU_BF16_BANKS.
     bf16_banks: bool = False
+    #: store the ProfileBank load/gen streams as int8 codes with
+    #: per-row f32 scale factors (ops.billpallas._quant_fold): the
+    #: sizing hot loop's dominant O(N*8760) HBM streams shrink to ONE
+    #: byte per hour (4x under f32, 2x under bf16 — the wholesale/sell
+    #: stream keeps the bank float dtype), kernels upcast + accumulate
+    #: in f32, and the dispatch/linear_sums/naep/keep_hourly floors
+    #: price dequantized f32 — the same floor rule as bf16_banks.
+    #: Inputs round to 1/254 of each bank row's range (~0.4% worst
+    #: case); see docs/perf.md for the measured golden envelope. Off by
+    #: default — the f32 full-hour path stays the parity oracle. Env:
+    #: DGEN_TPU_QUANT_BANKS.
+    quant_banks: bool = False
+    #: gather the sizing search's month-positional candidate streams
+    #: ONCE per size_agents call (billpallas.PackedStreams) instead of
+    #: once per bucket-sums engine call — one repack gather (and one
+    #: night-sums pass under daylight_compact) per year instead of up
+    #: to three. Off by default (the per-call path is the parity
+    #: oracle). Env: DGEN_TPU_PACK_ONCE.
+    pack_once: bool = False
+    #: run the candidate kernels on the double-buffered (agent-block x
+    #: month-segment) stream engine (billpallas._sums_pallas_stream):
+    #: the DMA of month segment m+1 overlaps compute on segment m, so
+    #: HBM reads hide behind the VPU floor instead of serializing
+    #: ahead of each agent's program. TPU only — elsewhere the XLA
+    #: twin runs (same math). Off by default. Env: DGEN_TPU_STREAM.
+    stream_segments: bool = False
     #: background host-IO pipeline (io.hostio.HostPipeline): per-year
     #: result collection, RunExporter parquet writes and orbax
     #: checkpoint saves run on worker threads against one batched
@@ -197,6 +223,12 @@ class RunConfig:
             overrides["daylight_compact"] = True
         if "bf16_banks" not in overrides and flag("DGEN_TPU_BF16_BANKS"):
             overrides["bf16_banks"] = True
+        if "quant_banks" not in overrides and flag("DGEN_TPU_QUANT_BANKS"):
+            overrides["quant_banks"] = True
+        if "pack_once" not in overrides and flag("DGEN_TPU_PACK_ONCE"):
+            overrides["pack_once"] = True
+        if "stream_segments" not in overrides and flag("DGEN_TPU_STREAM"):
+            overrides["stream_segments"] = True
         if "faults" not in overrides and os.environ.get("DGEN_TPU_FAULTS"):
             overrides["faults"] = os.environ["DGEN_TPU_FAULTS"].strip()
         # async_host_io deliberately NOT baked from the env here: the

@@ -24,11 +24,18 @@ from typing import Optional, Tuple
 import ml_dtypes  # ships with jax; bf16 <-> numpy bridge
 import numpy as np
 
-#: DGPB1 dtype codes (header bytes [6:8)); bf16 banks halve the disk
-#: and mmap footprint of the 8760-hour profile banks
-#: (RunConfig.bf16_banks consumes them natively on device)
-_CODE_TO_DTYPE = {0: np.dtype(np.float32), 1: np.dtype(ml_dtypes.bfloat16)}
+#: DGPB1 dtype codes (header bytes [6:8)); bf16 banks (code 1) halve
+#: the disk and mmap footprint of the 8760-hour profile banks and int8
+#: quantized banks (code 2, per-row f32 scale sidecar appended after
+#: the payload) quarter it — the at-rest companions of
+#: RunConfig.bf16_banks / RunConfig.quant_banks
+_CODE_TO_DTYPE = {
+    0: np.dtype(np.float32),
+    1: np.dtype(ml_dtypes.bfloat16),
+    2: np.dtype(np.int8),
+}
 _DTYPE_TO_CODE = {v: k for k, v in _CODE_TO_DTYPE.items()}
+_INT8_CODE = 2
 
 _SRC = os.path.join(os.path.dirname(__file__), "..", "..", "native",
                     "profile_store.cpp")
@@ -88,6 +95,8 @@ def _load() -> Optional[ctypes.CDLL]:
     ]
     lib.dg_store_dtype.restype = ctypes.c_int
     lib.dg_store_dtype.argtypes = [ctypes.c_void_p]
+    lib.dg_store_scales.restype = ctypes.c_void_p
+    lib.dg_store_scales.argtypes = [ctypes.c_void_p]
     lib.dg_store_open.restype = ctypes.c_void_p
     lib.dg_store_open.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
@@ -124,23 +133,57 @@ def _resolve_dtype(data: np.ndarray, dtype: Optional[str]) -> np.dtype:
         return np.dtype(np.float32)
     if dtype in ("bf16", "bfloat16"):
         return np.dtype(ml_dtypes.bfloat16)
-    raise ValueError(f"unsupported bank dtype {dtype!r} (f32 | bf16)")
+    if dtype in ("int8", "i8"):
+        return np.dtype(np.int8)
+    raise ValueError(
+        f"unsupported bank dtype {dtype!r} (f32 | bf16 | int8)")
 
 
 def write_bank(path: str, data: np.ndarray,
-               dtype: Optional[str] = None) -> None:
+               dtype: Optional[str] = None,
+               scales: Optional[np.ndarray] = None) -> None:
     """Persist a row-major matrix as a DGPB1 bank file.
 
     ``dtype``: None keeps the array's own dtype (f32 unless it is
-    already bf16); "bf16" converts on write — half the disk/mmap bytes
-    at ~3 significant digits, the at-rest companion of
-    ``RunConfig.bf16_banks``; "f32" forces full precision.
+    already bf16/int8); "bf16" converts on write — half the disk/mmap
+    bytes at ~3 significant digits, the at-rest companion of
+    ``RunConfig.bf16_banks``; "int8" quantizes on write (symmetric
+    per-row codes + a f32 per-row scale sidecar appended after the
+    payload — dtype code 2, the at-rest companion of
+    ``RunConfig.quant_banks``); "f32" forces full precision.
+
+    ``scales``: required when ``data`` is ALREADY int8 codes (the
+    [rows] f32 dequant factors to persist alongside); ignored —
+    derived by quantization — for float inputs written as "int8".
     """
     target = _resolve_dtype(np.asarray(data), dtype)
-    data = np.ascontiguousarray(data, dtype=target)
-    if data.ndim != 2:
+    if np.asarray(data).ndim != 2:
         raise ValueError("bank must be 2-D [rows, cols]")
-    code = _DTYPE_TO_CODE[target]
+    if target == np.dtype(np.int8):
+        if np.asarray(data).dtype == np.int8:
+            if scales is None:
+                raise ValueError(
+                    "int8 bank data needs its per-row f32 scales "
+                    "(write_bank(..., scales=...))"
+                )
+            data = np.ascontiguousarray(data, dtype=np.int8)
+            scales = np.ascontiguousarray(scales, dtype=np.float32)
+        else:
+            from dgen_tpu.models.agents import quantize_rows
+
+            data, scales = quantize_rows(np.asarray(data))
+        if scales.shape != (data.shape[0],):
+            raise ValueError(
+                f"scales must be [rows]={data.shape[0]}, "
+                f"got {scales.shape}"
+            )
+        payload = data.tobytes() + scales.astype("<f4").tobytes()
+    else:
+        if scales is not None:
+            raise ValueError("scales only apply to int8 banks")
+        data = np.ascontiguousarray(data, dtype=target)
+        payload = None
+    code = _DTYPE_TO_CODE[np.dtype(target)]
     lib = _load()
     from dgen_tpu.resilience.atomic import atomic_write
 
@@ -148,9 +191,16 @@ def write_bank(path: str, data: np.ndarray,
     # os.replace): a bank file is a run artifact, and a killed
     # converter must not leave a truncated DGPB at the published path
     if lib is not None:
+        # the native writer takes one contiguous body (payload, plus
+        # the int8 scale sidecar when present)
+        body = (
+            np.frombuffer(payload, dtype=np.uint8)
+            if payload is not None else data
+        )
+
         def _write_native(tmp_path: str) -> None:
             rc = lib.dg_store_write2(
-                tmp_path.encode(), data.ctypes.data_as(ctypes.c_void_p),
+                tmp_path.encode(), body.ctypes.data_as(ctypes.c_void_p),
                 data.shape[0], data.shape[1], code,
             )
             if rc != 0:
@@ -165,15 +215,18 @@ def write_bank(path: str, data: np.ndarray,
             f.write(code.to_bytes(2, "little"))
             f.write(int(data.shape[0]).to_bytes(8, "little"))
             f.write(int(data.shape[1]).to_bytes(8, "little"))
-            f.write(data.tobytes())
+            f.write(payload if payload is not None else data.tobytes())
 
     atomic_write(path, _write)
 
 
-def read_bank(path: str) -> np.ndarray:
-    """Load a DGPB1 bank in its stored dtype (f32 or bf16). Native
-    path: one mmap + zero-copy view (copied into an owned array before
-    the handle closes)."""
+def read_bank_raw(path: str) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Load a DGPB1 bank in its STORED representation: (array, scales)
+    with ``scales`` the [rows] f32 sidecar for int8 banks (dtype code
+    2) and None otherwise. Native path: one mmap + zero-copy view
+    (copied into owned arrays before the handle closes). This is the
+    device-path loader — ``RunConfig.quant_banks`` runs consume the
+    codes + scales directly."""
     lib = _load()
     if lib is not None:
         rows = ctypes.c_uint64()
@@ -193,9 +246,20 @@ def read_bank(path: str) -> np.ndarray:
                 np.frombuffer(buf, dtype=dt)
                 .reshape(rows.value, cols.value).copy()
             )
+            scales = None
+            sptr = lib.dg_store_scales(ctypes.c_void_p(h))
+            if sptr:
+                sbuf = ctypes.cast(
+                    sptr, ctypes.POINTER(ctypes.c_uint8 * (rows.value * 4))
+                ).contents
+                # bytewise copy: the sidecar starts right after an
+                # arbitrary-length payload, so it is not 4-aligned
+                scales = np.frombuffer(
+                    bytes(sbuf), dtype="<f4"
+                ).copy()
         finally:
             lib.dg_store_close(ctypes.c_void_p(h))
-        return arr
+        return arr, scales
     with open(path, "rb") as f:
         head = f.read(_HEADER)
         if head[:6] != _MAGIC:
@@ -207,7 +271,24 @@ def read_bank(path: str) -> np.ndarray:
         rows = int.from_bytes(head[8:16], "little")
         cols = int.from_bytes(head[16:24], "little")
         data = np.frombuffer(f.read(rows * cols * dt.itemsize), dtype=dt)
-    return data.reshape(rows, cols).copy()
+        scales = None
+        if code == _INT8_CODE:
+            raw = f.read(rows * 4)
+            if len(raw) != rows * 4:
+                raise IOError("truncated int8 scale sidecar")
+            scales = np.frombuffer(raw, dtype="<f4").copy()
+    return data.reshape(rows, cols).copy(), scales
+
+
+def read_bank(path: str) -> np.ndarray:
+    """Load a DGPB1 bank in its stored dtype (f32 or bf16); int8
+    quantized banks (dtype code 2) come back DEQUANTIZED to f32
+    (``scale[row] * code``), so every float consumer keeps working —
+    use :func:`read_bank_raw` for the codes + scale sidecar."""
+    arr, scales = read_bank_raw(path)
+    if scales is not None:
+        return arr.astype(np.float32) * scales[:, None]
+    return arr
 
 
 def csv_to_bank(

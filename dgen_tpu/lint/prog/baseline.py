@@ -36,8 +36,13 @@ DEFAULT_TOLERANCE = 0.02
 CONST_BYTES_SLACK = 64 * 1024
 
 #: the gated metrics (relative tolerance); program_hash and
-#: transcendentals are recorded but informational
-GATED_METRICS = ("flops", "bytes_accessed")
+#: transcendentals are recorded but informational. ``input_bytes``
+#: (sum of the program's parameter aval bytes) is the static
+#: kernel-input-traffic term — the one int8 quantized banks and
+#: packed streams shrink, which XLA:CPU's bytes_accessed cannot see
+#: (its cost model is dominated by f32 intermediates that stay in
+#: VMEM on TPU).
+GATED_METRICS = ("flops", "bytes_accessed", "input_bytes")
 
 #: mesh-tier re-seed command quoted in J7/J10 findings
 MESH_RESEED = (
@@ -65,6 +70,7 @@ def collect_fingerprints(audits: List[ProgramAudit]) -> Dict[str, dict]:
             "flops": a.cost_analysis["flops"],
             "bytes_accessed": a.cost_analysis["bytes_accessed"],
             "transcendentals": a.cost_analysis["transcendentals"],
+            "input_bytes": a.input_bytes,
             "const_bytes": a.const_bytes,
             "program_hash": a.fingerprint,
         }
@@ -166,6 +172,13 @@ def compare_to_baseline(
             continue
         deltas = {}
         for metric in GATED_METRICS:
+            if metric not in base:
+                # a baseline seeded before this metric existed gates
+                # the metrics it has — flagging a freshly-introduced
+                # metric as "100% growth" would hard-fail every older
+                # committed baseline with a misleading message;
+                # --update-baselines brings the new metric under gate
+                continue
             old = float(base.get(metric, 0.0))
             new = float(cur[metric])
             rel = _rel_drift(old, new)

@@ -75,7 +75,8 @@ _WORLDS: Dict[tuple, object] = {}
 
 
 def _build_world(daylight: bool, bf16: bool, chunk: int,
-                 mesh_shape: Optional[tuple]):
+                 mesh_shape: Optional[tuple], quant: bool = False,
+                 pack: bool = False):
     """ONE construction path for every audit world — single-device
     grid AND mesh tier — over the fixed tiny synthetic population, so
     the two tiers cannot silently audit divergent worlds. Simulation's
@@ -111,7 +112,7 @@ def _build_world(daylight: bool, bf16: bool, chunk: int,
     rc = RunConfig(
         sizing_iters=AUDIT_SIZING_ITERS, agent_chunk=chunk,
         agent_pad_multiple=32, daylight_compact=daylight,
-        bf16_banks=bf16,
+        bf16_banks=bf16, quant_banks=quant, pack_once=pack,
         partition_by_state=mesh_shape is None,
     )
     mesh = None
@@ -125,12 +126,14 @@ def _build_world(daylight: bool, bf16: bool, chunk: int,
     )
 
 
-def _world(daylight: bool = False, bf16: bool = False, chunk: int = 0):
+def _world(daylight: bool = False, bf16: bool = False, chunk: int = 0,
+           quant: bool = False, pack: bool = False):
     """The memoized single-device audit world per (daylight, bf16,
-    chunk) grid point."""
-    key = (daylight, bf16, chunk)
+    chunk, quant, pack) grid point."""
+    key = (daylight, bf16, chunk, quant, pack)
     if key not in _WORLDS:
-        _WORLDS[key] = _build_world(daylight, bf16, chunk, None)
+        _WORLDS[key] = _build_world(daylight, bf16, chunk, None,
+                                    quant=quant, pack=pack)
     return _WORLDS[key]
 
 
@@ -164,6 +167,15 @@ def _year_step_bound(daylight, bf16, net_billing, first_year,
                      year: int, chunk: int = 0) -> Bound:
     return _year_step_bound_for(
         _world(daylight, bf16, chunk), net_billing, first_year, year
+    )
+
+
+def _year_step_qp_bound(year: int) -> Bound:
+    """The quant_banks + pack_once year step (steady, net-billing) —
+    the J6 entry whose committed bytes_accessed proves the per-year
+    kernel-input traffic shrank (ISSUE 12)."""
+    return _year_step_bound_for(
+        _world(quant=True, pack=True), True, False, year
     )
 
 
@@ -273,12 +285,16 @@ def _size_agents_bound_for(sim, net_billing) -> Bound:
         n_periods=sim.tariffs.max_periods, n_years=sim.econ_years,
         n_iters=AUDIT_SIZING_ITERS, keep_hourly=False, impl="xla",
         net_billing=net_billing, daylight=sim._daylight, mesh=sim.mesh,
+        pack_once=sim.run_config.pack_once,
     ))
     return Bound(fn=fn, args=(envs,), kwargs={})
 
 
-def _size_agents_bound(net_billing, daylight, bf16) -> Bound:
-    return _size_agents_bound_for(_world(daylight, bf16), net_billing)
+def _size_agents_bound(net_billing, daylight, bf16, quant=False,
+                       pack=False) -> Bound:
+    return _size_agents_bound_for(
+        _world(daylight, bf16, quant=quant, pack=pack), net_billing
+    )
 
 
 def _kernel_arrays(bf16: bool):
@@ -317,6 +333,43 @@ def _import_sums_bound(layout_on: bool, bf16: bool) -> Bound:
         args=(load, gen, sell, bucket, scales),
         kwargs=dict(n_buckets=24, impl="xla", bf16=False, mesh=None,
                     layout=layout),
+    )
+
+
+def _import_sums_packed_bound() -> Bound:
+    """import_sums consuming a pre-built PackedStreams over the
+    daylight layout — the audited program then contains NO repack
+    gather and NO night-sums pass (they ran once at pack time), so its
+    committed bytes_accessed diff vs the unpacked daylight entry IS
+    the per-engine-call saving pack-once buys (x the up-to-3 calls
+    per sizing year)."""
+    from dgen_tpu.ops import billpallas
+
+    layout = _world(True, False)._daylight
+    load, gen, sell, bucket, scales = _kernel_arrays(False)
+    pk = billpallas.pack_streams(
+        load, gen, sell, bucket, 24, layout=layout)
+    return Bound(
+        fn=billpallas.import_sums,
+        args=(None, None, None, None, scales),
+        kwargs=dict(n_buckets=24, impl="xla", bf16=False, mesh=None,
+                    layout=layout, packed=pk),
+    )
+
+
+def _import_sums_quant_bound() -> Bound:
+    from dgen_tpu.models.agents import quantize_rows
+    from dgen_tpu.ops import billpallas
+
+    load, gen, sell, bucket, scales = _kernel_arrays(False)
+    lq, ls = quantize_rows(np.asarray(load))
+    gq, gs = quantize_rows(np.asarray(gen))
+    return Bound(
+        fn=billpallas.import_sums,
+        args=(jnp.asarray(lq), jnp.asarray(gq), sell, bucket, scales),
+        kwargs=dict(n_buckets=24, impl="xla", bf16=False, mesh=None,
+                    layout=None, load_scale=jnp.asarray(ls),
+                    gen_scale=jnp.asarray(gs)),
     )
 
 
@@ -405,6 +458,19 @@ def build_registry(grid: str = "default") -> List[ProgramSpec]:
         anchor=ys_anchor, donate_args=(4,), cost=True,
     ))
 
+    # int8 quantized banks + pack-once (ISSUE 12): a committed J6
+    # bytes_accessed entry to diff against the base point — the static
+    # proof that the per-year kernel-input traffic shrank (the fast
+    # grid skips it; tests/test_lint_prog.py asserts the committed
+    # relation instead of re-lowering)
+    if grid == "default":
+        specs.append(ProgramSpec(
+            entry="year_step", variant="dl0-bf0-nb1-q1-pk1-fy0",
+            build=partial(_year_step_qp_bound, 1),
+            steady=partial(_year_step_qp_bound, 2),
+            anchor=ys_anchor, donate_args=(4,), cost=True,
+        ))
+
     # sweep vmap mode (scenario axis S=2)
     sw_anchor = anchor_for(sweep_year_step)
     sweep_points = (
@@ -458,11 +524,36 @@ def build_registry(grid: str = "default") -> List[ProgramSpec]:
     )
     for nb, dl, bf in size_points:
         is_base = (nb, dl, bf) == (True, False, False)
+        # the daylight point carries a cost fingerprint too: the
+        # pack-once entry below diffs against it (fewer gather bytes)
         specs.append(ProgramSpec(
             entry="size_agents", variant=_v(dl, bf, nb),
             build=partial(_size_agents_bound, nb, dl, bf),
-            anchor=sz_anchor, cost=is_base,
+            anchor=sz_anchor,
+            cost=is_base or (nb, dl, bf) == (True, True, False),
         ))
+    if grid == "default":
+        # ISSUE 12 J6 proofs: int8 quantized banks must shrink the
+        # sizing entry's bytes_accessed >= 1.8x vs the committed base
+        # point, and pack-once must shrink the daylight entry's bytes
+        # (one gather + night pass instead of one per engine call) —
+        # tests/test_lint_prog.py gates both relations on the
+        # committed tools/prog_baseline.json
+        for variant, quant, dl, bf, pack in (
+            ("dl0-bf0-nb1-q1", True, False, False, False),
+            # quant + bf16 compose: int8 load/gen codes, bf16
+            # wholesale/sell — the recommended national-scale setting
+            # and the >= 1.8x input-bytes point
+            ("dl0-bf1-nb1-q1", True, False, True, False),
+            ("dl1-bf0-nb1-pk1", False, True, False, True),
+            ("dl0-bf0-nb1-q1-pk1", True, False, False, True),
+        ):
+            specs.append(ProgramSpec(
+                entry="size_agents", variant=variant,
+                build=partial(_size_agents_bound, True, dl, bf,
+                              quant, pack),
+                anchor=sz_anchor, cost=True,
+            ))
 
     # bill kernels (XLA engine pinned: the audit fingerprints must not
     # depend on which backend happens to trace them)
@@ -473,13 +564,27 @@ def build_registry(grid: str = "default") -> List[ProgramSpec]:
     )
     for layout_on, bf in kernel_points:
         is_base = (layout_on, bf) == (False, False)
+        # the daylight point carries a cost fingerprint too: the
+        # packed entry below diffs against it (the gather + night pass
+        # leave the per-call program)
         specs.append(ProgramSpec(
             entry="import_sums",
             variant=f"layout{int(layout_on)}-bf{int(bf)}",
             build=partial(_import_sums_bound, layout_on, bf),
-            anchor=k_anchor, cost=is_base,
+            anchor=k_anchor,
+            cost=is_base or (layout_on, bf) == (True, False),
         ))
     if grid == "default":
+        specs.append(ProgramSpec(
+            entry="import_sums", variant="layout0-bf0-q1",
+            build=_import_sums_quant_bound,
+            anchor=k_anchor, cost=True,
+        ))
+        specs.append(ProgramSpec(
+            entry="import_sums", variant="layout1-bf0-pk1",
+            build=_import_sums_packed_bound,
+            anchor=k_anchor, cost=True,
+        ))
         specs.append(ProgramSpec(
             entry="import_sums_pair", variant="layout0-bf0",
             build=_import_sums_pair_bound,

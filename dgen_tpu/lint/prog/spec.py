@@ -129,6 +129,14 @@ class ProgramAudit:
     const_bytes: int
     oversized_consts: List[Tuple[tuple, str, int]]   # (shape, dtype, nbytes)
     cost_analysis: Optional[Dict[str, float]]        # cost entries only
+    #: total bytes of the program's traced PARAMETERS (sum of invar
+    #: aval bytes) — the static kernel-input-traffic term: int8
+    #: quantized banks shrink it ~4x per stream and packed streams
+    #: swap the raw + gathered pair for the gathered lanes alone.
+    #: XLA:CPU's bytes_accessed cannot see either (its cost model is
+    #: dominated by the f32 VMEM-resident intermediates that never
+    #: touch HBM on TPU), so J6 gates this alongside it.
+    input_bytes: int = 0
     error: Optional[str] = None    # build/lower failure (itself a finding)
     #: mesh-tier analysis (meshaudit.MeshInfo) — J7-J10 inputs; None on
     #: single-device audits and on identity-only mesh cross-checks
@@ -235,6 +243,7 @@ def lower_spec(
                 sb.fn.trace(*sb.args, **sb.kwargs).lower().as_text()
             )
         cost = None
+        input_bytes = 0
         if with_cost and spec.cost:
             ca = lowered.compile().cost_analysis()
             if isinstance(ca, (list, tuple)):
@@ -244,6 +253,13 @@ def lower_spec(
                 "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
                 "transcendentals": float(ca.get("transcendentals", 0.0)),
             }
+            for v in closed.jaxpr.invars:
+                aval = getattr(v, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    input_bytes += int(
+                        np.prod(aval.shape, dtype=np.int64)
+                        * np.dtype(aval.dtype).itemsize
+                    )
         mesh_info = None
         if spec.mesh_shape is not None and spec.expect_same_as is None:
             # identity-only mesh cross-checks (expect_same_as) are J5's
@@ -264,6 +280,7 @@ def lower_spec(
             fingerprint=fp, steady_fingerprint=steady_fp,
             const_bytes=total, oversized_consts=oversized,
             cost_analysis=cost, mesh=mesh_info,
+            input_bytes=input_bytes,
             hlo_text=text if keep_text else None,
         )
     except Exception as e:  # noqa: BLE001 — a spec that cannot even

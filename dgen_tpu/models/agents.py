@@ -100,10 +100,32 @@ class ProfileBank:
     load: jax.Array       # [L, 8760] normalized to sum 1.0
     solar_cf: jax.Array   # [S, 8760] kWh per kW_dc per hour
     wholesale: jax.Array  # [R, 8760] $/kWh wholesale price by region
+    #: int8 quantized banks (RunConfig.quant_banks): per-row f32
+    #: dequant factors for ``load`` / ``solar_cf`` when those carry
+    #: int8 codes (real value = scale[row] * code); None = unquantized.
+    #: The wholesale/sell stream is never quantized (it mixes with f32
+    #: tariff TOU prices per agent; see billpallas.sell_rate_hourly).
+    load_scale: jax.Array = None
+    solar_cf_scale: jax.Array = None
 
     @property
     def hours(self) -> int:
         return self.load.shape[1]
+
+
+def quantize_rows(bank) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-row int8 quantization of a [R, 8760] profile bank:
+    ``codes = rint(x / scale)`` with ``scale = rowmax(|x|) / 127``
+    (all-zero rows get scale 1.0, so dequantization is exact zero).
+    Exact zeros stay exact zeros — the daylight-compaction premise
+    (gen == 0 off-daylight) survives quantization."""
+    x = np.asarray(bank, np.float32)
+    amax = np.max(np.abs(x), axis=1)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(
+        np.rint(x / scale[:, None]), -127, 127
+    ).astype(np.int8)
+    return q, scale
 
 
 def pad_to_multiple(n: int, multiple: int) -> int:

@@ -179,15 +179,30 @@ def build_econ_inputs(
         if rate_switch else None
     )
 
-    # multipliers are cast to the bank dtype BEFORE the product so bf16
-    # profile banks (RunConfig.bf16_banks) stay bf16 through the
-    # gathered [N, 8760] streams — a f32 multiplier would silently
-    # promote them and forfeit the halved HBM footprint (no-op for the
-    # default f32 banks)
-    bdt = profiles.load.dtype
-    load = profiles.load[table.load_idx] * \
-        ya.load_kwh_per_customer[:, None].astype(bdt)
-    gen_per_kw = profiles.solar_cf[table.cf_idx]
+    # int8 quantized banks (RunConfig.quant_banks): gather the CODES
+    # and fold the per-agent load multiplier into the gathered dequant
+    # scale instead of the stream — the [N, 8760] hot-loop streams stay
+    # one byte per hour end to end (ops.sizing dequantizes only at the
+    # f32 precision floors)
+    quant = profiles.load_scale is not None
+    if quant:
+        load = profiles.load[table.load_idx]
+        load_scale = (
+            profiles.load_scale[table.load_idx] * ya.load_kwh_per_customer
+        )
+        gen_per_kw = profiles.solar_cf[table.cf_idx]
+        gen_scale = profiles.solar_cf_scale[table.cf_idx]
+    else:
+        # multipliers are cast to the bank dtype BEFORE the product so
+        # bf16 profile banks (RunConfig.bf16_banks) stay bf16 through
+        # the gathered [N, 8760] streams — a f32 multiplier would
+        # silently promote them and forfeit the halved HBM footprint
+        # (no-op for the default f32 banks)
+        bdt = profiles.load.dtype
+        load = profiles.load[table.load_idx] * \
+            ya.load_kwh_per_customer[:, None].astype(bdt)
+        gen_per_kw = profiles.solar_cf[table.cf_idx]
+        load_scale = gen_scale = None
     # Net-billing sell rate = this year's wholesale price x retail
     # multiplier (reference financial_functions.py:182; wholesale
     # itself is merged per year, elec.py:608)
@@ -227,6 +242,8 @@ def build_econ_inputs(
         switch_min_kw=table.switch_min_kw,
         switch_max_kw=table.switch_max_kw,
         batt_rt_eff=ya.batt_rt_eff,
+        load_scale=load_scale,
+        gen_scale=gen_scale,
     )
 
 
@@ -358,6 +375,9 @@ _LIVE_HOUR_ARRAYS_ALL_NEM = 6
 #: envelope's hour arrays stay 4-byte — the int32 period stream plus
 #: the f32 dispatch trace (the SOC recursion upcasts; ops.sizing)
 _LIVE_HOUR_ARRAYS_F32 = 2
+#: under int8 quantized banks, the load/gen code streams + their month
+#: repacks ride at ONE byte/hour (sell keeps the bank float dtype)
+_LIVE_HOUR_ARRAYS_QUANT = 4
 _HBM_RESERVE_FRAC = 0.2        # compiler scratch / fragmentation
 
 
@@ -384,6 +404,7 @@ def _per_agent_step_bytes(
     net_billing: bool = True,
     rate_switch: bool = False,
     bank_bf16: bool = False,
+    bank_quant: bool = False,
 ) -> int:
     """Modeled peak HBM bytes per agent of one streaming-chunk step —
     the single footprint model shared by the chunk chooser and the
@@ -396,6 +417,15 @@ def _per_agent_step_bytes(
     are stored at bank precision too (billpallas._sums_out_dtype:
     bf16 in -> bf16 out) — the default configuration models ~1.8x
     fewer bytes per agent, and the auto chunk grows to match.
+
+    ``bank_quant`` (RunConfig.quant_banks): the load/gen-derived
+    streams (:data:`_LIVE_HOUR_ARRAYS_QUANT`, the gathered codes plus
+    their month repacks) drop to ONE byte per hour; the sell stream
+    keeps the bank float dtype (2 with bf16, else 4), the f32 floor
+    grows by the dequantized dispatch-load copy, and the candidate
+    sums store f32 (int8 in -> f32 out). Models roughly half the
+    bf16 per-agent bytes in the default configuration — the auto
+    chunk roughly doubles again.
     """
     from dgen_tpu.ops.billpallas import B_PAD, H_PAD, _round8
 
@@ -410,11 +440,23 @@ def _per_agent_step_bytes(
             hour_arrays += _LIVE_HOUR_ARRAYS_RATE_SWITCH
             kernel_outs += 1     # second tariff's [r_pad, B_PAD] sums
     f32_floor = _LIVE_HOUR_ARRAYS_F32
+    if bank_quant:
+        f32_floor += 1           # the dequantized dispatch-load copy
     if with_hourly:
         hour_arrays += _LIVE_HOUR_ARRAYS_HOURLY
         f32_floor += _LIVE_HOUR_ARRAYS_HOURLY
-    if bank_bf16:
-        f32_floor = min(f32_floor, hour_arrays)
+    f32_floor = min(f32_floor, hour_arrays)
+    bank_b = 2 if bank_bf16 else 4
+    if bank_quant:
+        one_b = min(_LIVE_HOUR_ARRAYS_QUANT, hour_arrays - f32_floor)
+        hour_bytes = (
+            4 * f32_floor + 1 * one_b
+            + bank_b * (hour_arrays - f32_floor - one_b)
+        )
+        # int8 alone -> f32 sums; composed with bf16 banks the sums
+        # store at the bf16 sell stream's precision (_sums_out_dtype)
+        out_bytes = 2 if bank_bf16 else 4
+    elif bank_bf16:
         hour_bytes = 4 * f32_floor + 2 * (hour_arrays - f32_floor)
         out_bytes = 2
     else:
@@ -433,6 +475,7 @@ def auto_agent_chunk(
     net_billing: bool = True,
     rate_switch: bool = False,
     bank_bf16: bool = False,
+    bank_quant: bool = False,
 ) -> int:
     """Derive the per-device streaming chunk from the HBM budget.
 
@@ -449,6 +492,7 @@ def auto_agent_chunk(
         sizing_iters=sizing_iters, econ_years=econ_years,
         with_hourly=with_hourly, net_billing=net_billing,
         rate_switch=rate_switch, bank_bf16=bank_bf16,
+        bank_quant=bank_quant,
     )
     budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
     # persistent whole-table state ([N] outputs/carry, ~50 f32 fields)
@@ -527,13 +571,15 @@ def year_step_impl(
     agent_chunk: int = 0,
     net_billing: bool = True,
     daylight=None,
+    pack_once: bool = False,
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
 
     ``daylight``: optional billpallas.DaylightLayout (a hashable STATIC
     host constant, like the month layout it compacts) — the sizing
     search's import kernels run daylight-compacted; None keeps the
-    full-hour oracle path.
+    full-hour oracle path. ``pack_once``: gather the month-positional
+    candidate streams once per sizing call (RunConfig.pack_once).
 
     Mirrors the reference's per-year sequence (dgen_model.py:242-438):
     trajectory application -> sizing -> max market share -> (initial
@@ -581,6 +627,7 @@ def year_step_impl(
                 envs_c, n_periods=n_periods, n_years=econ_years,
                 n_iters=sizing_iters, keep_hourly=False, impl=sizing_impl,
                 mesh=mesh, net_billing=net_billing, daylight=daylight,
+                pack_once=pack_once,
             )
             return None, res_c
 
@@ -599,6 +646,7 @@ def year_step_impl(
             envs, n_periods=n_periods, n_years=econ_years,
             n_iters=sizing_iters, keep_hourly=with_hourly, impl=sizing_impl,
             mesh=mesh, net_billing=net_billing, daylight=daylight,
+            pack_once=pack_once,
         )
 
     # --- market step ---
@@ -681,10 +729,22 @@ def year_step_impl(
             def _hourly_chunk(acc, xs_c):
                 (li, ci, st, mk, lkpc, rt, kw, bkw, bkwh,
                  b_cnt, p_only, b_mix) = xs_c
-                load = profiles.load[li] * lkpc[:, None]
-                gen = profiles.solar_cf[ci] * (
-                    kw * sizing_ops.INV_EFF
-                )[:, None]
+                if profiles.load_scale is not None:
+                    # int8 quantized banks: rematerialize the f32
+                    # profiles via the per-row dequant scales (the
+                    # keep_hourly floor stays f32, ops.sizing rule)
+                    load = profiles.load[li].astype(jnp.float32) * (
+                        profiles.load_scale[li] * lkpc
+                    )[:, None]
+                    gen = profiles.solar_cf[ci].astype(jnp.float32) * (
+                        profiles.solar_cf_scale[ci]
+                        * kw * sizing_ops.INV_EFF
+                    )[:, None]
+                else:
+                    load = profiles.load[li] * lkpc[:, None]
+                    gen = profiles.solar_cf[ci] * (
+                        kw * sizing_ops.INV_EFF
+                    )[:, None]
                 dr = jax.vmap(dispatch_ops.dispatch_battery)(
                     load, gen, bkw, bkwh, rt
                 )
@@ -788,6 +848,7 @@ YEAR_STEP_STATIC_ARGNAMES = (
     "n_periods", "econ_years", "sizing_iters", "first_year",
     "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
     "rate_switch", "mesh", "agent_chunk", "net_billing", "daylight",
+    "pack_once",
 )
 
 #: the jitted one-year program. The cross-year carry is threaded
@@ -973,17 +1034,47 @@ class Simulation:
                     billpallas.H_MONTHS / self._daylight.n_lanes,
                 )
 
+        # int8 quantized banks (config-gated): the load/gen streams
+        # shrink to one byte per hour with per-row f32 dequant scales;
+        # kernels fold the scales into the candidate grid and upcast +
+        # accumulate in f32 (ops.billpallas._quant_fold). Quantized
+        # AFTER the daylight layout (built from the f32 bank) and
+        # BEFORE any bf16 conversion — exact zeros stay exact zeros,
+        # so the night-lane premise survives.
+        if self.run_config.quant_banks:
+            from dgen_tpu.models.agents import quantize_rows
+
+            lq, ls = quantize_rows(np.asarray(profiles.load))
+            cq, cs = quantize_rows(np.asarray(profiles.solar_cf))
+            profiles = dataclasses.replace(
+                profiles,
+                load=jnp.asarray(lq), solar_cf=jnp.asarray(cq),
+                load_scale=jnp.asarray(ls),
+                solar_cf_scale=jnp.asarray(cs),
+            )
+            logger.info(
+                "int8 quantized profile banks: load/gen streams at "
+                "1 byte/hour (+%d per-row f32 scales)",
+                ls.size + cs.size,
+            )
+
         # bf16 profile banks (config-gated): halve the HBM-resident
         # banks AND the gathered O(N*8760) per-agent streams; kernels
-        # upcast to f32 on read (ops.billpallas)
+        # upcast to f32 on read (ops.billpallas). Applied per STREAM
+        # field — int8 code banks pass through untouched and the f32
+        # dequant scales deliberately stay full precision
         if self.run_config.bf16_banks:
-            profiles = jax.tree.map(
-                lambda x: (
-                    x.astype(jnp.bfloat16)
-                    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
-                    else jnp.asarray(x)
-                ),
+            def _to_bf16(x):
+                x = jnp.asarray(x)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    return x.astype(jnp.bfloat16)
+                return x
+
+            profiles = dataclasses.replace(
                 profiles,
+                load=_to_bf16(profiles.load),
+                solar_cf=_to_bf16(profiles.solar_cf),
+                wholesale=_to_bf16(profiles.wholesale),
             )
 
         # state-local shard layout (the reference's per-state task
@@ -1003,6 +1094,7 @@ class Simulation:
                 net_billing=self._net_billing,
                 rate_switch=self._rate_switch,
                 bank_bf16=self.run_config.bf16_banks,
+                bank_quant=self.run_config.quant_banks,
             )
             if chunk:
                 logger.info(
@@ -1124,12 +1216,16 @@ class Simulation:
             with_hourly=self.with_hourly,
             storage_enabled=self.scenario.storage_enabled,
             year_step_len=float(self.scenario.year_step),
-            sizing_impl="auto",
+            sizing_impl=(
+                "pallas_stream" if self.run_config.stream_segments
+                else "auto"
+            ),
             rate_switch=self._rate_switch,
             mesh=self.mesh,
             agent_chunk=self._agent_chunk,
             net_billing=self._net_billing,
             daylight=self._daylight,
+            pack_once=self.run_config.pack_once,
         )
 
     #: legacy private alias — internal call sites (and tests that
@@ -1163,6 +1259,7 @@ class Simulation:
             net_billing=self._net_billing,
             rate_switch=self._rate_switch,
             bank_bf16=self.run_config.bf16_banks,
+            bank_quant=self.run_config.quant_banks,
         )
         modeled = rows * per_agent + n_local * 50 * 4
         rec = {

@@ -171,6 +171,30 @@ class DaylightLayout:
     def n_lanes(self) -> int:
         return int(sum(self.seg_lens))
 
+    def uniform(self) -> "DaylightLayout":
+        """This layout with every month padded to the LONGEST month's
+        segment length — the uniform-block form the segment-streaming
+        engine needs (:func:`_sums_pallas_stream` pipelines one
+        fixed-shape (agent-block x month-segment) grid; variable
+        ``seg_lens`` would change the block shape per grid step).
+        Costs ``12 * max(seg_lens) - n_lanes`` extra zero lanes; still
+        compacted whenever any month is shorter than the longest."""
+        seg = max(self.seg_lens)
+        if all(s == seg for s in self.seg_lens):
+            return self
+        idx = np.zeros(MONTHS * seg, np.int32)
+        valid = np.zeros(MONTHS * seg, np.float32)
+        off = 0
+        for m, ln in enumerate(self.seg_lens):
+            cnt = int(np.sum(self.valid[off:off + ln]))
+            idx[m * seg:m * seg + cnt] = self.idx[off:off + cnt]
+            valid[m * seg:m * seg + cnt] = 1.0
+            off += ln
+        return DaylightLayout(
+            idx=idx, valid=valid, night=self.night.copy(),
+            seg_lens=(seg,) * MONTHS,
+        )
+
 
 def daylight_layout(gen_bank: np.ndarray) -> Optional[DaylightLayout]:
     """Union-daylight compacted layout from a [*, 8760] generation
@@ -213,7 +237,7 @@ def _seg_offsets(seg_lens) -> tuple:
     return tuple(offs)
 
 
-def _sums_out_dtype(load, gen):
+def _sums_out_dtype(load, gen, sell=None):
     """Engine output dtype rule: bf16 banks in -> bf16 bucket sums out.
 
     The [N, R, B_PAD] candidate sums are the other O(N*R) HBM term of
@@ -223,8 +247,16 @@ def _sums_out_dtype(load, gen):
     stays f32 in VMEM; only the stored result is bank-precision. The
     battery forward pass mixes a f32 dispatch trace into ``gen`` and
     therefore keeps f32 sums automatically.
+
+    int8 quantized banks alone keep f32 sums (the codes carry no
+    storage dtype to mirror); composed with bf16 banks (``sell`` at
+    bf16 — the recommended national-scale setting) the sums store
+    bf16, by the same bank-precision argument.
     """
     if load.dtype == jnp.bfloat16 and gen.dtype == jnp.bfloat16:
+        return jnp.bfloat16
+    if (load.dtype == jnp.int8 and sell is not None
+            and sell.dtype == jnp.bfloat16):
         return jnp.bfloat16
     return jnp.float32
 
@@ -523,8 +555,183 @@ def _month_repack(arrays, idx=None, valid=None):
     return out
 
 
-def _sums_pallas(load, gen, sell, bucket_id, scales, *, with_signed,
-                 n_periods=None, bf16=False, layout=None):
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PackedStreams:
+    """Month-positional candidate-kernel inputs, gathered ONCE.
+
+    ``_size_agents_fast`` calls the bucket-sums engines up to three
+    times per year (two refine rounds + the battery forward run), and
+    every call used to re-gather the ``[N, 8760]`` hour streams into
+    the month-padded lane layout before re-reading them from HBM.
+    This pytree is that repack done once per :func:`~dgen_tpu.ops.
+    sizing.size_agents` call (``RunConfig.pack_once``): the engines
+    consume the pre-packed lanes directly and, under a
+    :class:`DaylightLayout`, reuse the candidate-independent night
+    bucket sums instead of recomputing them per call.
+
+    All leaves are TRACED arrays (``[N, L]`` lanes at bank dtype —
+    bf16/int8 banks stay narrow through the pack); the static layout
+    contract (which ``seg_lens`` the lanes follow) still rides the
+    engines' hashable ``layout`` argument, and
+    :func:`_prep_positional` cross-checks the lane count against it.
+
+    ``sell_b``/``period_b``/``night_imp_b``: the second tariff
+    structure of a rate-switch population (``import_sums_pair``);
+    None otherwise. ``night_imp``/``night_imp_b`` are None for
+    full-hour layouts (no night lanes to add back).
+    """
+
+    load: jax.Array                          # [N, L]
+    gen: jax.Array                           # [N, L]
+    sell: jax.Array                          # [N, L]
+    period: jax.Array                        # [N, L] int32
+    night_imp: Optional[jax.Array] = None    # [N, B_PAD]
+    sell_b: Optional[jax.Array] = None
+    period_b: Optional[jax.Array] = None
+    night_imp_b: Optional[jax.Array] = None
+
+    def tariff_b(self) -> "PackedStreams":
+        """View of the SECOND tariff structure as a single-tariff pack
+        (the XLA pair fallback prices the two structures in two
+        independent passes)."""
+        return PackedStreams(
+            load=self.load, gen=self.gen, sell=self.sell_b,
+            period=self.period_b, night_imp=self.night_imp_b,
+        )
+
+
+def pack_streams(
+    load: jax.Array,       # [N, 8760]
+    gen: jax.Array,        # [N, 8760]
+    sell: jax.Array,       # [N, 8760]
+    bucket_id: jax.Array,  # [N, 8760] int32 month-major bucket ids
+    n_buckets: int,
+    layout: Optional[DaylightLayout] = None,
+    sell_b: Optional[jax.Array] = None,
+    bucket_b: Optional[jax.Array] = None,
+) -> PackedStreams:
+    """Build the pack-once stream bundle for the candidate engines.
+
+    ``layout`` must be the SAME static layout later passed to the
+    engine calls that consume the pack (None = full-hour month-padded
+    lanes). Night import sums are precomputed here for compacted
+    layouts — once per pack instead of once per engine call."""
+    n_periods = n_buckets // MONTHS
+    idx = None if layout is None else layout.idx
+    valid = None if layout is None else layout.valid
+    period = (bucket_id % n_periods).astype(jnp.int32)
+    arrays = [load, gen, sell, period]
+    if sell_b is not None:
+        period_b = (bucket_b % n_periods).astype(jnp.int32)
+        arrays += [sell_b, period_b]
+    packed = [a[:, 0, :] for a in _month_repack(arrays, idx, valid)]
+    night_imp = night_imp_b = None
+    if layout is not None:
+        night_imp, _ = _night_sums(
+            load, sell, bucket_id, layout.night, n_periods, False)
+        if sell_b is not None:
+            night_imp_b, _ = _night_sums(
+                load, sell_b, bucket_b, layout.night, n_periods, False)
+    return PackedStreams(
+        load=packed[0], gen=packed[1], sell=packed[2], period=packed[3],
+        night_imp=night_imp,
+        sell_b=packed[4] if sell_b is not None else None,
+        period_b=packed[5] if sell_b is not None else None,
+        night_imp_b=night_imp_b,
+    )
+
+
+def _prep_positional(load, gen, sell, bucket_id, n_periods, layout,
+                     packed):
+    """Shared engine input prep: month-positional [N, L] streams.
+
+    ``packed`` given: consume its lanes (cross-checking the lane count
+    against the static layout); a non-None raw ``gen`` alongside a
+    pack is the battery forward run's fresh dispatch stream and is
+    repacked here (full-hour layouts only — the battery breaks the
+    night-zero premise, so callers never combine it with a compacted
+    pack). ``packed`` None: gather per call (the legacy path)."""
+    segs = FULL_SEG_LENS if layout is None else layout.seg_lens
+    h_lanes = sum(segs)
+    idx = None if layout is None else layout.idx
+    valid = None if layout is None else layout.valid
+    if packed is not None:
+        if packed.load.shape[-1] != h_lanes:
+            raise ValueError(
+                f"packed streams carry {packed.load.shape[-1]} lanes "
+                f"but the engine layout expects {h_lanes}; build them "
+                "with pack_streams(..., layout=<the same layout>)"
+            )
+        if gen is None:
+            gen_p = packed.gen
+        else:
+            if layout is not None:
+                raise ValueError(
+                    "a fresh gen stream cannot ride a daylight-"
+                    "compacted pack (battery output is nonzero at "
+                    "night); price it full-hour"
+                )
+            (gen3,) = _month_repack((gen,), idx, valid)
+            gen_p = gen3[:, 0, :]
+        return packed.load, gen_p, packed.sell, packed.period
+    period = (bucket_id % n_periods).astype(jnp.int32)
+    load_p, gen_p, sell_p, period_p = _month_repack(
+        (load, gen, sell, period), idx, valid)
+    return (load_p[:, 0, :], gen_p[:, 0, :], sell_p[:, 0, :],
+            period_p[:, 0, :])
+
+
+def _night_for(load, sell, bucket_id, layout, n_periods, with_signed,
+               packed):
+    """(night_imports, night_signed) to add back, honoring a pack's
+    precomputed sums. The signed+compacted+packed combination has no
+    caller (bucket_sums never takes a layout) and is rejected."""
+    if layout is None:
+        return None, None
+    if packed is not None:
+        if with_signed:
+            raise ValueError(
+                "packed streams carry import night sums only; the "
+                "signed engine must repack (no caller needs this)"
+            )
+        return packed.night_imp, None
+    return _night_sums(load, sell, bucket_id, layout.night, n_periods,
+                       with_signed)
+
+
+def _quant_fold(scales, load_scale, gen_scale):
+    """int8 quantized banks: fold the per-agent dequant scales into the
+    candidate scale grid so the kernels run UNCHANGED in quantized
+    units. With real load = ls*ql and real gen = gs*qg (ql/qg the int8
+    codes, upcast on read):
+
+        relu(ls*ql - s*gs*qg) = ls * relu(ql - (s*gs/ls)*qg)
+
+    — every bucket column and the sell-weighted column scale uniformly
+    by ``ls`` (sell is never quantized, so its factor rides the same
+    ``ls``). Returns (effective scales, per-agent post factor); the
+    post factor is applied by :func:`_quant_unfold` AFTER the engine
+    (outside shard_map — a cheap [N, R, B] elementwise). ``ls == 0``
+    (an identically-zero load row) is floored inside the fold and
+    zeroed exactly by the post multiply."""
+    if load_scale is None:
+        return scales, None
+    safe = jnp.maximum(load_scale, jnp.float32(1e-20))
+    return scales * (gen_scale / safe)[:, None], load_scale
+
+
+def _quant_unfold(outs, post):
+    if post is None:
+        return outs
+    return tuple(
+        (o.astype(jnp.float32) * post[:, None, None]).astype(o.dtype)
+        for o in outs
+    )
+
+
+def _sums_pallas(load, gen, sell, bucket_id, scales, packed=None, *,
+                 with_signed, n_periods=None, bf16=False, layout=None):
     """Month-blocked masked-reduction engine (see _kernel_month).
 
     ``bucket_id`` must be the canonical month-major layout
@@ -541,20 +748,18 @@ def _sums_pallas(load, gen, sell, bucket_id, scales, *, with_signed,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n = load.shape[0]
+    n = scales.shape[0]
     r = scales.shape[1]
     r_pad = _round8(r)
     segs = FULL_SEG_LENS if layout is None else layout.seg_lens
     h_lanes = sum(segs)
     r_chunk = _pick_r_chunk(r_pad, with_signed, max(segs))
-    out_dtype = _sums_out_dtype(load, gen)
 
-    period = (bucket_id % n_periods).astype(jnp.int32)
-    load_p, gen_p, sell_p, period_p = _month_repack(
-        (load, gen, sell, period),
-        None if layout is None else layout.idx,
-        None if layout is None else layout.valid,
-    )
+    load_2d, gen_2d, sell_2d, period_2d = _prep_positional(
+        load, gen, sell, bucket_id, n_periods, layout, packed)
+    out_dtype = _sums_out_dtype(load_2d, gen_2d, sell_2d)
+    load_p, gen_p, sell_p, period_p = (
+        a[:, None, :] for a in (load_2d, gen_2d, sell_2d, period_2d))
     scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
 
     out3 = lambda i: (i, 0, 0)
@@ -587,8 +792,223 @@ def _sums_pallas(load, gen, sell, bucket_id, scales, *, with_signed,
     outs = tuple(o[:, :r] for o in outs)
     if layout is None:
         return outs
-    night_i, night_s = _night_sums(
-        load, sell, bucket_id, layout.night, n_periods, with_signed)
+    night_i, night_s = _night_for(
+        load, sell, bucket_id, layout, n_periods, with_signed, packed)
+    add = lambda o, nn: (
+        o.astype(jnp.float32) + nn[:, None, :]).astype(out_dtype)
+    if with_signed:
+        return (add(outs[0], night_i), add(outs[1], night_s))
+    return (add(outs[0], night_i),)
+
+
+def _pick_block_n(n: int, dtype=None) -> int:
+    """Agents per stream-engine block. 8 sublanes is the f32 native
+    tile; int8 streams prefer 32 (the int8 min sublane tile) when the
+    agent count allows. Always a divisor of ``n``."""
+    prefs = (32, 16, 8, 4, 2, 1) if dtype == jnp.int8 else (8, 4, 2, 1)
+    for b in prefs:
+        if n % b == 0:
+            return b
+    return 1
+
+
+def _pick_r_chunk_stream(r_pad: int, block_n: int, seg: int,
+                         with_signed: bool, n_periods: int) -> int:
+    """Largest multiple-of-8 scales chunk whose [block_n, r_chunk, seg]
+    working set (net + pos + masked temporaries) fits the stream
+    engine's VMEM budget NET of the fixed residents: the
+    double-buffered stream blocks, the [block_n, r_pad, B_PAD] output
+    block(s), and the [12, P, block_n, r_pad] accumulator scratch —
+    all of which stay live across every r-chunk."""
+    n_out = 2 if with_signed else 1
+    resident = (
+        2 * 4 * block_n * seg * 4                       # 2x4 stream bufs
+        + n_out * block_n * r_pad * B_PAD * 4           # output block(s)
+        + n_out * (MONTHS * n_periods + 1) * block_n * r_pad * 4  # acc
+    )
+    live = 4 if with_signed else 3
+    budget = max(10_000_000 - resident, 1_000_000)
+    r_chunk = min(r_pad, 512)
+    while r_chunk > 8 and live * 4 * block_n * r_chunk * seg > budget:
+        r_chunk //= 2
+    r_chunk = _round8(r_chunk)
+    while r_pad % r_chunk:
+        r_chunk -= 8
+    return max(r_chunk, 8)
+
+
+def _kernel_stream(scales_ref, load_ref, gen_ref, sell_ref, period_ref,
+                   *rest, r_pad, r_chunk, n_periods, with_signed,
+                   block_n):
+    """(agent-block x month-segment) grid step: ``block_n`` agents,
+    ONE month segment.
+
+    The month axis is the inner (fastest-varying) grid dimension, so
+    the Pallas pipeline double-buffers the stream blocks: the DMA of
+    month ``m+1``'s [block_n, seg] lanes overlaps compute on month
+    ``m`` — the whole agent stream is never resident at once (the
+    grid=(n,) kernels hold all 12 months in VMEM and serialize the
+    fetch ahead of the program). Partial bucket sums live in VMEM
+    scratch across the segment steps:
+
+      * ``acc`` [12, P, block_n, r_pad] — each (month, period) tile is
+        written exactly once (bucket columns are per-month); the
+        month index is the leading scratch dim so the per-step write
+        is a cheap dynamic-slice on rows, never on lanes;
+      * ``sell_acc`` [block_n, r_pad] — the sell-weighted sum is the
+        one cross-month accumulation (zeroed at m == 0);
+      * the [block_n, r_pad, B_PAD] output block keeps the
+        ``_kernel_month`` layout and is assembled once, on the last
+        segment step (its block index is month-invariant, so Pallas
+        keeps it resident across the inner axis).
+
+    Math is ``_kernel_month``'s: per-period masked row reductions with
+    the last period by subtraction from the month total (same f32
+    cancellation envelope), f32 accumulation, upcast-on-read inputs
+    (bf16 or int8 quantized banks).
+    """
+    from jax.experimental import pallas as pl
+
+    nb = MONTHS * n_periods
+    if with_signed:
+        (out_i_ref, out_s_ref, acc_i, sell_i_acc,
+         acc_s, sell_s_acc) = rest
+    else:
+        out_i_ref, acc_i, sell_i_acc = rest
+        out_s_ref = acc_s = sell_s_acc = None
+    m = pl.program_id(1)
+
+    @pl.when(m == 0)
+    def _init():
+        sell_i_acc[...] = jnp.zeros_like(sell_i_acc)
+        if with_signed:
+            sell_s_acc[...] = jnp.zeros_like(sell_s_acc)
+
+    load = load_ref[...].astype(jnp.float32)       # [block_n, seg]
+    gen = gen_ref[...].astype(jnp.float32)
+    sell = sell_ref[...].astype(jnp.float32)
+    period = period_ref[...]
+    scales_all = scales_ref[...]                   # [block_n, r_pad]
+
+    for r0 in range(0, r_pad, r_chunk):
+        scales = scales_all[:, r0:r0 + r_chunk]
+        net = (load[:, None, :]
+               - scales[:, :, None] * gen[:, None, :])
+        pos = jnp.maximum(net, 0.0)                # [bn, rc, seg]
+        sell_i_acc[:, r0:r0 + r_chunk] = (
+            sell_i_acc[:, r0:r0 + r_chunk]
+            + jnp.sum(pos * sell[:, None, :], axis=2))
+        rem_i = jnp.sum(pos, axis=2)
+        if with_signed:
+            sell_s_acc[:, r0:r0 + r_chunk] = (
+                sell_s_acc[:, r0:r0 + r_chunk]
+                + jnp.sum(net * sell[:, None, :], axis=2))
+            rem_s = jnp.sum(net, axis=2)
+        for p in range(n_periods - 1):
+            mask = (period == p).astype(jnp.float32)[:, None, :]
+            s_pm = jnp.sum(pos * mask, axis=2)
+            acc_i[m, p, :, r0:r0 + r_chunk] = s_pm
+            rem_i = rem_i - s_pm
+            if with_signed:
+                sgn_pm = jnp.sum(net * mask, axis=2)
+                acc_s[m, p, :, r0:r0 + r_chunk] = sgn_pm
+                rem_s = rem_s - sgn_pm
+        acc_i[m, n_periods - 1, :, r0:r0 + r_chunk] = rem_i
+        if with_signed:
+            acc_s[m, n_periods - 1, :, r0:r0 + r_chunk] = rem_s
+
+    @pl.when(m == pl.num_programs(1) - 1)
+    def _emit():
+        fill = jnp.zeros((block_n, r_pad, B_PAD - nb - 1), jnp.float32)
+
+        def assemble(acc, sell_acc):
+            acc_v = acc[...]                      # [12, P, bn, r_pad]
+            sell_v = sell_acc[...]
+            body = jnp.stack(
+                [acc_v[mm, p]
+                 for mm in range(MONTHS) for p in range(n_periods)],
+                axis=2,
+            )                                     # [bn, r_pad, nb]
+            return jnp.concatenate(
+                [body, fill, sell_v[:, :, None]], axis=2)
+
+        out_i_ref[...] = assemble(acc_i, sell_i_acc).astype(
+            out_i_ref.dtype)
+        if with_signed:
+            out_s_ref[...] = assemble(acc_s, sell_s_acc).astype(
+                out_s_ref.dtype)
+
+
+def _sums_pallas_stream(load, gen, sell, bucket_id, scales, packed=None,
+                        *, with_signed, n_periods=None, bf16=False,
+                        layout=None, interpret=False):
+    """Segment-streaming engine (see :func:`_kernel_stream`): an
+    (agent-block x month-segment) grid whose inner axis Pallas
+    double-buffers, so HBM reads of segment m+1 overlap compute on m.
+
+    Requires UNIFORM month segments (the full-hour 768-lane layout, or
+    a :meth:`DaylightLayout.uniform` compacted one — callers resolve
+    that before passing ``layout``). ``interpret`` runs the kernel in
+    the Pallas interpreter (the CPU parity-test path — Mosaic only
+    lowers on TPU)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = scales.shape[0]
+    r = scales.shape[1]
+    r_pad = _round8(r)
+    segs = FULL_SEG_LENS if layout is None else layout.seg_lens
+    if len(set(segs)) != 1:
+        raise ValueError(
+            "the stream engine needs uniform month segments; pass "
+            "layout.uniform() (and pack against it)"
+        )
+    seg = segs[0]
+
+    load_2d, gen_2d, sell_2d, period_2d = _prep_positional(
+        load, gen, sell, bucket_id, n_periods, layout, packed)
+    out_dtype = _sums_out_dtype(load_2d, gen_2d, sell_2d)
+    block_n = _pick_block_n(n, load_2d.dtype)
+    r_chunk = _pick_r_chunk_stream(r_pad, block_n, seg, with_signed,
+                                   n_periods)
+    scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))
+
+    n_out = 2 if with_signed else 1
+    stream_spec = pl.BlockSpec(
+        (block_n, seg), lambda i, m: (i, m), memory_space=pltpu.VMEM)
+    acc = pltpu.VMEM((MONTHS, n_periods, block_n, r_pad), jnp.float32)
+    sell_acc = pltpu.VMEM((block_n, r_pad), jnp.float32)
+    outs = pl.pallas_call(
+        partial(_kernel_stream, r_pad=r_pad, r_chunk=r_chunk,
+                n_periods=n_periods, with_signed=with_signed,
+                block_n=block_n),
+        grid=(n // block_n, MONTHS),
+        in_specs=[
+            pl.BlockSpec((block_n, r_pad), lambda i, m: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ] + [stream_spec] * 4,
+        out_specs=[
+            pl.BlockSpec((block_n, r_pad, B_PAD), lambda i, m: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+        ] * n_out,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, r_pad, B_PAD), out_dtype)
+        ] * n_out,
+        scratch_shapes=[acc, sell_acc] * n_out,
+        cost_estimate=pl.CostEstimate(
+            flops=(4 + 2 * n_periods) * n_out * n * r_pad * seg * MONTHS,
+            bytes_accessed=(
+                4 * n * seg * MONTHS * load_2d.dtype.itemsize
+                + n * r_pad * B_PAD * 4),
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(scales_p, load_2d, gen_2d, sell_2d, period_2d)
+    outs = tuple(o[:, :r] for o in outs)
+    if layout is None:
+        return outs
+    night_i, night_s = _night_for(
+        load, sell, bucket_id, layout, n_periods, with_signed, packed)
     add = lambda o, nn: (
         o.astype(jnp.float32) + nn[:, None, :]).astype(out_dtype)
     if with_signed:
@@ -597,7 +1017,7 @@ def _sums_pallas(load, gen, sell, bucket_id, scales, *, with_signed,
 
 
 def _sums_pallas_pair(load, gen, sell_a, bucket_a, sell_b, bucket_b,
-                      scales, *, n_periods, layout=None):
+                      scales, packed=None, *, n_periods, layout=None):
     """Fused two-tariff imports engine (see _kernel_month_pair):
     (imports_a, imports_b), each [N, R, B_PAD]. Accepts the same
     optional static DaylightLayout as :func:`_sums_pallas` (night sums
@@ -605,24 +1025,34 @@ def _sums_pallas_pair(load, gen, sell_a, bucket_a, sell_b, bucket_b,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n = load.shape[0]
+    n = scales.shape[0]
     r = scales.shape[1]
     r_pad = _round8(r)
     segs = FULL_SEG_LENS if layout is None else layout.seg_lens
     h_lanes = sum(segs)
     r_chunk = _pick_r_chunk(r_pad, with_signed=True,
                             max_seg=max(segs))  # 2 mask sets live
-    out_dtype = _sums_out_dtype(load, gen)
 
-    load_p, gen_p, sell_a_p, period_a_p, sell_b_p, period_b_p = (
-        _month_repack(
-            (load, gen,
-             sell_a, (bucket_a % n_periods).astype(jnp.int32),
-             sell_b, (bucket_b % n_periods).astype(jnp.int32)),
-            None if layout is None else layout.idx,
-            None if layout is None else layout.valid,
+    if packed is not None:
+        load_2d, gen_2d, sell_a_2d, period_a_2d = _prep_positional(
+            load, gen, sell_a, bucket_a, n_periods, layout, packed)
+        sell_b_2d, period_b_2d = packed.sell_b, packed.period_b
+    else:
+        idx = None if layout is None else layout.idx
+        valid = None if layout is None else layout.valid
+        (load_2d, gen_2d, sell_a_2d, period_a_2d, sell_b_2d,
+         period_b_2d) = (
+            a[:, 0, :] for a in _month_repack(
+                (load, gen,
+                 sell_a, (bucket_a % n_periods).astype(jnp.int32),
+                 sell_b, (bucket_b % n_periods).astype(jnp.int32)),
+                idx, valid,
+            )
         )
-    )
+    out_dtype = _sums_out_dtype(load_2d, gen_2d, sell_a_2d)
+    (load_p, gen_p, sell_a_p, period_a_p, sell_b_p, period_b_p) = (
+        a[:, None, :] for a in (load_2d, gen_2d, sell_a_2d,
+                                period_a_2d, sell_b_2d, period_b_2d))
     scales_p = jnp.pad(scales, ((0, 0), (0, r_pad - r)))[:, None, :]
 
     out3 = lambda i: (i, 0, 0)
@@ -650,10 +1080,13 @@ def _sums_pallas_pair(load, gen, sell_a, bucket_a, sell_b, bucket_b,
     outs = tuple(o[:, :r] for o in outs)
     if layout is None:
         return outs
-    night_a, _ = _night_sums(
-        load, sell_a, bucket_a, layout.night, n_periods, False)
-    night_b, _ = _night_sums(
-        load, sell_b, bucket_b, layout.night, n_periods, False)
+    if packed is not None:
+        night_a, night_b = packed.night_imp, packed.night_imp_b
+    else:
+        night_a, _ = _night_sums(
+            load, sell_a, bucket_a, layout.night, n_periods, False)
+        night_b, _ = _night_sums(
+            load, sell_b, bucket_b, layout.night, n_periods, False)
     add = lambda o, nn: (
         o.astype(jnp.float32) + nn[:, None, :]).astype(out_dtype)
     return (add(outs[0], night_a), add(outs[1], night_b))
@@ -705,8 +1138,8 @@ def _sums_pallas_dot(load, gen, sell, bucket_id, scales, with_signed,
     return tuple(o[:, :r] for o in outs)
 
 
-def _sums_xla(load, gen, sell, bucket_id, scales, *, n_buckets,
-              with_signed, layout=None):
+def _sums_xla(load, gen, sell, bucket_id, scales, packed=None, *,
+              n_buckets, with_signed, layout=None):
     """Pure-XLA twin (CPU tests, sharded runs): one [N, H] pass per
     scale via lax.map, bucketed with per-period masked matmuls against
     the SHARED month one-hot — no per-agent [H, B] one-hot is ever
@@ -725,10 +1158,11 @@ def _sums_xla(load, gen, sell, bucket_id, scales, *, n_buckets,
     from dgen_tpu.ops.bill import monthly_period_sums
 
     n_periods = n_buckets // MONTHS
-    hour_period = (bucket_id % n_periods).astype(jnp.int32)
-    n = load.shape[0]
+    n = scales.shape[0]
 
-    if layout is None:
+    if layout is None and packed is None:
+        hour_period = (bucket_id % n_periods).astype(jnp.int32)
+
         def bucketize(x):  # [N, H] -> [N, B] month-major
             mp = jax.vmap(
                 lambda row, hp: monthly_period_sums(row, hp, n_periods)
@@ -736,17 +1170,22 @@ def _sums_xla(load, gen, sell, bucket_id, scales, *, n_buckets,
             return mp.reshape(n, n_buckets)
 
         load_c, gen_c, sell_c = load, gen, sell
+        out_dtype = _sums_out_dtype(load, gen, sell)
     else:
-        # compact gather (static numpy indices — constant-folded);
-        # float lanes zeroed beyond each month's daylight count, the
+        # month-positional lanes: the compacted daylight gather, or a
+        # pack-once bundle (which may be full-hour month-padded). The
+        # gather indices are static numpy — constant-folded; float
+        # lanes are zeroed beyond each month's real-hour count, the
         # hour->month map positional
-        month_of_lane = np.repeat(
-            np.arange(MONTHS), layout.seg_lens)              # [Hc] static
+        segs = FULL_SEG_LENS if layout is None else layout.seg_lens
+        month_of_lane = np.repeat(np.arange(MONTHS), segs)   # static
         onehot_c = np.eye(MONTHS, dtype=np.float32)[month_of_lane]
-        idx, valid = layout.idx, layout.valid
-        vf = lambda a: a[:, idx].astype(jnp.float32) * valid[None, :]
-        load_c, gen_c, sell_c = vf(load), vf(gen), vf(sell)
-        period_c = hour_period[:, idx]
+        load_2d, gen_2d, sell_2d, period_c = _prep_positional(
+            load, gen, sell, bucket_id, n_periods, layout, packed)
+        out_dtype = _sums_out_dtype(load_2d, gen_2d, sell_2d)
+        load_c = load_2d.astype(jnp.float32)
+        gen_c = gen_2d.astype(jnp.float32)
+        sell_c = sell_2d.astype(jnp.float32)
 
         def bucketize(x):  # [N, Hc] -> [N, B] month-major
             cols = [
@@ -766,12 +1205,8 @@ def _sums_xla(load, gen, sell, bucket_id, scales, *, n_buckets,
         return ((imports, imp_sell),)
 
     outs = jax.lax.map(per_scale, jnp.swapaxes(scales, 0, 1))
-    if layout is None:
-        nights = (None, None)
-    else:
-        nights = _night_sums(
-            load, sell, bucket_id, layout.night, n_periods, with_signed)
-    out_dtype = _sums_out_dtype(load, gen)
+    nights = _night_for(
+        load, sell, bucket_id, layout, n_periods, with_signed, packed)
     result = []
     for (buckets, sell_sum), night_o in zip(outs, nights):
         o = jnp.swapaxes(buckets, 0, 1)                      # [N, R, B]
@@ -783,9 +1218,23 @@ def _sums_xla(load, gen, sell, bucket_id, scales, *, n_buckets,
     return tuple(result)
 
 
+def _reject_packed_for_dot(packed) -> None:
+    """The legacy pallas_dot A/B engine is a full-hour reference and
+    never consumes packed streams — one guard shared by every engine
+    wrapper so the contract cannot drift per call site."""
+    if packed is not None:
+        raise ValueError("pallas_dot is a full-hour A/B reference and "
+                         "does not consume packed streams")
+
+
 def _resolve_impl(impl: str) -> str:
     if impl == "auto":
         return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas_stream" and jax.default_backend() != "tpu":
+        # Mosaic only lowers on TPU; elsewhere the XLA twin is the
+        # stream engine's math oracle (RunConfig.stream_segments can
+        # therefore stay on in configs that sometimes run on CPU)
+        return "xla"
     return impl
 
 
@@ -838,7 +1287,7 @@ SUMS_STATIC_ARGNAMES = ("n_buckets", "impl", "bf16", "mesh", "layout")
 
 @partial(jax.jit, static_argnames=SUMS_STATIC_ARGNAMES)
 def import_sums(
-    load: jax.Array,      # [N, 8760]
+    load: jax.Array,      # [N, 8760] (None when ``packed`` carries it)
     gen: jax.Array,       # [N, 8760]
     sell: jax.Array,      # [N, 8760]
     bucket_id: jax.Array,  # [N, 8760] int32 in [0, n_buckets)
@@ -848,6 +1297,9 @@ def import_sums(
     bf16: bool = False,
     mesh=None,
     layout: Optional[DaylightLayout] = None,
+    packed: Optional[PackedStreams] = None,
+    load_scale: Optional[jax.Array] = None,   # [N] int8 dequant scales
+    gen_scale: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(imports [N,R,B], imp_sell [N,R]): positive-part bucket sums and
     the sell-weighted positive-part sum for R net-load scales.
@@ -858,22 +1310,39 @@ def import_sums(
     sums are added back; totals cover ALL hours either way. Valid only
     when ``gen`` is zero off-daylight (true for any bank-derived
     generation the layout was built from); the legacy ``pallas_dot``
-    engine ignores it (full-hour A/B reference)."""
+    engine ignores it (full-hour A/B reference).
+
+    ``packed``: optional :class:`PackedStreams` built against the same
+    ``layout`` — the engine then skips the per-call repack gather
+    (pass the raw stream arguments as None so jit sees one copy).
+    ``load_scale``/``gen_scale``: per-agent f32 dequant factors for
+    int8 quantized banks (:func:`_quant_fold`); the kernels run in
+    quantized units (f32 upcast + accumulate) and outputs rescale
+    once. ``impl="pallas_stream"`` selects the double-buffered
+    (agent-block x month-segment) engine on TPU (XLA twin elsewhere)."""
     _check_buckets(n_buckets)
     resolved = _resolve_impl(impl)
+    scales_eff, post = _quant_fold(scales, load_scale, gen_scale)
     if resolved == "pallas":
         fn = partial(_sums_pallas, with_signed=False,
                      n_periods=n_buckets // MONTHS, bf16=bf16,
                      layout=layout)
+    elif resolved == "pallas_stream":
+        fn = partial(_sums_pallas_stream, with_signed=False,
+                     n_periods=n_buckets // MONTHS, bf16=bf16,
+                     layout=layout)
     elif resolved == "pallas_dot":
         # full-hour engine; results are identical totals either way
+        _reject_packed_for_dot(packed)
         fn = partial(_sums_pallas_dot, with_signed=False, bf16=bf16)
     else:
         fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=False,
                      layout=layout)
-    (imp,) = _maybe_shard_agents(fn, mesh, 1)(
-        load, gen, sell, bucket_id, scales
-    )
+    args = (load, gen, sell, bucket_id, scales_eff)
+    if packed is not None:
+        args = args + (packed,)
+    (imp,) = _maybe_shard_agents(fn, mesh, 1, n_in=len(args))(*args)
+    (imp,) = _quant_unfold((imp,), post)
     return imp[:, :, :n_buckets], imp[:, :, SELL_COL]
 
 
@@ -892,34 +1361,52 @@ def import_sums_pair(
     impl: str = "auto",
     mesh=None,
     layout: Optional[DaylightLayout] = None,
+    packed: Optional[PackedStreams] = None,
+    load_scale: Optional[jax.Array] = None,
+    gen_scale: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """(imports_a [N,R,B], imp_sell_a [N,R], imports_b, imp_sell_b):
     the rate-switch search's two tariff structures priced over ONE
     shared ``relu(load - s*gen)`` grid (reference apply_rate_switch,
     agent_mutation/elec.py:838-845) — ~40% faster than two
     :func:`import_sums` calls on TPU because the net build dominates.
-    ``layout`` as in :func:`import_sums` (night sums are added per
-    tariff structure)."""
+    ``layout``/``packed``/``load_scale`` as in :func:`import_sums`
+    (night sums are added per tariff structure; a pack built with
+    ``sell_b``/``bucket_b`` carries both). The stream engine has no
+    fused-pair form — ``impl="pallas_stream"`` keeps the pair on the
+    month kernel (still one shared net grid)."""
     _check_buckets(n_buckets)
     resolved = _resolve_impl(impl)
-    if resolved == "pallas":
+    scales_eff, post = _quant_fold(scales, load_scale, gen_scale)
+    if resolved in ("pallas", "pallas_stream"):
         fn = partial(_sums_pallas_pair, n_periods=n_buckets // MONTHS,
                      layout=layout)
-        imp_a, imp_b = _maybe_shard_agents(fn, mesh, 2, n_in=7)(
-            load, gen, sell_a, bucket_a, sell_b, bucket_b, scales
+        args = (load, gen, sell_a, bucket_a, sell_b, bucket_b,
+                scales_eff)
+        if packed is not None:
+            args = args + (packed,)
+        imp_a, imp_b = _maybe_shard_agents(fn, mesh, 2, n_in=len(args))(
+            *args
         )
     else:
         # XLA twin / dot engine: two independent single-tariff passes
         # (the fusion is a TPU-kernel optimization, not a semantic one)
         if resolved == "pallas_dot":
+            _reject_packed_for_dot(packed)
             fa = partial(_sums_pallas_dot, with_signed=False)
         else:
             fa = partial(_sums_xla, n_buckets=n_buckets,
                          with_signed=False, layout=layout)
-        (imp_a,) = _maybe_shard_agents(fa, mesh, 1)(
-            load, gen, sell_a, bucket_a, scales)
-        (imp_b,) = _maybe_shard_agents(fa, mesh, 1)(
-            load, gen, sell_b, bucket_b, scales)
+        args_a = (load, gen, sell_a, bucket_a, scales_eff)
+        args_b = (load, gen, sell_b, bucket_b, scales_eff)
+        if packed is not None:
+            args_a = args_a + (packed,)
+            args_b = args_b + (packed.tariff_b(),)
+        (imp_a,) = _maybe_shard_agents(fa, mesh, 1, n_in=len(args_a))(
+            *args_a)
+        (imp_b,) = _maybe_shard_agents(fa, mesh, 1, n_in=len(args_b))(
+            *args_b)
+    imp_a, imp_b = _quant_unfold((imp_a, imp_b), post)
     return (imp_a[:, :, :n_buckets], imp_a[:, :, SELL_COL],
             imp_b[:, :, :n_buckets], imp_b[:, :, SELL_COL])
 
@@ -936,20 +1423,36 @@ def bucket_sums(
     n_buckets: int,
     impl: str = "auto",
     mesh=None,
+    packed: Optional[PackedStreams] = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """(signed [N,R,B], imports [N,R,B], export_credit [N,R]) — the full
-    reduction set (battery forward runs, tests)."""
+    reduction set (battery forward runs, tests).
+
+    ``packed``: an optional FULL-HOUR :class:`PackedStreams` whose
+    load/sell/period lanes are reused while ``gen`` (the battery-
+    modified output, not a scale of the gen bank) is repacked fresh —
+    the battery forward run then gathers one stream instead of four.
+    Compacted packs are rejected (a discharging battery breaks the
+    night-zero premise), and quantized packs never reach here (the
+    battery path prices dequantized f32 streams)."""
     _check_buckets(n_buckets)
     resolved = _resolve_impl(impl)
     if resolved == "pallas":
         fn = partial(_sums_pallas, with_signed=True,
                      n_periods=n_buckets // MONTHS)
+    elif resolved == "pallas_stream":
+        fn = partial(_sums_pallas_stream, with_signed=True,
+                     n_periods=n_buckets // MONTHS)
     elif resolved == "pallas_dot":
+        _reject_packed_for_dot(packed)
         fn = partial(_sums_pallas_dot, with_signed=True)
     else:
         fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=True)
-    imp, signed = _maybe_shard_agents(fn, mesh, 2)(
-        load, gen, sell, bucket_id, scales
+    args = (load, gen, sell, bucket_id, scales)
+    if packed is not None:
+        args = args + (packed,)
+    imp, signed = _maybe_shard_agents(fn, mesh, 2, n_in=len(args))(
+        *args
     )
     # exports = relu(-net) reductions = imports - signed (columnwise)
     credit = imp[:, :, SELL_COL] - signed[:, :, SELL_COL]

@@ -87,6 +87,12 @@ class AgentEconInputs:
     #: (year-dependent batt_tech trajectory, reference elec.py:319);
     #: None -> the dispatch default
     batt_rt_eff: jax.Array = None
+    #: int8 quantized banks (RunConfig.quant_banks): when set, ``load``
+    #: and ``gen_per_kw`` carry int8 codes and these [N] f32 factors
+    #: dequantize them (real load = load_scale * load; the per-agent
+    #: load multiplier is already folded in). None = unquantized.
+    load_scale: jax.Array = None
+    gen_scale: jax.Array = None
 
 
 def net_hourly_profiles(
@@ -338,7 +344,7 @@ def size_one_agent(
 @partial(
     jax.jit,
     static_argnames=("n_periods", "n_years", "n_iters", "keep_hourly", "impl",
-                     "mesh", "net_billing", "daylight"),
+                     "mesh", "net_billing", "daylight", "pack_once"),
 )
 def _size_agents_fast(
     envs: AgentEconInputs,
@@ -350,6 +356,7 @@ def _size_agents_fast(
     mesh=None,
     net_billing: bool = True,
     daylight=None,
+    pack_once: bool = False,
 ) -> SizingResult:
     """Table-level sizing via two refining candidate-grid rounds.
 
@@ -367,17 +374,43 @@ def _size_agents_fast(
     f32 = jnp.float32
     k = max(int(n_iters), 4)
 
-    # f32 accumulation even under bf16 profile banks (8760-term sum)
-    naep = jnp.sum(envs.gen_per_kw.astype(f32), axis=1)           # [N]
+    # the stream engine pipelines uniform (agent-block x month-segment)
+    # blocks; a compacted layout is padded to its longest month once,
+    # here, so the pack and every engine call agree on the lane map
+    if impl == "pallas_stream" and daylight is not None:
+        daylight = daylight.uniform()
+
+    # int8 quantized banks (RunConfig.quant_banks): the candidate
+    # kernels consume the int8 codes directly (the per-agent scales
+    # fold into the candidate grid, billpallas._quant_fold); the
+    # precision floors below — linear_sums, the battery SOC recursion,
+    # naep, keep_hourly profiles — price DEQUANTIZED f32 streams, the
+    # same rule bf16 banks follow
+    quant = envs.load_scale is not None
+    if quant:
+        gen_scale_eff = envs.gen_scale * INV_EFF                  # [N]
+        gen_shape = envs.gen_per_kw                               # codes
+        gen_f32 = envs.gen_per_kw.astype(f32) * gen_scale_eff[:, None]
+        load_f32 = envs.load.astype(f32) * envs.load_scale[:, None]
+        naep = jnp.sum(envs.gen_per_kw.astype(f32), axis=1) * envs.gen_scale
+    else:
+        gen_scale_eff = None
+        gen_shape = envs.gen_per_kw * INV_EFF                     # [N, H]
+        gen_f32 = gen_shape.astype(f32)
+        # f32 dispatch/profile floor even under bf16 banks (the SOC
+        # recursion compounds rounding over 8760 steps)
+        load_f32 = envs.load.astype(f32)
+        # f32 accumulation even under bf16 profile banks (8760-term sum)
+        naep = jnp.sum(envs.gen_per_kw.astype(f32), axis=1)       # [N]
+
     max_system = envs.load_kwh_per_customer / jnp.maximum(naep, 1e-9)
     lo = max_system * SIZE_LO_FRAC
     hi = max_system * SIZE_HI_FRAC
-    # NEM system-size limit caps the bracket while NEM is active
+    # NEM system-size limit caps the sizing bracket while NEM is active
     # (reference nem_system_kw_limit, elec.py:92-119)
     hi = jnp.minimum(hi, envs.nem_kw_cap)
     lo = jnp.minimum(lo, hi)
 
-    gen_shape = envs.gen_per_kw * INV_EFF                         # [N, H]
     n_buckets = 12 * n_periods
     # with-system bills price on the DG-rate-switched tariff_w only for
     # candidates inside the per-agent switch window; the counterfactual
@@ -396,9 +429,11 @@ def _size_agents_fast(
     df = (1.0 - envs.pv_degradation[:, None]) ** yr               # [N, Y]
 
     # once per call: the linear bill structure (NEM + export credit)
-    # on the with-system tariff
+    # on the with-system tariff (dequantized f32 floor under quant)
+    lin_load = load_f32 if quant else envs.load
+    lin_gen = gen_f32 if quant else gen_shape
     lin = billpallas.linear_sums(
-        envs.load, gen_shape, sell, tw.hour_period, n_periods
+        lin_load, lin_gen, sell, tw.hour_period, n_periods
     )
 
     # no-system bills: scale 0 through the linear path on the ORIGINAL
@@ -409,7 +444,7 @@ def _size_agents_fast(
     else:
         sell_wo = billpallas.sell_rate_hourly(envs.tariff, envs.ts_sell)
         lin_wo = billpallas.linear_sums(
-            envs.load, gen_shape, sell_wo, envs.tariff.hour_period, n_periods
+            lin_load, lin_gen, sell_wo, envs.tariff.hour_period, n_periods
         )
     imp0 = lin_wo[0][:, None, :]       # imports at s=0 == S_load buckets
     bills_wo = billpallas.bills_linear_nb(
@@ -451,6 +486,22 @@ def _size_agents_fast(
         if has_switch else bucket
     )
 
+    # pack-once (RunConfig.pack_once): ONE repack gather (+ one night-
+    # sums pass under a daylight layout) feeds both refine rounds —
+    # and, below, the battery forward run when the layouts line up —
+    # instead of each engine call re-gathering the [N, 8760] streams.
+    # Skipped for all-NEM programs (no candidate kernel runs at all).
+    packed = None
+    if pack_once and net_billing:
+        packed = billpallas.pack_streams(
+            envs.load, gen_shape, sell, bucket, n_buckets,
+            layout=daylight,
+            sell_b=sell_wo if has_switch else None,
+            bucket_b=bucket_wo if has_switch else None,
+        )
+    kq = dict(load_scale=envs.load_scale,
+              gen_scale=gen_scale_eff) if quant else {}
+
     def candidate_bills(scales):
         """[N, R] packed (candidate, year) scales -> with-system annual
         bills on a given tariff structure; evaluated on the switched
@@ -467,10 +518,13 @@ def _size_agents_fast(
                 return bills_sw, None
             return bills_sw, billpallas.bills_linear_nem(
                 lin_wo, scales, envs.tariff, n_periods)
+        none_if_packed = lambda a: None if packed is not None else a
         if not has_switch:
             imports, imp_sell = billpallas.import_sums(
-                envs.load, gen_shape, sell, bucket, scales, n_buckets,
-                impl, mesh=mesh, layout=daylight,
+                none_if_packed(envs.load), none_if_packed(gen_shape),
+                none_if_packed(sell), none_if_packed(bucket), scales,
+                n_buckets, impl, mesh=mesh, layout=daylight,
+                packed=packed, **kq,
             )
             return billpallas.bills_linear_nb(
                 lin, imports, imp_sell, scales, tw, n_periods
@@ -480,8 +534,11 @@ def _size_agents_fast(
         # dominates; see billpallas.import_sums_pair)
         imports, imp_sell, imports_o, imp_sell_o = (
             billpallas.import_sums_pair(
-                envs.load, gen_shape, sell, bucket, sell_wo, bucket_wo,
+                none_if_packed(envs.load), none_if_packed(gen_shape),
+                none_if_packed(sell), none_if_packed(bucket),
+                none_if_packed(sell_wo), none_if_packed(bucket_wo),
                 scales, n_buckets, impl, mesh=mesh, layout=daylight,
+                packed=packed, **kq,
             )
         )
         bills_sw = billpallas.bills_linear_nb(
@@ -540,7 +597,7 @@ def _size_agents_fast(
     kw_star = take(g2, i2)
 
     # --- PV-only outputs at kW* (select the winning candidate) ---
-    gen_n = gen_shape * kw_star[:, None]
+    gen_n = gen_f32 * kw_star[:, None]
     bills_w_n = jnp.take_along_axis(
         bills2, i2[:, None, None], axis=1
     )[:, 0, :]                                                    # [N, Y]
@@ -554,9 +611,8 @@ def _size_agents_fast(
         jnp.full(n, dispatch_ops.DEFAULT_RT_EFF, f32)
         if envs.batt_rt_eff is None else envs.batt_rt_eff
     )
-    # f32 dispatch even under bf16 banks: the SOC recursion compounds
-    # rounding over 8760 steps
-    load_f32 = envs.load.astype(f32)
+    # f32 dispatch even under bf16/int8 banks: the SOC recursion
+    # compounds rounding over 8760 steps (load_f32 dequantized above)
     dr = jax.vmap(dispatch_ops.dispatch_battery)(
         load_f32, gen_n, batt_kw, batt_kwh, rt_eff
     )
@@ -579,10 +635,23 @@ def _size_agents_fast(
     else:
         tariff_star, bucket_star, sell_star = tw, bucket, sell
     # battery-modified output is not a scale of gen_shape; use the full
-    # bucket-sums kernel with per-year degradation scales
+    # bucket-sums kernel with per-year degradation scales. Quantized
+    # runs price the battery on the DEQUANTIZED f32 load (one call per
+    # year; the SOC output is f32 anyway). The pack-once bundle is
+    # reusable here only when its load/sell/period match this call —
+    # full-hour lanes (no daylight compaction: a discharging battery
+    # breaks the night-zero premise), one tariff structure, no quant.
+    batt_load = load_f32 if quant else envs.load
+    batt_packed = (
+        packed if (packed is not None and daylight is None
+                   and not has_switch and not quant) else None
+    )
     s_b, i_b, c_b = billpallas.bucket_sums(
-        envs.load, dr.system_out, sell_star, bucket_star, df, n_buckets,
-        impl, mesh=mesh,
+        None if batt_packed is not None else batt_load,
+        dr.system_out,
+        None if batt_packed is not None else sell_star,
+        None if batt_packed is not None else bucket_star,
+        df, n_buckets, impl, mesh=mesh, packed=batt_packed,
     )
     bills_w_b = billpallas.bills_from_sums(
         s_b, i_b, c_b, tariff_star, n_periods
@@ -634,6 +703,7 @@ def size_agents(
     mesh=None,
     net_billing: bool = True,
     daylight=None,
+    pack_once: bool = False,
 ) -> SizingResult:
     """Sizing over the whole agent table (leading axis).
 
@@ -651,8 +721,17 @@ def size_agents(
     search-round import kernels run over the compacted daylight lanes
     only (night sums added back; the battery forward run always prices
     full-hour, since a discharging battery breaks the night-zero
-    premise).
+    premise). ``pack_once``: gather the month-positional candidate
+    streams once per call (:class:`billpallas.PackedStreams`) instead
+    of once per engine call — the refine rounds (and, where the
+    layouts line up, the battery run) then read pre-packed lanes.
     """
+    if envs.load_scale is not None and not fast:
+        raise ValueError(
+            "int8 quantized banks (AgentEconInputs.load_scale) are a "
+            "fast-path representation; the per-agent oracle prices "
+            "full-precision streams — dequantize or use fast=True"
+        )
     if (envs.nem_kw_cap is None or envs.switch_min_kw is None
             or envs.switch_max_kw is None):
         n = envs.load.shape[0]
@@ -675,6 +754,7 @@ def size_agents(
             envs, n_periods=n_periods, n_years=n_years, n_iters=n_iters,
             keep_hourly=keep_hourly, impl=impl, mesh=mesh,
             net_billing=net_billing, daylight=daylight,
+            pack_once=pack_once,
         )
     fn = partial(
         size_one_agent,

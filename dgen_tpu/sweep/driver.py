@@ -94,6 +94,7 @@ def sweep_year_step(
     agent_chunk: int = 0,
     net_billing: bool = True,
     daylight=None,
+    pack_once: bool = False,
 ):
     """One model year for S scenarios as a single device program: the
     un-jitted :func:`year_step_impl` vmapped over the scenario axis of
@@ -111,6 +112,7 @@ def sweep_year_step(
             year_step_len=year_step_len, sizing_impl=sizing_impl,
             rate_switch=rate_switch, mesh=mesh, agent_chunk=agent_chunk,
             net_billing=net_billing, daylight=daylight,
+            pack_once=pack_once,
         )
 
     return jax.vmap(one)(inputs_s, carry)
@@ -196,6 +198,7 @@ class SweepSimulation:
             with_hourly=with_hourly, econ_years=econ_years,
             sizing_iters=self.run_config.sizing_iters,
             bank_bf16=self.run_config.bf16_banks,
+            bank_quant=self.run_config.quant_banks,
             mesh=mesh,
             max_vmap_scenarios=max_vmap_scenarios,
         )

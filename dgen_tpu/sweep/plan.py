@@ -96,6 +96,7 @@ def plan_sweep(
     econ_years: int = 25,
     sizing_iters: int = 12,
     bank_bf16: bool = False,
+    bank_quant: bool = False,
     mesh=None,
     hbm_bytes: Optional[int] = -1,
     max_vmap_scenarios: Optional[int] = None,
@@ -143,6 +144,7 @@ def plan_sweep(
             sizing_iters=sizing_iters, econ_years=econ_years,
             with_hourly=with_hourly, net_billing=nb,
             rate_switch=rate_switch, bank_bf16=bank_bf16,
+            bank_quant=bank_quant,
         )
         for nb in by_flag
     )
@@ -163,6 +165,7 @@ def plan_sweep(
                     econ_years=econ_years, with_hourly=with_hourly,
                     hbm_bytes=hbm_bytes, net_billing=nb,
                     rate_switch=rate_switch, bank_bf16=bank_bf16,
+                    bank_quant=bank_quant,
                 )
                 if c:
                     chunk = c if chunk is None else min(chunk, c)
@@ -188,6 +191,7 @@ def plan_sweep(
                     econ_years=econ_years, with_hourly=with_hourly,
                     hbm_bytes=hbm_bytes, net_billing=nb,
                     rate_switch=rate_switch, bank_bf16=bank_bf16,
+                    bank_quant=bank_quant,
                 )
                 if c:
                     chunk = c if chunk is None else min(chunk, c)

@@ -13,15 +13,20 @@
 //
 // File format "DGPB1\0":
 //   [0:6)   magic "DGPB1\0"
-//   [6:8)   dtype code (u16 little-endian): 0 = f32, 1 = bf16
+//   [6:8)   dtype code (u16 little-endian): 0 = f32, 1 = bf16,
+//           2 = int8 quantized (per-row f32 scale sidecar)
 //   [8:16)  rows (u64 LE)
 //   [16:24) cols (u64 LE)
 //   [24:..) row-major payload
+//   dtype 2 only: payload is followed by rows f32 little-endian
+//           per-row dequantization scales (real = scale[r] * code)
 //
 // bf16 banks (dtype 1) halve the on-disk and mmap footprint of the
-// 8760-hour profile banks; the Python face converts to/from
-// ml_dtypes.bfloat16 and the TPU runtime consumes them natively
-// (RunConfig.bf16_banks).
+// 8760-hour profile banks; int8 banks (dtype 2) quarter it — the
+// at-rest companions of RunConfig.bf16_banks / RunConfig.quant_banks.
+// The Python face converts to/from ml_dtypes.bfloat16 and quantizes /
+// dequantizes int8 (io/store.py); the TPU runtime consumes both
+// natively.
 //
 // C ABI only (consumed via ctypes; no pybind11 in this image).
 
@@ -48,14 +53,23 @@ struct Handle {
   size_t map_len = 0;
   uint64_t rows = 0;
   uint64_t cols = 0;
-  uint16_t dtype = 0;  // 0 = f32, 1 = bf16
+  uint16_t dtype = 0;  // 0 = f32, 1 = bf16, 2 = int8 + scale sidecar
 };
 
 thread_local std::string g_err;
 
 void set_err(const std::string& e) { g_err = e; }
 
-size_t elem_size(uint16_t dtype) { return dtype == 1 ? 2 : 4; }
+size_t elem_size(uint16_t dtype) {
+  if (dtype == 1) return 2;
+  if (dtype == 2) return 1;
+  return 4;
+}
+
+// dtype-2 files append rows f32 per-row scales after the payload.
+size_t sidecar_bytes(uint16_t dtype, uint64_t rows) {
+  return dtype == 2 ? rows * 4 : 0;
+}
 
 }  // namespace
 
@@ -64,11 +78,12 @@ extern "C" {
 const char* dg_last_error() { return g_err.c_str(); }
 
 // Write a row-major matrix as a DGPB1 file; dtype 0 = f32 payload,
-// 1 = bf16 payload (caller supplies already-converted bytes).
-// Returns 0 on success.
+// 1 = bf16 payload, 2 = int8 payload immediately followed by rows
+// f32 per-row scales (caller supplies the already-converted,
+// already-concatenated bytes). Returns 0 on success.
 int dg_store_write2(const char* path, const void* data, uint64_t rows,
                     uint64_t cols, int dtype) {
-  if (dtype != 0 && dtype != 1) {
+  if (dtype != 0 && dtype != 1 && dtype != 2) {
     set_err("unsupported dtype code");
     return -1;
   }
@@ -78,12 +93,12 @@ int dg_store_write2(const char* path, const void* data, uint64_t rows,
     return -1;
   }
   uint16_t dt = static_cast<uint16_t>(dtype);
-  size_t es = elem_size(dt);
+  size_t body = rows * cols * elem_size(dt) + sidecar_bytes(dt, rows);
   bool ok = std::fwrite(kMagic, 1, 6, f) == 6 &&
             std::fwrite(&dt, 2, 1, f) == 1 &&
             std::fwrite(&rows, 8, 1, f) == 1 &&
             std::fwrite(&cols, 8, 1, f) == 1 &&
-            std::fwrite(data, es, rows * cols, f) == rows * cols;
+            std::fwrite(data, 1, body, f) == body;
   if (std::fclose(f) != 0) ok = false;
   if (!ok) {
     set_err("short write");
@@ -129,13 +144,15 @@ void* dg_store_open(const char* path, uint64_t* rows, uint64_t* cols) {
   std::memcpy(&h->dtype, base + 6, 2);
   std::memcpy(&h->rows, base + 8, 8);
   std::memcpy(&h->cols, base + 16, 8);
-  if (h->dtype != 0 && h->dtype != 1) {
+  if (h->dtype != 0 && h->dtype != 1 && h->dtype != 2) {
     set_err("unsupported dtype code");
     munmap(map, st.st_size);
     delete h;
     return nullptr;
   }
-  if (kHeader + h->rows * h->cols * elem_size(h->dtype) > h->map_len) {
+  if (kHeader + h->rows * h->cols * elem_size(h->dtype) +
+          sidecar_bytes(h->dtype, h->rows) >
+      h->map_len) {
     set_err("truncated payload");
     munmap(map, st.st_size);
     delete h;
@@ -155,6 +172,16 @@ const float* dg_store_data(void* handle) {
   auto* h = static_cast<Handle*>(handle);
   return reinterpret_cast<const float*>(
       static_cast<const char*>(h->map) + kHeader);
+}
+
+// Per-row f32 scale sidecar of a dtype-2 (int8 quantized) bank —
+// the bytes right after the payload. Null for other dtypes. The
+// returned pointer is NOT alignment-guaranteed (payload length is
+// arbitrary); callers must copy bytewise.
+const void* dg_store_scales(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  if (h->dtype != 2) return nullptr;
+  return static_cast<const char*>(h->map) + kHeader + h->rows * h->cols;
 }
 
 void dg_store_close(void* handle) {

@@ -262,6 +262,49 @@ def test_golden_daylight_compact_parity(golden_run):
     )
 
 
+def test_golden_quant_banks_within_tolerance(golden_run):
+    """int8 quantized profile banks (+ pack-once and the stream
+    engine's XLA twin) against the f32 golden run: the documented
+    envelope is 2% on national adoption curves — the same bound as
+    bf16 banks (inputs round to 1/254 of each bank row's range; the
+    symmetric rounding largely cancels over 8760-hour sums, observed
+    ~0.5% on this fixture). The DEFAULT-config golden curves are
+    pinned separately (test_golden_adoption_curves) — this path is a
+    gated opt-in, never the oracle."""
+    pop, res_f, _ = golden_run
+    _, res_q = _rerun_golden(
+        pop, RunConfig(sizing_iters=8, quant_banks=True, pack_once=True,
+                       stream_segments=True))
+    mask = np.asarray(pop.table.mask)
+    s_f = res_f.summary(mask)
+    s_q = res_q.summary(mask)
+    for k in ("adopters", "system_kw_cum", "batt_kwh_cum"):
+        ref = np.maximum(np.abs(np.asarray(s_f[k], np.float64)), 1e-6)
+        rel = np.max(np.abs(s_q[k] - s_f[k]) / ref)
+        assert rel < 2e-2, f"{k}: int8 drift {rel:.3e} exceeds envelope"
+    for v in res_q.agent.values():
+        assert np.all(np.isfinite(v))
+
+
+def test_golden_pack_once_parity(golden_run):
+    """pack-once alone (no precision change anywhere — the gather is
+    hoisted, not altered): the golden run must agree with the default
+    path at the f32 re-association envelope, bit-for-bit on the
+    daylight layout's packed form (test_roofline) and <= 1e-5 relative
+    on national curves here (full-hour packs route the XLA twin
+    through the month-positional bucketize)."""
+    pop, res_f, _ = golden_run
+    _, res_p = _rerun_golden(
+        pop, RunConfig(sizing_iters=8, pack_once=True))
+    mask = np.asarray(pop.table.mask)
+    s_f = res_f.summary(mask)
+    s_p = res_p.summary(mask)
+    for k in ("adopters", "system_kw_cum", "batt_kwh_cum"):
+        ref = np.maximum(np.abs(np.asarray(s_f[k], np.float64)), 1e-6)
+        rel = np.max(np.abs(s_p[k] - s_f[k]) / ref)
+        assert rel < 1e-4, f"{k}: pack-once drift {rel:.3e}"
+
+
 def test_golden_bf16_banks_within_tolerance(golden_run):
     """bf16 profile banks against the f32 golden run: the documented
     envelope is 2% on national adoption curves (inputs carry ~0.4%

@@ -124,6 +124,71 @@ def test_bf16_bank_roundtrip(tmp_path, matrix):
         store.read_bank(p16b).view(np.uint16), got.view(np.uint16))
 
 
+def test_int8_bank_roundtrip(tmp_path, matrix):
+    """dtype code 2 (int8 + per-row f32 scale sidecar): quarter the
+    payload bytes, codes + scales roundtrip exactly, read_bank comes
+    back dequantized f32 inside the per-row step bound."""
+    p32 = str(tmp_path / "m32.bank")
+    p8 = str(tmp_path / "m8.bank")
+    store.write_bank(p32, matrix)
+    store.write_bank(p8, matrix, dtype="int8")
+    rows = matrix.shape[0]
+    assert os.path.getsize(p8) - 24 - 4 * rows == \
+        (os.path.getsize(p32) - 24) // 4
+    q, s = store.read_bank_raw(p8)
+    assert q.dtype == np.int8 and s.dtype == np.float32
+    assert s.shape == (rows,)
+    deq = store.read_bank(p8)
+    assert deq.dtype == np.float32
+    step = np.max(np.abs(matrix), axis=1) / 127.0
+    assert np.all(np.abs(deq - matrix) <= step[:, None] / 2 + 1e-7)
+    # already-quantized codes persist verbatim when scales are given
+    p8b = str(tmp_path / "m8b.bank")
+    store.write_bank(p8b, q, scales=s)
+    q2, s2 = store.read_bank_raw(p8b)
+    np.testing.assert_array_equal(q, q2)
+    np.testing.assert_array_equal(s, s2)
+    # ...and refuse to guess scales
+    with pytest.raises(ValueError, match="scales"):
+        store.write_bank(str(tmp_path / "x.bank"), q)
+
+
+def test_int8_bank_python_fallback_interchange(tmp_path, matrix,
+                                               monkeypatch):
+    """int8 banks written natively read back identically through the
+    pure-Python fallback and vice versa (sidecar included)."""
+    native = str(tmp_path / "native.bank")
+    store.write_bank(native, matrix, dtype="int8")
+    monkeypatch.setattr(store, "_lib", None)
+    monkeypatch.setattr(store, "_load_failed", True)
+    fallback = str(tmp_path / "fallback.bank")
+    store.write_bank(fallback, matrix, dtype="int8")
+    qa, sa = store.read_bank_raw(native)
+    qb, sb = store.read_bank_raw(fallback)
+    np.testing.assert_array_equal(qa, qb)
+    np.testing.assert_array_equal(sa, sb)
+
+
+def test_int8_truncated_sidecar_rejected(tmp_path, matrix, monkeypatch):
+    p = str(tmp_path / "t.bank")
+    store.write_bank(p, matrix, dtype="int8")
+    full = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(full[:-8])          # clip half the last scales
+    with pytest.raises(IOError):
+        store.read_bank_raw(p)
+    # python fallback rejects it too
+    monkeypatch.setattr(store, "_lib", None)
+    monkeypatch.setattr(store, "_load_failed", True)
+    with pytest.raises(IOError):
+        store.read_bank_raw(p)
+
+
+def test_unknown_dtype_error_names_int8(tmp_path, matrix):
+    with pytest.raises(ValueError, match=r"f32 \| bf16 \| int8"):
+        store.write_bank(str(tmp_path / "x.bank"), matrix, dtype="fp8")
+
+
 def test_bf16_bank_python_fallback_interchange(tmp_path, matrix, monkeypatch):
     """bf16 banks written natively read back identically through the
     pure-Python fallback and vice versa."""

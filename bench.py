@@ -93,6 +93,11 @@ Knobs (env):
                           restart count and agent-years/sec per
                           process count into the payload
                           (docs/resilience.md "Gang runbook")
+  DGEN_TPU_BENCH_SENTINEL 1: A/B the always-on numerical-health
+                          sentinel (models.health) — steady-state
+                          wall with vs without the per-year fused
+                          health reductions; stamps overhead_frac
+                          (contract: <=2%)
   DGEN_TPU_BENCH_ASYNC    1: A/B the background host-IO pipeline
                           (io.hostio) — the SAME export+checkpoint run
                           with the pipeline on vs the serialized
@@ -143,6 +148,8 @@ _BENCH_ASYNC = os.environ.get(
     "DGEN_TPU_BENCH_ASYNC", "") not in ("", "0", "false")
 _BENCH_FAULTS = os.environ.get(
     "DGEN_TPU_BENCH_FAULTS", "") not in ("", "0", "false")
+_BENCH_SENTINEL = os.environ.get(
+    "DGEN_TPU_BENCH_SENTINEL", "") not in ("", "0", "false")
 # "0"/"false" disable, same convention as the sibling flags above
 _BENCH_SERVE = os.environ.get("DGEN_TPU_BENCH_SERVE", "").strip()
 if _BENCH_SERVE in ("0", "false"):
@@ -443,6 +450,36 @@ def _cpu_baseline(sim, pop) -> float:
             jax.block_until_ready(out)
         dt = (time.time() - t0) / n_rep
     return 8.0 / dt  # 8 workers, 1 agent-year per sizing call
+
+
+def _sentinel_ab(n_agents: int) -> dict:
+    """A/B the always-on numerical-health sentinel (models.health):
+    steady-state per-year step wall with the fused health reductions
+    riding the host fetch vs the sentinel disabled.  The contract is
+    <=2% overhead — the summary is a few hundred bytes per year on top
+    of the existing batched D2H, and its reduction program runs off
+    the critical path."""
+    import dataclasses as _dc
+
+    sim, pop = _build(n_agents, 2030)
+
+    def _run(sentinel_on: bool) -> float:
+        sim.run_config = _dc.replace(
+            sim.run_config, health_sentinel=sentinel_on)
+        t0 = time.time()
+        sim.run(collect=True)
+        return time.time() - t0
+
+    _run(True)                      # compile warmup (both programs)
+    off_s = _run(False)
+    on_s = _run(True)
+    return {
+        "agents": n_agents,
+        "wall_off_s": round(off_s, 3),
+        "wall_on_s": round(on_s, 3),
+        "overhead_frac": round(on_s / max(off_s, 1e-9) - 1.0, 4),
+        "breaches": (sim.health_report or {}).get("breaches", {}),
+    }
 
 
 def _async_io_ab(n_agents: int) -> dict:
@@ -1269,6 +1306,23 @@ def main() -> None:
                 payload["async_io"] = _async_io_ab(n_agents)
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["async_io"] = {
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- health-sentinel overhead A/B (DGEN_TPU_BENCH_SENTINEL=1):
+    # step wall with vs without the per-year fused health reductions
+    # (models.health) — the contract is <=2% overhead on the golden
+    # configuration (docs/resilience.md "Data quarantine & health
+    # sentinel") ---
+    if _BENCH_SENTINEL:
+        if not spendable(point_est * 3):
+            skipped["sentinel"] = "budget"
+        else:
+            try:
+                payload["sentinel"] = _sentinel_ab(n_agents)
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["sentinel"] = {
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

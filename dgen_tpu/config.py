@@ -168,6 +168,33 @@ class RunConfig:
     #: the field unless something installs the registry. Env:
     #: DGEN_TPU_FAULTS.
     faults: Optional[str] = None
+    #: load-time bad-data validation (resilience.quarantine): host-side
+    #: schema/range/finiteness/reference checks over the agent table,
+    #: profile banks (incl. int8 quant sidecars) and tariff bank at
+    #: Simulation construction; malformed rows are QUARANTINED (rewritten
+    #: to inert padding, mask 0 — exact-zero contributions everywhere)
+    #: with a reasoned report instead of poisoning their whole state.
+    #: None (default) = on unless the DGEN_TPU_VALIDATE env kill switch
+    #: says 0; clean inputs are untouched (object identity), so the
+    #: default costs one host-side scan and changes nothing.
+    validate_inputs: Optional[bool] = None
+    #: always-on numerical-health sentinel (models.health): cheap fused
+    #: on-device reductions per year (nonfinite counts + gross bound
+    #: breaches on bills/NPV/market-share per leaf) riding the existing
+    #: host-IO fetch — works under the async pipeline, unlike
+    #: debug_invariants.  None (default) = on unless DGEN_TPU_SENTINEL
+    #: says 0.  Breaches WARN by default; see ``sentinel_escalate``.
+    health_sentinel: Optional[bool] = None
+    #: escalate sentinel breaches as HealthBreachError instead of
+    #: warning — the run supervisor's detect -> attribute -> quarantine
+    #: -> resume loop rides this (run_supervised turns it on unless
+    #: explicitly disabled).  None/False = warn only.
+    sentinel_escalate: Optional[bool] = None
+    #: stable agent ids to quarantine by fiat at Simulation construction
+    #: (applied on top of validation findings) — the supervisor's
+    #: sentinel escalation round-trips the attributed ids through here
+    #: so the re-entered attempt re-runs with the offenders contained
+    quarantine_ids: Optional[Tuple[int, ...]] = None
     seed: int = 0
 
     def __post_init__(self) -> None:
@@ -175,6 +202,11 @@ class RunConfig:
         _check(4 <= self.sizing_iters <= 64, "sizing_iters out of range")
         _check(self.agent_chunk is None or self.agent_chunk >= 0,
                "agent_chunk must be None (auto) or >= 0")
+        if self.quarantine_ids is not None:
+            _check(
+                all(int(a) == a for a in self.quarantine_ids),
+                "quarantine_ids must be integer agent ids",
+            )
 
     @property
     def async_io_enabled(self) -> bool:
@@ -202,6 +234,28 @@ class RunConfig:
             return bool(self.async_host_io)
         return os.environ.get("DGEN_TPU_ASYNC_IO", "") not in (
             "", "0", "false", "off"
+        )
+
+    @property
+    def validate_enabled(self) -> bool:
+        """The resolved load-time validation decision: the explicit
+        field when set, else on unless the ``DGEN_TPU_VALIDATE`` kill
+        switch says 0/false/off (read at construction time)."""
+        if self.validate_inputs is not None:
+            return self.validate_inputs
+        return os.environ.get("DGEN_TPU_VALIDATE", "") not in (
+            "0", "false", "off"
+        )
+
+    @property
+    def sentinel_enabled(self) -> bool:
+        """The resolved health-sentinel decision: the explicit field
+        when set, else on unless ``DGEN_TPU_SENTINEL`` says
+        0/false/off (read at run time, like the async-IO switch)."""
+        if self.health_sentinel is not None:
+            return self.health_sentinel
+        return os.environ.get("DGEN_TPU_SENTINEL", "") not in (
+            "0", "false", "off"
         )
 
     @classmethod

@@ -39,6 +39,9 @@ import numpy as np
 import pandas as pd
 
 from dgen_tpu.resilience.atomic import atomic_to_parquet, atomic_write_json
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
 
 #: parquet codec: zstd beats the pyarrow default (snappy) ~2x on these
 #: numeric tables at equal write speed
@@ -269,8 +272,12 @@ class RunExporter:
         # into meta.json after every year so a run that silently
         # repaired data says so in its provenance (0 = clean run;
         # counts cover the quantized surfaces, which are the only
-        # place the zeroing happens)
+        # place the zeroing happens).  The per-leaf breakdown rides
+        # the ``quarantine`` meta block and every increment is logged
+        # at WARNING with the offending year + leaf — zeroing a
+        # symptom must never again be silent.
         self._nonfinite_zeroed = 0
+        self._nonfinite_by_field: Dict[str, int] = {}
         os.makedirs(run_dir, exist_ok=True)
         # provenance stamp: ``meta`` (notably market_curves:
         # synthetic_default vs ingested, from scenario ingest) is written
@@ -339,13 +346,31 @@ class RunExporter:
         rest = [a for a, q in zip(arrs, quant) if not q]
         return qs, scales, rest, nonfinite
 
-    def _host_reconstruct(self, host_prepared, quant) -> list:
+    def _host_reconstruct(self, host_prepared, quant,
+                          names=None, year=None) -> list:
         """Host-side tail of the transfer: reassemble per-field host
         arrays in original order from a FETCHED (qs, scales, rest,
         nonfinite) bundle, f32-reconstructing the quantized fields and
-        accumulating the nonfinite-zeroed provenance count."""
+        accumulating the nonfinite-zeroed provenance count.  ``names``
+        (the field names in ``quant`` order) and ``year`` feed the
+        WARNING log + per-leaf breakdown when anything was zeroed."""
         h_q, h_s, h_rest, h_nf = host_prepared
         self._nonfinite_zeroed += int(sum(int(c) for c in h_nf))
+        if names is not None and any(int(c) for c in h_nf):
+            q_names = [f for f, q in zip(names, quant) if q]
+            for f, c in zip(q_names, h_nf):
+                if int(c):
+                    self._nonfinite_by_field[f] = (
+                        self._nonfinite_by_field.get(f, 0) + int(c)
+                    )
+                    logger.warning(
+                        "export: zeroed %d non-finite value(s) in "
+                        "'%s'%s before int16 quantization — upstream "
+                        "data is producing poison (see the quarantine "
+                        "meta block / health sentinel)",
+                        int(c), f,
+                        "" if year is None else f" at year {year}",
+                    )
         qi = iter(zip(h_q, h_s))
         ri = iter(h_rest)
         out = []
@@ -364,7 +389,8 @@ class RunExporter:
     def _fin_quant(self) -> tuple:
         return (True,) if self.compact else (False, False)
 
-    def _local_fields(self, arrs, quant=None, prepared=None
+    def _local_fields(self, arrs, quant=None, prepared=None,
+                      names=None, year=None
                       ) -> tuple[list, np.ndarray]:
         """(rows per field, ids): the fast path reuses the first field's
         shard index for follow-up fields; any field whose sharding
@@ -387,7 +413,8 @@ class RunExporter:
                     quant = (False,) * len(arrs)   # identity bundle
                 prepared = self._quant_dispatch(arrs, quant)
             host = self._host_reconstruct(
-                jax.device_get(list(prepared)), quant)
+                jax.device_get(list(prepared)), quant,
+                names=names, year=year)
             return [h[self.keep] for h in host], self.agent_id
         first, idx = _host_rows(arrs[0])
         if idx is None:
@@ -524,14 +551,18 @@ class RunExporter:
         and frame layout)."""
         rows = [
             h[self.keep]
-            for h in self._host_reconstruct(host["ao"], self._ao_quant())
+            for h in self._host_reconstruct(
+                host["ao"], self._ao_quant(),
+                names=AGENT_OUTPUT_FIELDS, year=year)
         ]
         self._write_ao_frame(year, rows, self.agent_id)
         if self.finance_series:
             f_rows = [
                 h[self.keep]
                 for h in self._host_reconstruct(
-                    host["fin"], self._fin_quant())
+                    host["fin"], self._fin_quant(),
+                    names=("cash_flow", "energy_value_pv_only"),
+                    year=year)
             ]
             ev = None if self.compact else f_rows[1]
             self._write_fin_frame(year, f_rows[0], ev, self.agent_id)
@@ -571,6 +602,17 @@ class RunExporter:
         self._meta_dirty = True
         self._flush_meta()
 
+    def stamp_quarantine(self, summary: Dict[str, object]) -> None:
+        """Merge a quarantine-report summary (resilience.quarantine)
+        into the ``quarantine`` meta block — MERGED, not replaced, so
+        the exporter's own ``nonfinite_zeroed_by_field`` breakdown and
+        the load-time containment record coexist."""
+        block = dict(self.meta.get("quarantine") or {})
+        block.update(summary)
+        self.meta["quarantine"] = block
+        self._meta_dirty = True
+        self._flush_meta()
+
     def _record(self, year: int, relpath: str) -> None:
         if self._manifest is not None:
             self._manifest.record_artifact(year, relpath)
@@ -589,6 +631,12 @@ class RunExporter:
         ):
             return
         self.meta["nonfinite_zeroed"] = int(self._nonfinite_zeroed)
+        if self._nonfinite_by_field:
+            # per-leaf breakdown beside the load-time quarantine record
+            block = dict(self.meta.get("quarantine") or {})
+            block["nonfinite_zeroed_by_field"] = dict(
+                self._nonfinite_by_field)
+            self.meta["quarantine"] = block
         self._meta_dirty = False
         self._write_meta()
 
@@ -610,6 +658,7 @@ class RunExporter:
             [getattr(outs, f) for f in AGENT_OUTPUT_FIELDS],
             quant=_AGENT_OUTPUT_QUANT,
             prepared=prepared,
+            names=AGENT_OUTPUT_FIELDS, year=year,
         )
         self._write_ao_frame(year, rows, ids)
 
@@ -640,6 +689,7 @@ class RunExporter:
             (cf,), ids = self._local_fields(
                 [outs.cash_flow], quant=(True,),   # [n, Y+1]
                 prepared=prepared,
+                names=("cash_flow",), year=year,
             )
             ev = None
         else:

@@ -160,6 +160,70 @@ class HostIOPool:
 #                   at drain (main thread), success or failure
 
 
+class HealthConsumer:
+    """The always-on numerical-health sentinel stage (models.health).
+
+    The per-year fused summary reductions are dispatched at submit time
+    (main thread, right behind the producing step) and the tiny [C, 2]
+    verdict rides the batched fetch — zero extra host syncs, which is
+    exactly why the sentinel works under the async pipeline while
+    ``debug_invariants`` cannot.  Breaches are checked on the io thread
+    BEFORE any export/checkpoint consumer runs (the driver lists this
+    stage first), so a breached year is never flushed to parquet or
+    marked complete in the manifest — the supervisor's resume frontier
+    re-runs it after quarantining the attributed agents.
+
+    Only the ATTRIBUTION leaves' device refs are stashed per queued
+    year (pruned at consume), so attribution on the failure path never
+    requires pinning the year's full ``YearOutputs`` — the pipeline's
+    depth budget stays honest on HBM-tight configs."""
+
+    name = "health"
+    timer_name = "health_check"
+    needs_device = False
+
+    def __init__(self, mask, agent_ids_host, mask_host,
+                 escalate: bool,
+                 breaches_out: Optional[Dict[int, list]] = None) -> None:
+        self._mask = mask                      # placed device mask
+        self._agent_ids = agent_ids_host
+        self._mask_host = mask_host
+        self.escalate = bool(escalate)
+        self.breaches = (
+            breaches_out if breaches_out is not None else {}
+        )
+        self.years_checked = 0
+        self._leaves: Dict[int, dict] = {}     # year_idx -> device refs
+
+    def device_payload(self, year, year_idx, outs, carry):
+        from dgen_tpu.models import health as health_mod
+
+        self._leaves[int(year_idx)] = {
+            name: getattr(outs, name)
+            for name in sorted(health_mod.ATTRIBUTION_LEAVES)
+        }
+        return health_mod.health_summary(outs, self._mask)
+
+    def consume(self, year, year_idx, host, outs) -> None:
+        from dgen_tpu.models import health as health_mod
+
+        self.years_checked += 1
+        refs = self._leaves.pop(int(year_idx), None)
+        b = health_mod.check_host(host)
+        if not b:
+            return
+        self.breaches[int(year)] = b
+        err = health_mod.breach_error(
+            year, year_idx, b, refs, self._agent_ids, self._mask_host,
+        )
+        if self.escalate:
+            raise err
+        logger.warning("health sentinel: %s", err)
+
+    def finalize(self, stats, failed) -> None:
+        self._leaves.clear()
+
+
 class CollectConsumer:
     """Result collection: the async analogue of the serialized loop's
     per-year batched ``device_get`` + append."""

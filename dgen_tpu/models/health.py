@@ -1,0 +1,252 @@
+"""The always-on numerical-health sentinel: cheap fused on-device
+reductions over each year's outputs, checked on the host-IO path.
+
+``RunConfig.debug_invariants`` already catches nonfinite state — but it
+forces a per-year host sync, so nobody runs it in production, which is
+exactly when silent data corruption (a flipped HBM bank row, a bad
+ingest batch that escaped validation) strikes.  The sentinel closes
+that gap the way extreme-scale ABM platforms do (per-step sanity
+monitors as a prerequisite for trusting scaled runs):
+
+* :func:`health_summary` — ONE small jitted program per year computing,
+  for each monitored ``YearOutputs`` leaf, the nonfinite count and the
+  gross bound-breach count (bills/NPV/market-share per leaf).  The
+  result is a [C, 2] int32 array — a few hundred bytes that ride the
+  existing batched host fetch (``io.hostio.HealthConsumer``), so the
+  async pipeline's overlap is untouched (unlike ``debug_invariants``).
+* :func:`check_host` — the host-side verdict over the fetched summary.
+* :func:`breach_error` — per-agent attribution: the breached
+  *per-agent* leaves (sizing outputs are pure functions of one agent's
+  own data, so their bad rows are root causes, not group-level smear)
+  are fetched and scanned for offending rows, producing a
+  :class:`HealthBreachError` that names the year, the leaves, and the
+  offending agent ids — the supervisor's quarantine escalation
+  consumes exactly those ids (``RunConfig.quarantine_ids``).
+
+Bounds are deliberately loose (orders of magnitude beyond any
+reachable value): the sentinel exists to catch poison — NaN/inf and
+1e30-style garbage — not to police modeling choices.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: (YearOutputs leaf, lower, upper): nonfinite always counts; finite
+#: values outside [lower, upper] count as bound breaches.
+HEALTH_CHECKS: Tuple[Tuple[str, float, float], ...] = (
+    ("npv", -1e14, 1e14),
+    ("payback_period", -1e-3, 1e3),
+    ("system_kw", -1e-3, 1e9),
+    ("batt_kw", -1e-3, 1e9),
+    ("batt_kwh", -1e-3, 1e10),
+    ("first_year_bill_with_system", -1e12, 1e12),
+    ("first_year_bill_without_system", -1e12, 1e12),
+    ("cash_flow", -1e14, 1e14),
+    ("max_market_share", -1e-3, 10.0),
+    ("market_share", -1e-3, 10.0),
+    ("number_of_adopters", -1e-3, 1e12),
+    ("system_kw_cum", -1e-3, 1e13),
+)
+
+#: leaves whose values are per-agent pure functions of that agent's own
+#: inputs (the sizing/bill engine) — a bad row there is a ROOT CAUSE.
+#: Market-step leaves mix agents through group aggregates, so their
+#: breaches smear across the group and are only used for attribution
+#: when no per-agent leaf breached.
+ATTRIBUTION_LEAVES = frozenset((
+    "npv", "payback_period", "system_kw", "batt_kw", "batt_kwh",
+    "first_year_bill_with_system", "first_year_bill_without_system",
+    "cash_flow",
+))
+
+#: attribution cap: more offending rows than this and the report is
+#: truncated (the error says so) — quarantining cannot outrun a
+#: wholesale-corrupt input, and validation owns that case
+MAX_ATTRIBUTED = 4096
+
+
+class HealthBreachError(RuntimeError):
+    """A sentinel breach: nonfinite or out-of-bounds values in a model
+    year's outputs.  ``agent_ids`` (when attribution succeeded) are the
+    offending agents' stable ids — the supervisor quarantines exactly
+    these and re-runs the year from the last checkpoint."""
+
+    def __init__(
+        self,
+        year: int,
+        year_idx: int,
+        breaches: List[dict],
+        agent_rows: Sequence[int] = (),
+        agent_ids: Sequence[int] = (),
+        truncated: bool = False,
+    ) -> None:
+        leaves = ", ".join(
+            f"{b['leaf']} (nonfinite={b['nonfinite']}, "
+            f"out_of_bounds={b['out_of_bounds']})"
+            for b in breaches
+        )
+        ids = list(agent_ids)
+        super().__init__(
+            f"numerical-health breach at year {year}: {leaves}"
+            + (
+                f"; attributed to {len(ids)} agent(s) "
+                f"{ids[:8]}{'...' if len(ids) > 8 else ''}"
+                + (" [truncated]" if truncated else "")
+                if ids else "; unattributed"
+            )
+        )
+        self.year = int(year)
+        self.year_idx = int(year_idx)
+        self.breaches = list(breaches)
+        self.agent_rows = tuple(int(r) for r in agent_rows)
+        self.agent_ids = tuple(int(a) for a in ids)
+        self.truncated = bool(truncated)
+
+
+# ---------------------------------------------------------------------------
+# The on-device summary
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _summary_impl(leaves: Dict[str, jax.Array],
+                  mask: jax.Array) -> jax.Array:
+    """[C, 2] int32: per HEALTH_CHECKS row, (nonfinite count,
+    finite-but-out-of-bounds count) over MASKED-IN agents — padding
+    rows are inert by construction but not semantically meaningful, so
+    they never count.  One fused reduction program — compiled once per
+    output shape, dispatched right behind the year step so the tiny
+    result rides the year's batched host fetch."""
+    keep = mask > 0
+    rows = []
+    for name, lo, hi in HEALTH_CHECKS:
+        x = leaves[name]
+        k = keep if x.ndim == 1 else keep[:, None]
+        finite = jnp.isfinite(x)
+        nonf = jnp.sum(
+            (~finite & k).astype(jnp.int32), dtype=jnp.int32)
+        oob = jnp.sum(
+            (finite & ((x < lo) | (x > hi)) & k).astype(jnp.int32),
+            dtype=jnp.int32,
+        )
+        rows.append(jnp.stack([nonf, oob]))
+    return jnp.stack(rows)
+
+
+def health_summary(outs, mask: jax.Array) -> jax.Array:
+    """Dispatch the fused health reductions over one year's outputs
+    (``mask``: the agent table's [N] real-row mask)."""
+    return _summary_impl(
+        {name: getattr(outs, name) for name, _, _ in HEALTH_CHECKS},
+        mask,
+    )
+
+
+def check_host(summary) -> List[dict]:
+    """Host verdict over a fetched summary: the breached checks as
+    ``[{"leaf", "nonfinite", "out_of_bounds"}, ...]`` (empty = clean)."""
+    s = np.asarray(summary)
+    out = []
+    for (name, _, _), (nonf, oob) in zip(HEALTH_CHECKS, s):
+        if nonf or oob:
+            out.append({
+                "leaf": name,
+                "nonfinite": int(nonf),
+                "out_of_bounds": int(oob),
+            })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Attribution
+# ---------------------------------------------------------------------------
+
+def _host_leaf(arr):
+    """(values, global_row_idx) of the process-locally addressable part
+    of a per-agent leaf; idx None = every row is local (the
+    single-controller case)."""
+    if getattr(arr, "is_fully_addressable", True) is not False:
+        return np.asarray(jax.device_get(arr)), None
+    rows, idx = [], []
+    seen = set()
+    for s in arr.addressable_shards:
+        sl = s.index[0] if s.index else slice(None)
+        start = sl.start or 0
+        if start in seen:
+            continue
+        seen.add(start)
+        stop = sl.stop if sl.stop is not None else arr.shape[0]
+        rows.append(np.asarray(s.data))
+        idx.append(np.arange(start, stop))
+    return np.concatenate(rows), np.concatenate(idx)
+
+
+def _leaf_of(outs, name):
+    """A leaf by name from either a YearOutputs-shaped object or a
+    ``{name: device array}`` ref dict (the async HealthConsumer stashes
+    only the attribution leaves, not the full outputs); None = absent."""
+    if isinstance(outs, dict):
+        return outs.get(name)
+    return getattr(outs, name, None)
+
+
+def attribute(outs, breaches: List[dict], mask_host: np.ndarray
+              ) -> Tuple[np.ndarray, bool]:
+    """Offending agent rows (global row indices, sorted) for a breach:
+    the union of bad MASKED-IN rows across the breached per-agent
+    leaves (ATTRIBUTION_LEAVES), falling back to every breached leaf
+    when no per-agent leaf breached.  Returns ``(rows, truncated)``."""
+    names = [b["leaf"] for b in breaches
+             if b["leaf"] in ATTRIBUTION_LEAVES]
+    if not names:
+        names = [b["leaf"] for b in breaches]
+    bounds = {name: (lo, hi) for name, lo, hi in HEALTH_CHECKS}
+    keep = np.asarray(mask_host) > 0
+    bad_rows: set = set()
+    for name in names:
+        lo, hi = bounds[name]
+        leaf = _leaf_of(outs, name)
+        if leaf is None:
+            continue
+        vals, idx = _host_leaf(leaf)
+        flat = vals.reshape(vals.shape[0], -1)
+        finite = np.isfinite(flat)
+        bad = (~finite) | (
+            finite & ((flat < lo) | (flat > hi))
+        )
+        local = np.flatnonzero(bad.any(axis=1))
+        if idx is not None:
+            local = idx[local]
+        local = local[keep[local]]
+        bad_rows.update(int(r) for r in local)
+    rows = np.asarray(sorted(bad_rows), dtype=np.int64)
+    truncated = rows.size > MAX_ATTRIBUTED
+    return rows[:MAX_ATTRIBUTED], truncated
+
+
+def breach_error(year, year_idx, breaches: List[dict], outs,
+                 agent_ids_host: np.ndarray,
+                 mask_host: np.ndarray) -> HealthBreachError:
+    """Build the attributed :class:`HealthBreachError` for a breached
+    year: per-chunk/per-leaf narrowing to offending rows, then row ->
+    stable agent id via the host id copy (placed row order)."""
+    try:
+        if outs is None:
+            rows, truncated = np.asarray([], dtype=np.int64), False
+        else:
+            rows, truncated = attribute(outs, breaches, mask_host)
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        rows, truncated = np.asarray([], dtype=np.int64), False
+    ids = (
+        np.asarray(agent_ids_host)[rows] if rows.size else
+        np.asarray([], dtype=np.int64)
+    )
+    return HealthBreachError(
+        year, year_idx, breaches,
+        agent_rows=rows.tolist(), agent_ids=ids.tolist(),
+        truncated=truncated,
+    )

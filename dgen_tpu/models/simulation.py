@@ -58,7 +58,7 @@ from dgen_tpu.ops import dispatch as dispatch_ops
 from dgen_tpu.ops import sizing as sizing_ops
 from dgen_tpu.ops.tariff import NET_BILLING, TariffBank
 from dgen_tpu.parallel.mesh import agent_spec
-from dgen_tpu.resilience.faults import fault_point
+from dgen_tpu.resilience.faults import corrupt_point, corrupt_rows, fault_point
 from dgen_tpu.utils import timing
 from dgen_tpu.utils.logging import get_logger
 
@@ -931,6 +931,24 @@ def run_static_flags(
 # Host-side driver
 # ---------------------------------------------------------------------------
 
+def _corrupt_bank_rows(profiles: ProfileBank) -> ProfileBank:
+    """The ``bank_corrupt_row`` fault payload (kind ``corrupt``): NaN
+    one deterministic load-bank row — or, under int8 quantized banks,
+    that row's f32 dequant scale (codes cannot hold a NaN).  Models a
+    bad bank file at load time (hit #1, Simulation construction — the
+    quarantine validator's case) and silent in-memory data corruption
+    mid-run (later hits, before a year step — the health sentinel's
+    case)."""
+    row = int(corrupt_rows()[0]) % int(np.asarray(profiles.load).shape[0])
+    if profiles.load_scale is not None:
+        sc = np.array(np.asarray(profiles.load_scale))
+        sc[row] = np.nan
+        return dataclasses.replace(profiles, load_scale=jnp.asarray(sc))
+    arr = np.array(np.asarray(profiles.load))
+    arr[row] = np.nan
+    return dataclasses.replace(profiles, load=jnp.asarray(arr))
+
+
 @dataclasses.dataclass
 class SimResults:
     """Host-side stacked run outputs: dict of [n_years, ...] numpy
@@ -976,6 +994,7 @@ class Simulation:
         mesh: Optional[Mesh] = None,
         with_hourly: bool = False,
         econ_years: int = 25,
+        quarantine=None,
     ) -> None:
         self.scenario = scenario
         self.run_config = run_config or RunConfig()
@@ -988,6 +1007,53 @@ class Simulation:
                 f"inputs cover {inputs.n_years} years but scenario has "
                 f"{len(self.years)}"
             )
+
+        # resilience fault site (kind ``corrupt``): a profile-bank row
+        # going bad at LOAD time — hit #1 lands here, BEFORE validation,
+        # which must catch it; later hits fire in Simulation.step
+        # (mid-run corruption only the health sentinel catches)
+        if corrupt_point("bank_corrupt_row"):
+            profiles = _corrupt_bank_rows(profiles)
+
+        # --- bad-data quarantine (resilience.quarantine): load-time
+        # validation of the table/banks, containment of malformed rows
+        # as inert padding (mask 0 -> exact-zero contributions), plus
+        # any by-fiat quarantine (an explicit report, or the
+        # supervisor's sentinel escalation via rc.quarantine_ids).
+        # Runs FIRST: downstream host logic (run_static_flags'
+        # metering[tariff_idx] indexing, the daylight layout, int8
+        # quantization) assumes in-range references and finite banks.
+        # Clean inputs pass through untouched (object identity), so the
+        # default-on validation changes nothing for healthy runs and
+        # the compiled programs (J5/J6 fingerprints) never move — the
+        # quarantine mask IS the existing AgentTable.mask data plane.
+        rc = self.run_config
+        self.quarantine_report = None
+        if rc.validate_enabled or rc.quarantine_ids or quarantine is not None:
+            from dgen_tpu.resilience.quarantine import (
+                QuarantineReport,
+                apply_quarantine,
+                validate_population,
+            )
+
+            rep = (
+                validate_population(table, profiles, tariffs)
+                if rc.validate_enabled
+                else QuarantineReport(
+                    n_agents=int(np.sum(np.asarray(table.mask) > 0)))
+            )
+            if quarantine is not None:
+                rep.merge(quarantine)
+            if rc.quarantine_ids:
+                rep.add_ids(rc.quarantine_ids, "config:quarantine_ids")
+            if not rep.is_clean:
+                table, profiles = apply_quarantine(table, profiles, rep)
+            self.quarantine_report = rep
+
+        # per-run health-sentinel state (models.health); populated by
+        # the year loops when RunConfig.sentinel_enabled
+        self.health_report: Optional[dict] = None
+        self._health_breaches: Dict[int, list] = {}
 
         # static flags, computed BEFORE chunking/partitioning (the HBM
         # chunk model needs them); see run_static_flags
@@ -1330,6 +1396,55 @@ class Simulation:
                 "the static all-NEM kernel skip is unsound for this run"
             )
 
+    def _sanitize_restored_carry(self, carry: SimCarry) -> SimCarry:
+        """Zero quarantined rows of a restored cross-year carry: a
+        checkpoint written BEFORE a mid-run quarantine still holds the
+        offending agents' market state, and the state-capacity segment
+        sums read the carry directly (not mask-gated) — resuming
+        without this would let a contained agent keep contributing.
+        No-op (object identity) when nothing is quarantined."""
+        rep = getattr(self, "quarantine_report", None)
+        if rep is None or not rep.n_quarantined:
+            return carry
+        q = np.isin(self.host_agent_id, np.asarray(rep.ids))
+        if not q.any():
+            return carry
+        keep = jnp.asarray((~q).astype(np.float32))
+        if self._shard is not None:
+            keep = self._put(keep, self._shard)
+        n = self.table.n_agents
+
+        def _zero(x):
+            if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n:
+                return x * keep.reshape((n,) + (1,) * (x.ndim - 1))
+            return x
+
+        return jax.tree.map(_zero, carry)
+
+    def _health_verdict(self, year: int, yi: int, summary_host,
+                        outs, escalate: bool) -> None:
+        """Host-side sentinel verdict for one year's fetched summary:
+        record breaches, attribute offending agents from the year's
+        device outputs (when still referenced), and raise
+        ``HealthBreachError`` under escalation — the supervisor's
+        quarantine loop consumes the attributed ids."""
+        from dgen_tpu.models import health as health_mod
+
+        breaches = health_mod.check_host(summary_host)
+        if not breaches:
+            return
+        self._health_breaches[int(year)] = breaches
+        if outs is not None:
+            err = health_mod.breach_error(
+                year, yi, breaches, outs,
+                self.host_agent_id, self.host_mask,
+            )
+        else:
+            err = health_mod.HealthBreachError(year, yi, breaches)
+        if escalate:
+            raise err
+        logger.warning("health sentinel: %s", err)
+
     def with_inputs(
         self,
         inputs: ScenarioInputs,
@@ -1386,6 +1501,18 @@ class Simulation:
         # error a real chunk-scan OOM surfaces with, so the
         # supervisor's chunk-halving degradation is testable on CPU.
         fault_point("year_step")
+        # resilience fault site (kind ``corrupt``): silent mid-run data
+        # corruption — a profile-bank row flips to NaN between year
+        # steps (same data shapes/dtypes, so the compiled program is
+        # untouched).  Only the health sentinel's breach -> attribute
+        # -> quarantine escalation can catch this.
+        if corrupt_point("bank_corrupt_row"):
+            profiles = _corrupt_bank_rows(self.profiles)
+            if self._put is not None:
+                repl = NamedSharding(self.mesh, P())
+                profiles = jax.tree.map(
+                    lambda x: self._put(x, repl), profiles)
+            self.profiles = profiles
         return year_step(
             self.table, self.profiles, self.tariffs, self.inputs, carry,
             jnp.asarray(year_idx, dtype=jnp.int32),
@@ -1467,7 +1594,7 @@ class Simulation:
                     checkpoint_dir, self.table.n_agents, last,
                     sharding=self._shard,
                 )
-                carry = restored
+                carry = self._sanitize_restored_carry(restored)
                 start_idx = self.years.index(last) + 1
                 logger.info("resuming after year %d (index %d)", last, start_idx)
 
@@ -1508,6 +1635,8 @@ class Simulation:
         )
         self.hostio_stats = None
         self._stop_idx: Optional[int] = None
+        self.health_report = None
+        self._health_breaches = {}
         try:
             if async_io:
                 carry, collected, hourly = self._run_years_async(
@@ -1544,6 +1673,13 @@ class Simulation:
             self._stop_idx if self._stop_idx is not None
             else len(self.years)
         )
+        if self.run_config.sentinel_enabled:
+            self.health_report = {
+                "breaches": {
+                    int(y): b for y, b in self._health_breaches.items()
+                },
+                "clean": not self._health_breaches,
+            }
         return SimResults(
             years=self.years[start_idx:end_idx],
             agent=agent,
@@ -1578,6 +1714,18 @@ class Simulation:
         from dgen_tpu.io import hostio
 
         consumers: list = []
+        # the health sentinel stage comes FIRST: a breached year must
+        # be detected before its export/checkpoint consumers run, so
+        # it is never marked complete in the manifest and the
+        # supervisor's resume frontier re-runs it after quarantine
+        if self.run_config.sentinel_enabled:
+            consumers.append(hostio.HealthConsumer(
+                mask=self.table.mask,
+                agent_ids_host=self.host_agent_id,
+                mask_host=self.host_mask,
+                escalate=bool(self.run_config.sentinel_escalate),
+                breaches_out=self._health_breaches,
+            ))
         collector = None
         if collect:
             collector = hostio.CollectConsumer(
@@ -1674,6 +1822,20 @@ class Simulation:
         hourly: List[np.ndarray] = []
         if debug:
             from dgen_tpu.utils import invariants
+
+        # always-on numerical-health sentinel (models.health): the
+        # per-year fused summary is dispatched right behind the step;
+        # on the serialized path it rides the existing per-year sync,
+        # on the deferred-callback path it is checked just before that
+        # year's export flushes, and on the no-consumer pipelined path
+        # the summaries are batch-fetched once at the end (still
+        # detected, attribution deferred to the supervised re-entry)
+        sentinel = self.run_config.sentinel_enabled
+        escalate = bool(self.run_config.sentinel_escalate)
+        if sentinel:
+            from dgen_tpu.models import health as health_mod
+        queued_health: List[tuple] = []
+        pending_health = None          # (year, yi, summary, outs)
 
         profiled = False
 
@@ -1777,6 +1939,20 @@ class Simulation:
                         # STATE_KW_BOUND; it stays sound only while the live
                         # state totals remain under that bound
                         self._check_state_kw_bound(carry, f"year {year}")
+                summary = None
+                if sentinel:
+                    summary = health_mod.health_summary(
+                        outs, self.table.mask)
+                    if sync_per_year:
+                        # serialized oracle path: the tiny [C, 2]
+                        # verdict rides this year's existing host sync
+                        self._health_verdict(
+                            year, yi,
+                            jax.device_get(summary),  # dgenlint: disable=L9
+                            outs, escalate,
+                        )
+                    elif not defer_callback:
+                        queued_health.append((year, yi, summary))
                 logger.info("year %d (%d/%d) %.2fs%s", year, yi + 1,
                             len(self.years), time.time() - t0,
                             "" if sync_per_year else " (queued)")
@@ -1788,8 +1964,19 @@ class Simulation:
                             # re-write the same year's partition on top
                             # of partially-written parquet parts
                             prev, pending_cb = pending_cb, None
+                            prev_h, pending_health = pending_health, None
+                            if prev_h is not None and prev_h[2] is not None:
+                                # the deferred year's health verdict
+                                # gates its export: a breached year is
+                                # never flushed to parquet
+                                self._health_verdict(
+                                    prev_h[0], prev_h[1],
+                                    jax.device_get(prev_h[2]),  # dgenlint: disable=L9
+                                    prev_h[3], escalate,
+                                )
                             callback(*prev)
                         pending_cb = (year, yi, outs)
+                        pending_health = (year, yi, summary, outs)
                         # let the exporter enqueue its device-side
                         # transfer prep (e.g. compact quantization) NOW,
                         # right behind this year's step — at callback
@@ -1842,6 +2029,17 @@ class Simulation:
                 # flush the deferred trailing callback (the final year
                 # on success; the last completed year on failure)
                 try:
+                    if (
+                        pending_health is not None
+                        and pending_health[2] is not None
+                    ):
+                        # health verdict gates the flush: a breached
+                        # trailing year must surface, not export
+                        self._health_verdict(
+                            pending_health[0], pending_health[1],
+                            jax.device_get(pending_health[2]),  # dgenlint: disable=L9
+                            pending_health[3], escalate,
+                        )
                     callback(*pending_cb)
                 except Exception:  # noqa: BLE001
                     if not loop_failed:
@@ -1860,4 +2058,12 @@ class Simulation:
             with timing.timer("device_drain", ctx=self.timing_ctx):
                 jax.block_until_ready(carry.market.market_share)
                 float(jnp.sum(carry.batt_adopters_cum))
+        if queued_health:
+            # no-consumer pipelined path: one batched fetch of every
+            # queued year's verdict at the end — detection is still
+            # guaranteed, per-agent attribution happens on the
+            # supervised re-entry (the device outputs are gone)
+            hosts = jax.device_get([s for _, _, s in queued_health])
+            for (qy, qyi, _), h in zip(queued_health, hosts):
+                self._health_verdict(qy, qyi, h, None, escalate)
         return carry, collected, hourly

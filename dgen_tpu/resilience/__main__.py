@@ -119,6 +119,16 @@ def _cmd_drill(args) -> int:
         )
         print(json.dumps(rec, indent=1))
         return 0 if rec["ok"] else 1
+    if args.quarantine:
+        from dgen_tpu.resilience.quarantinedrill import run_quarantine_drill
+
+        root = args.root or tempfile.mkdtemp(prefix="dgen-qdrill-")
+        rec = run_quarantine_drill(
+            root, n_agents=args.agents, end_year=end_year,
+            fast=args.fast,
+        )
+        print(json.dumps(rec, indent=1))
+        return 0 if rec["ok"] else 1
     if args.serve_fleet:
         from dgen_tpu.resilience.fleetdrill import run_fleet_drill
 
@@ -189,6 +199,19 @@ def main(argv=None) -> int:
     drl.add_argument("--sites", default=None,
                      help="comma list of drill names to run "
                           "(default: the full matrix)")
+    drl.add_argument("--quarantine", action="store_true",
+                     help="quarantine drill instead: corrupt rows "
+                          "injected at ingest, at bank load, and "
+                          "mid-run (the health sentinel's case) must "
+                          "be detected, attributed to exactly the "
+                          "injected rows, and contained — parquet "
+                          "bit-exact vs a clean pre-quarantined "
+                          "baseline (docs/resilience.md 'Data "
+                          "quarantine & health sentinel')")
+    drl.add_argument("--fast", action="store_true",
+                     help="quarantine drill: load-time rounds only "
+                          "(the check.sh smoke tier); skips the "
+                          "mid-run sentinel round")
     drl.add_argument("--serve-fleet", action="store_true",
                      help="fleet drill instead: boot a replica fleet, "
                           "kill + hang replicas under closed-loop "

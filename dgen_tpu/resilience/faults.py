@@ -141,9 +141,47 @@ SITES: Dict[str, str] = {
         "collective error at the year boundary); the worker dies and "
         "the supervisor restarts the gang"
     ),
+    "ingest_corrupt_row": (
+        "models.agents.build_agent_table — malformed rows entering the "
+        "agent table at ingest (``corrupt``: NaN customer counts, "
+        "negative loads, out-of-range tariff references on the "
+        "DGEN_TPU_FAULT_CORRUPT_ROWS rows); load-time validation "
+        "(resilience.quarantine) must quarantine exactly those rows"
+    ),
+    "bank_corrupt_row": (
+        "models.simulation — a profile-bank row going bad (``corrupt``: "
+        "NaN load row, or a NaN quant scale under int8 banks).  Hit #1 "
+        "is Simulation construction (load-time corruption, caught by "
+        "validation); later hits fire before a year step (silent "
+        "mid-run data corruption, caught only by the health sentinel's "
+        "breach -> attribute -> quarantine escalation)"
+    ),
 }
 
-KINDS = ("error", "oom", "kill", "truncate", "hang")
+KINDS = ("error", "oom", "kill", "truncate", "hang", "corrupt")
+
+#: which rows the ``corrupt`` kind damages (deterministic; env-tunable
+#: so drills can aim at specific rows)
+CORRUPT_ROWS_ENV = "DGEN_TPU_FAULT_CORRUPT_ROWS"
+CORRUPT_ROWS_DEFAULT = (3, 17)
+
+
+def corrupt_rows() -> tuple:
+    """Deterministic row indices the ``corrupt`` kind damages (callers
+    take them modulo their own row count).  A malformed env spec raises
+    — same fail-loud rule as the fault-spec grammar: a drill aimed at
+    rows that silently became the defaults proves nothing."""
+    raw = os.environ.get(CORRUPT_ROWS_ENV, "").strip()
+    if not raw:
+        return CORRUPT_ROWS_DEFAULT
+    try:
+        rows = tuple(int(r) for r in raw.split(",") if r.strip())
+    except ValueError as e:
+        raise ValueError(
+            f"malformed {CORRUPT_ROWS_ENV}={raw!r}: expected a comma "
+            "list of row indices"
+        ) from e
+    return rows or CORRUPT_ROWS_DEFAULT
 
 #: how long a ``hang`` fault stalls its site (seconds); env-tunable so
 #: drills can pick a stall longer than the front's forward timeout but
@@ -264,9 +302,11 @@ class FaultRegistry:
         with self._lock:
             return self._fired.get(site, 0)
 
-    def hit(self, site: str, path: Optional[str] = None) -> None:
+    def hit(self, site: str, path: Optional[str] = None) -> int:
         """Count a visit to ``site``; raise/kill/truncate when a clause
-        matches.  ``path`` is the landed artifact for truncate sites."""
+        matches.  ``path`` is the landed artifact for truncate sites.
+        Returns 1 when a ``corrupt``-kind clause fired (the CALLER owns
+        the data mutation — see :func:`corrupt_point`), else 0."""
         if site not in SITES:
             raise ValueError(f"unregistered fault site '{site}'")
         with self._lock:
@@ -279,14 +319,20 @@ class FaultRegistry:
             if clause is not None:
                 self._fired[site] = self._fired.get(site, 0) + 1
         if clause is None:
-            return
+            return 0
+        if clause.kind == "corrupt":
+            # the site's caller applies a deterministic data mutation
+            # (NaN rows, garbage references) and continues NORMALLY —
+            # the model of bad input data / silent data corruption that
+            # only validation or the health sentinel can catch
+            return 1
         if clause.kind == "hang":
             # model a stall, not a death: hold the site for the
             # configured wall, then continue NORMALLY — the caller
             # never learns it hung, exactly like a wedged device or a
             # GC/paging stall.  Timeout enforcement is the test.
             time.sleep(hang_seconds())
-            return
+            return 0
         if clause.kind == "kill":
             # model a preemption/OOM-kill: no cleanup, no finally, no
             # atexit — exactly what the crash-consistent artifact layer
@@ -357,3 +403,15 @@ def fault_point(site: str, path: Optional[str] = None) -> None:
     reg = _active
     if reg is not None:
         reg.hit(site, path=path)
+
+
+def corrupt_point(site: str) -> int:
+    """The data-corruption hook: count a visit to ``site`` and return
+    1 when a ``corrupt``-kind clause fires there (the caller then
+    applies its deterministic mutation and continues), else 0.
+    Non-corrupt kinds registered at the site still raise/kill as
+    usual.  Uninstalled fast path: one global read."""
+    reg = _active
+    if reg is None:
+        return 0
+    return reg.hit(site) or 0

@@ -189,6 +189,15 @@ def main() -> int:
             "n_agents_padded": int(sim.table.n_agents),
         }},
     )
+    # load-time quarantine carries through gang sharding unchanged
+    # (deterministic validation of the identical host population on
+    # every process -> identical mask); process 0 publishes the ledger
+    # and the merged-manifest verify covers it
+    rep_q = getattr(sim, "quarantine_report", None)
+    if rep_q is not None and not rep_q.is_clean:
+        if index == 0:
+            rep_q.save(os.path.join(run_dir, "quarantine.json"))
+        exporter.stamp_quarantine(rep_q.summary())
 
     def callback(year: int, year_idx: int, outs) -> None:
         # resilience drill hook: a ``kill`` here is a worker dying

@@ -1,0 +1,472 @@
+"""Bad-data quarantine: ingest/load-time validation of the agent table
+and profile banks, with per-agent containment instead of run-wide
+poisoning.
+
+The reference pipeline assumes clean Postgres inputs; at synthetic
+10M-agent national scale (plus int8/bf16 quantized banks) malformed
+rows — nonfinite loads, zero-scale quant rows, out-of-range tariff
+references, negative prices — are statistically certain, and a single
+NaN agent propagates through the state-level battery-adopter sort and
+the Bass-diffusion group aggregates to corrupt *every* agent in its
+state.  ``io.export`` only zeroes the symptom at the very end
+(``nonfinite_zeroed``), after the damage is done.
+
+This module is the detect/attribute/contain layer in front of the
+device program:
+
+* :func:`validate_population` — host-side schema/range/finiteness/
+  reference checks over the agent table, the profile banks (including
+  int8 quant-scale sidecars) and the tariff bank, producing a
+  :class:`QuarantineReport`: per-agent reasons plus the bad bank rows.
+* :func:`apply_quarantine` — rewrite quarantined rows to the exact
+  inert fills padding agents carry (mask 0, index 0, the
+  ``models.agents._PAD_FILLS`` sentinels) and zero unreadable bank
+  rows, so quarantined agents contribute **exact zeros** to bills,
+  sizing, the adopter sort and the state aggregates.  The mask rides
+  the existing ``AgentTable.mask`` data plane — shapes, statics and
+  jit groups are untouched, so the committed J5/J6 program
+  fingerprints cannot move.
+* :class:`QuarantineReport` round-trips through an atomic
+  ``quarantine.json`` (recorded in the RunManifest by the run
+  supervisor) so a run's provenance names exactly which rows were
+  contained and why.
+
+The always-on *numerical-health sentinel* that catches corruption
+appearing MID-run (silent data corruption, a flipped bank row) lives in
+:mod:`dgen_tpu.models.health`; its supervisor escalation funnels back
+into this module via ``RunConfig.quarantine_ids``.
+
+This module is numpy-only at validation time; jax is imported lazily by
+:func:`apply_quarantine` (the one function that rebuilds device-bound
+leaves), so the serve layer can import the error type without cost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dgen_tpu.resilience.atomic import atomic_write_json
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: report schema version (quarantine.json)
+_VERSION = 1
+
+#: bound on how many agents one validation/attribution pass will
+#: quarantine — a report bigger than this almost certainly means the
+#: INPUTS are the wrong file, not that 100k rows each went bad
+MAX_QUARANTINE = 65536
+
+
+class QuarantinedAgentError(Exception):
+    """A request addressed a quarantined agent.  The serve layer maps
+    this to HTTP 422 (the row exists but its data was contained at
+    load); carries the machine-readable reasons."""
+
+    def __init__(self, agent_id: int, reasons: Sequence[str]) -> None:
+        super().__init__(
+            f"agent {agent_id} is quarantined ({'; '.join(reasons)})"
+        )
+        self.agent_id = int(agent_id)
+        self.reasons = list(reasons)
+
+
+@dataclasses.dataclass
+class QuarantineReport:
+    """Reasoned per-agent quarantine decisions + bad bank rows.
+
+    ``records`` maps stable agent id -> ``{"row": int, "reasons":
+    [str, ...]}``; ``bank_rows`` maps a ProfileBank field name to the
+    sorted bad row indices that :func:`apply_quarantine` must zero
+    (every agent referencing them is quarantined, so zeroing is
+    output-invariant)."""
+
+    n_agents: int = 0
+    records: Dict[int, dict] = dataclasses.field(default_factory=dict)
+    bank_rows: Dict[str, List[int]] = dataclasses.field(
+        default_factory=dict)
+    context: str = "load"
+
+    # -- construction ---------------------------------------------------
+
+    def add(self, agent_id: int, row: int, reason: str) -> None:
+        rec = self.records.setdefault(
+            int(agent_id), {"row": int(row), "reasons": []}
+        )
+        if reason not in rec["reasons"]:
+            rec["reasons"].append(reason)
+
+    def add_ids(self, ids: Iterable[int], reason: str) -> None:
+        """Quarantine agents by stable id alone (operator/config fiat,
+        the supervisor's sentinel escalation round-trip)."""
+        for a in ids:
+            self.add(int(a), -1, reason)
+
+    def add_bank_row(self, field: str, row: int) -> None:
+        rows = self.bank_rows.setdefault(field, [])
+        if int(row) not in rows:
+            rows.append(int(row))
+            rows.sort()
+
+    def merge(self, other: "QuarantineReport") -> None:
+        for a, rec in other.records.items():
+            for reason in rec["reasons"]:
+                self.add(int(a), rec.get("row", -1), reason)
+        for field, rows in other.bank_rows.items():
+            for r in rows:
+                self.add_bank_row(field, r)
+
+    # -- queries --------------------------------------------------------
+
+    @property
+    def n_quarantined(self) -> int:
+        return len(self.records)
+
+    @property
+    def ids(self) -> Tuple[int, ...]:
+        return tuple(sorted(self.records))
+
+    @property
+    def is_clean(self) -> bool:
+        return not self.records and not any(self.bank_rows.values())
+
+    def reasons_for(self, agent_id: int) -> List[str]:
+        rec = self.records.get(int(agent_id))
+        return list(rec["reasons"]) if rec else []
+
+    def reason_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for rec in self.records.values():
+            for reason in rec["reasons"]:
+                out[reason] = out.get(reason, 0) + 1
+        return out
+
+    def summary(self) -> Dict[str, object]:
+        """The compact provenance block exporters stamp into meta.json
+        beside ``nonfinite_zeroed``."""
+        return {
+            "context": self.context,
+            "n_agents": int(self.n_agents),
+            "n_quarantined": self.n_quarantined,
+            "reasons": self.reason_counts(),
+            "bank_rows": {
+                k: list(v) for k, v in self.bank_rows.items() if v
+            },
+        }
+
+    # -- persistence ----------------------------------------------------
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "version": _VERSION,
+            "context": self.context,
+            "n_agents": int(self.n_agents),
+            "n_quarantined": self.n_quarantined,
+            "agents": {
+                str(a): self.records[a] for a in sorted(self.records)
+            },
+            "bank_rows": {
+                k: list(v) for k, v in self.bank_rows.items() if v
+            },
+        }
+
+    def save(self, path: str) -> None:
+        """Publish the report atomically (temp + rename): a killed
+        writer can never leave truncated JSON at the published path."""
+        atomic_write_json(path, self.to_json(), indent=1)
+
+    @classmethod
+    def from_json(cls, blob: Dict[str, object]) -> "QuarantineReport":
+        rep = cls(
+            n_agents=int(blob.get("n_agents", 0)),
+            context=str(blob.get("context", "load")),
+        )
+        for a, rec in (blob.get("agents") or {}).items():
+            for reason in rec.get("reasons", ()):
+                rep.add(int(a), int(rec.get("row", -1)), reason)
+        for field, rows in (blob.get("bank_rows") or {}).items():
+            for r in rows:
+                rep.add_bank_row(field, int(r))
+        return rep
+
+    @classmethod
+    def load(cls, path: str) -> "QuarantineReport":
+        import json
+
+        with open(path) as f:
+            return cls.from_json(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# Validation
+# ---------------------------------------------------------------------------
+
+#: per-agent float columns checked for finiteness (and, where listed
+#: below, range).  The documented sentinels (nem_kw_limit/switch 1e30,
+#: sunset 9999) are FINITE and in-range by design.
+_FLOAT_COLS = (
+    "customers_in_bin", "load_kwh_per_customer_in_bin",
+    "developable_frac", "one_time_charge", "nem_kw_limit",
+    "nem_first_year", "nem_sunset_year", "switch_min_kw",
+    "switch_max_kw",
+)
+
+#: (column, lower, upper) inclusive range checks over finite values;
+#: bounds are deliberately loose — this catches corruption (negative
+#: loads, 1e38 garbage), not modeling choices
+_RANGE_COLS = (
+    ("customers_in_bin", 0.0, 1e12),
+    ("load_kwh_per_customer_in_bin", 0.0, 1e12),
+    ("developable_frac", -1e-6, 1.0 + 1e-6),
+    ("one_time_charge", 0.0, 1e9),
+)
+
+
+def quant_sidecar_bad_rows(codes: np.ndarray,
+                           scales: np.ndarray) -> np.ndarray:
+    """Bad row indices of an int8 quant bank's f32 scale sidecar.
+
+    A NONFINITE or negative scale destroys the row (dequantization is
+    ``scale * code``); a ZERO scale is the all-zero-row floor path
+    (``models.agents.quantize_rows`` stores 1.0, but an external DGPB
+    writer may store 0.0 — dequantization is exact zero either way) and
+    is valid **only** while every code in the row is zero: zero scale
+    under nonzero codes silently flattens real data to zero."""
+    scales = np.asarray(scales)
+    codes = np.asarray(codes)
+    bad = ~np.isfinite(scales) | (scales < 0)
+    zero = np.isfinite(scales) & (scales == 0)
+    if np.any(zero):
+        nonzero_row = np.any(codes != 0, axis=1)
+        bad = bad | (zero & nonzero_row)
+    return np.flatnonzero(bad)
+
+
+def _bad_bank_rows(bank, scales=None, nonneg: bool = True) -> np.ndarray:
+    """Row indices of a profile bank that cannot be priced: any
+    nonfinite element, any negative element for nonnegative-by-
+    construction banks (load shapes, solar CF), or a broken quant
+    sidecar."""
+    arr = np.asarray(bank)
+    if arr.dtype == np.int8:
+        # codes themselves are always finite; the sidecar is the risk
+        bad = np.zeros(arr.shape[0], dtype=bool)
+    else:
+        a = arr.astype(np.float32, copy=False)
+        bad = ~np.isfinite(a).all(axis=1)
+        if nonneg:
+            bad |= (np.where(np.isfinite(a), a, 0.0) < 0).any(axis=1)
+    if scales is not None:
+        bad_s = np.zeros(arr.shape[0], dtype=bool)
+        bad_s[quant_sidecar_bad_rows(arr, scales)] = True
+        bad |= bad_s
+    return np.flatnonzero(bad)
+
+
+def validate_population(table, profiles=None, tariffs=None,
+                        context: str = "load") -> QuarantineReport:
+    """Host-side load-time validation (numpy, pre-placement) of an
+    agent population: schema/finiteness/range checks on the per-agent
+    columns, bank-reference bounds, unusable profile-bank rows
+    (including int8 quant sidecars) and unusable tariff rows.  Only
+    masked-in rows are validated — padding rows are inert by
+    construction.  Returns the reasoned :class:`QuarantineReport`."""
+    mask = np.asarray(table.mask) > 0
+    ids = np.asarray(table.agent_id)
+    rep = QuarantineReport(n_agents=int(mask.sum()), context=context)
+
+    def _refuse(reason: str) -> None:
+        raise ValueError(
+            f"validation would quarantine more than {MAX_QUARANTINE} "
+            f"of {rep.n_agents} agents (first overflow at "
+            f"'{reason}'); this is an input-file problem, not row "
+            "corruption — refusing to mask it (reasons so far: "
+            f"{sorted(rep.reason_counts())[:5]})"
+        )
+
+    def flag(bad: np.ndarray, reason: str) -> None:
+        rows = np.flatnonzero(bad & mask)
+        # bail BEFORE building millions of per-row records: at 10M-agent
+        # scale a wholesale-corrupt column must refuse in O(1) wall,
+        # not after minutes of pure-python dict churn
+        if rep.n_quarantined + rows.size > MAX_QUARANTINE:
+            _refuse(reason)
+        for r in rows:
+            rep.add(int(ids[r]), int(r), reason)
+
+    # 1. finiteness of the per-agent float columns (+ incentive leaves)
+    for name in _FLOAT_COLS:
+        col = np.asarray(getattr(table, name))
+        flag(~np.isfinite(col), f"nonfinite:{name}")
+    inc = getattr(table, "incentives", None)
+    if inc is not None:
+        for f in dataclasses.fields(type(inc)):
+            leaf = np.asarray(getattr(inc, f.name))
+            if leaf.dtype.kind != "f":
+                continue
+            flag(
+                ~np.isfinite(leaf).all(axis=tuple(range(1, leaf.ndim))),
+                f"nonfinite:incentives.{f.name}",
+            )
+
+    # 2. gross range checks
+    for name, lo, hi in _RANGE_COLS:
+        col = np.asarray(getattr(table, name))
+        finite = np.isfinite(col)
+        flag(finite & ((col < lo) | (col > hi)), f"range:{name}")
+
+    # 3. bank/tariff reference bounds
+    bounds = [("state_idx", int(table.n_states)),
+              ("sector_idx", int(table.n_sectors))]
+    if profiles is not None:
+        bounds += [
+            ("load_idx", int(np.asarray(profiles.load).shape[0])),
+            ("cf_idx", int(np.asarray(profiles.solar_cf).shape[0])),
+            ("region_idx", int(np.asarray(profiles.wholesale).shape[0])),
+        ]
+    if tariffs is not None:
+        n_t = int(np.asarray(tariffs.metering).shape[0])
+        bounds += [("tariff_idx", n_t), ("tariff_switch_idx", n_t)]
+    for name, n in bounds:
+        col = np.asarray(getattr(table, name))
+        flag((col < 0) | (col >= n), f"index:{name}")
+
+    # 4. unusable profile-bank rows -> quarantine every referencing
+    # agent and remember the rows for sanitization
+    if profiles is not None:
+        for field, idx_name, scales, nonneg in (
+            ("load", "load_idx",
+             getattr(profiles, "load_scale", None), True),
+            ("solar_cf", "cf_idx",
+             getattr(profiles, "solar_cf_scale", None), True),
+            # real wholesale prices go negative; only nonfinite is bad
+            ("wholesale", "region_idx", None, False),
+        ):
+            bank = np.asarray(getattr(profiles, field))
+            bad_rows = _bad_bank_rows(
+                bank,
+                None if scales is None else np.asarray(scales),
+                nonneg=nonneg,
+            )
+            if bad_rows.size == 0:
+                continue
+            for r in bad_rows:
+                rep.add_bank_row(field, int(r))
+            idx = np.asarray(getattr(table, idx_name))
+            inb = (idx >= 0) & (idx < bank.shape[0])
+            for r in bad_rows:
+                flag(inb & (idx == r), f"bank:{field}[{int(r)}]")
+
+    # 5. unusable tariff rows (nonfinite anywhere, negative buy price)
+    if tariffs is not None:
+        price = np.asarray(tariffs.price, dtype=np.float32)
+        bad_t = ~np.isfinite(price).all(axis=(1, 2))
+        bad_t |= (np.where(np.isfinite(price), price, 0.0) < 0).any(
+            axis=(1, 2))
+        for name in ("sell_price", "tier_cap", "fixed_monthly"):
+            a = np.asarray(getattr(tariffs, name), dtype=np.float32)
+            bad_t |= ~np.isfinite(a).all(
+                axis=tuple(range(1, a.ndim)))
+        bad_rows = np.flatnonzero(bad_t)
+        if bad_rows.size:
+            for r in bad_rows:
+                rep.add_bank_row("tariff", int(r))
+            n_t = price.shape[0]
+            for idx_name in ("tariff_idx", "tariff_switch_idx"):
+                idx = np.asarray(getattr(table, idx_name))
+                inb = (idx >= 0) & (idx < n_t)
+                for r in bad_rows:
+                    flag(inb & (idx == r), f"tariff:[{int(r)}]")
+
+    if rep.n_quarantined > MAX_QUARANTINE:
+        raise ValueError(
+            f"validation would quarantine {rep.n_quarantined} of "
+            f"{rep.n_agents} agents (> {MAX_QUARANTINE}); this is an "
+            "input-file problem, not row corruption — refusing to mask "
+            "it (reasons: "
+            f"{sorted(rep.reason_counts())[:5]})"
+        )
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# Containment
+# ---------------------------------------------------------------------------
+
+def apply_quarantine(table, profiles, report: QuarantineReport):
+    """Contain a report's rows: quarantined agents become padding
+    (mask 0, bank indices 0, the ``_PAD_FILLS`` sentinel fills, zeroed
+    incentives — the exact layout ``models.agents.pad_table`` gives
+    masked rows, so they contribute exact zeros everywhere padding
+    already does), and unreadable profile-bank rows are zeroed (quant
+    scales to 1.0) so daylight layouts, quantization and whole-bank
+    scans stay NaN-free.  Stable ``agent_id`` is preserved — the serve
+    layer answers 422 by id.  Returns ``(table, profiles)``; the inputs
+    are returned untouched (object identity) for a clean report."""
+    if report.is_clean:
+        return table, profiles
+
+    import jax.numpy as jnp
+
+    from dgen_tpu.models.agents import _PAD_FILLS
+
+    mask = np.asarray(table.mask)
+    q = np.isin(np.asarray(table.agent_id), np.asarray(report.ids)) \
+        & (mask > 0)
+    if q.any():
+        repl = {}
+        for f in dataclasses.fields(type(table)):
+            if f.name in ("incentives", "n_states", "agent_id", "mask"):
+                continue
+            col = np.asarray(getattr(table, f.name))
+            fill = np.asarray(_PAD_FILLS.get(f.name, 0), dtype=col.dtype)
+            shaped = np.broadcast_to(
+                q.reshape((-1,) + (1,) * (col.ndim - 1)), col.shape)
+            repl[f.name] = jnp.asarray(np.where(shaped, fill, col))
+        repl["mask"] = jnp.asarray(
+            np.where(q, 0.0, mask).astype(mask.dtype))
+        inc = table.incentives
+        inc_repl = {}
+        for f in dataclasses.fields(type(inc)):
+            leaf = np.asarray(getattr(inc, f.name))
+            shaped = np.broadcast_to(
+                q.reshape((-1,) + (1,) * (leaf.ndim - 1)), leaf.shape)
+            inc_repl[f.name] = jnp.asarray(np.where(
+                shaped, np.asarray(0, dtype=leaf.dtype), leaf))
+        table = dataclasses.replace(
+            table, incentives=dataclasses.replace(inc, **inc_repl),
+            **repl,
+        )
+
+    bank_repl = {}
+    for field in ("load", "solar_cf", "wholesale"):
+        rows = report.bank_rows.get(field) or []
+        if not rows:
+            continue
+        arr = np.array(np.asarray(getattr(profiles, field)))
+        arr[np.asarray(rows, dtype=np.intp)] = 0
+        bank_repl[field] = jnp.asarray(arr)
+        scale_name = {"load": "load_scale",
+                      "solar_cf": "solar_cf_scale"}.get(field)
+        if scale_name and getattr(profiles, scale_name, None) is not None:
+            sc = np.array(np.asarray(getattr(profiles, scale_name)))
+            sc[np.asarray(rows, dtype=np.intp)] = 1.0
+            bank_repl[scale_name] = jnp.asarray(sc)
+    if bank_repl:
+        profiles = dataclasses.replace(profiles, **bank_repl)
+
+    logger.warning(
+        "quarantine: contained %d agent(s)%s — reasons %s",
+        report.n_quarantined,
+        "".join(
+            f", zeroed {len(v)} {k} bank row(s)"
+            for k, v in report.bank_rows.items()
+            if v and k != "tariff"
+        ),
+        report.reason_counts(),
+    )
+    return table, profiles

@@ -1,0 +1,295 @@
+"""The quarantine drill: prove, on CPU, that corrupt rows injected at
+ingest, at bank load, and MID-run are detected, attributed to exactly
+the injected rows, and contained — with the supervised run's parquet
+bit-exact against a clean baseline on all non-quarantined rows.
+
+``python -m dgen_tpu.resilience drill --quarantine`` runs it
+(tools/check.sh wires the ``--fast`` smoke: the two load-time rounds).
+
+Rounds:
+
+* **ingest** — ``ingest_corrupt_row`` (kind ``corrupt``) damages two
+  deterministic agent rows at table build (NaN customer count, an
+  out-of-range tariff reference).  Load-time validation must
+  quarantine exactly those rows, the run must succeed on the FIRST
+  attempt (zero retries — detection beats failure), and every parquet
+  partition must be byte-identical to a clean-population baseline run
+  under the same quarantine report: containment means the corrupt
+  values influenced nothing that survived.
+* **bank** — ``bank_corrupt_row@1`` NaNs a profile-bank row at load.
+  Validation must quarantine every agent referencing the row, zero the
+  row, and again match the pre-quarantined clean baseline byte-for-
+  byte.
+* **sentinel** (skipped under ``--fast``) — ``bank_corrupt_row@3``
+  flips the row MID-run, after a clean exported year.  The health
+  sentinel must breach at that year (never exporting it), the
+  supervisor must attribute + quarantine exactly the referencing
+  agents and resume from the last checkpoint, the pre-breach years
+  must stay byte-identical to an uninterrupted clean run, and the
+  re-run years must be finite with the quarantined rows absent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from dgen_tpu.resilience import faults as faults_mod
+from dgen_tpu.resilience.drill import compare_run_dirs
+from dgen_tpu.resilience.manifest import verify_run_dir
+from dgen_tpu.resilience.quarantine import QuarantineReport
+from dgen_tpu.resilience.supervisor import RetryPolicy, run_supervised
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+def _make_population(n_agents: int, seed: int = 11):
+    from dgen_tpu.io import synth
+
+    return synth.generate_population(
+        n_agents, states=["DE", "CA"], seed=seed, pad_multiple=64,
+    )
+
+
+def _make_sim_factory(pop, inputs, cfg, sizing_iters: int = 8,
+                      prequarantine: Optional[QuarantineReport] = None):
+    from dgen_tpu.models.simulation import Simulation
+
+    def make_sim(rc):
+        rc = dataclasses.replace(
+            rc, sizing_iters=sizing_iters, guard_retrace=True,
+        )
+        return Simulation(
+            pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc,
+            quarantine=prequarantine,
+        )
+
+    return make_sim
+
+
+def _load_report(run_dir: str) -> QuarantineReport:
+    return QuarantineReport.load(os.path.join(run_dir, "quarantine.json"))
+
+
+def _exported_ids(run_dir: str, year: int) -> np.ndarray:
+    import pandas as pd
+
+    p = os.path.join(run_dir, "agent_outputs", f"year={year}.parquet")
+    return np.asarray(pd.read_parquet(p, columns=["agent_id"])["agent_id"])
+
+
+def _all_parquet_finite(run_dir: str) -> bool:
+    import pandas as pd
+
+    for sub in ("agent_outputs", "finance_series", "state_hourly"):
+        d = os.path.join(run_dir, sub)
+        if not os.path.isdir(d):
+            continue
+        for f in sorted(os.listdir(d)):
+            if not f.endswith(".parquet"):
+                continue
+            df = pd.read_parquet(os.path.join(d, f))
+            for col in df.columns:
+                v = df[col].values
+                if v.dtype == object:
+                    v = np.stack(v)
+                if v.dtype.kind in "fc" and not np.isfinite(v).all():
+                    return False
+    return True
+
+
+def run_quarantine_drill(
+    root: str,
+    *,
+    n_agents: int = 96,
+    end_year: int = 2016,
+    fast: bool = False,
+    policy: Optional[RetryPolicy] = None,
+) -> Dict[str, object]:
+    """Run the quarantine drill under ``root``; returns the drill
+    record (``ok`` plus per-round detail — the bench payload shape)."""
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.models import scenario as scen
+
+    policy = policy or RetryPolicy(max_retries=3, backoff_base_s=0.01)
+    cfg = ScenarioConfig(
+        name="qdrill", start_year=2014, end_year=end_year,
+        anchor_years=(),
+    )
+    pop = _make_population(n_agents)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+    )
+    n_real = int(np.sum(np.asarray(pop.table.mask) > 0))
+    rounds: Dict[str, dict] = {}
+    ok = True
+
+    def supervised(make_sim, run_dir):
+        return run_supervised(
+            make_sim, RunConfig(), run_dir=run_dir, collect=False,
+            policy=policy,
+        )
+
+    # ---- round 1: corrupt rows at INGEST --------------------------------
+    t0 = time.perf_counter()
+    with faults_mod.injected("ingest_corrupt_row@1:corrupt") as reg:
+        pop_c = _make_population(n_agents)
+    expected_ingest = sorted(
+        {int(r) % n_real for r in faults_mod.corrupt_rows()}
+    )
+    d_corrupt = os.path.join(root, "ingest")
+    _, rep1 = supervised(
+        _make_sim_factory(pop_c, inputs, cfg), d_corrupt)
+    q1 = _load_report(d_corrupt)
+    d_base1 = os.path.join(root, "ingest_baseline")
+    _, _ = supervised(
+        _make_sim_factory(pop, inputs, cfg, prequarantine=q1), d_base1)
+    cmp1 = compare_run_dirs(d_base1, d_corrupt)
+    verify1 = all(r.ok for r in verify_run_dir(d_corrupt))
+    r1_ok = bool(
+        reg.fired("ingest_corrupt_row") == 1
+        and rep1.succeeded and rep1.retries == 0
+        and list(q1.ids) == expected_ingest
+        and cmp1["ok"] and verify1
+    )
+    rounds["ingest"] = {
+        "fired": reg.fired("ingest_corrupt_row"),
+        "retries": rep1.retries,
+        "quarantined_ids": list(q1.ids),
+        "expected_ids": expected_ingest,
+        "parquet_bit_exact": cmp1["ok"],
+        "compared": cmp1["compared"],
+        "verify_ok": verify1,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "ok": r1_ok,
+    }
+    ok = ok and r1_ok
+    logger.info("quarantine drill ingest: %s", "ok" if r1_ok else "FAILED")
+
+    # ---- round 2: corrupt bank row at LOAD ------------------------------
+    t0 = time.perf_counter()
+    n_bank = int(np.asarray(pop.profiles.load).shape[0])
+    bank_row = int(faults_mod.corrupt_rows()[0]) % n_bank
+    keep = np.asarray(pop.table.mask) > 0
+    li = np.asarray(pop.table.load_idx)
+    expected_bank = sorted(
+        int(a) for a in np.asarray(pop.table.agent_id)[
+            keep & (li == bank_row)]
+    )
+    d_bank = os.path.join(root, "bank")
+    with faults_mod.injected("bank_corrupt_row@1:corrupt") as reg2:
+        _, rep2 = supervised(
+            _make_sim_factory(pop, inputs, cfg), d_bank)
+    q2 = _load_report(d_bank)
+    d_base2 = os.path.join(root, "bank_baseline")
+    _, _ = supervised(
+        _make_sim_factory(pop, inputs, cfg, prequarantine=q2), d_base2)
+    cmp2 = compare_run_dirs(d_base2, d_bank)
+    verify2 = all(r.ok for r in verify_run_dir(d_bank))
+    r2_ok = bool(
+        reg2.fired("bank_corrupt_row") == 1
+        and rep2.succeeded and rep2.retries == 0
+        and list(q2.ids) == expected_bank
+        and q2.bank_rows.get("load") == [bank_row]
+        and cmp2["ok"] and verify2
+    )
+    rounds["bank"] = {
+        "fired": reg2.fired("bank_corrupt_row"),
+        "retries": rep2.retries,
+        "quarantined_ids": list(q2.ids),
+        "expected_ids": expected_bank,
+        "bank_rows": dict(q2.bank_rows),
+        "parquet_bit_exact": cmp2["ok"],
+        "compared": cmp2["compared"],
+        "verify_ok": verify2,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "ok": r2_ok,
+    }
+    ok = ok and r2_ok
+    logger.info("quarantine drill bank: %s", "ok" if r2_ok else "FAILED")
+
+    # ---- round 3: silent MID-run corruption -> sentinel -----------------
+    if not fast:
+        t0 = time.perf_counter()
+        cfg3 = ScenarioConfig(
+            name="qdrill-sentinel", start_year=2014,
+            end_year=max(end_year, 2018), anchor_years=(),
+        )
+        inputs3 = scen.uniform_inputs(
+            cfg3, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+        )
+        d_clean = os.path.join(root, "sentinel_clean")
+        _, rep_clean = supervised(
+            _make_sim_factory(pop, inputs3, cfg3), d_clean)
+        d_sent = os.path.join(root, "sentinel")
+        # hits of bank_corrupt_row in attempt 1: #1 = Simulation
+        # construction (clean), #2 = before the 2014 step, #3 = before
+        # the 2016 step -> the corruption lands AFTER a clean exported
+        # year, so only the sentinel can catch it
+        with faults_mod.injected("bank_corrupt_row@3:corrupt") as reg3:
+            _, rep3 = supervised(
+                _make_sim_factory(pop, inputs3, cfg3), d_sent)
+        q3 = _load_report(d_sent)
+        breach_year_ok = any(
+            "year-2016" in d for d in rep3.degradations
+        )
+        # pre-breach years byte-identical to the uninterrupted clean
+        # run; the breached year re-ran under quarantine, so assert
+        # finiteness + exact exclusion there instead
+        pre = compare_run_dirs(d_clean, d_sent)
+        pre_ok = not any(
+            "year=2014" in rel for rel in pre["mismatched"]
+        )
+        excluded = [
+            bool(np.isin(q3.ids, _exported_ids(d_sent, y)).any())
+            for y in (2016, 2018)
+        ]
+        verify3 = all(r.ok for r in verify_run_dir(d_sent))
+        r3_ok = bool(
+            reg3.fired("bank_corrupt_row") == 1
+            and rep_clean.retries == 0
+            and rep3.succeeded and rep3.retries >= 1
+            and breach_year_ok
+            and list(q3.ids) == expected_bank
+            and not any(excluded)
+            and pre_ok
+            and _all_parquet_finite(d_sent)
+            and verify3
+        )
+        rounds["sentinel"] = {
+            "fired": reg3.fired("bank_corrupt_row"),
+            "retries": rep3.retries,
+            "degradations": rep3.degradations,
+            "quarantined_ids": list(q3.ids),
+            "expected_ids": expected_bank,
+            "pre_breach_bit_exact": pre_ok,
+            "quarantined_absent_post_breach": not any(excluded),
+            "verify_ok": verify3,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "ok": r3_ok,
+        }
+        ok = ok and r3_ok
+        logger.info(
+            "quarantine drill sentinel: %s", "ok" if r3_ok else "FAILED")
+
+    return {
+        "ok": ok,
+        "n_agents": n_agents,
+        "end_year": end_year,
+        "fast": fast,
+        "rounds": rounds,
+    }
+
+
+if __name__ == "__main__":  # manual runs: python -m ...quarantinedrill
+    import tempfile
+
+    rec = run_quarantine_drill(tempfile.mkdtemp(prefix="dgen-qdrill-"))
+    print(json.dumps(rec, indent=1))
+    raise SystemExit(0 if rec["ok"] else 1)

@@ -51,6 +51,11 @@ OOM = "oom"
 HOSTIO = "hostio"
 TRANSIENT = "transient"
 FATAL = "fatal"
+#: a numerical-health sentinel breach (models.health.HealthBreachError):
+#: the degradation quarantines the attributed agents
+#: (RunConfig.quarantine_ids) and re-enters from the resume frontier —
+#: the breached year re-runs with the offenders contained
+HEALTH = "health"
 
 #: substrings that mark a device allocation failure in XLA/runtime
 #: errors (real TPU OOMs raise XlaRuntimeError with RESOURCE_EXHAUSTED;
@@ -71,11 +76,20 @@ _FATAL_TYPES = (ValueError, TypeError, KeyError, AttributeError,
 
 
 def classify_error(exc: BaseException) -> str:
-    """Sort an escaped exception into OOM / HOSTIO / TRANSIENT / FATAL
-    (module docstring has the policy attached to each class)."""
+    """Sort an escaped exception into OOM / HOSTIO / HEALTH /
+    TRANSIENT / FATAL (module docstring has the policy attached to
+    each class)."""
     msg = f"{type(exc).__name__}: {exc}"
     if any(m in msg for m in _OOM_MARKERS):
         return OOM
+    # duck-typed (name + breach payload) so this module stays jax-free
+    # for the gang supervisor; models.health.HealthBreachError is the
+    # only producer of the shape
+    if (
+        type(exc).__name__ == "HealthBreachError"
+        and hasattr(exc, "breaches")
+    ):
+        return HEALTH
     if isinstance(exc, faults_mod.FaultError):
         if exc.site in _HOSTIO_SITES:
             return HOSTIO
@@ -180,13 +194,39 @@ class Supervisor:
     # -- degradation ----------------------------------------------------
 
     def _degrade(self, rc, cls: str, ctx: AttemptContext,
-                 hostio_failures: int
+                 hostio_failures: int,
+                 exc: Optional[BaseException] = None,
                  ) -> tuple[Any, Optional[str], bool]:
         """The degraded config for the next attempt, a human
         description of what changed (None = plain retry), and a
         give-up flag: True means no degradation can help (e.g. OOM at
         the chunk floor is deterministic — re-running it is noise, not
         resilience), so the caller re-raises instead of retrying."""
+        if cls == HEALTH:
+            ids = tuple(
+                int(a) for a in getattr(exc, "agent_ids", ()) or ()
+            )
+            if ids:
+                merged = tuple(sorted(
+                    set(rc.quarantine_ids or ()) | set(ids)
+                ))
+                if merged != (rc.quarantine_ids or ()):
+                    rc = dataclasses.replace(rc, quarantine_ids=merged)
+                    return rc, (
+                        f"health: quarantined {len(ids)} agent(s) "
+                        f"after the year-{getattr(exc, 'year', '?')} "
+                        "breach"
+                    ), False
+                # same offenders breached again THROUGH the quarantine:
+                # containment is not working, retrying cannot help
+                logger.error(
+                    "health breach repeats over already-quarantined "
+                    "agents — giving up")
+                return rc, None, True
+            # unattributed breach (no-consumer pipelined run): plain
+            # retry — a deterministic corruption will exhaust the
+            # budget and surface, a transient one heals
+            return rc, None, False
         if cls == OOM:
             chunk = rc.agent_chunk if rc.agent_chunk else None
             if chunk is None:
@@ -264,7 +304,7 @@ class Supervisor:
                 degradation = None
                 if not give_up:
                     rc, degradation, give_up = self._degrade(
-                        rc, cls, ctx, hostio_failures)
+                        rc, cls, ctx, hostio_failures, exc=e)
                 if give_up:
                     try:
                         e.supervisor_report = report  # type: ignore[attr-defined]
@@ -340,6 +380,11 @@ def run_supervised(
     from dgen_tpu.io import checkpoint as ckpt
 
     rc = run_config or RunConfig()
+    # supervised runs escalate sentinel breaches by default: the
+    # breach -> attribute -> quarantine -> resume loop is exactly what
+    # this supervisor exists for (plain Simulation.run only warns)
+    if rc.sentinel_escalate is None:
+        rc = dataclasses.replace(rc, sentinel_escalate=True)
     installed: Optional[faults_mod.FaultRegistry] = None
     if faults_mod.active() is None:
         spec = getattr(rc, "faults", None) or os.environ.get(
@@ -423,6 +468,21 @@ def run_supervised(
             faults_mod.install(None)
     if manifest is not None and checkpoint_dir is not None:
         manifest.record_checkpoints(checkpoint_dir, sim.years)
+    # publish the quarantine ledger: the reasoned report lands as an
+    # atomic quarantine.json beside meta.json, is content-hash recorded
+    # in the manifest, and its summary is stamped into the exporter's
+    # quarantine meta block (beside nonfinite_zeroed)
+    rep_q = getattr(sim, "quarantine_report", None)
+    if rep_q is not None and run_dir is not None:
+        import jax
+
+        if jax.process_index() == 0:
+            rep_q.save(os.path.join(run_dir, "quarantine.json"))
+            if manifest is not None:
+                manifest.record_run_artifact("quarantine.json")
+                manifest.flush()
+        if exporter is not None:
+            exporter.stamp_quarantine(rep_q.summary())
     if exporter is not None:
         exporter.stamp_meta(supervisor=report.to_json())
     return res, report

@@ -279,6 +279,15 @@ class ServeEngine:
         for row in np.flatnonzero(mask):
             self._id_to_row.setdefault(int(ids[row]), int(row))
         self.n_agents = int(mask.sum())
+        # quarantined agents (resilience.quarantine): their rows exist
+        # but were contained at load (mask 0) — a query for one answers
+        # 422 with the machine-readable reasons, never a silent-garbage
+        # 200 and never an indistinguishable-from-typo 400
+        rep = getattr(sim, "quarantine_report", None)
+        self._quarantined: Dict[int, list] = (
+            {int(a): rep.reasons_for(a) for a in rep.ids}
+            if rep is not None else {}
+        )
         self._static_kwargs = query_static_kwargs(sim)
         self._override_cache: "OrderedDict[str, ScenarioInputs]" = (
             OrderedDict()
@@ -303,7 +312,12 @@ class ServeEngine:
 
     def rows_for(self, agent_ids: Sequence[int]) -> np.ndarray:
         """[n] int32 table rows for stable agent ids; unknown ids raise
-        KeyError naming the id (a clean 4xx at the HTTP layer)."""
+        KeyError naming the id (a clean 4xx at the HTTP layer) and
+        quarantined ids raise
+        :class:`~dgen_tpu.resilience.quarantine.QuarantinedAgentError`
+        (422: the row exists, its data was contained at load)."""
+        from dgen_tpu.resilience.quarantine import QuarantinedAgentError
+
         rows = np.empty(len(agent_ids), dtype=np.int32)
         for i, a in enumerate(agent_ids):
             try:
@@ -312,7 +326,12 @@ class ServeEngine:
                 # silently answer for the WRONG agent)
                 if ai != a:
                     raise ValueError("non-integer id")
+                if ai in self._quarantined:
+                    raise QuarantinedAgentError(
+                        ai, self._quarantined[ai])
                 rows[i] = self._id_to_row[ai]
+            except QuarantinedAgentError:
+                raise
             except (KeyError, TypeError, ValueError):
                 raise KeyError(f"unknown agent_id {a!r}") from None
         return rows

@@ -61,6 +61,7 @@ import numpy as np
 
 from dgen_tpu.config import ServeConfig
 from dgen_tpu.io.export import provenance_stamp
+from dgen_tpu.resilience.quarantine import QuarantinedAgentError
 from dgen_tpu.serve.batcher import Microbatcher, QueueFullError
 from dgen_tpu.serve.engine import QUERY_FIELDS, OverrideError, ServeEngine
 from dgen_tpu.utils import compilecache, timing
@@ -482,6 +483,18 @@ class _Handler(_JsonHandler):
             self._send(503, {"error": str(e), "retry": True,
                              "draining": True},
                        headers={"Retry-After": str(_RETRY_AFTER_S)})
+        except QuarantinedAgentError as e:
+            # the agent exists but its data was contained at load
+            # (resilience.quarantine): 422 with the reasons, so a
+            # client can distinguish bad-data containment from a typo'd
+            # id (400) and stop retrying
+            self._send(422, {
+                "error": str(e),
+                "quarantine": {
+                    "agent_id": e.agent_id,
+                    "reasons": e.reasons,
+                },
+            })
         except (KeyError, ValueError, OverrideError) as e:
             # KeyError's str() re-quotes its message; unwrap it
             msg = e.args[0] if isinstance(e, KeyError) and e.args else str(e)

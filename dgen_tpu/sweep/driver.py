@@ -557,6 +557,7 @@ class SweepSimulation:
                 sim._hostio_pool = None
             if pool is not None:
                 pool.close()
+        rep_q = getattr(self.base, "quarantine_report", None)
         return SweepResults(
             labels=list(self.labels),
             baseline=self.baseline,
@@ -565,4 +566,11 @@ class SweepSimulation:
             bank_bytes_shared=self.bank_bytes_shared,
             host_mask=self.base.host_mask,
             host_agent_id=self.base.host_agent_id,
+            # load-time quarantine of the ONE shared table: the mask
+            # rides every scenario/shard unchanged, so a single block
+            # attributes the whole sweep's exports
+            quarantine=(
+                rep_q.summary()
+                if rep_q is not None and not rep_q.is_clean else None
+            ),
         )

@@ -52,6 +52,12 @@ class SweepResults:
     bank_bytes_shared: int        # profile-bank bytes uploaded ONCE
     host_mask: np.ndarray
     host_agent_id: np.ndarray
+    #: load-time quarantine summary of the shared population
+    #: (resilience.quarantine; None = validation off, {} = clean):
+    #: every scenario runs over the SAME contained table, so one block
+    #: covers the whole sweep — stamped into each scenario's meta.json
+    #: and into sweep.json by :meth:`export`
+    quarantine: Optional[Dict[str, object]] = None
 
     @property
     def n_scenarios(self) -> int:
@@ -185,6 +191,11 @@ class SweepResults:
                     "scenario_index": i,
                     "sweep_baseline": self.labels[self.baseline],
                     "sweep_n_scenarios": self.n_scenarios,
+                    # the shared population's load-time quarantine
+                    # block: the mask is carried through sharding and
+                    # every scenario, so each exported surface names it
+                    **({"quarantine": self.quarantine}
+                       if self.quarantine else {}),
                     **(meta or {}),
                 },
             )
@@ -198,6 +209,8 @@ class SweepResults:
             report = {"delta_report_unavailable": str(e),
                       "baseline": self.labels[self.baseline]}
         report["bank_bytes_shared"] = int(self.bank_bytes_shared)
+        if self.quarantine:
+            report["quarantine"] = self.quarantine
         report["groups"] = [
             {"mode": g.mode, "net_billing": bool(g.net_billing),
              "scenarios": [self.labels[i] for i in g.indices]}

@@ -62,9 +62,25 @@ def check_transform(
             )
 
 
+def nonfinite_rows(arr, k: int = 8) -> np.ndarray:
+    """First ``k`` leading-axis (agent) indices holding any non-finite
+    value — the attribution primitive shared by :func:`check_finite`'s
+    error messages and the health sentinel's narrowing step
+    (``dgen_tpu.models.health``)."""
+    a = np.asarray(arr)
+    if a.ndim == 0:
+        return np.asarray([0] if not np.isfinite(a) else [],
+                          dtype=np.int64)
+    bad = ~np.isfinite(a.reshape(a.shape[0], -1)).all(axis=1)
+    return np.flatnonzero(bad)[:k]
+
+
 def check_finite(tree, allow_nonfinite: Optional[Iterable[str]] = None,
-                 context: str = "") -> None:
-    """Assert every float leaf is finite (allowlist by path substring).
+                 context: str = "", top_k: int = 8) -> None:
+    """Assert every float leaf is finite (allowlist by path substring);
+    violations name the first ``top_k`` offending *agent indices*
+    (leading-axis rows), not just the leaf path, so a failure is
+    attributable without a rerun.
 
     Host-side check — call sparingly (it syncs device values)."""
     allow = tuple(allow_nonfinite or ())
@@ -74,6 +90,8 @@ def check_finite(tree, allow_nonfinite: Optional[Iterable[str]] = None,
         arr = np.asarray(leaf)
         if arr.dtype.kind == "f" and not np.isfinite(arr).all():
             n_bad = int((~np.isfinite(arr)).sum())
+            rows = nonfinite_rows(arr, k=top_k).tolist()
             raise InvariantViolation(
-                f"{context}: {n_bad} non-finite values in {path}"
+                f"{context}: {n_bad} non-finite values in {path} "
+                f"(first offending agent rows: {rows})"
             )

@@ -1,0 +1,508 @@
+"""Bad-data quarantine + numerical-health sentinel
+(dgen_tpu.resilience.quarantine / dgen_tpu.models.health / the
+supervisor's breach -> attribute -> quarantine -> resume loop)."""
+
+import dataclasses
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+from dgen_tpu.resilience import faults
+from dgen_tpu.resilience.quarantine import (
+    QuarantinedAgentError,
+    QuarantineReport,
+    apply_quarantine,
+    quant_sidecar_bad_rows,
+    validate_population,
+)
+
+N = 96
+STATES = ["DE", "CA"]
+
+
+def _pop(seed=11, n=N):
+    return synth.generate_population(
+        n, states=STATES, seed=seed, pad_multiple=64)
+
+
+def _sim_parts(pop, end_year=2016):
+    cfg = ScenarioConfig(
+        name="q", start_year=2014, end_year=end_year, anchor_years=())
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions)
+    return cfg, inputs
+
+
+def _make_sim(pop, cfg, inputs, rc=None, **kw):
+    return Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+        rc or RunConfig(sizing_iters=8), **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+def test_clean_population_validates_clean():
+    pop = _pop()
+    rep = validate_population(pop.table, pop.profiles, pop.tariffs)
+    assert rep.is_clean
+    assert rep.n_agents == N
+    assert rep.summary()["n_quarantined"] == 0
+
+
+def test_validation_flags_nonfinite_and_bad_references():
+    pop = _pop()
+    t = pop.table
+    cust = np.array(np.asarray(t.customers_in_bin))
+    cust[5] = np.nan
+    lk = np.array(np.asarray(t.load_kwh_per_customer_in_bin))
+    lk[7] = -1e4                       # negative load
+    ti = np.array(np.asarray(t.tariff_idx))
+    ti[9] = 999999                     # out-of-range tariff ref
+    bad = dataclasses.replace(
+        t, customers_in_bin=jnp.asarray(cust),
+        load_kwh_per_customer_in_bin=jnp.asarray(lk),
+        tariff_idx=jnp.asarray(ti),
+    )
+    rep = validate_population(bad, pop.profiles, pop.tariffs)
+    assert rep.ids == (5, 7, 9)
+    assert "nonfinite:customers_in_bin" in rep.reasons_for(5)
+    assert "range:load_kwh_per_customer_in_bin" in rep.reasons_for(7)
+    assert "index:tariff_idx" in rep.reasons_for(9)
+    # padding rows are never validated
+    assert all(r["row"] < t.n_agents for r in rep.records.values())
+
+
+def test_validation_flags_bad_bank_row_and_referencing_agents():
+    pop = _pop()
+    load = np.array(np.asarray(pop.profiles.load))
+    load[2] = np.nan
+    profiles = dataclasses.replace(pop.profiles, load=jnp.asarray(load))
+    rep = validate_population(pop.table, profiles, pop.tariffs)
+    assert rep.bank_rows["load"] == [2]
+    keep = np.asarray(pop.table.mask) > 0
+    expected = sorted(
+        int(a) for a in np.asarray(pop.table.agent_id)[
+            keep & (np.asarray(pop.table.load_idx) == 2)]
+    )
+    assert list(rep.ids) == expected
+    for a in expected:
+        assert "bank:load[2]" in rep.reasons_for(a)
+
+
+def test_quant_sidecar_zero_scale_all_zero_row_is_valid():
+    # PR 12's floor path: an all-zero load row may carry scale 0.0
+    # (quantize_rows stores 1.0; an external writer may store 0.0 —
+    # dequantization is exact zero either way)
+    codes = np.zeros((3, 8), np.int8)
+    codes[1, :] = 5
+    scales = np.asarray([0.0, 2.0, 1.0], np.float32)
+    assert quant_sidecar_bad_rows(codes, scales).size == 0
+    # zero scale under NONZERO codes flattens real data -> bad
+    scales2 = np.asarray([0.0, 0.0, 1.0], np.float32)
+    assert quant_sidecar_bad_rows(codes, scales2).tolist() == [1]
+    # nonfinite / negative scales destroy the row
+    scales3 = np.asarray([np.nan, 2.0, -1.0], np.float32)
+    assert quant_sidecar_bad_rows(codes, scales3).tolist() == [0, 2]
+
+
+def test_validation_refuses_wholesale_corruption_masquerade():
+    # > MAX_QUARANTINE rows bad means the INPUT FILE is wrong; masking
+    # it as quarantine would hide a pipeline bug
+    from dgen_tpu.resilience import quarantine as q
+
+    pop = _pop()
+    cust = np.array(np.asarray(pop.table.customers_in_bin))
+    cust[:] = np.nan
+    bad = dataclasses.replace(
+        pop.table, customers_in_bin=jnp.asarray(cust))
+    old = q.MAX_QUARANTINE
+    q.MAX_QUARANTINE = 10
+    try:
+        with pytest.raises(ValueError, match="refusing"):
+            validate_population(bad, pop.profiles, pop.tariffs)
+    finally:
+        q.MAX_QUARANTINE = old
+
+
+# ---------------------------------------------------------------------------
+# containment
+# ---------------------------------------------------------------------------
+
+def test_apply_quarantine_clean_report_is_identity():
+    pop = _pop()
+    rep = QuarantineReport(n_agents=N)
+    t2, p2 = apply_quarantine(pop.table, pop.profiles, rep)
+    assert t2 is pop.table and p2 is pop.profiles
+
+
+def test_apply_quarantine_makes_rows_inert_padding():
+    pop = _pop()
+    rep = QuarantineReport(n_agents=N)
+    rep.add(4, 4, "test")
+    rep.add_bank_row("load", 1)
+    t2, p2 = apply_quarantine(pop.table, pop.profiles, rep)
+    assert np.asarray(t2.mask)[4] == 0.0
+    assert np.asarray(t2.agent_id)[4] == 4          # id preserved
+    assert np.asarray(t2.customers_in_bin)[4] == 0.0
+    assert np.asarray(t2.nem_kw_limit)[4] >= 1e29   # pad sentinel
+    assert np.asarray(t2.switch_min_kw)[4] >= 1e29
+    assert np.asarray(t2.tariff_idx)[4] == 0
+    assert np.all(np.asarray(p2.load)[1] == 0.0)
+    # dtypes/shapes unchanged -> same compiled program
+    for f in dataclasses.fields(type(pop.table)):
+        if f.name == "n_states":
+            continue
+        a, b = getattr(pop.table, f.name), getattr(t2, f.name)
+        for la, lb in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        ):
+            assert la.shape == lb.shape and la.dtype == lb.dtype
+
+
+def test_report_roundtrips_through_json(tmp_path):
+    rep = QuarantineReport(n_agents=5, context="load")
+    rep.add(3, 3, "nonfinite:customers_in_bin")
+    rep.add(3, 3, "index:tariff_idx")
+    rep.add_bank_row("load", 2)
+    p = str(tmp_path / "quarantine.json")
+    rep.save(p)
+    back = QuarantineReport.load(p)
+    assert back.ids == (3,)
+    assert back.reasons_for(3) == rep.reasons_for(3)
+    assert back.bank_rows == {"load": [2]}
+    assert back.n_agents == 5
+
+
+def test_ingest_corruption_contained_bit_exact_vs_prequarantined():
+    """The containment theorem: a corrupted-then-quarantined run is
+    BIT-IDENTICAL to a clean run with the same rows pre-quarantined —
+    the corrupt values influenced nothing that survived."""
+    pop = _pop()
+    with faults.injected("ingest_corrupt_row@1:corrupt") as reg:
+        pop_c = _pop()
+    assert reg.fired("ingest_corrupt_row") == 1
+    cfg, inputs = _sim_parts(pop)
+    sim_c = _make_sim(pop_c, cfg, inputs)
+    assert sim_c.quarantine_report.ids == (3, 17)
+    res_c = sim_c.run()
+    rep = sim_c.quarantine_report
+    sim_b = _make_sim(pop, cfg, inputs, quarantine=rep)
+    res_b = sim_b.run()
+    for k in res_c.agent:
+        np.testing.assert_array_equal(res_c.agent[k], res_b.agent[k])
+
+
+def test_quarantine_ids_config_round_trip():
+    pop = _pop()
+    cfg, inputs = _sim_parts(pop)
+    rc = RunConfig(sizing_iters=8, quarantine_ids=(2, 11))
+    sim = _make_sim(pop, cfg, inputs, rc=rc)
+    assert set(sim.quarantine_report.ids) == {2, 11}
+    assert "config:quarantine_ids" in sim.quarantine_report.reasons_for(2)
+    assert np.asarray(sim.table.mask)[2] == 0.0
+
+
+def test_validate_kill_switch(monkeypatch):
+    monkeypatch.setenv("DGEN_TPU_VALIDATE", "0")
+    assert not RunConfig().validate_enabled
+    monkeypatch.setenv("DGEN_TPU_SENTINEL", "0")
+    assert not RunConfig().sentinel_enabled
+    monkeypatch.delenv("DGEN_TPU_VALIDATE")
+    monkeypatch.delenv("DGEN_TPU_SENTINEL")
+    assert RunConfig().validate_enabled
+    assert RunConfig().sentinel_enabled
+    assert RunConfig(validate_inputs=False, health_sentinel=False) \
+        .validate_enabled is False
+
+
+# ---------------------------------------------------------------------------
+# the health sentinel
+# ---------------------------------------------------------------------------
+
+def test_sentinel_clean_run_reports_clean():
+    pop = _pop()
+    cfg, inputs = _sim_parts(pop)
+    sim = _make_sim(pop, cfg, inputs)
+    sim.run()
+    assert sim.health_report is not None
+    assert sim.health_report["clean"]
+
+
+def test_health_summary_counts_masked_rows_only():
+    from dgen_tpu.models import health
+
+    class Outs:
+        pass
+
+    n = 8
+    outs = Outs()
+    for name, _, _ in health.HEALTH_CHECKS:
+        setattr(outs, name, jnp.zeros(n, jnp.float32))
+    # poison a PADDING row (mask 0) and a real row
+    outs.npv = jnp.asarray(
+        [np.nan, 0, 0, 0, 0, 0, 0, np.nan], jnp.float32)
+    mask = jnp.asarray([1, 1, 1, 1, 1, 1, 1, 0], jnp.float32)
+    s = np.asarray(health.health_summary(outs, mask))
+    checks = health.check_host(s)
+    assert checks == [{"leaf": "npv", "nonfinite": 1,
+                       "out_of_bounds": 0}]
+    # gross bound breach (finite garbage) counts too
+    outs.npv = jnp.asarray([1e30] + [0.0] * 7, jnp.float32)
+    checks = health.check_host(
+        np.asarray(health.health_summary(outs, mask)))
+    assert checks == [{"leaf": "npv", "nonfinite": 0,
+                       "out_of_bounds": 1}]
+
+
+def test_sentinel_breach_sync_path_attributes_exactly():
+    """Mid-run bank corruption on the serialized path: the breach
+    names the year and exactly the referencing agents."""
+    from dgen_tpu.models.health import HealthBreachError
+
+    pop = _pop()
+    cfg, inputs = _sim_parts(pop)
+    rc = RunConfig(
+        sizing_iters=8, sentinel_escalate=True, async_host_io=False)
+    sim = _make_sim(pop, cfg, inputs, rc=rc)
+    with faults.injected("bank_corrupt_row@2:corrupt"):
+        with pytest.raises(HealthBreachError) as ei:
+            sim.run()
+    err = ei.value
+    assert err.year == 2016
+    keep = np.asarray(pop.table.mask) > 0
+    li = np.asarray(pop.table.load_idx)
+    expected = sorted(
+        int(a) for a in np.asarray(pop.table.agent_id)[keep & (li == 3)])
+    assert list(err.agent_ids) == expected
+    assert any(b["leaf"] == "npv" for b in err.breaches)
+    assert sim._health_breaches          # recorded before the raise
+
+
+def test_sentinel_breach_async_pipeline_path():
+    """The async host-IO path: the summary rides the batched fetch
+    (HealthConsumer) and the breach surfaces from the pipeline."""
+    from dgen_tpu.models.health import HealthBreachError
+
+    pop = _pop()
+    cfg, inputs = _sim_parts(pop)
+    rc = RunConfig(
+        sizing_iters=8, sentinel_escalate=True, async_host_io=True)
+    sim = _make_sim(pop, cfg, inputs, rc=rc)
+    with faults.injected("bank_corrupt_row@2:corrupt"):
+        with pytest.raises(HealthBreachError) as ei:
+            sim.run(collect=True)
+    assert ei.value.year == 2016
+    assert len(ei.value.agent_ids) > 0
+
+
+class _CaptureHandler(logging.Handler):
+    """The repo logger sets propagate=False, so caplog misses it;
+    capture by attaching a handler directly."""
+
+    def __init__(self):
+        super().__init__()
+        self.messages = []
+
+    def emit(self, record):
+        self.messages.append(record.getMessage())
+
+
+def _captured_dgen_log():
+    h = _CaptureHandler()
+    logging.getLogger("dgen_tpu").addHandler(h)
+    return h
+
+
+def test_sentinel_warn_only_by_default():
+    """Plain (unsupervised) runs WARN on a breach instead of dying —
+    escalation is the supervisor's contract."""
+    pop = _pop()
+    cfg, inputs = _sim_parts(pop)
+    sim = _make_sim(
+        pop, cfg, inputs,
+        rc=RunConfig(sizing_iters=8, async_host_io=False))
+    h = _captured_dgen_log()
+    try:
+        with faults.injected("bank_corrupt_row@2:corrupt"):
+            sim.run()
+    finally:
+        logging.getLogger("dgen_tpu").removeHandler(h)
+    assert sim.health_report is not None
+    assert not sim.health_report["clean"]
+    assert 2016 in sim.health_report["breaches"]
+    assert any("health sentinel" in m for m in h.messages)
+
+
+def test_classify_and_degrade_health():
+    from dgen_tpu.models.health import HealthBreachError
+    from dgen_tpu.resilience.supervisor import (
+        HEALTH,
+        AttemptContext,
+        Supervisor,
+        classify_error,
+    )
+
+    err = HealthBreachError(
+        2016, 1, [{"leaf": "npv", "nonfinite": 3, "out_of_bounds": 0}],
+        agent_rows=(4, 7), agent_ids=(4, 7),
+    )
+    assert classify_error(err) == HEALTH
+    sup = Supervisor()
+    rc = RunConfig(quarantine_ids=(2,))
+    ctx = AttemptContext(attempt=0, run_config=rc, resume=False)
+    rc2, desc, give_up = sup._degrade(rc, HEALTH, ctx, 0, exc=err)
+    assert not give_up
+    assert rc2.quarantine_ids == (2, 4, 7)
+    assert "quarantined 2 agent(s)" in desc
+    # the same offenders breaching THROUGH the quarantine = give up
+    _, _, give_up2 = sup._degrade(rc2, HEALTH, ctx, 0, exc=err)
+    assert give_up2
+
+
+def test_supervised_breach_quarantines_and_recovers(tmp_path):
+    """End-to-end mini sentinel loop: mid-run corruption -> breach ->
+    attributed quarantine -> resume from the last checkpoint -> clean
+    finish with quarantine.json + meta stamped."""
+    from dgen_tpu.resilience.supervisor import run_supervised
+
+    pop = _pop()
+    cfg, inputs = _sim_parts(pop, end_year=2018)
+
+    def make_sim(rc):
+        rc = dataclasses.replace(rc, sizing_iters=8)
+        return _make_sim(pop, cfg, inputs, rc=rc)
+
+    run_dir = str(tmp_path / "run")
+    with faults.injected("bank_corrupt_row@3:corrupt") as reg:
+        res, report = run_supervised(
+            make_sim, RunConfig(), run_dir=run_dir, collect=False,
+        )
+    assert reg.fired("bank_corrupt_row") == 1
+    assert report.succeeded and report.retries >= 1
+    assert any("health: quarantined" in d for d in report.degradations)
+    q = json.load(open(os.path.join(run_dir, "quarantine.json")))
+    keep = np.asarray(pop.table.mask) > 0
+    li = np.asarray(pop.table.load_idx)
+    expected = sorted(
+        int(a) for a in np.asarray(pop.table.agent_id)[keep & (li == 3)])
+    assert sorted(int(a) for a in q["agents"]) == expected
+    meta = json.load(open(os.path.join(run_dir, "meta.json")))
+    assert meta["quarantine"]["n_quarantined"] == len(expected)
+    assert "config:quarantine_ids" in meta["quarantine"]["reasons"]
+    # the breached year re-ran: its export excludes the quarantined ids
+    import pandas as pd
+
+    ids_2016 = pd.read_parquet(
+        os.path.join(run_dir, "agent_outputs", "year=2016.parquet"),
+        columns=["agent_id"],
+    )["agent_id"].to_numpy()
+    assert not np.isin(expected, ids_2016).any()
+    # manifest verifies (quarantine.json is ledgered)
+    from dgen_tpu.resilience.manifest import verify_run_dir
+
+    assert all(r.ok for r in verify_run_dir(run_dir))
+
+
+# ---------------------------------------------------------------------------
+# serve: 422 for quarantined agents
+# ---------------------------------------------------------------------------
+
+def test_serve_answers_422_for_quarantined_agent():
+    from dgen_tpu.serve.engine import ServeEngine
+
+    pop = _pop()
+    cfg, inputs = _sim_parts(pop)
+    rc = RunConfig(sizing_iters=8, quarantine_ids=(7,))
+    sim = _make_sim(pop, cfg, inputs, rc=rc)
+    eng = ServeEngine(sim)
+    with pytest.raises(QuarantinedAgentError) as ei:
+        eng.rows_for([7])
+    assert ei.value.agent_id == 7
+    assert ei.value.reasons == ["config:quarantine_ids"]
+    # unknown ids still read as 400-shaped KeyErrors
+    with pytest.raises(KeyError):
+        eng.rows_for([10 ** 9])
+    # healthy ids still resolve
+    assert eng.rows_for([1]).shape == (1,)
+
+
+# ---------------------------------------------------------------------------
+# invariants satellite: offending agent indices
+# ---------------------------------------------------------------------------
+
+def test_check_finite_names_offending_agent_rows():
+    from dgen_tpu.utils.invariants import (
+        InvariantViolation,
+        check_finite,
+        nonfinite_rows,
+    )
+
+    arr = np.zeros((6, 3), np.float32)
+    arr[2, 1] = np.nan
+    arr[5, 0] = np.inf
+    assert nonfinite_rows(arr).tolist() == [2, 5]
+    assert nonfinite_rows(arr, k=1).tolist() == [2]
+    with pytest.raises(InvariantViolation, match=r"agent rows: \[2, 5\]"):
+        check_finite({"x": arr}, context="t")
+
+
+# ---------------------------------------------------------------------------
+# export satellite: WARNING + per-leaf breakdown
+# ---------------------------------------------------------------------------
+
+def test_export_nonfinite_warning_and_per_leaf_breakdown(tmp_path):
+    from dgen_tpu.io import export as exp
+
+    n = 6
+    ex = exp.RunExporter(
+        str(tmp_path / "run"), agent_id=np.arange(n),
+        mask=np.ones(n, np.float32), compact=True,
+    )
+    dirty = jnp.asarray([1.0, np.nan, 2.0, np.inf, -np.inf, 3.0],
+                        jnp.float32)
+    clean = jnp.arange(n, dtype=jnp.float32)
+    h = _captured_dgen_log()
+    try:
+        ex._local_fields(
+            [dirty, clean], quant=(True, True),
+            names=("npv", "system_kw"), year=2016,
+        )
+    finally:
+        logging.getLogger("dgen_tpu").removeHandler(h)
+    assert any("'npv'" in m and "2016" in m for m in h.messages)
+    ex._flush_meta()
+    meta = json.load(open(tmp_path / "run" / "meta.json"))
+    assert meta["nonfinite_zeroed"] == 3
+    assert meta["quarantine"]["nonfinite_zeroed_by_field"] == {"npv": 3}
+    # a stamped report summary MERGES with the breakdown
+    ex.stamp_quarantine({"n_quarantined": 2, "reasons": {"x": 2}})
+    meta = json.load(open(tmp_path / "run" / "meta.json"))
+    assert meta["quarantine"]["n_quarantined"] == 2
+    assert meta["quarantine"]["nonfinite_zeroed_by_field"] == {"npv": 3}
+
+
+# ---------------------------------------------------------------------------
+# the full drill (slow tier; check.sh runs the --fast smoke)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_full_quarantine_drill(tmp_path):
+    from dgen_tpu.resilience.quarantinedrill import run_quarantine_drill
+
+    rec = run_quarantine_drill(str(tmp_path), n_agents=96)
+    assert rec["ok"], json.dumps(rec, indent=1)
+    assert set(rec["rounds"]) == {"ingest", "bank", "sentinel"}
+    assert rec["rounds"]["ingest"]["parquet_bit_exact"]
+    assert rec["rounds"]["sentinel"]["retries"] >= 1

@@ -205,3 +205,87 @@ def test_bf16_bank_python_fallback_interchange(tmp_path, matrix, monkeypatch):
     b = store.read_bank(fallback)
     assert a.dtype == b.dtype == ml_dtypes.bfloat16
     np.testing.assert_array_equal(a.view(np.uint16), b.view(np.uint16))
+
+
+def _quant_edge_bank():
+    """A bank with an all-zero row (the int8 floor path) and normal
+    rows — the quarantine validator's quant edge cases."""
+    bank = np.arange(4 * 16, dtype=np.float32).reshape(4, 16) - 10.0
+    bank[2] = 0.0                      # all-zero load row
+    return bank
+
+
+@pytest.mark.parametrize("force_fallback", [False, True])
+def test_zero_scale_all_zero_row_is_valid_at_load(
+        tmp_path, monkeypatch, force_fallback):
+    """PR 12's floor path: an all-zero load row stored with a ZERO
+    int8 scale must validate clean through BOTH DGPB readers —
+    dequantization is exact zero either way."""
+    from dgen_tpu.models.agents import quantize_rows
+    from dgen_tpu.resilience.quarantine import quant_sidecar_bad_rows
+
+    bank = _quant_edge_bank()
+    q, s = quantize_rows(bank)
+    s = s.copy()
+    s[2] = 0.0                         # external-writer floor encoding
+    p = str(tmp_path / "zero_scale.bank")
+    if force_fallback:
+        monkeypatch.setattr(store, "_lib", None)
+        monkeypatch.setattr(store, "_load_failed", True)
+    store.write_bank(p, q, scales=s)
+    codes, scales = store.read_bank_raw(p)
+    assert scales[2] == 0.0
+    assert quant_sidecar_bad_rows(codes, scales).size == 0
+    # read_bank still dequantizes the row to exact zeros
+    np.testing.assert_array_equal(store.read_bank(p)[2], 0.0)
+
+
+@pytest.mark.parametrize("force_fallback", [False, True])
+def test_nan_scale_sidecar_quarantined_at_load(
+        tmp_path, monkeypatch, force_fallback):
+    """A NaN quant-scale sidecar row is unusable: the validator must
+    flag the row (and every agent referencing it) through both the
+    native and fallback readers."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from dgen_tpu.io import synth
+    from dgen_tpu.models.agents import ProfileBank, quantize_rows
+    from dgen_tpu.resilience.quarantine import (
+        quant_sidecar_bad_rows,
+        validate_population,
+    )
+
+    bank = _quant_edge_bank()
+    q, s = quantize_rows(bank)
+    s = s.copy()
+    s[1] = np.nan
+    p = str(tmp_path / "nan_scale.bank")
+    if force_fallback:
+        monkeypatch.setattr(store, "_lib", None)
+        monkeypatch.setattr(store, "_load_failed", True)
+    store.write_bank(p, q, scales=s)
+    codes, scales = store.read_bank_raw(p)
+    assert np.isnan(scales[1])
+    assert quant_sidecar_bad_rows(codes, scales).tolist() == [1]
+
+    # wire the loaded quant bank into a population: every agent whose
+    # load_idx points at the NaN-scale row must be quarantined
+    pop = synth.generate_population(
+        32, states=["DE"], seed=5, pad_multiple=32)
+    li = np.asarray(pop.table.load_idx) % codes.shape[0]
+    table = dataclasses.replace(pop.table, load_idx=jnp.asarray(li))
+    profiles = ProfileBank(
+        load=jnp.asarray(codes),
+        solar_cf=pop.profiles.solar_cf,
+        wholesale=pop.profiles.wholesale,
+        load_scale=jnp.asarray(scales),
+        solar_cf_scale=None,
+    )
+    rep = validate_population(table, profiles, pop.tariffs)
+    assert rep.bank_rows["load"] == [1]
+    keep = np.asarray(table.mask) > 0
+    expected = sorted(
+        int(a) for a in np.asarray(table.agent_id)[keep & (li == 1)])
+    assert list(rep.ids) == expected

@@ -72,6 +72,16 @@ if [ "$mode" != "--test-only" ]; then
     JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill \
         --agents 96 --end-year 2016 --sites year_step,ckpt_save \
         >/tmp/_drill.json || rc=1
+    # quarantine smoke drill (docs/resilience.md "Data quarantine &
+    # health sentinel"): two corrupt rows injected at ingest and a
+    # NaN'd bank row at load must be quarantined with a reasoned
+    # quarantine.json naming exactly the injected rows, and the
+    # supervised run's parquet must be byte-identical to a clean
+    # pre-quarantined baseline (the mid-run sentinel round runs in the
+    # slow tier / tests/test_quarantine.py)
+    echo "== quarantine drill smoke (python -m dgen_tpu.resilience drill --quarantine --fast) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --quarantine \
+        --fast --agents 96 --end-year 2016 >/tmp/_quarantine.json || rc=1
     # serve-fleet smoke drill (docs/serve.md "Fleet operations"): boot
     # a 2-replica fleet behind the routing front, kill one replica and
     # hang the other under closed-loop load, and assert self-healing —

@@ -106,6 +106,12 @@ Knobs (env):
                           overlap target is measured against; stamps
                           walls, host_blocked_wall and
                           overlap_efficiency into the payload
+
+Weak/strong scaling curves vs DEVICE COUNT (1M/10M national tables,
+agent-years/sec, the SCALE_r*.json trajectory) live in their own
+harness — `python tools/bench_scale.py`, knobs DGEN_TPU_BENCH_SCALE_*
+(docs/perf.md "Scaling curves"); this file's DGEN_TPU_BENCH_SCALE knob
+above scales POPULATION on a fixed device set.
 """
 
 from __future__ import annotations

@@ -151,7 +151,12 @@ class RunConfig:
     #: steps back to back instead of serializing on every host
     #: consumer. None (default) = on unless the DGEN_TPU_ASYNC_IO env
     #: kill switch says 0; False restores the serialized per-year path
-    #: (the bit-exact parity oracle); True forces it on. debug runs
+    #: (the bit-exact parity oracle); True forces it on. Applies to
+    #: single- AND multi-process (jax.distributed) runs: each process's
+    #: pipeline writes only its own addressable shard (parity proven
+    #: byte-identical by tests/test_gang.py), so multi-process runs
+    #: default on too — except ``collect=True`` there, which fetches
+    #: full GLOBAL arrays and always serializes. debug runs
     #: (debug_invariants) and DGEN_TPU_PROFILE always serialize — they
     #: need per-year host sync regardless.
     async_host_io: Optional[bool] = None
@@ -218,22 +223,6 @@ class RunConfig:
             return self.async_host_io
         return os.environ.get("DGEN_TPU_ASYNC_IO", "") not in (
             "0", "false", "off"
-        )
-
-    @property
-    def async_io_multiprocess_optin(self) -> bool:
-        """Whether a MULTI-PROCESS (jax.distributed) run may use the
-        async host-IO pipeline.  Each process only ever writes its own
-        addressable shard, so the pipeline is sound there — but the
-        serialized per-shard path stays the default: multi-process runs
-        engage the pipeline only on an explicit opt-in (the field set
-        True, or ``DGEN_TPU_ASYNC_IO`` explicitly set truthy — same
-        value vocabulary as the kill switch), never on the
-        single-process default of "on unless set"."""
-        if self.async_host_io is not None:
-            return bool(self.async_host_io)
-        return os.environ.get("DGEN_TPU_ASYNC_IO", "") not in (
-            "", "0", "false", "off"
         )
 
     @property

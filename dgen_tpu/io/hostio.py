@@ -57,6 +57,10 @@ The serialized per-year path survives as the bit-exact parity oracle
 behind ``RunConfig.async_host_io=False`` (env kill switch
 ``DGEN_TPU_ASYNC_IO=0``) and is still forced by ``debug_invariants``
 and ``DGEN_TPU_PROFILE`` runs, which need per-year host sync anyway.
+Multi-process (jax.distributed) runs ride the pipeline by default like
+single-process ones — each process's pipeline only ever touches its own
+addressable shards — except ``collect=True``, whose global-array
+fetches always serialize.
 """
 
 from __future__ import annotations

@@ -78,8 +78,16 @@ def save_population(
     profiles: ProfileBank,
     tariff_specs: Sequence[dict],
     states: Sequence[str],
+    quant_banks: bool = False,
 ) -> None:
-    """Write a population package (unpadded rows only)."""
+    """Write a population package (unpadded rows only).
+
+    ``quant_banks`` writes the load/solar DGPB banks int8-quantized
+    with per-row f32 scale sidecars (store dtype code 2, the at-rest
+    companion of ``RunConfig.quant_banks``) — 4x smaller, dequantized
+    transparently by :func:`load_population`; wholesale stays f32 (it
+    is never quantized in HBM either).
+    """
     os.makedirs(pkg_dir, exist_ok=True)
     keep = np.asarray(table.mask) > 0
 
@@ -93,10 +101,11 @@ def save_population(
             cols[f"{leaf}_{slot}"] = vals[:, slot]
     pd.DataFrame(cols).to_parquet(os.path.join(pkg_dir, "agents.parquet"))
 
+    bank_dtype = "int8" if quant_banks else None
     store.write_bank(os.path.join(pkg_dir, "load_profiles.dgpb"),
-                     np.asarray(profiles.load))
+                     np.asarray(profiles.load), dtype=bank_dtype)
     store.write_bank(os.path.join(pkg_dir, "solar_cf.dgpb"),
-                     np.asarray(profiles.solar_cf))
+                     np.asarray(profiles.solar_cf), dtype=bank_dtype)
     store.write_bank(os.path.join(pkg_dir, "wholesale.dgpb"),
                      np.asarray(profiles.wholesale))
 

@@ -10,7 +10,10 @@ customer clusters, archetypal hourly load shapes, latitude-graded solar
 capacity-factor profiles, and a TOU/flat tariff mix.
 
 Used by tests, benchmarks, and the quickstart; real agent dumps load
-through dgen_tpu.io.store / ingest instead.
+through dgen_tpu.io.store / ingest instead. Pod-scale (1M/10M-row)
+worlds come from :mod:`dgen_tpu.models.synth` — a chunk-deterministic,
+state-stratified generator that reuses this module's profile/tariff
+corpora (docs/userguide.md "National-scale synthetic runs").
 """
 
 from __future__ import annotations

@@ -1,4 +1,5 @@
 """Model layer: the columnar agent table, scenario inputs, the market
-(diffusion/attachment) step, and the multi-year driver."""
+(diffusion/attachment) step, the multi-year driver, and the
+national-scale synthetic table generator (``models.synth``)."""
 
 from dgen_tpu.models import agents, market, scenario, simulation  # noqa: F401

@@ -184,6 +184,7 @@ def build_agent_table(
     load_kwh_per_customer_in_bin: np.ndarray,
     developable_frac: np.ndarray,
     n_states: int,
+    agent_id: np.ndarray | None = None,
     incentives: IncentiveParams | None = None,
     tariff_switch_idx: np.ndarray | None = None,
     one_time_charge: np.ndarray | None = None,
@@ -199,6 +200,11 @@ def build_agent_table(
     Padding agents carry mask 0, zero customers/load, and point at
     index 0 of every bank so gathers stay in-bounds; every kernel output
     is masked before aggregation.
+
+    ``agent_id``: stable per-row ids (default ``arange(n)``). Shard
+    generation (models.synth: each gang worker materializing only its
+    row range) passes the GLOBAL row ids here so per-shard exports key
+    identically to a whole-table run.
     """
     n = int(state_idx.shape[0])
 
@@ -282,7 +288,7 @@ def build_agent_table(
         switch_max_kw = np.full(n, 1e30, dtype=np.float32)
 
     return AgentTable(
-        agent_id=pad_i(np.arange(n)),
+        agent_id=pad_i(np.arange(n) if agent_id is None else agent_id),
         mask=jnp.asarray(mask),
         state_idx=pad_i(state_idx),
         sector_idx=pad_i(sector_idx),

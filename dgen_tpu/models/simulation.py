@@ -379,6 +379,12 @@ _LIVE_HOUR_ARRAYS_F32 = 2
 #: repacks ride at ONE byte/hour (sell keeps the bank float dtype)
 _LIVE_HOUR_ARRAYS_QUANT = 4
 _HBM_RESERVE_FRAC = 0.2        # compiler scratch / fragmentation
+#: persistent whole-table bytes per agent row ([N] outputs/carry, ~50
+#: f32 fields) — shared with the sweep planner's global-budget checks
+#: (sweep.plan) so the two byte models cannot drift
+_PERSISTENT_ROW_BYTES = 50 * 4
+#: the smallest lane-aligned streaming chunk the year step runs at
+_CHUNK_FLOOR_ROWS = 128
 
 
 def default_hbm_bytes() -> Optional[int]:
@@ -496,11 +502,12 @@ def auto_agent_chunk(
     )
     budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
     # persistent whole-table state ([N] outputs/carry, ~50 f32 fields)
-    budget -= n_local * 50 * 4
+    budget -= n_local * _PERSISTENT_ROW_BYTES
     fit = budget // per_agent
     if n_local <= fit:
         return 0
-    return max(128, int(fit // 128) * 128)
+    floor = _CHUNK_FLOOR_ROWS
+    return max(floor, int(fit // floor) * floor)
 
 
 def _n_chunks(n: int, d: int, chunk: int) -> int:
@@ -1173,19 +1180,25 @@ class Simulation:
             mesh is not None and mesh.devices.size > 1
             and self.run_config.partition_by_state
         ):
+            from dgen_tpu.parallel.mesh import mesh_shape_of
             from dgen_tpu.parallel.partition import partition_table
 
             pad_mult = self.run_config.agent_pad_multiple
             if chunk:
                 # per-shard length must divide into agent chunks
                 pad_mult = int(np.lcm(pad_mult, chunk))
+            # 2-D hosts x devices grids pack hierarchically: whole
+            # states stay host-local, so the straddle psums ride ICI
+            # within a host row instead of DCN across it
             table, self.partition = partition_table(
                 table, int(mesh.devices.size), pad_mult,
+                mesh_shape=mesh_shape_of(mesh),
             )
             logger.info(
-                "partitioned %d agents into %d state-local shards of %d",
+                "partitioned %d agents into %d state-local shards of %d "
+                "(mesh %dx%d)",
                 int(np.sum(np.asarray(table.mask))), mesh.devices.size,
-                self.partition.shard_len,
+                self.partition.shard_len, *mesh_shape_of(mesh),
             )
         elif chunk:
             # keep the lane-alignment invariant alongside chunk
@@ -1327,7 +1340,7 @@ class Simulation:
             bank_bf16=self.run_config.bf16_banks,
             bank_quant=self.run_config.quant_banks,
         )
-        modeled = rows * per_agent + n_local * 50 * 4
+        modeled = rows * per_agent + n_local * _PERSISTENT_ROW_BYTES
         rec = {
             "modeled_step_bytes": int(modeled),
             "device_peak_bytes": int(peak) if peak else None,
@@ -1554,12 +1567,12 @@ class Simulation:
         ``RunConfig.async_host_io=False`` (env ``DGEN_TPU_ASYNC_IO=0``)
         restores the serialized per-year path, which also remains in
         force for ``debug_invariants`` and profiling.  Multi-process
-        runs default to serialized too, but may OPT IN to the pipeline
-        (``DGEN_TPU_ASYNC_IO=1`` or ``async_host_io=True``): each
+        (jax.distributed) runs ride the pipeline by default too: each
         process's pipeline writes only its own addressable shard, so
-        the per-shard export/checkpoint semantics are preserved —
-        ``collect=True`` still serializes there (collection fetches
-        the full global arrays).
+        the per-shard export/checkpoint semantics are preserved
+        (byte-parity proven by the gang tests) — only ``collect=True``
+        still serializes there (collection fetches the full global
+        arrays).
 
         ``should_stop(year, year_idx)`` is evaluated after each
         completed year (exports dispatched, checkpoint issued); True
@@ -1617,20 +1630,17 @@ class Simulation:
         profile_dir = os.environ.get("DGEN_TPU_PROFILE")
 
         # background host-IO pipeline (io.hostio): the default for any
-        # single-process run with a host consumer. debug_invariants and
-        # profiling need per-year host sync; multi-process runs keep
-        # the synchronous per-shard writes unless the operator opts in
-        # explicitly (each process's pipeline writes only its own
-        # addressable shard — but collection fetches GLOBAL arrays, so
-        # collect=True always serializes there).
+        # run with a host consumer — single- AND multi-process, since
+        # every process's pipeline writes only its own addressable
+        # shard (byte-parity proven at the 1M scale before the default
+        # flipped; DGEN_TPU_ASYNC_IO=0 is the kill switch).
+        # debug_invariants and profiling need per-year host sync, and
+        # multi-process collect=True still serializes (collection
+        # fetches full GLOBAL arrays).
         async_io = (
             self.run_config.async_io_enabled
             and not debug and not profile_dir
-            and (
-                jax.process_count() == 1
-                or (self.run_config.async_io_multiprocess_optin
-                    and not collect)
-            )
+            and (jax.process_count() == 1 or not collect)
             and (collect or callback is not None or ckpt_writer is not None)
         )
         self.hostio_stats = None

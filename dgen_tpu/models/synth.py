@@ -1,0 +1,660 @@
+"""National-scale synthetic table generator.
+
+:mod:`dgen_tpu.io.synth` builds the small audit/test worlds in one shot
+— every column materialized by one RNG stream, fine up to ~100k rows.
+The pod-scale path needs more than that:
+
+* **1M/10M-row worlds in O(chunk) host memory**: columns are generated
+  in fixed :data:`NationalSpec.gen_chunk` row blocks, each block from
+  its own counter-seeded RNG, so the transient working set is one
+  block regardless of table size (the output columns themselves are
+  the table).
+* **Byte-determinism independent of materialization**: block ``i``
+  always draws from ``SeedSequence((seed, i))``, so generating the
+  whole table, generating it range by range, or having each gang
+  worker generate ONLY its shard (``rows=``) all produce identical
+  bytes — the multi-process analogue of the reference's
+  identical-pickle-everywhere contract, without shipping a 10M-row
+  pickle to every host.
+* **State-stratified strata**: rows are laid out state-major with
+  per-state counts allocated from census-scale population shares
+  (largest-remainder, so strata are exact and deterministic), the
+  shape a national run's whole-state device partitioning
+  (parallel.partition) expects.
+* **Scale-ready bank formats**: worlds save as standard agent packages
+  (:mod:`dgen_tpu.io.package`) whose load/solar DGPB banks are written
+  int8-quantized with per-row f32 scale sidecars (store dtype code 2,
+  the at-rest companion of ``RunConfig.quant_banks``), plus a hashed
+  ``world.json`` manifest so a generated world can be re-verified
+  against its spec bit-for-bit.
+
+CLI: ``python -m dgen_tpu.models.synth`` (generate / verify / smoke —
+docs/userguide.md "National-scale synthetic runs").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dgen_tpu.io.synth import (
+    N_STATES,
+    STATE_IDX,
+    STATES,
+    SynthPopulation,
+    make_load_profiles,
+    make_solar_cf_profiles,
+    make_tariff_specs,
+    make_wholesale_prices,
+)
+from dgen_tpu.models.agents import AgentTable, ProfileBank, build_agent_table
+from dgen_tpu.ops.tariff import NET_METERING, compile_tariffs
+
+#: approximate 2020-census population shares (percent) over the
+#: contiguous-US + DC modeling universe (io.synth.STATES) — the strata
+#: weights a national table is stratified by. Values need not sum to
+#: 100; they are normalized over the spec's state subset.
+STATE_SHARES: Dict[str, float] = {
+    "AL": 1.51, "AR": 0.91, "AZ": 2.16, "CA": 11.91, "CO": 1.74,
+    "CT": 1.09, "DC": 0.21, "DE": 0.30, "FL": 6.49, "GA": 3.23,
+    "IA": 0.96, "ID": 0.55, "IL": 3.86, "IN": 2.04, "KS": 0.88,
+    "KY": 1.36, "LA": 1.40, "MA": 2.12, "MD": 1.86, "ME": 0.41,
+    "MI": 3.03, "MN": 1.72, "MO": 1.85, "MS": 0.89, "MT": 0.33,
+    "NC": 3.15, "ND": 0.23, "NE": 0.59, "NH": 0.42, "NJ": 2.80,
+    "NM": 0.64, "NV": 0.94, "NY": 6.08, "OH": 3.55, "OK": 1.19,
+    "OR": 1.28, "PA": 3.91, "RI": 0.33, "SC": 1.54, "SD": 0.27,
+    "TN": 2.08, "TX": 8.77, "UT": 0.98, "VA": 2.60, "VT": 0.19,
+    "WA": 2.32, "WI": 1.77, "WV": 0.54, "WY": 0.17,
+}
+
+#: rows per generation block — the byte-determinism unit (part of the
+#: seed contract: changing it changes the RNG stream, like the seed)
+GEN_CHUNK = 131072
+
+#: tariff corpus selectors: "mixed" is the full io.synth corpus
+#: (net-billing + TOU tariffs keep the bucket-sums kernel compiled in);
+#: "nem" restricts to the net-metering subset, so run_static_flags
+#: proves net_billing=False and the year step compiles the linear-NEM
+#: program — the throughput protocol the scaling bench runs
+#: (docs/perf.md "Scaling curves")
+TARIFF_MIXES = ("mixed", "nem")
+
+
+@dataclasses.dataclass(frozen=True)
+class NationalSpec:
+    """Seed contract for a national synthetic world: every field
+    participates in determinism (two equal specs generate
+    byte-identical tables and banks, however materialized)."""
+
+    n_agents: int
+    seed: int = 0
+    states: Tuple[str, ...] = tuple(STATES)
+    sector_weights: Tuple[float, float, float] = (0.7, 0.2, 0.1)
+    tariff_mix: str = "mixed"
+    n_regions: int = 10
+    rate_switch_frac: float = 0.0
+    gen_chunk: int = GEN_CHUNK
+    #: bank corpus sizes (the national corpora are richer than the
+    #: io.synth defaults: more archetypes per sector, finer latitude
+    #: grading)
+    load_profiles_per_sector: int = 8
+    n_cf_profiles: int = 16
+
+    def __post_init__(self) -> None:
+        if self.n_agents < 1:
+            raise ValueError("n_agents must be >= 1")
+        if self.gen_chunk < 1:
+            raise ValueError("gen_chunk must be >= 1")
+        if self.tariff_mix not in TARIFF_MIXES:
+            raise ValueError(
+                f"tariff_mix {self.tariff_mix!r} not in {TARIFF_MIXES}")
+        unknown = [s for s in self.states if s not in STATE_IDX]
+        if unknown:
+            raise ValueError(f"unknown states {unknown}")
+        if abs(sum(self.sector_weights) - 1.0) > 1e-6:
+            raise ValueError("sector_weights must sum to 1")
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["states"] = list(self.states)
+        d["sector_weights"] = list(self.sector_weights)
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "NationalSpec":
+        d = dict(d)
+        d["states"] = tuple(d["states"])
+        d["sector_weights"] = tuple(d["sector_weights"])
+        return cls(**d)
+
+
+def state_counts(spec: NationalSpec) -> np.ndarray:
+    """Exact per-state row counts: largest-remainder allocation of
+    ``n_agents`` over the normalized population shares (ties broken by
+    state order, so the strata are deterministic)."""
+    w = np.asarray([STATE_SHARES[s] for s in spec.states], np.float64)
+    w = w / w.sum()
+    exact = w * spec.n_agents
+    base = np.floor(exact).astype(np.int64)
+    short = spec.n_agents - int(base.sum())
+    order = np.argsort(-(exact - base), kind="stable")
+    base[order[:short]] += 1
+    return base
+
+
+def _state_bounds(spec: NationalSpec) -> np.ndarray:
+    """[n_spec_states] cumulative row bounds of the state-major layout."""
+    return np.cumsum(state_counts(spec))
+
+
+def make_national_tariffs(mix: str) -> list:
+    """The tariff corpus for a mix (raw spec dicts, io.package-ready).
+
+    ``"nem"`` keeps only the net-metering specs of the synthetic corpus
+    — with the table's default always-open NEM window this statically
+    drops the bucket-sums kernel (models.simulation.run_static_flags),
+    the cheapest honest national protocol.
+    """
+    specs = make_tariff_specs()
+    if mix == "mixed":
+        return specs
+    return [s for s in specs if s.get("metering") == NET_METERING]
+
+
+def _chunk_columns(spec: NationalSpec, ci: int, bounds: np.ndarray,
+                   n_tariffs: int, res_tariffs: np.ndarray,
+                   com_tariffs: np.ndarray, ind_tariff: int) -> dict:
+    """All columns of generation block ``ci`` (full block, before any
+    range slicing) — one counter-seeded RNG per block."""
+    lo = ci * spec.gen_chunk
+    hi = min(lo + spec.gen_chunk, spec.n_agents)
+    n = hi - lo
+    rng = np.random.default_rng(np.random.SeedSequence((spec.seed, ci)))
+
+    # state-major strata: block rows map to states by the cumulative
+    # bounds, no RNG involved (strata stay exact under sharding)
+    local_state = np.searchsorted(bounds, np.arange(lo, hi), side="right")
+    local_state = local_state.astype(np.int32)
+    global_state = np.asarray(
+        [STATE_IDX[s] for s in spec.states], np.int32)[local_state]
+
+    # normalized before the draw: __post_init__ accepts weights to a
+    # 1e-6 tolerance, Generator.choice demands ~1.5e-8 — a spec that
+    # validates must also generate
+    w = np.asarray(spec.sector_weights, np.float64)
+    sector = rng.choice(3, size=n, p=w / w.sum()).astype(np.int32)
+    lps = spec.load_profiles_per_sector
+    load_idx = (sector * lps + rng.integers(0, lps, n)).astype(np.int32)
+    cf_idx = np.clip(
+        (global_state.astype(np.int64) * spec.n_cf_profiles) // N_STATES
+        + rng.integers(-1, 2, n),
+        0, spec.n_cf_profiles - 1,
+    ).astype(np.int32)
+    region_idx = (global_state % spec.n_regions).astype(np.int32)
+
+    load_kwh = np.where(
+        sector == 0,
+        np.exp(rng.uniform(np.log(4e3), np.log(1.5e4), n)),
+        np.where(
+            sector == 1,
+            np.exp(rng.uniform(np.log(3e4), np.log(4e5), n)),
+            np.exp(rng.uniform(np.log(4e5), np.log(4e6), n)),
+        ),
+    ).astype(np.float32)
+    customers = np.exp(
+        rng.uniform(np.log(50.0), np.log(5000.0), n)).astype(np.float32)
+    developable = rng.uniform(0.2, 0.95, n).astype(np.float32)
+
+    tariff_idx = np.where(
+        sector == 0,
+        res_tariffs[rng.integers(0, len(res_tariffs), n)],
+        np.where(
+            sector == 1,
+            com_tariffs[rng.integers(0, len(com_tariffs), n)],
+            ind_tariff,
+        ),
+    ).astype(np.int32)
+    switch = (rng.random(n) < spec.rate_switch_frac) & (sector == 0)
+    dg_rate = n_tariffs - 1   # the corpus' DG rate is always last
+    tariff_switch_idx = np.where(switch, dg_rate, tariff_idx).astype(np.int32)
+    one_time_charge = np.where(
+        switch, rng.uniform(100.0, 800.0, n), 0.0).astype(np.float32)
+
+    return dict(
+        state_idx=global_state,
+        sector_idx=sector,
+        region_idx=region_idx,
+        tariff_idx=tariff_idx,
+        tariff_switch_idx=tariff_switch_idx,
+        load_idx=load_idx,
+        cf_idx=cf_idx,
+        customers_in_bin=customers,
+        load_kwh_per_customer_in_bin=load_kwh,
+        developable_frac=developable,
+        one_time_charge=one_time_charge,
+    )
+
+
+#: generated column order (fixed: world manifests hash in this order)
+COLUMNS = (
+    "state_idx", "sector_idx", "region_idx", "tariff_idx",
+    "tariff_switch_idx", "load_idx", "cf_idx", "customers_in_bin",
+    "load_kwh_per_customer_in_bin", "developable_frac", "one_time_charge",
+)
+
+
+def _tariff_pools(spec: NationalSpec) -> tuple:
+    """(n_tariffs, res_pool, com_pool, ind_tariff) for a mix — index
+    pools into :func:`make_national_tariffs`'s corpus order."""
+    n = len(make_national_tariffs(spec.tariff_mix))
+    if spec.tariff_mix == "nem":
+        # corpus: [flat NEM, tiered NEM, commercial TOU NEM, DG rate]
+        return n, np.asarray([0, 1], np.int32), \
+            np.asarray([1, 2], np.int32), 2
+    # full corpus (io.synth.make_tariff_specs order)
+    return n, np.arange(0, 5, dtype=np.int32), \
+        np.asarray([1, 3, 5], np.int32), 5
+
+
+def generate_columns(
+    spec: NationalSpec,
+    start: int = 0,
+    stop: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """Columns for absolute rows ``[start, stop)`` — byte-identical to
+    the same slice of a whole-table materialization, whatever blocks
+    the request spans (each covering block is generated in full from
+    its own RNG and sliced)."""
+    stop = spec.n_agents if stop is None else stop
+    if not (0 <= start <= stop <= spec.n_agents):
+        raise ValueError(
+            f"row range [{start}, {stop}) outside [0, {spec.n_agents})")
+    bounds = _state_bounds(spec)
+    n_tariffs, res_p, com_p, ind_t = _tariff_pools(spec)
+    out = {c: [] for c in COLUMNS}
+    first = start // spec.gen_chunk
+    last = max((stop - 1) // spec.gen_chunk, first) if stop > start else first
+    for ci in range(first, last + 1):
+        if stop == start:
+            break
+        cols = _chunk_columns(spec, ci, bounds, n_tariffs, res_p, com_p,
+                              ind_t)
+        lo = ci * spec.gen_chunk
+        a = max(start - lo, 0)
+        b = min(stop - lo, spec.gen_chunk)
+        for c in COLUMNS:
+            out[c].append(cols[c][a:b])
+    return {
+        c: (np.concatenate(v) if v else
+            np.empty(0, np.int32 if c.endswith("idx") else np.float32))
+        for c, v in out.items()
+    }
+
+
+def _hash_columns(cols: Dict[str, np.ndarray]) -> Dict[str, str]:
+    """Per-column sha256 over the columns' raw bytes. Hashing whole
+    columns and hashing them block-by-block walk the identical byte
+    stream, so these digests match :func:`column_hashes` exactly."""
+    return {
+        c: hashlib.sha256(
+            np.ascontiguousarray(cols[c]).tobytes()).hexdigest()
+        for c in COLUMNS
+    }
+
+
+def column_hashes(spec: NationalSpec) -> Dict[str, str]:
+    """Per-column sha256 of the whole table's bytes, accumulated block
+    by block (O(chunk) memory — the world-manifest fingerprint)."""
+    bounds = _state_bounds(spec)
+    n_tariffs, res_p, com_p, ind_t = _tariff_pools(spec)
+    hashers = {c: hashlib.sha256() for c in COLUMNS}
+    n_blocks = (spec.n_agents + spec.gen_chunk - 1) // spec.gen_chunk
+    for ci in range(n_blocks):
+        cols = _chunk_columns(spec, ci, bounds, n_tariffs, res_p, com_p,
+                              ind_t)
+        for c in COLUMNS:
+            hashers[c].update(np.ascontiguousarray(cols[c]).tobytes())
+    return {c: h.hexdigest() for c, h in hashers.items()}
+
+
+def generate_table(
+    spec: NationalSpec,
+    rows: Optional[Tuple[int, int]] = None,
+    pad_multiple: int = 128,
+) -> AgentTable:
+    """Build the :class:`AgentTable` for the whole world, or — with
+    ``rows=(start, stop)`` — for one shard of it (a gang worker
+    generating only its slice). Shard tables carry GLOBAL agent ids,
+    so shard exports concatenate into exactly the whole-table rows."""
+    start, stop = rows if rows is not None else (0, spec.n_agents)
+    cols = generate_columns(spec, start, stop)
+    return build_agent_table(
+        n_states=N_STATES,
+        pad_multiple=pad_multiple,
+        agent_id=np.arange(start, stop, dtype=np.int64),
+        **cols,
+    )
+
+
+def generate_banks(spec: NationalSpec) -> ProfileBank:
+    """The world's f32 profile banks (shared [rows, 8760] corpora —
+    tiny next to the table; quantization happens at save time or under
+    ``RunConfig.quant_banks``)."""
+    import jax.numpy as jnp
+
+    return ProfileBank(
+        load=jnp.asarray(make_load_profiles(
+            n_per_sector=spec.load_profiles_per_sector, seed=spec.seed)),
+        solar_cf=jnp.asarray(make_solar_cf_profiles(
+            spec.n_cf_profiles, seed=spec.seed + 1)),
+        wholesale=jnp.asarray(make_wholesale_prices(
+            spec.n_regions, seed=spec.seed + 2)),
+    )
+
+
+def generate_world(
+    spec: NationalSpec,
+    rows: Optional[Tuple[int, int]] = None,
+    pad_multiple: int = 128,
+) -> SynthPopulation:
+    """Table (whole or shard) + banks + compiled tariffs."""
+    return SynthPopulation(
+        table=generate_table(spec, rows=rows, pad_multiple=pad_multiple),
+        profiles=generate_banks(spec),
+        tariffs=compile_tariffs(make_national_tariffs(spec.tariff_mix)),
+        n_regions=spec.n_regions,
+    )
+
+
+# ---------------------------------------------------------------------------
+# On-disk worlds: standard agent packages + a hashed world manifest
+# ---------------------------------------------------------------------------
+
+WORLD_MANIFEST = "world.json"
+
+_BANK_FILES = ("load_profiles.dgpb", "solar_cf.dgpb", "wholesale.dgpb")
+
+#: package artifacts hashed as-written (agents.parquet is the file the
+#: Simulation actually loads rows from — it must be covered too)
+_PKG_FILES = ("agents.parquet", "tariffs.json", "meta.json")
+
+
+def _file_sha256(path: str) -> str:
+    # one streaming file-hash convention repo-wide (the run manifest's)
+    from dgen_tpu.resilience.manifest import _sha256_file
+
+    return _sha256_file(path)
+
+
+def save_world(
+    spec: NationalSpec,
+    out_dir: str,
+    quant_banks: bool = True,
+) -> dict:
+    """Materialize + persist a world as an agent package
+    (:func:`dgen_tpu.io.package.load_population` loads it unchanged).
+
+    ``quant_banks`` (default) re-writes the load/solar DGPB banks
+    int8-quantized with per-row f32 scale sidecars (store dtype code 2)
+    — 4x smaller at rest, dequantized transparently on read; wholesale
+    stays f32 (it is never quantized in HBM either). Returns the
+    ``world.json`` manifest (spec + column/bank hashes) it wrote.
+    """
+    import os
+
+    from dgen_tpu.io import package
+    from dgen_tpu.resilience.atomic import atomic_write_json
+
+    # one generation pass: the same columns feed the table AND the
+    # manifest hashes (block-wise and whole-column hashing walk the
+    # identical byte stream, so verify_world's streamed column_hashes
+    # reproduce these digests)
+    cols = generate_columns(spec)
+    col_hashes = _hash_columns(cols)
+    table = build_agent_table(
+        n_states=N_STATES, pad_multiple=128,
+        agent_id=np.arange(spec.n_agents, dtype=np.int64), **cols,
+    )
+    profiles = generate_banks(spec)
+    package.save_population(
+        out_dir, table, profiles,
+        make_national_tariffs(spec.tariff_mix), list(spec.states),
+        quant_banks=quant_banks,
+    )
+    manifest = {
+        "format": 1,
+        "spec": spec.to_json(),
+        "quant_banks": bool(quant_banks),
+        "columns": col_hashes,
+        "banks": {
+            f: _file_sha256(os.path.join(out_dir, f)) for f in _BANK_FILES
+        },
+        "files": {
+            f: _file_sha256(os.path.join(out_dir, f)) for f in _PKG_FILES
+        },
+    }
+    atomic_write_json(os.path.join(out_dir, WORLD_MANIFEST), manifest)
+    return manifest
+
+
+def verify_world(world_dir: str) -> list:
+    """Re-derive the world from its manifest spec and compare hashes.
+
+    Returns a list of problem strings (empty = clean): a changed
+    generator, a tampered bank file, or a stale manifest all surface
+    here — the generation analogue of the run manifest's verify.
+    """
+    import json
+    import os
+
+    path = os.path.join(world_dir, WORLD_MANIFEST)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable {WORLD_MANIFEST}: {e}"]
+    problems = []
+    try:
+        spec = NationalSpec.from_json(manifest["spec"])
+    except (KeyError, TypeError, ValueError) as e:
+        return [f"bad spec in {WORLD_MANIFEST}: {e}"]
+    fresh = column_hashes(spec)
+    for c, want in manifest.get("columns", {}).items():
+        got = fresh.get(c)
+        if got != want:
+            problems.append(
+                f"column {c}: generated {got} != recorded {want}")
+    for kind, key in (("bank", "banks"), ("file", "files")):
+        for f, want in manifest.get(key, {}).items():
+            fp = os.path.join(world_dir, f)
+            if not os.path.exists(fp):
+                problems.append(f"{kind} {f}: missing")
+            elif _file_sha256(fp) != want:
+                problems.append(f"{kind} {f}: content hash mismatch")
+    return problems
+
+
+def shard_rows(spec: NationalSpec, shard: int, n_shards: int,
+               pad_multiple: int = 1) -> Tuple[int, int]:
+    """Contiguous row range of shard ``shard`` of ``n_shards`` (even
+    split, remainder to the early shards; ``pad_multiple`` rounds the
+    boundaries so each shard's table pads independently)."""
+    if not (0 <= shard < n_shards):
+        raise ValueError(f"shard {shard} outside [0, {n_shards})")
+    base = spec.n_agents // n_shards
+    rem = spec.n_agents % n_shards
+    if pad_multiple > 1 and base < pad_multiple:
+        # rounding spans smaller than one pad unit would silently
+        # empty the early shards and pile every row onto the last
+        raise ValueError(
+            f"cannot split {spec.n_agents} rows into {n_shards} shards "
+            f"at pad_multiple={pad_multiple}: each shard spans ~{base} "
+            f"rows, fewer than one pad unit — grow the table, use "
+            f"fewer shards, or drop the pad rounding")
+    start = shard * base + min(shard, rem)
+    stop = start + base + (1 if shard < rem else 0)
+    if pad_multiple > 1:
+        start = (start // pad_multiple) * pad_multiple
+        if stop != spec.n_agents:
+            stop = (stop // pad_multiple) * pad_multiple
+    return start, stop
+
+
+# ---------------------------------------------------------------------------
+# CLI: generate / verify / smoke
+# ---------------------------------------------------------------------------
+
+def _spec_from_args(args) -> NationalSpec:
+    return NationalSpec(
+        n_agents=args.agents,
+        seed=args.seed,
+        states=tuple(args.states.split(",")) if args.states else tuple(STATES),
+        tariff_mix=args.tariff_mix,
+        n_regions=args.regions,
+        rate_switch_frac=args.rate_switch_frac,
+        gen_chunk=args.gen_chunk,
+    )
+
+
+def _smoke(args) -> int:
+    """check.sh gate: generate a small national world, step two model
+    years through the production 2-D placement path on a forced
+    hosts x devices CPU mesh, and verify the run manifest — so the
+    generator and the mesh promotion cannot rot between bench rounds."""
+    import json
+    import os
+    import tempfile
+    import time
+
+    from dgen_tpu.parallel.mesh import parse_mesh_shape
+    from dgen_tpu.utils import compat
+
+    h, d = parse_mesh_shape(args.mesh)
+    compat.set_cpu_device_count(h * d)
+
+    import jax
+
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.io.export import RunExporter
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models.simulation import Simulation
+    from dgen_tpu.parallel.mesh import make_mesh
+    from dgen_tpu.resilience.manifest import RunManifest
+
+    if len(jax.devices()) < h * d:
+        print(f"smoke: cannot force {h * d} CPU devices "
+              f"(got {len(jax.devices())})")
+        return 2
+
+    spec = _spec_from_args(args)
+    t0 = time.time()
+    world = generate_world(spec)
+    gen_s = time.time() - t0
+
+    cfg = ScenarioConfig(name="synth-smoke", start_year=2014,
+                         end_year=2016, anchor_years=())
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=world.table.n_groups, n_regions=spec.n_regions)
+    run_dir = args.out or tempfile.mkdtemp(prefix="dgen-synth-smoke-")
+    mesh = make_mesh(shape=(h, d))
+    sim = Simulation(
+        world.table, world.profiles, world.tariffs, inputs, cfg,
+        RunConfig(sizing_iters=4), mesh=mesh,
+    )
+    manifest = RunManifest(run_dir)
+    exporter = RunExporter(
+        run_dir, agent_id=sim.host_agent_id, mask=sim.host_mask,
+        manifest=manifest,
+        meta={"smoke": {"mesh": args.mesh, "agents": spec.n_agents}},
+    )
+    t0 = time.time()
+    res = sim.run(callback=exporter, collect=False,
+                  checkpoint_dir=os.path.join(run_dir, "ckpt"))
+    run_s = time.time() - t0
+    report = manifest.verify()
+    ok = report.ok and len(res.years) == len(cfg.model_years)
+    print(json.dumps({
+        "smoke": "ok" if ok else "FAILED",
+        "agents": spec.n_agents,
+        "mesh": f"{h}x{d}",
+        "years": [int(y) for y in res.years],
+        "generate_s": round(gen_s, 2),
+        "run_s": round(run_s, 2),
+        "manifest_ok": report.ok,
+        "manifest": report.to_json(),
+        "run_dir": run_dir,
+    }))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        prog="python -m dgen_tpu.models.synth",
+        description="national-scale synthetic world generator "
+                    "(docs/userguide.md 'National-scale synthetic runs')",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def world_args(sp):
+        sp.add_argument("--agents", type=int, default=10_240)
+        sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument("--states", default="",
+                        help="comma list (default: all 49)")
+        sp.add_argument("--tariff-mix", choices=TARIFF_MIXES,
+                        default="mixed")
+        sp.add_argument("--regions", type=int, default=10)
+        sp.add_argument("--rate-switch-frac", type=float, default=0.0)
+        sp.add_argument("--gen-chunk", type=int, default=GEN_CHUNK)
+
+    g = sub.add_parser(
+        "generate", help="materialize a world as an agent package "
+        "(+ hashed world.json manifest)")
+    world_args(g)
+    g.add_argument("--out", required=True)
+    g.add_argument("--no-quant-banks", action="store_true",
+                   help="keep the DGPB banks f32 instead of int8+scales")
+
+    v = sub.add_parser(
+        "verify", help="re-derive a saved world from its manifest spec "
+        "and compare hashes")
+    v.add_argument("world_dir")
+
+    s = sub.add_parser(
+        "smoke", help="generate a small world, step 2 years on a forced "
+        "hosts x devices CPU mesh, verify the run manifest (check.sh)")
+    world_args(s)
+    s.set_defaults(tariff_mix="nem")
+    s.add_argument("--mesh", default="1x8", help="HxD (default 1x8)")
+    s.add_argument("--out", default="",
+                   help="run dir (default: a fresh temp dir)")
+
+    args = p.parse_args(argv)
+    if args.cmd == "generate":
+        spec = _spec_from_args(args)
+        manifest = save_world(
+            spec, args.out, quant_banks=not args.no_quant_banks)
+        print(json.dumps({
+            "world": args.out, "agents": spec.n_agents,
+            "states": len(spec.states),
+            "quant_banks": manifest["quant_banks"],
+        }))
+        return 0
+    if args.cmd == "verify":
+        problems = verify_world(args.world_dir)
+        for prob in problems:
+            print(f"verify: {prob}")
+        print(json.dumps({"world": args.world_dir,
+                          "clean": not problems,
+                          "problems": len(problems)}))
+        return 0 if not problems else 1
+    return _smoke(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

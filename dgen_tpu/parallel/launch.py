@@ -209,7 +209,7 @@ def main() -> None:
     )
     from dgen_tpu.models.agents import ProfileBank
     from dgen_tpu.models.simulation import Simulation
-    from dgen_tpu.parallel.mesh import make_mesh
+    from dgen_tpu.parallel.mesh import default_mesh
 
     shard = int(os.environ.get("DGEN_SHARD_INDEX", "0"))
     states = shard_states_from_env() or ["DE"]
@@ -241,7 +241,10 @@ def main() -> None:
             wholesale=jnp.asarray(wholesale_profile_bank(meta, root)),
         )
 
-    mesh = make_mesh() if len(jax.devices()) > 1 else None
+    # production placement: the 2-D hosts x devices grid under
+    # jax.distributed, the flat agent mesh single-host, DGEN_TPU_MESH
+    # to force a shape (parallel.mesh.default_mesh)
+    mesh = default_mesh()
     sim = Simulation(pop.table, profiles, pop.tariffs, inputs, cfg,
                      RunConfig.from_env(), mesh=mesh)
     # one persistence path for single- AND multi-host runs: orbax saves

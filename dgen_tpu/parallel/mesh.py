@@ -18,6 +18,7 @@ axes instead of silently replicating across host rows.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -26,6 +27,41 @@ from jax.sharding import Mesh, PartitionSpec
 
 AGENT_AXIS = "agents"
 HOST_AXIS = "hosts"
+
+
+def default_mesh_shape(n_devices: Optional[int] = None) -> Tuple[int, int]:
+    """The production (hosts, devices) grid for this topology.
+
+    ``DGEN_TPU_MESH=HxD`` forces a shape (:func:`parse_mesh_shape`);
+    otherwise a jax.distributed run gets the true 2-D
+    ``process_count x local-devices`` grid — the placement every
+    run mesh should default to at pod scale, so the host axis of the
+    (tiny) cross-shard reductions rides DCN-grouped collectives — and
+    a single-process run gets the flat 1-D agent mesh ``(1, D)``.
+    """
+    raw = os.environ.get("DGEN_TPU_MESH", "").strip()
+    if raw:
+        return parse_mesh_shape(raw)
+    total = len(jax.devices()) if n_devices is None else int(n_devices)
+    procs = jax.process_count()
+    if procs > 1 and total % procs == 0:
+        return (procs, total // procs)
+    return (1, total)
+
+
+def default_mesh(devices: Optional[Sequence] = None) -> Optional[Mesh]:
+    """The production run mesh (or None on a single device).
+
+    One constructor for every production entry point (parallel.launch,
+    the gang worker, the sweep CLI, the scale bench), so national runs
+    land on the 2-D hosts x devices grid by default instead of each
+    caller hand-rolling ``make_mesh()`` flat.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    h, d = default_mesh_shape(len(devs))
+    if h * d <= 1:
+        return None
+    return make_mesh(devices=devs, shape=(h, d))
 
 
 def make_mesh(n_devices: Optional[int] = None,

@@ -43,6 +43,7 @@ def partition_by_state(
     n_states: int,
     n_devices: int,
     pad_multiple: int = 8,
+    mesh_shape: Tuple[int, int] | None = None,
 ) -> Partition:
     """Greedy largest-first packing of states onto devices.
 
@@ -50,18 +51,43 @@ def partition_by_state(
     Agents of one state always land on one device (states bigger than a
     balanced share still go to the currently-lightest device — matching
     the reference's whole-state-per-task granularity).
+
+    ``mesh_shape=(H, D)`` with H > 1 (the 2-D hosts x devices grid,
+    parallel.mesh) packs hierarchically: states go to the lightest HOST
+    row first, then to the lightest device within that host — so whole
+    states stay host-local and the straddle psums the flat packing
+    would route over DCN become intra-host ICI traffic. The global
+    device index is ``host * D + device`` (row-major, matching
+    make_mesh's device order).
     """
     state_idx = np.asarray(state_idx)
     counts = np.bincount(state_idx, minlength=n_states)
-    device_load = np.zeros(n_devices, dtype=np.int64)
     device_of_state = np.zeros(n_states, dtype=np.int32)
-    for s in np.argsort(-counts):
-        if counts[s] == 0:
-            device_of_state[s] = 0
-            continue
-        d = int(np.argmin(device_load))
-        device_of_state[s] = d
-        device_load[d] += counts[s]
+    if mesh_shape is not None and mesh_shape[0] > 1:
+        h, d = int(mesh_shape[0]), int(mesh_shape[1])
+        if h * d != n_devices:
+            raise ValueError(
+                f"mesh shape {h}x{d} does not cover {n_devices} devices")
+        host_load = np.zeros(h, dtype=np.int64)
+        dev_load = np.zeros((h, d), dtype=np.int64)
+        for s in np.argsort(-counts):
+            if counts[s] == 0:
+                device_of_state[s] = 0
+                continue
+            hh = int(np.argmin(host_load))
+            dd = int(np.argmin(dev_load[hh]))
+            device_of_state[s] = hh * d + dd
+            host_load[hh] += counts[s]
+            dev_load[hh, dd] += counts[s]
+    else:
+        device_load = np.zeros(n_devices, dtype=np.int64)
+        for s in np.argsort(-counts):
+            if counts[s] == 0:
+                device_of_state[s] = 0
+                continue
+            dd = int(np.argmin(device_load))
+            device_of_state[s] = dd
+            device_load[dd] += counts[s]
 
     agent_device = device_of_state[state_idx]
     order = np.argsort(agent_device, kind="stable")
@@ -93,7 +119,8 @@ def apply_partition_indices(part: Partition, n_agents: int) -> Tuple[np.ndarray,
     return gather, mask
 
 
-def partition_table(table, n_devices: int, pad_multiple: int = 128):
+def partition_table(table, n_devices: int, pad_multiple: int = 128,
+                    mesh_shape: Tuple[int, int] | None = None):
     """(reordered AgentTable, Partition): lay agents out so each device
     shard holds whole states, the TPU analogue of the reference's
     per-state task binning (state_input_csvs/ + submit_all.sh).
@@ -101,13 +128,15 @@ def partition_table(table, n_devices: int, pad_multiple: int = 128):
     The partition is computed over REAL agents only (padding rows are
     re-created per shard); every [N]-leading leaf is gathered into the
     new order and the mask re-derived, so results keyed by ``agent_id``
-    are invariant under the permutation.
+    are invariant under the permutation. ``mesh_shape`` makes the
+    packing host-hierarchical on a 2-D grid (:func:`partition_by_state`).
     """
     old_mask = np.asarray(table.mask) > 0
     real_rows = np.nonzero(old_mask)[0]
     state_real = np.asarray(table.state_idx)[real_rows]
     part = partition_by_state(
-        state_real, table.n_states, n_devices, pad_multiple
+        state_real, table.n_states, n_devices, pad_multiple,
+        mesh_shape=mesh_shape,
     )
     gather_sub, valid = apply_partition_indices(part, len(real_rows))
     gather = real_rows[gather_sub]

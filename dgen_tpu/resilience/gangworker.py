@@ -139,7 +139,7 @@ def main() -> int:
     from dgen_tpu.models import scenario as scen
     from dgen_tpu.models.simulation import Simulation
     from dgen_tpu.parallel import elastic
-    from dgen_tpu.parallel.mesh import make_mesh
+    from dgen_tpu.parallel.mesh import default_mesh
     from dgen_tpu.resilience.manifest import RunManifest
 
     n_proc = jax.process_count()
@@ -147,30 +147,50 @@ def main() -> int:
 
     # deterministic, identical world on every process: the table is a
     # pure function of the env knobs, so global-array placement can
-    # slice each process's shards out of the same host copy
-    states = [
-        s for s in os.environ.get("DGEN_GANG_STATES", "DE,CA").split(",")
-        if s
-    ]
+    # slice each process's shards out of the same host copy.
+    # DGEN_GANG_WORLD=national swaps the tiny io.synth test world for
+    # the state-stratified national generator (models.synth) — the
+    # pod-scale drill/bench shape (DGEN_AGENTS rows, chunk-deterministic
+    # so every process materializes identical bytes)
     cfg = ScenarioConfig(
         name=os.environ.get("DGEN_GANG_NAME", "gang"),
         start_year=_env_int("DGEN_GANG_START_YEAR", 2014),
         end_year=_env_int("DGEN_END_YEAR", 2016),
         anchor_years=(),
     )
-    pop = synth.generate_population(
-        _env_int("DGEN_AGENTS", 96), states=states,
-        seed=_env_int("DGEN_GANG_SEED", 11), pad_multiple=64,
-    )
+    if os.environ.get("DGEN_GANG_WORLD", "") == "national":
+        from dgen_tpu.models import synth as national
+
+        spec = national.NationalSpec(
+            n_agents=_env_int("DGEN_AGENTS", 10_240),
+            seed=_env_int("DGEN_GANG_SEED", 11),
+            tariff_mix=os.environ.get("DGEN_GANG_TARIFF_MIX", "mixed"),
+        )
+        pop = national.generate_world(spec)
+    else:
+        states = [
+            s for s in
+            os.environ.get("DGEN_GANG_STATES", "DE,CA").split(",")
+            if s
+        ]
+        pop = synth.generate_population(
+            _env_int("DGEN_AGENTS", 96), states=states,
+            seed=_env_int("DGEN_GANG_SEED", 11), pad_multiple=64,
+        )
     inputs = scen.uniform_inputs(
         cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
     )
     rc = RunConfig.from_env(
         sizing_iters=_env_int("DGEN_GANG_SIZING_ITERS", 6),
     )
-    mesh = make_mesh() if len(jax.devices()) > 1 else None
+    # production placement: the 2-D process_count x local-devices grid
+    # (parallel.mesh.default_mesh; DGEN_TPU_MESH forces a shape) —
+    # row-major placement-identical to the old flat mesh, with the
+    # host-axis slice of the (tiny) state reductions grouped for DCN
+    mesh = default_mesh()
     sim = Simulation(
         pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc, mesh=mesh,
+        econ_years=_env_int("DGEN_GANG_ECON_YEARS", 25),
     )
 
     manifest = RunManifest(run_dir, shard=index, n_processes=n_proc)

@@ -18,6 +18,7 @@ from dgen_tpu.sweep.plan import (  # noqa: F401
     MODE_LOOP,
     MODE_VMAP,
     ScenarioGroup,
+    SweepBudgetError,
     SweepPlan,
     plan_sweep,
 )

@@ -57,6 +57,7 @@ def main(argv=None) -> int:
     from dgen_tpu.config import RunConfig, ScenarioConfig
     from dgen_tpu.io import synth
     from dgen_tpu.models import scenario as scen
+    from dgen_tpu.parallel.mesh import default_mesh
     from dgen_tpu.sweep import SweepSimulation
     from dgen_tpu.utils import compilecache
 
@@ -108,6 +109,9 @@ def main(argv=None) -> int:
     sweep = SweepSimulation(
         pop.table, pop.profiles, pop.tariffs, members, cfg,
         RunConfig(sizing_iters=args.sizing_iters),
+        # production placement (2-D hosts x devices under
+        # jax.distributed, flat single-host, DGEN_TPU_MESH override)
+        mesh=default_mesh(),
         with_hourly=args.with_hourly, labels=labels,
         baseline=args.baseline,
     )

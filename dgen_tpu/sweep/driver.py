@@ -237,6 +237,15 @@ class SweepSimulation:
             for i, m in enumerate(members)
         ]
 
+        if self.plan.global_hbm_bytes is not None:
+            logger.info(
+                "sweep HBM budget: %.2f GiB global (%dx%d mesh, "
+                "%.2f GiB/device), %d bytes/agent-row modeled",
+                self.plan.global_hbm_bytes / 1024**3,
+                *self.plan.mesh_shape,
+                self.plan.hbm_bytes / 1024**3,
+                self.plan.per_agent_bytes,
+            )
         for g in self.plan.groups:
             logger.info(
                 "sweep group (%d scenario(s), net_billing=%s): %s mode",

@@ -25,6 +25,13 @@ cheap-parameter-axis observation of the columnar-ABM literature);
 ``loop`` runs scenario-major over the SAME compiled single-scenario
 executable when S would blow the vmapped working set — HBM stays
 bounded by ``auto_agent_chunk`` either way.
+
+Budgets are **mesh-global**: per-device HBM x mesh size is what a
+national-scale plan actually has to spend (the J9 mesh audit
+cross-checks the same per-device model against the compiler's static
+memory analysis at 3x slack, docs/lint.md). A plan that cannot fit
+even the 128-row streaming-chunk floor raises
+:class:`SweepBudgetError` naming the mesh shape and the global budget.
 """
 
 from __future__ import annotations
@@ -34,7 +41,9 @@ from typing import List, Optional, Sequence, Tuple
 
 from dgen_tpu.models.scenario import ScenarioInputs, validate_scenario_statics
 from dgen_tpu.models.simulation import (
+    _CHUNK_FLOOR_ROWS,
     _HBM_RESERVE_FRAC,
+    _PERSISTENT_ROW_BYTES,
     _per_agent_step_bytes,
     auto_agent_chunk,
     default_hbm_bytes,
@@ -49,6 +58,38 @@ DEFAULT_MAX_VMAP_SCENARIOS = 8
 
 MODE_VMAP = "vmap"
 MODE_LOOP = "loop"
+
+
+class SweepBudgetError(ValueError):
+    """A sweep plan that cannot fit the mesh's GLOBAL HBM even at the
+    streaming-chunk floor. The message names the mesh shape, the
+    per-device and global budgets, and the footprint that broke them —
+    an over-budget 10M-row national plan must be diagnosable from the
+    message alone (no debugger, no byte model spelunking)."""
+
+
+def _gib(n: int) -> str:
+    return f"{n / 1024**3:.2f} GiB"
+
+
+def _budget_error(
+    *, what: str, need_bytes: int, hbm_bytes: int, mesh_shape, n_dev: int,
+    n_global_rows: int, group_scenarios: int, per_agent: int,
+) -> SweepBudgetError:
+    h, d = mesh_shape
+    return SweepBudgetError(
+        f"sweep plan over budget: {what} needs {_gib(need_bytes)} per "
+        f"device, but the {h}x{d} mesh budgets {_gib(hbm_bytes)}/device "
+        f"({_gib(hbm_bytes * n_dev)} global HBM across {n_dev} devices, "
+        f"{_HBM_RESERVE_FRAC:.0%} reserved for compiler scratch) for "
+        f"{n_global_rows} global agent rows at {per_agent} modeled "
+        f"bytes/row (models.simulation._per_agent_step_bytes); the "
+        f"scenario-major loop holds ONE of the group's "
+        f"{group_scenarios} scenario(s) resident at a time, so this is "
+        f"already the plan's cheapest mode. Fixes: grow the mesh (more "
+        f"global HBM), split the scenario axis across runs, or shrink "
+        f"the table. docs/perf.md 'HBM budgeting'."
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +120,13 @@ class SweepPlan:
     hbm_bytes: Optional[int]
     #: modeled peak step bytes per (agent x scenario) row
     per_agent_bytes: int
+    #: (hosts, devices) shape of the mesh the plan budgeted for —
+    #: (1, 1) for meshless runs, so every budget decision names its
+    #: topology (J9 cross-checks this same model per device)
+    mesh_shape: Tuple[int, int] = (1, 1)
+    #: hbm_bytes x mesh size: the global accelerator memory the whole
+    #: sweep is budgeted against (None = unknown backend)
+    global_hbm_bytes: Optional[int] = None
 
     @property
     def max_vmap_width(self) -> int:
@@ -100,6 +148,7 @@ def plan_sweep(
     mesh=None,
     hbm_bytes: Optional[int] = -1,
     max_vmap_scenarios: Optional[int] = None,
+    enforce_budget: bool = True,
 ) -> SweepPlan:
     """Plan an S-scenario sweep over one shared population.
 
@@ -109,7 +158,14 @@ def plan_sweep(
     :data:`DEFAULT_MAX_VMAP_SCENARIOS` width cap).
 
     Raises :class:`~dgen_tpu.models.scenario.ScenarioStackError` when
-    scenarios disagree on a static field (the error names it).
+    scenarios disagree on a static field (the error names it), and
+    :class:`SweepBudgetError` when even the 128-row streaming-chunk
+    floor cannot fit the mesh's budget — the message names the mesh
+    shape and the GLOBAL (per-device x mesh size) HBM budget, so an
+    over-budget national plan is diagnosable from the message alone.
+    ``enforce_budget=False`` returns the best-effort plan instead
+    (floor chunks may overshoot the device — the pre-pod behavior,
+    kept for deliberately starved what-if planning).
     """
     scenarios = list(scenarios)
     validate_scenario_statics(scenarios)
@@ -134,8 +190,37 @@ def plan_sweep(
             table, tariffs, inputs, years, table_cache=tcache)
         by_flag.setdefault(nb, []).append(i)
 
+    from dgen_tpu.parallel.mesh import mesh_shape_of
+
     n_dev = int(mesh.devices.size) if mesh is not None else 1
+    mesh_shape = mesh_shape_of(mesh) if mesh is not None else (1, 1)
     n_local = max(table.n_agents // n_dev, 1)
+
+    def check_chunk_floor(group_scenarios: int, per_agent_b: int,
+                          what: str) -> None:
+        # the one unplannable case: even a floor-sized streaming chunk
+        # (plus the persistent [N] row state — loop mode keeps ONE
+        # scenario resident at a time, the same model auto_agent_chunk
+        # budgets) busts the per-device budget — auto_agent_chunk would
+        # silently return the floor and the run would OOM, so fail HERE
+        # with the mesh/global numbers
+        if hbm_bytes is None or not enforce_budget:
+            return
+        budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
+        persistent = n_local * _PERSISTENT_ROW_BYTES
+        # a shard SMALLER than the floor that fits whole is plannable
+        # (auto_agent_chunk returns 0 there) — only a shard that can't
+        # stream even min(n_local, floor) rows is hopeless
+        need_rows = min(n_local, _CHUNK_FLOOR_ROWS)
+        need = persistent + need_rows * per_agent_b
+        if (budget - persistent) // per_agent_b < need_rows:
+            raise _budget_error(
+                what=what, need_bytes=need, hbm_bytes=hbm_bytes,
+                mesh_shape=mesh_shape, n_dev=n_dev,
+                n_global_rows=table.n_agents,
+                group_scenarios=group_scenarios,
+                per_agent=per_agent_b,
+            )
 
     # worst-case per-row footprint across the sweep's flag groups (a
     # single chunk choice must hold for every group)
@@ -160,6 +245,11 @@ def plan_sweep(
             # including its per-device streaming chunk
             mode = MODE_LOOP
             if hbm_bytes is not None:
+                check_chunk_floor(
+                    s, per_agent,
+                    f"the scenario-major loop's floor chunk "
+                    f"({_CHUNK_FLOOR_ROWS} rows/device)",
+                )
                 c = auto_agent_chunk(
                     n_local, sizing_iters=sizing_iters,
                     econ_years=econ_years, with_hourly=with_hourly,
@@ -176,16 +266,22 @@ def plan_sweep(
             # auto_agent_chunk uses, with the persistent [S, N] carry
             # counted S-wide)
             budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
-            budget -= s * n_local * 50 * 4
+            budget -= s * n_local * _PERSISTENT_ROW_BYTES
             rows_fit = max(budget, 0) // per_agent
             if s <= max_vmap and s * n_local <= rows_fit:
                 mode = MODE_VMAP            # whole table, S-way batched
-            elif s <= max_vmap and rows_fit // s >= 128:
+            elif s <= max_vmap and rows_fit // s >= _CHUNK_FLOOR_ROWS:
                 mode = MODE_VMAP            # chunked, S-way batched
-                c = int(rows_fit // s) // 128 * 128
+                c = (int(rows_fit // s) // _CHUNK_FLOOR_ROWS
+                     * _CHUNK_FLOOR_ROWS)
                 chunk = c if chunk is None else min(chunk, c)
             else:
                 mode = MODE_LOOP
+                check_chunk_floor(
+                    s, per_agent,
+                    f"the scenario-major loop's floor chunk "
+                    f"({_CHUNK_FLOOR_ROWS} rows/device)",
+                )
                 c = auto_agent_chunk(
                     n_local, sizing_iters=sizing_iters,
                     econ_years=econ_years, with_hourly=with_hourly,
@@ -208,4 +304,7 @@ def plan_sweep(
         agent_chunk=chunk,
         hbm_bytes=hbm_bytes,
         per_agent_bytes=per_agent,
+        mesh_shape=mesh_shape,
+        global_hbm_bytes=(
+            hbm_bytes * n_dev if hbm_bytes is not None else None),
     )

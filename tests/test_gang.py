@@ -69,20 +69,20 @@ def test_gang_config_from_env(monkeypatch):
     assert cfg.devices_for(2) == 4
 
 
-def test_async_io_multiprocess_optin(monkeypatch):
-    """Multi-process async host IO is opt-in ONLY: the single-process
-    'on unless killed' default must not leak across."""
+def test_async_io_default_on(monkeypatch):
+    """Async host IO is default-on for single- AND multi-process runs
+    (the opt-in gate is gone): one resolved decision, one kill switch.
+    There is no separate multi-process property anymore — the run gate
+    only adds the collect=True serialization (models.simulation)."""
     monkeypatch.delenv("DGEN_TPU_ASYNC_IO", raising=False)
     rc = RunConfig()
-    assert rc.async_io_enabled is True           # single-process default
-    assert rc.async_io_multiprocess_optin is False
-    monkeypatch.setenv("DGEN_TPU_ASYNC_IO", "1")
-    assert RunConfig().async_io_multiprocess_optin is True
+    assert rc.async_io_enabled is True           # on unless killed
+    assert not hasattr(rc, "async_io_multiprocess_optin")
     monkeypatch.setenv("DGEN_TPU_ASYNC_IO", "0")
-    assert RunConfig().async_io_multiprocess_optin is False
+    assert RunConfig().async_io_enabled is False  # the kill switch
     monkeypatch.delenv("DGEN_TPU_ASYNC_IO", raising=False)
-    assert RunConfig(async_host_io=True).async_io_multiprocess_optin
-    assert not RunConfig(async_host_io=False).async_io_multiprocess_optin
+    assert RunConfig(async_host_io=True).async_io_enabled
+    assert not RunConfig(async_host_io=False).async_io_enabled
 
 
 def test_gang_fault_sites_registered():
@@ -536,10 +536,10 @@ def test_gang_drill_kill_and_elastic(tmp_path):
 
 @pytest.mark.slow
 def test_multiprocess_async_io_parity(tmp_path):
-    """Satellite: the async host-IO pipeline on a 2-process gang
-    (explicit DGEN_TPU_ASYNC_IO=1 opt-in) writes byte-identical
-    parquet shards and an equal restored carry vs the serialized
-    oracle."""
+    """The async host-IO pipeline on a 2-process gang — engaged by
+    DEFAULT now (RunConfig.async_host_io=None, no opt-in) — writes
+    byte-identical parquet shards and an equal restored carry vs the
+    serialized oracle."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -586,7 +586,7 @@ def test_multiprocess_async_io_parity(tmp_path):
                     checkpoint_dir=os.path.join(rd, "ckpt"))
             return sim
 
-        sim = run("async", True)
+        sim = run("async", None)   # None = the default -> pipeline on
         run("sync", False)
         # this process's shard parts must be byte-identical
         for surface in ("agent_outputs", "finance_series"):
@@ -609,8 +609,8 @@ def test_multiprocess_async_io_parity(tmp_path):
         assert np.array_equal(totals[0], totals[1])
         print(f"P{{pid}}_PARITY_OK")
     """)
-    env = {**os.environ, "PYTHONUNBUFFERED": "1",
-           "DGEN_TPU_ASYNC_IO": "1"}
+    env = {**os.environ, "PYTHONUNBUFFERED": "1"}
+    env.pop("DGEN_TPU_ASYNC_IO", None)   # prove the un-opted default
     env.pop("XLA_FLAGS", None)
     env.pop("DGEN_TPU_FAULTS", None)
     logs = [open(tmp_path / f"p{pid}.log", "w+") for pid in (0, 1)]

@@ -323,3 +323,48 @@ def test_golden_bf16_banks_within_tolerance(golden_run):
         assert rel < 2e-2, f"{k}: bf16 drift {rel:.3e} exceeds envelope"
     for v in res_b.agent.values():
         assert np.all(np.isfinite(v))
+
+
+def test_golden_mesh2d_parity(golden_run):
+    """ISSUE 14 acceptance: the production 2-D hosts x devices grid
+    (2x4) reproduces the flat 1-D mesh run (1x8) to <= 2e-5 on the
+    golden e2e. The agent-axis placement is row-major identical across
+    the two shapes (parallel.mesh.agent_spec spans both axes), so only
+    collective GROUPING differs — any drift beyond the f32
+    re-association envelope means the 2-D promotion changed math."""
+    from dgen_tpu.parallel.mesh import make_mesh
+
+    pop, _, _ = golden_run
+    cfg = ScenarioConfig(name="golden", start_year=2014, end_year=2050,
+                         anchor_years=())
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups,
+        n_regions=np.asarray(pop.profiles.wholesale).shape[0],
+        overrides={
+            "attachment_rate": np.full((pop.table.n_groups,), 0.35,
+                                       np.float32),
+        },
+        n_states=pop.table.n_states,
+    )
+
+    def run_mesh(shape):
+        sim = Simulation(
+            pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+            RunConfig(sizing_iters=8), with_hourly=True,
+            mesh=make_mesh(shape=shape),
+        )
+        res = sim.run()
+        mask = sim.host_mask
+        ids = np.asarray(sim.table.agent_id)[mask > 0]
+        order = np.argsort(ids)
+        s = res.summary(mask)
+        kw = res.agent["system_kw"][-1][mask > 0][order]
+        return s, kw
+
+    s1, kw1 = run_mesh((1, 8))
+    s2, kw2 = run_mesh((2, 4))
+    for k in ("adopters", "system_kw_cum", "batt_kwh_cum"):
+        ref = np.maximum(np.abs(np.asarray(s1[k], np.float64)), 1e-6)
+        rel = np.max(np.abs(np.asarray(s2[k]) - np.asarray(s1[k])) / ref)
+        assert rel <= 2e-5, f"{k}: 2-D mesh drift {rel:.3e}"
+    np.testing.assert_allclose(kw2, kw1, rtol=2e-5, atol=1e-6)

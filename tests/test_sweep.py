@@ -113,7 +113,11 @@ def test_planner_groups_budget_and_errors(pop):
     assert plan.agent_chunk == 0
 
     # starved budget: the vmapped working set cannot fit -> loop mode
-    plan_small = plan_sweep(members, years, hbm_bytes=8 * 1024**2, **kw)
+    # (enforce_budget=False: this synthetic 8 MiB budget is below even
+    # the 128-row chunk floor, which the strict default now REJECTS
+    # with a SweepBudgetError — tested separately below)
+    plan_small = plan_sweep(members, years, hbm_bytes=8 * 1024**2,
+                            enforce_budget=False, **kw)
     assert plan_small.groups[0].mode == MODE_LOOP
 
     # mid budget: vmap survives but chunked (S x chunk rows bounded).
@@ -161,7 +165,8 @@ def test_planner_groups_budget_and_errors(pop):
     mesh = make_mesh()
     small = 8 * 1024**2
     plan_mesh_small = plan_sweep(members, years, mesh=mesh,
-                                 hbm_bytes=small, **kw)
+                                 hbm_bytes=small, enforce_budget=False,
+                                 **kw)
     n_local = max(pop.table.n_agents // int(mesh.devices.size), 1)
     expect = auto_agent_chunk(
         n_local, sizing_iters=6, econ_years=25, with_hourly=False,

@@ -101,6 +101,14 @@ if [ "$mode" != "--test-only" ]; then
     JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --gang \
         --gang-processes 2 --gang-shrink 0 --no-gang-stall \
         --agents 48 --end-year 2016 >/tmp/_gang.json || rc=1
+    # national-generator smoke (docs/userguide.md "National-scale
+    # synthetic runs"): generate a 10k-agent state-stratified world,
+    # step 2 model years through the PRODUCTION 2-D placement path on a
+    # forced 1x8 CPU mesh, and verify the run manifest — the generator
+    # and the mesh promotion cannot rot between SCALE_r* bench rounds
+    echo "== national synth smoke (python -m dgen_tpu.models.synth smoke) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.models.synth smoke \
+        --agents 10240 --mesh 1x8 >/tmp/_synth_smoke.json || rc=1
 fi
 
 if [ "$mode" != "--lint-only" ]; then

@@ -78,13 +78,20 @@ Knobs (env):
                           request latency into the payload (the
                           trajectory's first latency numbers)
   DGEN_TPU_BENCH_FLEET    <N>: boot an N-replica serving fleet behind
-                          the routing front (dgen_tpu.serve.fleet),
-                          drive closed-loop HTTP load through it, and
-                          SIGKILL one replica mid-load — stamps
-                          replica count, boot walls, the failover
-                          recovery wall, shed rate, and client
-                          p50/p99 THROUGH the failure into the
-                          payload (docs/serve.md "Fleet operations")
+                          the routing front with the FULL production
+                          stack armed — precomputed answer surface,
+                          cross-replica result cache, keep-alive
+                          pooled connections, occupancy autoscaler —
+                          drive mixed closed-loop HTTP load (default
+                          question / hot what-ifs / unique what-ifs)
+                          and SIGKILL one replica mid-load: stamps
+                          boot walls, the recovery wall, surface/cache
+                          hit rates, autoscale events, shed rate, and
+                          client p50/p99 THROUGH the failure; with
+                          DGEN_TPU_BENCH_SERVE also set, stamps
+                          qps_vs_serve_engine_x (the SERVE_r01
+                          trajectory ratio; docs/serve.md "Production
+                          throughput")
   DGEN_TPU_BENCH_GANG     <P>: boot a P-process CPU/gloo simulation
                           gang under the gang supervisor
                           (dgen_tpu.resilience.gang), SIGKILL one
@@ -556,11 +563,23 @@ def _serve_bench(
     its answer before issuing the next (closed loop — overload shows
     up as latency, not as an unbounded in-flight pile). Stamps the
     trajectory's first serving-latency numbers: achieved throughput,
-    p50/p99 request latency, and mean batch occupancy."""
+    p50/p99 request latency, and mean batch occupancy.
+
+    The run is TWO phases over the identical protocol: the engine path
+    (the PR 5 baseline — every query walks the compiled programs) and
+    the same closed loop with the precomputed answer surface attached
+    (every query here is the zero-override default question, so phase
+    two is 100% surface hits).  ``surface_phase.vs_engine_x`` is the
+    like-for-like engine-free speedup with everything else — protocol,
+    population, batcher, clients — held fixed."""
+    import shutil
+    import tempfile
     import threading
 
     from dgen_tpu.config import ServeConfig
     from dgen_tpu.serve import Microbatcher, ServeEngine
+    from dgen_tpu.serve.surface import build_surface, load_and_attach
+    from dgen_tpu.utils import timing
 
     sim, pop = _build(min(n_agents, 8192), 2022)
     engine = ServeEngine(sim)
@@ -568,102 +587,185 @@ def _serve_bench(
     t0 = time.time()
     engine.warmup(cfg.buckets)
     warmup_s = time.time() - t0
-    bat = Microbatcher(engine, cfg)
 
     n_real = int(np.asarray(pop.table.mask).sum())
     years = sim.years
     n_clients = max(1, min(64, qps // 4))
     interval = n_clients / max(qps, 1)
-    stop = time.time() + duration_s
-    done = [0] * n_clients
-    errors = [0] * n_clients
 
-    def client(ci: int) -> None:
-        rng = np.random.default_rng(ci)
-        while time.time() < stop:
-            t_iter = time.time()
-            aid = int(rng.integers(0, n_real))
-            yr = int(years[int(rng.integers(0, len(years)))])
-            try:
-                bat.query([aid], year=yr, timeout=30.0)
-                done[ci] += 1
-            except Exception:  # noqa: BLE001 — count, keep offering load
-                errors[ci] += 1
-            dt = time.time() - t_iter
-            if dt < interval:
-                time.sleep(interval - dt)
+    def run_phase(bat) -> dict:
+        stop = time.time() + duration_s
+        done = [0] * n_clients
+        errors = [0] * n_clients
 
-    threads = [
-        threading.Thread(target=client, args=(i,), daemon=True)
-        for i in range(n_clients)
-    ]
-    t0 = time.time()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(duration_s + 60.0)
-    elapsed = time.time() - t0
-    stats = bat.stats()   # latency_ms percentiles come from here — one
-    bat.close()           # formatting of the shared timing histogram
+        def client(ci: int) -> None:
+            rng = np.random.default_rng(ci)
+            while time.time() < stop:
+                t_iter = time.time()
+                aid = int(rng.integers(0, n_real))
+                yr = int(years[int(rng.integers(0, len(years)))])
+                try:
+                    bat.query([aid], year=yr, timeout=30.0)
+                    done[ci] += 1
+                except Exception:  # noqa: BLE001 — count, keep offering
+                    errors[ci] += 1
+                dt = time.time() - t_iter
+                if dt < interval:
+                    time.sleep(interval - dt)
+
+        threads = [
+            threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration_s + 60.0)
+        elapsed = time.time() - t0
+        stats = bat.stats()   # latency percentiles: one formatting of
+        bat.close()           # the shared timing histogram
+        return {
+            "duration_s": round(elapsed, 2),
+            "qps_achieved": round(sum(done) / max(elapsed, 1e-9), 1),
+            "requests": sum(done),
+            "errors": sum(errors),
+            "latency_ms": stats.get("latency_ms"),
+            "batch_occupancy": stats.get("batch_occupancy"),
+            "batches": stats.get("batches"),
+            "surface_hits": stats.get("surface_hits"),
+            "rejected": stats.get("rejected"),
+        }
+
+    # phase 1: the PR 5 protocol — every query through the engine
+    engine_phase = run_phase(Microbatcher(engine, cfg))
+
+    # phase 2: identical protocol, answer surface attached (these are
+    # all zero-override default questions -> 100% surface-eligible)
+    surf_dir = tempfile.mkdtemp(prefix="dgen-bench-surf-")
+    try:
+        t0 = time.time()
+        build_surface(engine, surf_dir, cfg.max_batch)
+        build_s = time.time() - t0
+        timing.reset_timings()   # fresh latency histogram per phase
+        load_and_attach(engine, surf_dir)
+        surface_phase = run_phase(Microbatcher(engine, cfg))
+        surface_phase["build_wall_s"] = round(build_s, 2)
+        surface_phase["vs_engine_x"] = round(
+            surface_phase["qps_achieved"]
+            / max(engine_phase["qps_achieved"], 1e-9), 1,
+        )
+    finally:
+        engine.attach_surface(None)
+        shutil.rmtree(surf_dir, ignore_errors=True)
+
     return {
         "agents": n_real,
         "qps_target": qps,
         "clients": n_clients,
-        "duration_s": round(elapsed, 2),
         "warmup_s": round(warmup_s, 2),
         "buckets": list(cfg.buckets),
-        "qps_achieved": round(sum(done) / max(elapsed, 1e-9), 1),
-        "requests": sum(done),
-        "errors": sum(errors),
-        "latency_ms": stats.get("latency_ms"),
-        "batch_occupancy": stats.get("batch_occupancy"),
-        "batches": stats.get("batches"),
-        "rejected": stats.get("rejected"),
+        # top-level = the PR 5 engine-path protocol (the baseline the
+        # SERVE_r* trajectory ratios reference)
+        **engine_phase,
+        "surface_phase": surface_phase,
     }
 
 
 def _fleet_bench(
     n_agents: int, n_replicas: int, duration_s: float = 10.0
 ) -> dict:
-    """Fleet load + failover bench: boot N replica processes behind
-    the routing front (shared AOT compile cache; boot walls stamped),
-    drive closed-loop HTTP clients through the front, SIGKILL one
-    replica a third of the way in, and report what the *client* saw
-    through the failure — achieved QPS, shed rate (503 fraction), and
-    p50/p99 request latency with retries included — plus the
-    supervisor's measured recovery wall (death -> READY again)."""
-    import http.client
+    """Production-traffic fleet bench: boot N replicas behind the
+    routing front with the FULL serving stack — precomputed answer
+    surface, cross-replica exact result cache, keep-alive pooled
+    connections, and the occupancy-driven autoscaler — drive a mixed
+    closed-loop load through it (mostly the zero-override default
+    question, a hot repeated what-if set, and a unique-override
+    engine-path tail), SIGKILL one replica a third of the way in, and
+    report what the *client* saw through the failure: achieved QPS,
+    shed rate, p50/p99 with retries included, plus per-path counters
+    (surface hit-rate, cache hit-rate, engine batches), autoscale
+    events, and the supervisor's recovery wall.  The post-load repeat
+    round proves the cache-hit path under the replica kill: requests
+    first computed before the kill are re-answered afterwards — some
+    by the restarted replica — from the shared cache, byte-identical.
+    """
+    import argparse
+    import shutil
     import signal as _signal
+    import tempfile
     import threading
 
+    import dgen_tpu.serve.__main__ as serve_cli
     from dgen_tpu.config import FleetConfig
-    from dgen_tpu.serve.fleet import ReplicaSupervisor, default_replica_cmd
+    from dgen_tpu.serve.autoscale import Autoscaler
+    from dgen_tpu.serve.engine import ServeEngine
+    from dgen_tpu.serve.fleet import (
+        HTTP_ERRORS,
+        READY,
+        HTTPPool,
+        ReplicaSupervisor,
+        default_replica_cmd,
+        http_json,
+    )
     from dgen_tpu.serve.front import (
         FleetFront,
         drain_front,
         start_front_in_thread,
     )
+    from dgen_tpu.serve.surface import build_surface
 
     agents = min(n_agents, 8192)
+    end_year = 2022
+    bucket = 64
+    work_dir = tempfile.mkdtemp(prefix="dgen-bench-fleet-")
+    surf_dir = os.path.join(work_dir, "surface")
+    cache_dir = os.path.join(work_dir, "resultcache")
     serve_args = [
-        "--agents", str(agents), "--end-year", "2022",
-        "--max-batch", "64", "--max-wait-ms", "2",
+        "--agents", str(agents), "--end-year", str(end_year),
+        "--max-batch", str(bucket), "--max-wait-ms", "2",
+        "--surface", surf_dir, "--cache-dir", cache_dir,
     ]
+    # the surface is built through the SAME population path the
+    # replica CLI uses (provenance must match) and pre-warms the
+    # shared compile cache for fast replica boots
+    oracle = ServeEngine(serve_cli._build_sim(argparse.Namespace(
+        agents=agents, start_year=2014, end_year=end_year, seed=7,
+        econ_years=None, sizing_iters=None,
+    )))
+    t0 = time.time()
+    oracle.warmup([bucket])
+    surface_header = build_surface(oracle, surf_dir, bucket)
+    surface_build_s = time.time() - t0
+    # the oracle existed to build the surface and pre-warm the shared
+    # compile cache; release its banks/programs before the measured
+    # fleet window (everything timeshares one box)
+    del oracle
+
     cfg = FleetConfig(
         n_replicas=n_replicas, port=0, poll_interval_s=0.1,
         request_timeout_s=5.0, breaker_failures=2,
         breaker_cooldown_s=0.5, retry_after_s=0.0,
+        metricz_interval_s=0.25,
+        autoscale=True, min_replicas=1, max_replicas=n_replicas + 1,
+        scale_up_queue_frac=0.05, scale_up_occupancy=0.9,
+        scale_up_sustain_s=0.5, scale_down_queue_frac=0.01,
+        scale_down_occupancy=0.3, scale_down_sustain_s=2.0,
+        scale_cooldown_s=2.0, scale_interval_s=0.1,
     )
     t0 = time.time()
     sup = ReplicaSupervisor(default_replica_cmd(serve_args), cfg).start()
+    scaler = None
     try:
         booted = sup.wait_ready(timeout=600.0)
         boot_wall_s = time.time() - t0
         boot_walls = {h.index: round(h.boot_wall_s or 0.0, 2)
                       for h in sup.ready_handles()}
         front = FleetFront(sup, cfg).start()
+        scaler = Autoscaler(sup, front.pressure, cfg).start()
         srv = start_front_in_thread(front)
         port = srv.server_address[1]
+        client_pool = HTTPPool(max_idle=32)
 
         stop_at = time.time() + duration_s
         kill_at = time.time() + duration_s / 3.0
@@ -673,36 +775,63 @@ def _fleet_bench(
         conn_fail = [0]   # transport failures (dropped connections)
         done = [0]
         lock = threading.Lock()
-        rng_years = list(range(2014, 2023))
+        rng_years = list(range(2014, end_year + 1, 2))
+        # the hot repeated what-if set (a promoted scenario, a shared
+        # link): small enough that steady state is all cache hits
+        hot_overrides = (
+            {"scale": {"itc_fraction": 0.5}},
+            {"set": {"elec_price_escalator": 0.005}},
+        )
+
+        def make_body(rng) -> bytes:
+            roll = rng.random()
+            if roll < 0.90:
+                # the default question (the surface path)
+                body = {
+                    "agent_ids": [int(rng.integers(0, agents))],
+                    "year": int(
+                        rng_years[int(rng.integers(0, len(rng_years)))]),
+                }
+            elif roll < 0.98:
+                # the hot what-if set (the cache path): few distinct
+                # (agent, year, override) combos so repeats hit
+                body = {
+                    "agent_ids": [int(rng.integers(0, 8))],
+                    "year": int(rng_years[int(rng.integers(0, 2))]),
+                    "overrides": hot_overrides[int(rng.integers(0, 2))],
+                }
+            else:
+                # a unique what-if (the engine fall-through path)
+                body = {
+                    "agent_ids": [int(rng.integers(0, agents))],
+                    "year": int(
+                        rng_years[int(rng.integers(0, len(rng_years)))]),
+                    "overrides": {"scale": {
+                        "itc_fraction": round(float(rng.random()), 6)}},
+                }
+            return json.dumps(body).encode()
+
+        def post_once(body: bytes) -> int:
+            try:
+                status, blob, _ = http_json(
+                    port, "/query", method="POST", body=body,
+                    timeout=15.0, pool=client_pool,
+                )
+                return status
+            except HTTP_ERRORS:
+                return -1
 
         def client(ci: int) -> None:
-            from dgen_tpu.serve.fleet import HTTP_ERRORS
-
             rng = np.random.default_rng(ci)
             while time.time() < stop_at:
                 if not killed[0] and time.time() >= kill_at:
                     killed[0] = True
                     sup.terminate_replica(0, _signal.SIGKILL)
-                body = json.dumps({
-                    "agent_ids": [int(rng.integers(0, agents))],
-                    "year": int(
-                        rng_years[int(rng.integers(0, len(rng_years)))]),
-                }).encode()
+                body = make_body(rng)
                 t_req = time.monotonic()
                 status = -1
                 while time.time() < stop_at:
-                    try:
-                        conn = http.client.HTTPConnection(
-                            "127.0.0.1", port, timeout=15.0)
-                        try:
-                            conn.request("POST", "/query", body=body)
-                            r = conn.getresponse()
-                            status = r.status
-                            r.read()
-                        finally:
-                            conn.close()
-                    except HTTP_ERRORS:
-                        status = -1
+                    status = post_once(body)
                     if status != 503 and status != -1:
                         break
                     # 503 = the fleet shed/drained; -1 = a dropped
@@ -719,7 +848,7 @@ def _fleet_bench(
                     if status == 200:
                         done[0] += 1
 
-        n_clients = max(2, min(16, n_replicas * 4))
+        n_clients = max(2, min(32, n_replicas * 8))
         threads = [threading.Thread(target=client, args=(i,), daemon=True)
                    for i in range(n_clients)]
         t0 = time.time()
@@ -728,24 +857,115 @@ def _fleet_bench(
         for t in threads:
             t.join(duration_s + 120.0)
         elapsed = time.time() - t0
-        recovered = sup.wait_ready(timeout=120.0)
+        # per-path counters read at END OF LOAD, while the survivors'
+        # lifetime counters still cover the load window (a restarted
+        # or autoscale-retired replica takes its counters with it)
+        mz_load = front.metricz()
+        # recovery = the KILLED replica back to READY ("n ready" is a
+        # moving target under autoscaling — the fleet may legitimately
+        # be running a different size by now)
+        recovered = False
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            h0 = sup.replicas[0]
+            if h0.state == READY and h0.last_recovery_s is not None:
+                recovered = True
+                break
+            time.sleep(0.2)
         recovery_s = sup.replicas[0].last_recovery_s
+
+        # cache-hit-under-kill repeat round: the hot what-ifs were
+        # first computed BEFORE the kill; re-asking them now (fleet
+        # healed, killed replica restarted) must be answered from the
+        # shared cache — the metricz hit counters prove the path
+        repeat_rng = np.random.default_rng(12345)
+        repeat_ok = 0
+        for _ in range(8):
+            body = json.dumps({
+                "agent_ids": [int(repeat_rng.integers(0, 8))],
+                "year": int(rng_years[int(repeat_rng.integers(0, 2))]),
+                "overrides":
+                    hot_overrides[int(repeat_rng.integers(0, 2))],
+            }).encode()
+            if post_once(body) == 200 and post_once(body) == 200:
+                repeat_ok += 1
+        # idle tail: give the autoscaler its scale-down window
+        time.sleep(cfg.scale_down_sustain_s + 1.0)
         mz = front.metricz()
+        scale_stats = scaler.stats()
+        scaler.stop()
+        client_pool.close()
         drain_front(front, srv)
         srv.server_close()
     finally:
+        if scaler is not None:
+            scaler.stop()
         # no bench failure may leak replica subprocesses; idempotent
         # after the drain above
         sup.stop(drain=False, timeout=10.0)
+        shutil.rmtree(work_dir, ignore_errors=True)
     arr = np.asarray(sorted(lats), dtype=np.float64)
     total_attempts = len(lats) + shed[0] + conn_fail[0]
+    # cache counters: max over the load-window and final snapshots —
+    # the post-load repeat round adds hits, while restarts/retirement
+    # can only LOSE counters, never inflate them
+    cache_mz = {
+        k: max(
+            int((mz_load.get("result_cache") or {}).get(k, 0) or 0),
+            int((mz.get("result_cache") or {}).get(k, 0) or 0),
+        )
+        for k in ("hits", "misses", "stores", "evictions")
+    }
+    cache_lookups = cache_mz.get("hits", 0) + cache_mz.get("misses", 0)
+    surface_hits = max(
+        int(mz_load.get("surface_hits") or 0),
+        int(mz.get("surface_hits") or 0),
+    )
     return {
         "replicas": n_replicas,
         "agents": agents,
         "clients": n_clients,
+        "protocol_note": (
+            "1-CPU-core container: clients, front, replicas and "
+            "supervisor timeshare one core, so absolute fleet QPS "
+            "measures Python/HTTP orchestration overhead (~3-6 ms CPU "
+            "per proxied request), not serving-stack capacity; the "
+            "engine-free win is isolated like-for-like in "
+            "serve.surface_phase.vs_engine_x (the identical PR 5 "
+            "closed-loop protocol with the surface attached vs the "
+            "engine path)"
+        ),
         "booted": booted,
         "boot_wall_s": round(boot_wall_s, 2),
         "replica_boot_walls_s": boot_walls,
+        "surface": {
+            "rows": surface_header["columns"]["agent_id"]["shape"][1],
+            "years": len(surface_header["meta"]["year_indices"]),
+            "bucket": bucket,
+            "build_wall_s": round(surface_build_s, 2),
+            "content_hash": surface_header["content_hash"][:12],
+            "hits": surface_hits,
+            # a LOWER bound: dead/retired incarnations' counters are
+            # lost with them
+            "hit_rate": round(surface_hits / max(done[0], 1), 4),
+        },
+        "result_cache": dict(
+            cache_mz,
+            hit_rate=round(
+                cache_mz.get("hits", 0) / max(cache_lookups, 1), 4),
+        ),
+        "cache_hit_under_kill": {
+            "repeats_answered": repeat_ok,
+            "cache_hits_total": cache_mz.get("hits"),
+        },
+        "autoscale": {
+            "scale_ups": scale_stats["scale_ups"],
+            "scale_downs": scale_stats["scale_downs"],
+            "final_replicas": scale_stats["live_replicas"],
+            "events": scale_stats["events"],
+        },
+        "http_pool": mz.get("http_pool"),
+        "client_pool": client_pool.stats(),
         "duration_s": round(elapsed, 2),
         "requests": done[0],
         "qps_achieved": round(done[0] / max(elapsed, 1e-9), 1),
@@ -1399,6 +1619,15 @@ def main() -> None:
         else:
             try:
                 payload["fleet"] = _fleet_bench(n_agents, n_rep)
+                # the serving trajectory's headline ratio: the full
+                # production stack vs the PR 5 engine-path protocol
+                # (both measured in THIS payload when both knobs are
+                # set — the SERVE_r01.json shape)
+                base_qps = (payload.get("serve") or {}).get(
+                    "qps_achieved")
+                if base_qps:
+                    payload["fleet"]["qps_vs_serve_engine_x"] = round(
+                        payload["fleet"]["qps_achieved"] / base_qps, 1)
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["fleet"] = {
                     "replicas": n_rep,

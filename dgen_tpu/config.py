@@ -324,6 +324,20 @@ class ServeConfig:
     #: sending its body (or never reads its response) releases the
     #: handler thread after this long instead of holding it forever
     socket_timeout_s: float = 30.0
+    #: precomputed answer-surface directory (serve.surface): when set,
+    #: the engine mmaps the surface at boot (provenance-gated — a
+    #: surface built under a different config_hash/git_sha/population
+    #: is refused with a named reason) and answers zero-override
+    #: queries for covered years engine-free.  None = engine path only.
+    surface_dir: Optional[str] = None
+    #: cross-replica exact result cache directory (serve.resultcache):
+    #: when set, bucketed answers are cached in this shared directory
+    #: keyed by (year, override key, bucket, rows, provenance) — every
+    #: replica of a fleet points at the same directory.  None = off.
+    result_cache_dir: Optional[str] = None
+    #: result-cache entry bound (files); least-recently-used entries
+    #: are evicted on store
+    result_cache_entries: int = 512
 
     def __post_init__(self) -> None:
         _check(_is_pow2(self.max_batch), "max_batch must be a power of two")
@@ -334,6 +348,8 @@ class ServeConfig:
         _check(0 <= self.port <= 65535, "port out of range")
         _check(self.request_timeout_s > 0.0, "request_timeout_s must be > 0")
         _check(self.socket_timeout_s > 0.0, "socket_timeout_s must be > 0")
+        _check(self.result_cache_entries >= 1,
+               "result_cache_entries must be >= 1")
 
     @property
     def buckets(self) -> Tuple[int, ...]:
@@ -352,8 +368,20 @@ class ServeConfig:
         DGEN_TPU_SERVE_MAX_BATCH, DGEN_TPU_SERVE_WAIT_MS,
         DGEN_TPU_SERVE_QUEUE, DGEN_TPU_SERVE_HOST, DGEN_TPU_SERVE_PORT,
         DGEN_TPU_SERVE_WARMUP (0/false = off),
-        DGEN_TPU_SERVE_REQ_TIMEOUT_S, DGEN_TPU_SERVE_SOCK_TIMEOUT_S."""
+        DGEN_TPU_SERVE_REQ_TIMEOUT_S, DGEN_TPU_SERVE_SOCK_TIMEOUT_S,
+        DGEN_TPU_SERVE_SURFACE (answer-surface dir),
+        DGEN_TPU_SERVE_CACHE_DIR / DGEN_TPU_SERVE_CACHE_ENTRIES
+        (result cache)."""
         env = os.environ.get
+        if "surface_dir" not in overrides and env("DGEN_TPU_SERVE_SURFACE"):
+            overrides["surface_dir"] = env("DGEN_TPU_SERVE_SURFACE")
+        if ("result_cache_dir" not in overrides
+                and env("DGEN_TPU_SERVE_CACHE_DIR")):
+            overrides["result_cache_dir"] = env("DGEN_TPU_SERVE_CACHE_DIR")
+        if ("result_cache_entries" not in overrides
+                and env("DGEN_TPU_SERVE_CACHE_ENTRIES")):
+            overrides["result_cache_entries"] = int(
+                env("DGEN_TPU_SERVE_CACHE_ENTRIES"))
         if "max_batch" not in overrides and env("DGEN_TPU_SERVE_MAX_BATCH"):
             overrides["max_batch"] = int(env("DGEN_TPU_SERVE_MAX_BATCH"))
         if "max_wait_ms" not in overrides and env("DGEN_TPU_SERVE_WAIT_MS"):
@@ -423,9 +451,64 @@ class FleetConfig:
     #: graceful drain bound: in-flight requests get this long to finish
     #: after SIGTERM before the process exits anyway
     drain_timeout_s: float = 30.0
+    #: occupancy-driven autoscaling (serve.autoscale.Autoscaler): scale
+    #: the fleet between min_replicas and max_replicas from the
+    #: aggregated /metricz pressure signal instead of holding
+    #: n_replicas fixed.  Off by default — the PR 9 fixed-fleet
+    #: behavior is unchanged until an operator opts in.
+    autoscale: bool = False
+    #: autoscale bounds (n_replicas is the BOOT size and must sit
+    #: inside them when autoscaling is on)
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: scale-up trigger: aggregate queue depth >= this fraction of
+    #: aggregate queue capacity, OR mean batch occupancy >= the
+    #: occupancy threshold, sustained for scale_up_sustain_s
+    scale_up_queue_frac: float = 0.25
+    scale_up_occupancy: float = 0.75
+    scale_up_sustain_s: float = 2.0
+    #: scale-down trigger: queue empty below this fraction AND batch
+    #: occupancy below the occupancy bound, sustained for
+    #: scale_down_sustain_s (hysteresis: the down thresholds must sit
+    #: strictly below the up thresholds or the fleet oscillates)
+    scale_down_queue_frac: float = 0.02
+    scale_down_occupancy: float = 0.25
+    scale_down_sustain_s: float = 10.0
+    #: minimum wall between ANY two scale actions (a freshly added
+    #: replica needs time to go READY and absorb load before the
+    #: signal is trusted again)
+    scale_cooldown_s: float = 5.0
+    #: autoscaler decision cadence
+    scale_interval_s: float = 0.5
 
     def __post_init__(self) -> None:
         _check(self.n_replicas >= 1, "n_replicas must be >= 1")
+        _check(self.min_replicas >= 1, "min_replicas must be >= 1")
+        _check(self.max_replicas >= self.min_replicas,
+               "max_replicas must be >= min_replicas")
+        if self.autoscale:
+            _check(
+                self.min_replicas <= self.n_replicas <= self.max_replicas,
+                "with autoscale on, n_replicas (the boot size) must lie "
+                "within [min_replicas, max_replicas]",
+            )
+        _check(0.0 < self.scale_up_queue_frac <= 1.0,
+               "scale_up_queue_frac must be in (0, 1]")
+        _check(0.0 <= self.scale_down_queue_frac
+               < self.scale_up_queue_frac,
+               "scale_down_queue_frac must be < scale_up_queue_frac "
+               "(hysteresis)")
+        _check(0.0 < self.scale_up_occupancy <= 1.0,
+               "scale_up_occupancy must be in (0, 1]")
+        _check(0.0 <= self.scale_down_occupancy < self.scale_up_occupancy,
+               "scale_down_occupancy must be < scale_up_occupancy "
+               "(hysteresis)")
+        _check(self.scale_up_sustain_s >= 0,
+               "scale_up_sustain_s must be >= 0")
+        _check(self.scale_down_sustain_s >= 0,
+               "scale_down_sustain_s must be >= 0")
+        _check(self.scale_cooldown_s >= 0, "scale_cooldown_s must be >= 0")
+        _check(self.scale_interval_s > 0, "scale_interval_s must be > 0")
         _check(0 <= self.port <= 65535, "port out of range")
         _check(self.boot_timeout_s > 0, "boot_timeout_s must be > 0")
         _check(self.poll_interval_s > 0, "poll_interval_s must be > 0")
@@ -448,8 +531,17 @@ class FleetConfig:
         DGEN_TPU_FLEET_MAX_RESTARTS, DGEN_TPU_FLEET_BREAKER_FAILURES,
         DGEN_TPU_FLEET_BREAKER_COOLDOWN_S,
         DGEN_TPU_FLEET_REQ_TIMEOUT_S, DGEN_TPU_FLEET_SHED_FRAC,
-        DGEN_TPU_FLEET_RETRY_AFTER_S, DGEN_TPU_FLEET_DRAIN_TIMEOUT_S."""
+        DGEN_TPU_FLEET_RETRY_AFTER_S, DGEN_TPU_FLEET_DRAIN_TIMEOUT_S,
+        DGEN_TPU_FLEET_AUTOSCALE (1/true = on),
+        DGEN_TPU_FLEET_MIN_REPLICAS, DGEN_TPU_FLEET_MAX_REPLICAS,
+        DGEN_TPU_FLEET_SCALE_UP_QUEUE_FRAC,
+        DGEN_TPU_FLEET_SCALE_UP_SUSTAIN_S,
+        DGEN_TPU_FLEET_SCALE_DOWN_SUSTAIN_S,
+        DGEN_TPU_FLEET_SCALE_COOLDOWN_S."""
         env = os.environ.get
+        if "autoscale" not in overrides and env("DGEN_TPU_FLEET_AUTOSCALE"):
+            overrides["autoscale"] = env(
+                "DGEN_TPU_FLEET_AUTOSCALE") not in ("0", "false", "off")
         for key, envname, conv in (
             ("n_replicas", "DGEN_TPU_FLEET_REPLICAS", int),
             ("host", "DGEN_TPU_FLEET_HOST", str),
@@ -463,6 +555,16 @@ class FleetConfig:
             ("shed_queue_frac", "DGEN_TPU_FLEET_SHED_FRAC", float),
             ("retry_after_s", "DGEN_TPU_FLEET_RETRY_AFTER_S", float),
             ("drain_timeout_s", "DGEN_TPU_FLEET_DRAIN_TIMEOUT_S", float),
+            ("min_replicas", "DGEN_TPU_FLEET_MIN_REPLICAS", int),
+            ("max_replicas", "DGEN_TPU_FLEET_MAX_REPLICAS", int),
+            ("scale_up_queue_frac",
+             "DGEN_TPU_FLEET_SCALE_UP_QUEUE_FRAC", float),
+            ("scale_up_sustain_s",
+             "DGEN_TPU_FLEET_SCALE_UP_SUSTAIN_S", float),
+            ("scale_down_sustain_s",
+             "DGEN_TPU_FLEET_SCALE_DOWN_SUSTAIN_S", float),
+            ("scale_cooldown_s",
+             "DGEN_TPU_FLEET_SCALE_COOLDOWN_S", float),
         ):
             if key not in overrides and env(envname):
                 overrides[key] = conv(env(envname))

@@ -525,7 +525,10 @@ class HostPipeline:
                 self.max_observed_depth, self._in_flight)
         item = _Item(year, year_idx, payloads,
                      outs if self._needs_device else None)
-        self._items.append(item)
+        # one record per submitted model YEAR of one run (tens), read
+        # back by drain() — a batch-driver ledger, not request-keyed
+        # serving state
+        self._items.append(item)   # dgenlint: disable=L12
         try:
             self.pool.fetch.submit(self._fetch_job, item)
         except BaseException as e:  # pool torn down under us

@@ -1,4 +1,4 @@
-"""dgenlint rules L1-L11: JAX/TPU anti-patterns for the dgen-tpu stack.
+"""dgenlint rules L1-L12: JAX/TPU anti-patterns for the dgen-tpu stack.
 
 Every rule is a generator ``rule(module, index) -> (line, message)``;
 :func:`run_rules` applies suppressions and wraps results in
@@ -22,6 +22,9 @@ Scope notes:
   * L11 is a host-side ARTIFACT rule: write-mode opens and frame
     writers are fine inside (or handed to) the temp+rename helpers
     (resilience.atomic), flagged everywhere else.
+  * L12 is a host-side SERVING rule like L10 (request-path heuristic,
+    anywhere in the repo): per-request growth of a ``self`` container
+    with no eviction evidence in the class.
 """
 
 from __future__ import annotations
@@ -642,6 +645,136 @@ def rule_l11(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
 
 
 # ---------------------------------------------------------------------------
+# L12 — unbounded in-memory caches in request-handling paths
+# ---------------------------------------------------------------------------
+#
+# A serving process is long-lived: any dict/list it grows per REQUEST
+# (a result memo keyed by request data, a seen-requests log) is a slow
+# memory leak that an averages-dashboard never shows — the process
+# OOMs at 3 a.m. after weeks of organic key diversity.  The serve
+# layer's caches (the override-variant LRU, the file-backed result
+# cache) are bounded by construction; this rule catches the unbounded
+# shape statically: a ``self.X[key] = ...`` store or ``self.X.append``
+# in a request-path function whose class never evicts X (no
+# popitem/pop/clear/remove/del, no ``maxlen=`` bound at construction).
+
+#: request-path heuristic (superset of L10's): http.server do_* verbs,
+#: handle/request names, *Handler methods, plus the serving vocabulary
+#: (submit/query/route)
+_L12_NAME_PARTS = ("handle", "request", "submit", "query", "route")
+
+#: a class calling any of these on the attribute IS bounding it
+_L12_EVICTORS = {"popitem", "pop", "clear", "remove"}
+
+
+def _is_l12_request_fn(fn: FuncInfo) -> bool:
+    name = fn.node.name.lower()
+    if name.startswith("do_") or any(t in name for t in _L12_NAME_PARTS):
+        return True
+    return bool(fn.class_name and fn.class_name.lower().endswith("handler"))
+
+
+def _l12_self_attr(node: ast.AST) -> Optional[str]:
+    """``'x'`` for a ``self.x`` attribute expression, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _l12_bounded_attrs(m: ModuleInfo) -> set:
+    """(class, attr) pairs with eviction/bound evidence anywhere in
+    the class: an evictor call, a ``del self.X[...]``, or a
+    ``maxlen=``-bounded constructor assignment."""
+    bounded = set()
+    for fn in m.functions:
+        cls = fn.class_name
+        if cls is None:
+            continue
+        for node in walk_own_body(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                attr = _l12_self_attr(node.func.value)
+                if attr is not None and node.func.attr in _L12_EVICTORS:
+                    bounded.add((cls, attr))
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _l12_self_attr(t.value)
+                        if attr is not None:
+                            bounded.add((cls, attr))
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                value = node.value
+                if isinstance(value, ast.Call) and any(
+                    kw.arg == "maxlen" for kw in value.keywords
+                ):
+                    for t in targets:
+                        attr = _l12_self_attr(t)
+                        if attr is not None:
+                            bounded.add((cls, attr))
+    return bounded
+
+
+def rule_l12(m: ModuleInfo, index: ProjectIndex) -> Iterable[RuleHit]:
+    """Request-keyed accumulation into an unbounded ``self`` container
+    inside request-handling paths: the class must evict (or bound at
+    construction) anything a request can grow."""
+    bounded = _l12_bounded_attrs(m)
+    for fn in m.functions:
+        if fn.class_name is None:
+            continue
+        inside = fn if _is_l12_request_fn(fn) else fn.parent
+        while inside is not None and not _is_l12_request_fn(inside):
+            inside = inside.parent
+        if inside is None:
+            continue
+        for node in walk_own_body(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if not isinstance(t, ast.Subscript):
+                        continue
+                    attr = _l12_self_attr(t.value)
+                    if (
+                        attr is not None
+                        and not isinstance(t.slice, ast.Constant)
+                        and (fn.class_name, attr) not in bounded
+                    ):
+                        yield node.lineno, (
+                            f"`self.{attr}[...]` grows per request in "
+                            f"`{fn.qualname}` and nothing in the class "
+                            "ever evicts it — a long-lived serving "
+                            "process leaks until OOM; bound it (LRU "
+                            "popitem, maxlen, or the file-backed "
+                            "result cache)"
+                        )
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("append", "setdefault")
+            ):
+                attr = _l12_self_attr(node.func.value)
+                if (
+                    attr is not None
+                    and (fn.class_name, attr) not in bounded
+                ):
+                    yield node.lineno, (
+                        f"`self.{attr}.{node.func.attr}(...)` grows "
+                        f"per request in `{fn.qualname}` with no "
+                        "eviction anywhere in the class — bound it "
+                        "(deque(maxlen=...), explicit eviction) or "
+                        "move it off the request path"
+                    )
+
+
+# ---------------------------------------------------------------------------
 # Registry / driver
 # ---------------------------------------------------------------------------
 
@@ -657,6 +790,7 @@ RULES: Dict[str, Tuple[str, object]] = {
     "L9": ("synchronous host fetches in per-year driver loops", rule_l9),
     "L10": ("jit construction inside request-handling paths", rule_l10),
     "L11": ("bare run-artifact writes outside temp+rename", rule_l11),
+    "L12": ("unbounded in-memory caches in request paths", rule_l12),
 }
 
 

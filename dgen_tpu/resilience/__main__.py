@@ -129,12 +129,20 @@ def _cmd_drill(args) -> int:
         )
         print(json.dumps(rec, indent=1))
         return 0 if rec["ok"] else 1
+    if args.serve_scale:
+        from dgen_tpu.resilience.fleetdrill import run_scale_drill
+
+        rec = run_scale_drill(agents=args.agents, end_year=end_year)
+        rec.pop("supervisor_events", None)
+        print(json.dumps(rec, indent=1))
+        return 0 if rec["ok"] else 1
     if args.serve_fleet:
         from dgen_tpu.resilience.fleetdrill import run_fleet_drill
 
         rec = run_fleet_drill(
             replicas=args.replicas, agents=args.agents,
             end_year=end_year, requests=args.requests,
+            layers=args.layers,
         )
         # the event/boot detail is for logs, not the summary line
         rec.pop("supervisor_events", None)
@@ -238,6 +246,19 @@ def main(argv=None) -> int:
                           "the P->P' shrink so resumes are bit-exact")
     drl.add_argument("--no-gang-stall", action="store_true",
                      help="gang drill: skip the heartbeat-stall round")
+    drl.add_argument("--serve-scale", action="store_true",
+                     help="autoscale drill instead: a 1-replica fleet "
+                          "scaled 1 -> 2 -> 1 by the autoscaler under "
+                          "synthetic occupancy, with a result-cache "
+                          "hit proven byte-identical to the engine "
+                          "answer (docs/serve.md 'Production "
+                          "throughput')")
+    drl.add_argument("--layers", action="store_true",
+                     help="fleet drill: arm the answer surface + "
+                          "shared result cache on every replica and "
+                          "prove all three serving paths (surface, "
+                          "cache, engine) bit-exact through the "
+                          "kill, cache hits included")
     drl.add_argument("--replicas", type=int, default=2,
                      help="fleet drill: replica count")
     drl.add_argument("--requests", type=int, default=80,

@@ -141,6 +141,14 @@ SITES: Dict[str, str] = {
         "collective error at the year boundary); the worker dies and "
         "the supervisor restarts the gang"
     ),
+    "surface_load": (
+        "serve.surface.AnswerSurface.load — the precomputed answer "
+        "surface failing to load/verify at replica boot (``error``: an "
+        "unreadable mmap; ``truncate``: the drill truncates table.bin "
+        "before the open, modeling torn storage).  The engine must "
+        "refuse the surface with a named reason and fall through to "
+        "the compiled query path — never serve damaged answers"
+    ),
     "ingest_corrupt_row": (
         "models.agents.build_agent_table — malformed rows entering the "
         "agent table at ingest (``corrupt``: NaN customer counts, "

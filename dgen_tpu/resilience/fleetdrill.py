@@ -107,9 +107,22 @@ def run_fleet_drill(
     max_client_retries: int = 200,
     p99_bound_s: float = 30.0,
     seed: int = 7,
+    layers: bool = False,
 ) -> Dict[str, object]:
     """Run the drill (module docstring); returns the drill record
-    (``ok`` + the numbers a bench payload stamps)."""
+    (``ok`` + the numbers a bench payload stamps).
+
+    ``layers=True`` additionally arms the production-throughput stack
+    — a provenance-matched answer surface and the shared result cache
+    on every replica — and, after the kill/hang load, runs a repeat
+    round: request plans first computed BEFORE the kill are re-asked
+    twice each through the healed fleet (restarted replica included)
+    and must come back bit-identical to the oracle, with the fleet's
+    surface-hit and cache-hit counters proving which engine-free path
+    answered.  The drill then fails unless all three serving paths
+    (surface, cache, engine fall-through) were exercised."""
+    import tempfile
+
     from dgen_tpu.config import FleetConfig
     from dgen_tpu.serve.fleet import ReplicaSupervisor, default_replica_cmd
     from dgen_tpu.serve.server import _rows_to_json
@@ -144,6 +157,16 @@ def run_fleet_drill(
     oracle_warm_s = time.perf_counter() - t0
     n_real = oracle.n_agents
     years = list(oracle.years)
+
+    work_dir = None
+    if layers:
+        from dgen_tpu.serve.surface import build_surface
+
+        work_dir = tempfile.mkdtemp(prefix="dgen-fleet-layers-")
+        surf_dir = f"{work_dir}/surface"
+        cache_dir = f"{work_dir}/resultcache"
+        build_surface(oracle, surf_dir, bucket)
+        serve_argv += ["--surface", surf_dir, "--cache-dir", cache_dir]
 
     expected: List[dict] = []
     for k in range(requests):
@@ -188,13 +211,17 @@ def run_fleet_drill(
             kill_at=kill_at, hang_at=hang_at, hang_s=hang_s,
             forward_timeout_s=forward_timeout_s,
             max_client_retries=max_client_retries,
-            p99_bound_s=p99_bound_s,
+            p99_bound_s=p99_bound_s, layers=layers,
         )
     finally:
         # no exception path may leak N serving subprocesses — the CI
         # lint gate runs this drill on every push.  Idempotent: the
         # success path already drained + stopped the fleet.
         sup.stop(drain=False, timeout=10.0)
+        if work_dir is not None:
+            import shutil
+
+            shutil.rmtree(work_dir, ignore_errors=True)
     rec["oracle_warmup_s"] = round(oracle_warm_s, 3)
     rec["drill_wall_s"] = round(time.perf_counter() - t_drill0, 3)
     logger.info(
@@ -210,7 +237,7 @@ def run_fleet_drill(
 def _drive_fleet(
     sup, fleet_cfg, *, expected, n_real, years, replicas, agents,
     requests, clients, kill_at, hang_at, hang_s, forward_timeout_s,
-    max_client_retries, p99_bound_s,
+    max_client_retries, p99_bound_s, layers=False,
 ) -> Dict[str, object]:
     """The fleet-facing half of the drill: load, faults, asserts.
     Runs under run_fleet_drill's finally so the fleet is always torn
@@ -290,6 +317,36 @@ def _drive_fleet(
     # the killed replica must be back: full READY strength
     recovered = sup.wait_ready(timeout=90.0)
 
+    # layered repeat round: plans first computed BEFORE the kill are
+    # re-asked twice each through the healed fleet — zero-override
+    # plans answer from the surface mmap, override plans' second ask
+    # answers from the shared result cache (whichever replica gets it,
+    # the restarted one included), all bit-identical to the oracle
+    repeat_mismatches: List[int] = []
+    repeat_failures = 0
+    if layers:
+        for k in range(min(12, len(expected))):
+            plan = _request_plan(k, n_real, years)
+            for _ask in range(2):
+                status, blob = None, b""
+                for _r in range(60):
+                    try:
+                        status, blob, _ra = _post(
+                            front_port, plan,
+                            timeout=2 * forward_timeout_s + 10.0,
+                        )
+                    except http_errors:
+                        status = -1
+                    if status not in (503, -1):
+                        break
+                    time.sleep(0.1)
+                if status != 200:
+                    repeat_failures += 1
+                    continue
+                row = (json.loads(blob).get("results") or [None])[0]
+                if row != expected[k]:
+                    repeat_mismatches.append(k)
+
     mismatches = []
     for k, got in sorted(answers.items()):
         want_row = expected[k]
@@ -301,12 +358,20 @@ def _drive_fleet(
     hang_fired = 0
     steady_compiles: Dict[str, Optional[int]] = {}
     steady_traces: Dict[str, Optional[int]] = {}
+    surface_hits_total = 0
+    cache_totals = {"hits": 0, "misses": 0, "stores": 0, "evictions": 0}
+    engine_batches_total = 0
     for h in sup.ready_handles():
         mz = _get(h.port, "/metricz") or {}
         steady_compiles[str(h.index)] = mz.get("steady_state_compiles")
         steady_traces[str(h.index)] = mz.get("steady_state_traces")
         hang_fired += int(
             (mz.get("faults_fired") or {}).get("serve_replica_hang", 0))
+        surface_hits_total += int(mz.get("surface_hits", 0) or 0)
+        engine_batches_total += int(mz.get("batches", 0) or 0)
+        for key in cache_totals:
+            cache_totals[key] += int(
+                (mz.get("result_cache") or {}).get(key, 0) or 0)
 
     lat = np.asarray(sorted(latencies), dtype=np.float64)
     p50 = float(np.percentile(lat, 50)) if lat.size else 0.0
@@ -322,6 +387,18 @@ def _drive_fleet(
     compiles_clean = all(
         c == 0 for c in steady_compiles.values()
     ) and bool(steady_compiles)
+    layers_ok = True
+    if layers:
+        # all three serving paths exercised, bit-exact, and the
+        # cache-hit path proven AFTER the kill (the repeat round ran
+        # against the healed fleet, restarted replica included)
+        layers_ok = bool(
+            surface_hits_total > 0
+            and cache_totals["hits"] > 0
+            and engine_batches_total > 0
+            and not repeat_mismatches
+            and repeat_failures == 0
+        )
     ok = bool(
         booted
         and len(answers) == requests
@@ -332,6 +409,7 @@ def _drive_fleet(
         and (hang_fired >= 1 if replicas > 1 else True)
         and compiles_clean
         and p99 <= p99_bound_s
+        and layers_ok
     )
     rec = {
         "ok": ok,
@@ -357,6 +435,15 @@ def _drive_fleet(
         },
         "steady_state_compiles": steady_compiles,
         "steady_state_traces": steady_traces,
+        "layers": (
+            {
+                "surface_hits": surface_hits_total,
+                "result_cache": cache_totals,
+                "engine_batches": engine_batches_total,
+                "repeat_mismatches": repeat_mismatches,
+                "repeat_failures": repeat_failures,
+            } if layers else None
+        ),
         "latency_s": {
             "p50": round(p50, 3),
             "p99": round(p99, 3),
@@ -373,4 +460,211 @@ def _drive_fleet(
         "drained": drained,
         "supervisor_events": list(sup.events),
     }
+    return rec
+
+
+def run_scale_drill(
+    *,
+    agents: int = 64,
+    end_year: int = 2016,
+    econ_years: int = 4,
+    sizing_iters: int = 6,
+    bucket: int = 8,
+    seed: int = 7,
+    ready_timeout_s: float = 180.0,
+) -> Dict[str, object]:
+    """The autoscale + cache round-trip drill (the tools/check.sh
+    cache+autoscale leg): boot a 1-replica fleet with the autoscaler
+    armed on a SYNTHETIC occupancy signal, drive it 1 -> 2 -> 1, and
+    prove a cache hit byte-identical to the engine answer along the
+    way.  Passes only if:
+
+    * sustained synthetic pressure scales the fleet to 2 READY
+      replicas (the new replica boots off the shared compile cache and
+      is readiness-gated like any other);
+    * a what-if query asked twice comes back BYTE-IDENTICAL both
+      times and to an in-process engine oracle, with the fleet's
+      result-cache hit counter proving the second answer never touched
+      the engine;
+    * sustained synthetic idleness drains the fleet back to 1 (the
+      retired replica exits via SIGTERM drain, is never restarted, and
+      its exit is not counted as a death);
+    * both scale events land in the fleet ledger.
+    """
+    import argparse
+    import shutil
+    import tempfile
+
+    import dgen_tpu.serve.__main__ as serve_cli
+    from dgen_tpu.config import FleetConfig
+    from dgen_tpu.serve.autoscale import Autoscaler
+    from dgen_tpu.serve.engine import ServeEngine
+    from dgen_tpu.serve.fleet import (
+        STOPPED,
+        ReplicaSupervisor,
+        default_replica_cmd,
+    )
+    from dgen_tpu.serve.front import (
+        FleetFront,
+        drain_front,
+        start_front_in_thread,
+    )
+    from dgen_tpu.serve.server import _rows_to_json
+    from dgen_tpu.serve.surface import build_surface
+
+    t0 = time.perf_counter()
+    work_dir = tempfile.mkdtemp(prefix="dgen-scale-drill-")
+    surf_dir = f"{work_dir}/surface"
+    cache_dir = f"{work_dir}/resultcache"
+
+    # in-process oracle over the same population path as the replicas
+    sim = serve_cli._build_sim(argparse.Namespace(
+        agents=agents, start_year=2014, end_year=end_year, seed=seed,
+        econ_years=econ_years, sizing_iters=sizing_iters,
+    ))
+    oracle = ServeEngine(sim)
+    oracle.warmup([bucket])
+    build_surface(oracle, surf_dir, bucket)
+    years = list(oracle.years)
+    overrides = {"scale": {"itc_fraction": 0.5}}
+    want = _rows_to_json(
+        oracle.query([1], year=years[0], overrides=overrides,
+                     bucket=bucket),
+        cash_flow=False,
+    )[0]
+
+    serve_argv = [
+        "--agents", str(agents), "--end-year", str(end_year),
+        "--seed", str(seed), "--econ-years", str(econ_years),
+        "--sizing-iters", str(sizing_iters),
+        "--max-batch", str(bucket), "--min-bucket", str(bucket),
+        "--max-wait-ms", "2",
+        "--surface", surf_dir, "--cache-dir", cache_dir,
+    ]
+    cfg = FleetConfig(
+        n_replicas=1, port=0, poll_interval_s=0.1,
+        request_timeout_s=10.0, retry_after_s=0.0,
+        metricz_interval_s=0.2,
+        autoscale=True, min_replicas=1, max_replicas=2,
+        scale_up_queue_frac=0.5, scale_up_occupancy=0.8,
+        scale_up_sustain_s=0.3, scale_down_queue_frac=0.05,
+        scale_down_occupancy=0.2, scale_down_sustain_s=0.3,
+        scale_cooldown_s=0.5, scale_interval_s=0.05,
+    )
+    # SYNTHETIC occupancy: the drill scripts the pressure signal so
+    # the 1 -> 2 -> 1 round-trip is deterministic (real-signal scaling
+    # is exercised by the bench; this leg gates the mechanism)
+    phase = {"hot": False}
+
+    def signal_fn():
+        if phase["hot"]:
+            return {"queue_frac": 0.9, "occupancy": 0.95}
+        return {"queue_frac": 0.0, "occupancy": 0.0}
+
+    sup = ReplicaSupervisor(default_replica_cmd(serve_argv), cfg).start()
+    scaler = Autoscaler(sup, signal_fn, cfg)
+    front = FleetFront(sup, cfg).start()
+    srv = None
+    try:
+        booted = sup.wait_ready(n=1, timeout=ready_timeout_s)
+        srv = start_front_in_thread(front)
+        front_port = srv.server_address[1]
+        scaler.start()
+
+        # cache round 1: miss -> engine -> store (replica 0)
+        body = {"agent_ids": [1], "year": years[0],
+                "overrides": overrides}
+        s1, b1, _ = _post(front_port, body, timeout=60.0)
+        ans1 = (json.loads(b1).get("results") or [None])[0] \
+            if s1 == 200 else None
+
+        # scale up: sustained synthetic pressure -> 2 READY replicas
+        phase["hot"] = True
+        scaled_up = False
+        deadline = time.monotonic() + ready_timeout_s
+        while time.monotonic() < deadline:
+            if sup.wait_ready(n=2, timeout=1.0):
+                scaled_up = True
+                break
+        # cache round 2 at full strength: byte-identical, from cache
+        s2, b2, _ = _post(front_port, body, timeout=60.0)
+        ans2 = (json.loads(b2).get("results") or [None])[0] \
+            if s2 == 200 else None
+        # let the scrape thread pick the hit counters up before the
+        # aggregate read (3x the scrape cadence = the freshness bound)
+        time.sleep(3 * cfg.metricz_interval_s)
+        mz_up = front.metricz()
+
+        # scale down: sustained synthetic idleness -> back to 1
+        phase["hot"] = False
+        scaled_down = False
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if sup.live_count() == 1:
+                scaled_down = True
+                break
+            time.sleep(0.1)
+        # the retired replica must actually exit (SIGTERM drain), and
+        # must not be counted as a death or restarted
+        retired = [h for h in sup.replicas if h.state == STOPPED]
+        retired_exited = False
+        if retired:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if all(h.proc is not None and h.proc.poll() is not None
+                       for h in retired):
+                    retired_exited = True
+                    break
+                time.sleep(0.1)
+        still_one_ready = sup.wait_ready(n=1, timeout=30.0)
+
+        events = [e["event"] for e in sup.events]
+        cache_mz = (mz_up.get("result_cache") or {})
+        ok = bool(
+            booted
+            and scaled_up
+            and scaled_down
+            and retired_exited
+            and still_one_ready
+            and s1 == 200 and s2 == 200
+            and ans1 is not None and ans1 == want and ans2 == want
+            and cache_mz.get("hits", 0) >= 1
+            and "autoscale_up" in events
+            and "autoscale_down" in events
+            and not any(
+                h.deaths for h in sup.replicas
+            )   # nothing died: growth and retirement only
+        )
+        rec = {
+            "ok": ok,
+            "booted": booted,
+            "scaled_up": scaled_up,
+            "scaled_down": scaled_down,
+            "retired_exited": retired_exited,
+            "back_to_one_ready": still_one_ready,
+            "cache_answer_byte_identical": (
+                ans1 == want and ans2 == want),
+            "result_cache": cache_mz,
+            "surface_hits": mz_up.get("surface_hits"),
+            "autoscale_events": scaler.events,
+            "scale_ups": scaler.n_scale_up,
+            "scale_downs": scaler.n_scale_down,
+            "supervisor_events": [
+                e for e in sup.events
+                if e["event"].startswith(("autoscale", "scale"))
+            ],
+            "drill_wall_s": round(time.perf_counter() - t0, 3),
+        }
+    finally:
+        scaler.stop()
+        if srv is not None:
+            drain_front(front, srv)
+            srv.server_close()
+        sup.stop(drain=False, timeout=10.0)
+        shutil.rmtree(work_dir, ignore_errors=True)
+    logger.info(
+        "serve-scale drill: %s (up=%s down=%s cache_hits=%s)",
+        "ok" if rec["ok"] else "FAILED", rec["scaled_up"],
+        rec["scaled_down"], (rec["result_cache"] or {}).get("hits"),
+    )
     return rec

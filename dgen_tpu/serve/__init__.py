@@ -20,8 +20,19 @@ processes alive and readiness-gated behind a
 :class:`~dgen_tpu.serve.front.FleetFront` that round-robins, breaks
 circuits, sheds load, and drains gracefully (docs/serve.md "Fleet
 operations").
+
+Production throughput (docs/serve.md "Production throughput"): the
+zero-override default question serves engine-free from a precomputed,
+provenance-gated, memory-mapped :class:`~dgen_tpu.serve.surface.
+AnswerSurface` (``--build-surface`` / ``--surface``); repeated
+what-ifs hit the cross-replica exact
+:class:`~dgen_tpu.serve.resultcache.ResultCache` (``--cache-dir``);
+and the :class:`~dgen_tpu.serve.autoscale.Autoscaler`
+(``--autoscale``) grows/drains the fleet from the aggregated
+occupancy signal.
 """
 
+from dgen_tpu.serve.autoscale import Autoscaler  # noqa: F401
 from dgen_tpu.serve.batcher import Microbatcher, QueueFullError  # noqa: F401
 from dgen_tpu.serve.engine import (  # noqa: F401
     QUERY_FIELDS,
@@ -33,7 +44,15 @@ from dgen_tpu.serve.engine import (  # noqa: F401
     query_program,
 )
 from dgen_tpu.serve.fleet import (  # noqa: F401
+    HTTPPool,
     ReplicaSupervisor,
     default_replica_cmd,
 )
 from dgen_tpu.serve.front import CircuitBreaker, FleetFront  # noqa: F401
+from dgen_tpu.serve.resultcache import ResultCache  # noqa: F401
+from dgen_tpu.serve.surface import (  # noqa: F401
+    AnswerSurface,
+    StaleSurfaceError,
+    SurfaceError,
+    build_surface,
+)

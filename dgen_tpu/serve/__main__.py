@@ -2,7 +2,15 @@
 endpoint over a synthetic population (or a preset's population when a
 reference input mount exists).
 
-Three modes:
+Production-throughput layers (docs/serve.md "Production throughput"):
+``--build-surface DIR`` precomputes the zero-override answer surface
+(batch mode: build, print the header, exit); ``--surface DIR`` serves
+from it (provenance-gated); ``--cache-dir DIR`` shares an exact
+result cache across replicas; ``--autoscale`` (fleet mode) sizes the
+fleet from the aggregated occupancy signal between
+``--min-replicas``/``--max-replicas``.
+
+Three serving modes:
 
 * **single process** (default) — the PR 5 behavior::
 
@@ -77,6 +85,9 @@ def _serve_config(args):
         ("host", args.host), ("port", args.port),
         ("max_batch", args.max_batch), ("max_wait_ms", args.max_wait_ms),
         ("min_bucket", args.min_bucket),
+        ("surface_dir", args.surface),
+        ("result_cache_dir", args.cache_dir),
+        ("result_cache_entries", args.cache_entries),
     ):
         if v is not None:
             overrides[k] = v
@@ -85,11 +96,57 @@ def _serve_config(args):
     return ServeConfig.from_env(**overrides)
 
 
+def _attach_layers(engine, serve_cfg):
+    """Attach the engine-free serving layers a config names: the
+    provenance-gated answer surface and the cross-replica result
+    cache.  Refusals are loud and non-fatal (engine-path serving is
+    always available)."""
+    from dgen_tpu.serve import surface as surface_mod
+    from dgen_tpu.serve.resultcache import ResultCache
+
+    if serve_cfg.surface_dir:
+        surface_mod.load_and_attach(engine, serve_cfg.surface_dir)
+    if serve_cfg.result_cache_dir:
+        engine.attach_result_cache(ResultCache(
+            serve_cfg.result_cache_dir,
+            provenance_key=surface_mod.provenance_key(engine),
+            max_entries=serve_cfg.result_cache_entries,
+        ))
+    return engine
+
+
+def _build_surface_cmd(args) -> None:
+    """``--build-surface DIR``: sweep the zero-override answer for
+    every (year, table row) through the live query program at full
+    bucket width and publish it as a provenance-stamped mmap table."""
+    import json as _json
+
+    from dgen_tpu.serve.engine import ServeEngine
+    from dgen_tpu.serve.surface import build_surface
+
+    serve_cfg = _serve_config(args)
+    engine = ServeEngine(_build_sim(args))
+    bucket = serve_cfg.max_batch
+    engine.warmup([bucket])
+    header = build_surface(engine, args.build_surface, bucket)
+    print(_json.dumps({
+        "surface_dir": args.build_surface,
+        "bucket": bucket,
+        "years": header["meta"]["year_indices"],
+        "rows": header["columns"]["agent_id"]["shape"][1],
+        "content_hash": header["content_hash"],
+        "build_wall_s": header["meta"]["build_wall_s"],
+        "provenance": header["meta"]["provenance"],
+    }, indent=1))
+
+
 def _run_single(args) -> None:
     from dgen_tpu.serve.engine import ServeEngine
     from dgen_tpu.serve.server import ServeApp, serve_forever
 
-    app = ServeApp(ServeEngine(_build_sim(args)), _serve_config(args))
+    serve_cfg = _serve_config(args)
+    engine = _attach_layers(ServeEngine(_build_sim(args)), serve_cfg)
+    app = ServeApp(engine, serve_cfg)
     serve_forever(app)
 
 
@@ -103,8 +160,10 @@ def _run_replica(args) -> None:
 
     logger = get_logger()
     faults.install_from_env()   # the drill's per-replica fault specs
+    serve_cfg = _serve_config(args)
+    engine = _attach_layers(ServeEngine(_build_sim(args)), serve_cfg)
     app = ServeApp(
-        ServeEngine(_build_sim(args)), _serve_config(args),
+        engine, serve_cfg,
         replica_index=args.replica_index, defer_warmup=True,
     )
     srv = make_server(app)
@@ -149,6 +208,12 @@ def _run_fleet(args) -> None:
         overrides["host"] = args.host
     if args.port is not None:
         overrides["port"] = args.port
+    if args.autoscale:
+        overrides["autoscale"] = True
+    if args.min_replicas is not None:
+        overrides["min_replicas"] = args.min_replicas
+    if args.max_replicas is not None:
+        overrides["max_replicas"] = args.max_replicas
     fleet_cfg = FleetConfig.from_env(**overrides)
 
     serve_args = [
@@ -169,11 +234,22 @@ def _run_fleet(args) -> None:
         serve_args += ["--max-wait-ms", str(args.max_wait_ms)]
     if args.no_warmup:
         serve_args += ["--no-warmup"]
+    if args.surface:
+        serve_args += ["--surface", args.surface]
+    if args.cache_dir:
+        serve_args += ["--cache-dir", args.cache_dir]
+    if args.cache_entries is not None:
+        serve_args += ["--cache-entries", str(args.cache_entries)]
 
     sup = ReplicaSupervisor(
         default_replica_cmd(serve_args), fleet_cfg,
     ).start()
     front = FleetFront(sup, fleet_cfg).start()
+    scaler = None
+    if fleet_cfg.autoscale:
+        from dgen_tpu.serve.autoscale import Autoscaler
+
+        scaler = Autoscaler(sup, front.pressure, fleet_cfg).start()
     srv = make_front_server(front)
     install_sigterm_drain_front(front, srv)
     host, port = srv.server_address[:2]
@@ -187,6 +263,8 @@ def _run_fleet(args) -> None:
     except KeyboardInterrupt:
         logger.info("fleet front: shutting down")
     finally:
+        if scaler is not None:
+            scaler.stop()
         srv.server_close()
         front.close()
         sup.stop(drain=True)
@@ -209,6 +287,25 @@ def main(argv=None) -> None:
     ap.add_argument("--min-bucket", type=int, default=None)
     ap.add_argument("--max-wait-ms", type=float, default=None)
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--surface", default=None, metavar="DIR",
+                    help="serve zero-override queries from this "
+                         "precomputed answer surface (provenance-"
+                         "gated; docs/serve.md 'Production "
+                         "throughput')")
+    ap.add_argument("--build-surface", default=None, metavar="DIR",
+                    help="build the answer surface for this "
+                         "population/config into DIR and exit")
+    ap.add_argument("--cache-dir", default=None, metavar="DIR",
+                    help="cross-replica exact result cache directory "
+                         "(shared by every replica of a fleet)")
+    ap.add_argument("--cache-entries", type=int, default=None,
+                    help="result cache entry bound (LRU eviction)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="fleet mode: scale replicas between "
+                         "--min-replicas/--max-replicas from the "
+                         "aggregated occupancy signal")
+    ap.add_argument("--min-replicas", type=int, default=None)
+    ap.add_argument("--max-replicas", type=int, default=None)
     ap.add_argument("--fleet", type=int, default=None, metavar="N",
                     help="supervise N replicas behind the routing front")
     ap.add_argument("--replica-index", type=int, default=None,
@@ -225,6 +322,12 @@ def main(argv=None) -> None:
 
     if args.fleet is not None and args.replica_index is not None:
         ap.error("--fleet and --replica-index are mutually exclusive")
+    if args.build_surface is not None:
+        if args.fleet is not None or args.replica_index is not None:
+            ap.error("--build-surface is a batch command (no fleet/"
+                     "replica flags)")
+        _build_surface_cmd(args)
+        return
     if args.fleet is not None:
         _run_fleet(args)
     elif args.replica_index is not None or args.portfile:

@@ -1,0 +1,207 @@
+"""Occupancy-driven fleet autoscaling: close the loop the PR 9 fleet
+left open.
+
+:class:`~dgen_tpu.serve.fleet.ReplicaSupervisor` already knows how to
+spawn, warm, health-gate, restart, and drain replicas; the fleet front
+already aggregates every replica's ``/metricz`` occupancy and queue
+depth.  This module connects the two: a small control loop that grows
+the fleet under sustained pressure and drains it back down when idle,
+instead of holding N fixed while queues melt or machines sit warm and
+empty.
+
+Control policy (deliberately boring — serving control loops reward
+predictability over cleverness):
+
+* **signal** — :meth:`FleetFront.pressure`: aggregate queue depth as a
+  fraction of aggregate queue capacity, plus batch-weighted occupancy,
+  over *fresh* READY-replica scrapes.  No fresh signal = no action
+  (never scale blind, the same rule load shedding follows).
+* **hysteresis** — pressure must be *sustained* for
+  ``scale_up_sustain_s`` before a scale-up, and idleness for
+  ``scale_down_sustain_s`` before a scale-down; the down thresholds
+  sit strictly below the up thresholds (enforced by
+  :class:`~dgen_tpu.config.FleetConfig`), so a blip can't flap the
+  fleet.
+* **cooldown** — after ANY action the controller holds for
+  ``scale_cooldown_s``: a freshly spawned replica needs time to reach
+  READY and absorb load before the signal means anything again.
+* **bounds** — the fleet never leaves
+  ``[min_replicas, max_replicas]``.
+* **verbs** — scale-up is ``supervisor.add_replica()`` (readiness-
+  gated boot off the shared compile cache: seconds, not minutes);
+  scale-down is ``supervisor.retire_replica(i)`` on the
+  highest-index READY replica (SIGTERM -> the replica drains its
+  in-flight batches; the monitor does not count the exit as a death).
+
+Every decision lands in the supervisor's event ledger (and the
+autoscaler's own ``events`` list), so a bench or drill can replay
+exactly when and why the fleet changed size.
+
+``signal_fn`` and ``clock`` are injectable: unit tests drive the full
+hysteresis matrix with scripted signals and a fake clock; the
+``--serve-scale`` drill feeds synthetic occupancy to a REAL fleet.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from dgen_tpu.config import FleetConfig
+from dgen_tpu.serve.fleet import READY, ReplicaSupervisor
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+class Autoscaler:
+    """The control loop (module docstring).
+
+    Parameters
+    ----------
+    supervisor : the fleet to scale.
+    signal_fn : ``() -> Optional[dict]`` with keys ``queue_frac``,
+        ``occupancy`` (:meth:`FleetFront.pressure`); None = no fresh
+        signal, hold.
+    config : :class:`~dgen_tpu.config.FleetConfig` (autoscale knobs).
+    clock : injectable monotonic clock (tests).
+    """
+
+    def __init__(
+        self,
+        supervisor: ReplicaSupervisor,
+        signal_fn: Callable[[], Optional[dict]],
+        config: Optional[FleetConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.sup = supervisor
+        self.signal_fn = signal_fn
+        self.config = config or supervisor.config
+        self._clock = clock
+        self._pressure_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_action_at: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.events: List[dict] = []
+        self.n_scale_up = 0
+        self.n_scale_down = 0
+
+    # -- decision core (pure given signal + clock; unit-testable) ------
+
+    def _record(self, action: str, **detail) -> None:
+        rec = {"t": round(time.time(), 3), "action": action, **detail}
+        self.events.append(rec)
+        self.sup._event(-1, f"autoscale_{action}", **detail)
+
+    def _in_cooldown(self, now: float) -> bool:
+        return (
+            self._last_action_at is not None
+            and now - self._last_action_at < self.config.scale_cooldown_s
+        )
+
+    def tick(self) -> Optional[str]:
+        """One control decision; returns "up"/"down" when an action
+        was taken, else None."""
+        cfg = self.config
+        now = self._clock()
+        sig = self.signal_fn()
+        if sig is None:
+            # no fresh signal: hold, and restart both hysteresis
+            # windows — a gap in telemetry proves nothing either way
+            self._pressure_since = None
+            self._idle_since = None
+            return None
+        hot = (
+            sig["queue_frac"] >= cfg.scale_up_queue_frac
+            or sig["occupancy"] >= cfg.scale_up_occupancy
+        )
+        idle = (
+            sig["queue_frac"] <= cfg.scale_down_queue_frac
+            and sig["occupancy"] <= cfg.scale_down_occupancy
+        )
+        n = self.sup.live_count()
+        if hot:
+            self._idle_since = None
+            if self._pressure_since is None:
+                self._pressure_since = now
+            sustained = now - self._pressure_since >= cfg.scale_up_sustain_s
+            if sustained and not self._in_cooldown(now) \
+                    and n < cfg.max_replicas:
+                self.sup.add_replica()
+                self.n_scale_up += 1
+                self._last_action_at = now
+                self._pressure_since = None
+                self._record(
+                    "up", n_replicas=n + 1,
+                    queue_frac=round(sig["queue_frac"], 4),
+                    occupancy=round(sig["occupancy"], 4),
+                )
+                return "up"
+        elif idle:
+            self._pressure_since = None
+            if self._idle_since is None:
+                self._idle_since = now
+            sustained = now - self._idle_since >= cfg.scale_down_sustain_s
+            if sustained and not self._in_cooldown(now) \
+                    and n > cfg.min_replicas:
+                victim = self._pick_victim()
+                if victim is not None and self.sup.retire_replica(
+                    victim, drain_timeout_s=cfg.drain_timeout_s
+                ):
+                    self.n_scale_down += 1
+                    self._last_action_at = now
+                    self._idle_since = None
+                    self._record(
+                        "down", retired=victim, n_replicas=n - 1,
+                        queue_frac=round(sig["queue_frac"], 4),
+                        occupancy=round(sig["occupancy"], 4),
+                    )
+                    return "down"
+        else:
+            # between the bands: neither window accumulates
+            self._pressure_since = None
+            self._idle_since = None
+        return None
+
+    def _pick_victim(self) -> Optional[int]:
+        """Highest-index READY replica (LIFO: the most recently scaled
+        up is the first retired — lower indices keep stable
+        identities)."""
+        ready = [h.index for h in self.sup.replicas if h.state == READY]
+        return max(ready) if ready else None
+
+    # -- loop ----------------------------------------------------------
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(
+            target=self._loop, name="dgen-fleet-autoscale", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the controller must
+                # outlive any bad tick (same rule as the fleet monitor)
+                logger.exception("autoscaler: tick failed")
+            self._stop.wait(self.config.scale_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        return {
+            "enabled": True,
+            "min_replicas": self.config.min_replicas,
+            "max_replicas": self.config.max_replicas,
+            "live_replicas": self.sup.live_count(),
+            "scale_ups": self.n_scale_up,
+            "scale_downs": self.n_scale_down,
+            "events": list(self.events),
+        }

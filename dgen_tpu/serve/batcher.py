@@ -82,6 +82,9 @@ class Microbatcher:
         self.n_rejected = 0
         self.n_batches = 0
         self.n_rows = 0
+        #: requests answered straight from the answer surface's mmap
+        #: (engine-free; they never entered the queue)
+        self.n_surface_hits = 0
         self._occupancy_sum = 0.0
         self._thread = threading.Thread(
             target=self._worker, name="dgen-serve-batcher", daemon=True
@@ -111,10 +114,28 @@ class Microbatcher:
             )
         rows = self.engine.rows_for(agent_ids)
         year_idx = self.engine.year_index(year)
+        okey = override_key(overrides)
+        # engine-free fast path: the zero-override question for a
+        # surface-covered year is a mmap read — it never queues, never
+        # pads, never touches the device, and does not count against
+        # admission control (it consumes no engine capacity).
+        # getattr: test stubs implement only the query surface
+        surf = getattr(self.engine, "surface", None)
+        if not okey and surf is not None and surf.covers(year_idx):
+            req = _Request(rows, year_idx, (year_idx, okey), None)
+            out = surf.lookup(rows, year_idx)
+            with self._cv:
+                if self._closed:
+                    raise RuntimeError("batcher is closed")
+                self.n_requests += 1
+                self.n_surface_hits += 1
+            timing.observe(
+                REQUEST_LATENCY, time.monotonic() - req.t_submit
+            )
+            req.future.set_result(out)
+            return req.future
         inputs = self.engine.inputs_for(overrides)
-        req = _Request(
-            rows, year_idx, (year_idx, override_key(overrides)), inputs
-        )
+        req = _Request(rows, year_idx, (year_idx, okey), inputs)
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -207,7 +228,7 @@ class Microbatcher:
         try:
             out = self.engine.query_rows(
                 rows, batch[0].year_idx, inputs=batch[0].inputs,
-                bucket=bucket,
+                bucket=bucket, key=batch[0].key[1],
             )
         except BaseException as e:  # noqa: BLE001 — fail the futures,
             for r in batch:         # never the worker thread
@@ -243,6 +264,7 @@ class Microbatcher:
                 "rejected": self.n_rejected,
                 "batches": self.n_batches,
                 "rows": self.n_rows,
+                "surface_hits": self.n_surface_hits,
                 "batch_occupancy": (
                     round(self._occupancy_sum / self.n_batches, 4)
                     if self.n_batches else None
@@ -250,6 +272,11 @@ class Microbatcher:
             }
         rec["buckets"] = list(self.config.buckets)
         rec["warm_buckets"] = sorted(self.engine.warm_buckets)
+        # surface/result-cache counters (empty when neither layer is
+        # attached) — the fleet front aggregates these across replicas
+        serve_stats = getattr(self.engine, "serve_stats", None)
+        if serve_stats is not None:
+            rec.update(serve_stats())
         lat = timing.histogram(REQUEST_LATENCY)
         if lat is not None:
             snap = lat.snapshot()

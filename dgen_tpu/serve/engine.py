@@ -294,11 +294,53 @@ class ServeEngine:
         )
         self._override_lock = threading.Lock()
         self._max_override_cache = int(max_override_cache)
+        # engine-free serving layers, attached after construction:
+        # the precomputed zero-override answer surface (serve.surface)
+        # and the cross-replica exact result cache (serve.resultcache)
+        self._surface = None
+        self._result_cache = None
+        #: why a configured surface was refused (stale/corrupt), for
+        #: /metricz — a refused surface must be VISIBLY absent, not
+        #: silently absent
+        self.surface_refused: Optional[str] = None
         # bucket sizes whose program has executed at least once;
         # mutated by worker threads, snapshotted under the lock (the
         # /healthz "warm" report; a report, not a guard — RetraceGuard
         # is the enforcement)
         self._warm: set = set()
+
+    # -- engine-free layers --------------------------------------------
+
+    @property
+    def surface(self):
+        """The attached :class:`~dgen_tpu.serve.surface.AnswerSurface`
+        (or None): zero-override queries for covered years are served
+        straight from its mmap, engine-free."""
+        return self._surface
+
+    @property
+    def result_cache(self):
+        """The attached :class:`~dgen_tpu.serve.resultcache.
+        ResultCache` (or None)."""
+        return self._result_cache
+
+    def attach_surface(self, surface) -> None:
+        self._surface = surface
+
+    def attach_result_cache(self, cache) -> None:
+        self._result_cache = cache
+
+    def serve_stats(self) -> dict:
+        """Surface/cache counters for /metricz (empty when neither
+        layer is attached)."""
+        rec = {}
+        if self._surface is not None:
+            rec["surface"] = self._surface.stats()
+        elif self.surface_refused:
+            rec["surface_refused"] = self.surface_refused
+        if self._result_cache is not None:
+            rec["result_cache"] = self._result_cache.stats()
+        return rec
 
     @property
     def warm_buckets(self) -> tuple:
@@ -376,6 +418,7 @@ class ServeEngine:
         year_idx: int,
         inputs: Optional[ScenarioInputs] = None,
         bucket: Optional[int] = None,
+        key: Optional[str] = None,
     ) -> Dict[str, np.ndarray]:
         """Run one bucket: table rows -> host result arrays [n, ...].
 
@@ -384,6 +427,15 @@ class ServeEngine:
         rows to B (repeating row 0 — per-row math, so padding rows
         change nothing) and slices the first n answers back out. The
         two paths are bit-identical per row.
+
+        ``key`` is the request's canonical override key when known
+        (``""`` = zero-override): it unlocks the engine-free layers —
+        a zero-override query for a surface-covered year answers from
+        the mmap (bit-exact at the surface's build bucket), and any
+        keyed bucketed query consults/feeds the cross-replica result
+        cache (hits are exact: same key + bucket + rows = same bytes).
+        ``key=None`` (the default, and what every pre-existing caller
+        passes) bypasses both — the compiled-engine parity oracle.
         """
         # resilience drill hooks: a device failure on the serving path
         # (the batcher must fail only this batch's futures — its worker
@@ -397,6 +449,22 @@ class ServeEngine:
         fault_point("serve_replica_hang")
         rows = np.asarray(rows, dtype=np.int32)
         n = rows.shape[0]
+        if (
+            key == ""
+            and self._surface is not None
+            and self._surface.covers(year_idx)
+        ):
+            return self._surface.lookup(rows, year_idx)
+        cache_key = None
+        if (
+            key is not None
+            and bucket is not None
+            and self._result_cache is not None
+        ):
+            cache_key = self._result_cache.key(year_idx, key, bucket, rows)
+            hit = self._result_cache.get(cache_key)
+            if hit is not None:
+                return hit
         if bucket is not None:
             if bucket < n:
                 raise ValueError(f"bucket {bucket} < {n} requested rows")
@@ -412,9 +480,12 @@ class ServeEngine:
         with self._override_lock:
             self._warm.add(int(rows.shape[0]))
         host = jax.device_get(out)
-        return {
+        res = {
             f: np.asarray(getattr(host, f))[:n] for f in QUERY_FIELDS
         }
+        if cache_key is not None:
+            self._result_cache.put(cache_key, res)
+        return res
 
     def query(
         self,
@@ -424,7 +495,9 @@ class ServeEngine:
         bucket: Optional[int] = None,
     ) -> Dict[str, np.ndarray]:
         """Convenience single-shot query by stable agent id (the
-        microbatcher is the production path; this is the direct one)."""
+        microbatcher is the production path; this is the direct one).
+        Bypasses the surface/cache layers: this is the parity oracle
+        the engine-free paths are proven bit-exact against."""
         return self.query_rows(
             self.rows_for(agent_ids),
             self.year_index(year),

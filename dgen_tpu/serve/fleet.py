@@ -45,6 +45,7 @@ import json
 import os
 import random
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -79,14 +80,43 @@ REPLICA_HOST = "127.0.0.1"
 HTTP_ERRORS = (OSError, http.client.HTTPException, ValueError)
 
 
+class _NoDelayHTTPConnection(http.client.HTTPConnection):
+    """HTTPConnection with Nagle disabled.  http.client writes headers
+    and body in separate sends; with Nagle + delayed ACK that costs a
+    ~40 ms stall PER HOP on loopback keep-alive POSTs — two hops
+    (client->front->replica) turn a microsecond mmap lookup into an
+    80 ms answer.  TCP_NODELAY removes it; the server side sets
+    ``disable_nagle_algorithm`` for the same reason."""
+
+    def connect(self):
+        super().connect()
+        try:
+            self.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass   # non-TCP transports (tests) just skip it
+
+
 def http_json(port: int, path: str, *, method: str = "GET",
               body: Optional[bytes] = None, timeout: float = 5.0,
-              host: str = REPLICA_HOST) -> tuple:
-    """One-shot HTTP request to a local replica/front: ``(status, raw
-    body bytes, headers dict)``.  Transport failures raise members of
+              host: str = REPLICA_HOST,
+              pool: Optional["HTTPPool"] = None) -> tuple:
+    """HTTP request to a local replica/front: ``(status, raw body
+    bytes, headers dict)``.  Transport failures raise members of
     :data:`HTTP_ERRORS`; callers decide whether to swallow (probes,
-    scrapes) or fail over (the front's forwards)."""
-    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    scrapes) or fail over (the front's forwards).
+
+    Without ``pool`` this is one-shot: fresh TCP connection, closed
+    after the response.  With ``pool`` the connection is checked out
+    of (and, on a clean keep-alive response, back into) the pool — the
+    serving path's steady state then pays zero TCP handshakes per
+    query (the replica side already speaks HTTP/1.1 keep-alive)."""
+    if pool is not None:
+        return pool.request(
+            port, path, method=method, body=body, timeout=timeout,
+            host=host,
+        )
+    conn = _NoDelayHTTPConnection(host, port, timeout=timeout)
     try:
         conn.request(
             method, path, body=body,
@@ -99,6 +129,141 @@ def http_json(port: int, path: str, *, method: str = "GET",
         return r.status, r.read(), dict(r.getheaders())
     finally:
         conn.close()
+
+
+class HTTPPool:
+    """Keep-alive connection pool for the loopback serving mesh.
+
+    Every proxied query used to pay a fresh TCP handshake (connect +
+    slow-start) on the front->replica hop; with both sides speaking
+    HTTP/1.1 keep-alive, pooling makes the steady-state hop a single
+    write+read on an established socket.  Semantics:
+
+    * per-(host, port) stacks of idle connections, bounded by
+      ``max_idle`` (extras are closed on check-in, not refused);
+    * a response advertising ``Connection: close`` (or any transport
+      error) closes the connection instead of pooling it;
+    * a **reused** connection that fails mid-request is retried once
+      on a FRESH connection — the server may have idle-timed the
+      socket between uses, which is not a replica failure and must
+      not count against a breaker.  A fresh connection's failure
+      propagates (that IS a replica/transport failure).
+
+    Thread-safe; counters feed ``/metricz`` (``reused / requests``
+    is the handshake-elision rate the keep-alive satellite exists
+    to prove).
+    """
+
+    def __init__(self, max_idle: int = 8) -> None:
+        self.max_idle = int(max_idle)
+        self._idle: Dict[tuple, List[http.client.HTTPConnection]] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self.n_requests = 0
+        self.n_created = 0
+        self.n_reused = 0
+        self.n_stale_retries = 0
+
+    def _checkout(self, key: tuple, timeout: float):
+        with self._lock:
+            stack = self._idle.get(key)
+            conn = stack.pop() if stack else None
+            if conn is not None:
+                self.n_reused += 1
+        if conn is not None:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            return conn, True
+        with self._lock:
+            self.n_created += 1
+        return _NoDelayHTTPConnection(
+            key[0], key[1], timeout=timeout), False
+
+    def _checkin(self, key: tuple, conn) -> None:
+        with self._lock:
+            if not self._closed:
+                stack = self._idle.setdefault(key, [])
+                if len(stack) < self.max_idle:
+                    stack.append(conn)
+                    return
+        conn.close()
+
+    def _once(self, conn, method: str, path: str,
+              body: Optional[bytes]) -> tuple:
+        conn.request(
+            method, path, body=body,
+            headers=(
+                {"Content-Type": "application/json"}
+                if body is not None else {}
+            ),
+        )
+        r = conn.getresponse()
+        return r.status, r.read(), dict(r.getheaders()), r.will_close
+
+    def request(self, port: int, path: str, *, method: str = "GET",
+                body: Optional[bytes] = None, timeout: float = 5.0,
+                host: str = REPLICA_HOST) -> tuple:
+        key = (host, port)
+        with self._lock:
+            self.n_requests += 1
+        conn, reused = self._checkout(key, timeout)
+        try:
+            status, blob, headers, will_close = self._once(
+                conn, method, path, body)
+        except HTTP_ERRORS as e:
+            conn.close()
+            # retry ONLY the reused-and-idle-closed shape (server shut
+            # the pooled socket between uses: reset/broken-pipe on
+            # send, BadStatusLine on the response read).  A TIMEOUT is
+            # not that — the request was delivered and the replica is
+            # hanging; retrying it would double both the time-to-
+            # failover and the hung replica's queued work
+            if not reused or isinstance(e, TimeoutError):
+                raise
+            with self._lock:
+                self.n_stale_retries += 1
+                self.n_created += 1
+            conn = _NoDelayHTTPConnection(host, port, timeout=timeout)
+            try:
+                status, blob, headers, will_close = self._once(
+                    conn, method, path, body)
+            except HTTP_ERRORS:
+                conn.close()
+                raise
+        if will_close:
+            conn.close()
+        else:
+            self._checkin(key, conn)
+        return status, blob, headers
+
+    def drop(self, port: int, host: str = REPLICA_HOST) -> None:
+        """Close every idle connection to one endpoint (a replica died
+        or was retired; its pooled sockets are garbage)."""
+        with self._lock:
+            stack = self._idle.pop((host, port), [])
+        for c in stack:
+            c.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            idle = sum(len(s) for s in self._idle.values())
+            return {
+                "requests": self.n_requests,
+                "created": self.n_created,
+                "reused": self.n_reused,
+                "stale_retries": self.n_stale_retries,
+                "idle": idle,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            stacks = list(self._idle.values())
+            self._idle = {}
+        for s in stacks:
+            for c in s:
+                c.close()
 
 
 @dataclasses.dataclass
@@ -329,6 +494,13 @@ class ReplicaSupervisor:
         now = time.monotonic()
         with self._lock:
             for h in self.replicas:
+                if h.state == STOPPED:
+                    # a retired (scale-down) replica drains on its own;
+                    # poll() reaps the eventual exit so it never
+                    # lingers as a zombie
+                    if h.proc is not None:
+                        h.proc.poll()
+                    continue
                 if h.state in (SPAWNING, BOOTING, READY):
                     rc = h.proc.poll() if h.proc is not None else 1
                     if rc is not None:
@@ -377,6 +549,75 @@ class ReplicaSupervisor:
                 # unsupervised fleet that still LOOKS supervised
                 logger.exception("fleet monitor: tick failed")
             time.sleep(self.config.poll_interval_s)
+
+    # -- elasticity (the autoscaler's verbs) ---------------------------
+
+    def add_replica(self) -> ReplicaHandle:
+        """Grow the fleet by one replica slot and spawn it (the
+        autoscaler's scale-up verb; also usable by an operator).  The
+        monitor gates it through the normal SPAWNING -> BOOTING ->
+        READY lifecycle — it joins routing only when /readyz is green,
+        which is fast when the shared compile cache is warm."""
+        with self._lock:
+            if self._stopping:
+                raise RuntimeError("supervisor is stopping")
+            i = len(self.replicas)
+            h = ReplicaHandle(
+                index=i,
+                portfile=os.path.join(self.fleet_dir, f"replica-{i}.json"),
+            )
+            self.replicas.append(h)
+            self._spawn(h)
+        self._event(i, "scale_up_spawned", n_replicas=i + 1)
+        return h
+
+    def retire_replica(self, index: int,
+                       drain_timeout_s: float = 30.0) -> bool:
+        """Shrink the fleet: SIGTERM one replica (it drains its
+        in-flight batches — the replica CLI's SIGTERM handler) and
+        mark it STOPPED so the monitor neither counts the exit as a
+        death nor restarts it.  The process is reaped asynchronously
+        by the monitor.  False when the slot is already dead/stopped.
+        """
+        with self._lock:
+            if not (0 <= index < len(self.replicas)):
+                return False
+            h = self.replicas[index]
+            if h.state == STOPPED or h.proc is None \
+                    or h.proc.poll() is not None:
+                return False
+            # state first: the monitor's next tick must already see
+            # STOPPED when the SIGTERM exit lands
+            h.state = STOPPED
+            h.proc.send_signal(signal.SIGTERM)
+        self._event(index, "scale_down_retired",
+                    drain_timeout_s=drain_timeout_s)
+        return True
+
+    def live_count(self) -> int:
+        """Replica slots not STOPPED/FAILED (what the fleet is
+        currently trying to keep alive — the autoscaler's notion of
+        current size)."""
+        with self._lock:
+            return sum(
+                1 for h in self.replicas
+                if h.state not in (STOPPED, FAILED)
+            )
+
+    def live_indices(self) -> set:
+        """Indices of slots not STOPPED (the front prunes per-replica
+        state keyed outside this set)."""
+        with self._lock:
+            return {h.index for h in self.replicas if h.state != STOPPED}
+
+    def stopped_ports(self) -> List[int]:
+        """Ports of retired (STOPPED) slots — their pooled sockets are
+        garbage the front should drop."""
+        with self._lock:
+            return [
+                h.port for h in self.replicas
+                if h.state == STOPPED and h.port is not None
+            ]
 
     # -- queries -------------------------------------------------------
 

@@ -51,6 +51,7 @@ from dgen_tpu.config import FleetConfig
 from dgen_tpu.resilience.faults import FaultError, fault_point
 from dgen_tpu.serve.fleet import (
     HTTP_ERRORS,
+    HTTPPool,
     ReplicaSupervisor,
     http_json,
 )
@@ -147,8 +148,17 @@ class FleetFront:
         self._lock = threading.Lock()
         self._closed = False
         self._scrape_thread: Optional[threading.Thread] = None
+        #: pooled keep-alive connections for forwards + scrapes: the
+        #: steady-state front->replica hop pays no TCP handshake
+        self._pool = HTTPPool()
         #: replica index -> (monotonic scrape time, /metricz payload)
         self._metricz: Dict[int, tuple] = {}
+        #: replica index -> (scrape time, batches, occupancy-sum) at
+        #: the previous pressure() call — the windowed-occupancy
+        #: baseline (pruned with the other per-replica maps); held
+        #: value covers ticks between scrapes
+        self._occ_prev: Dict[int, tuple] = {}
+        self._held_occupancy = 0.0
         self._lat = timing.LogHistogram()
         # counters (under _lock)
         self.n_requests = 0
@@ -183,21 +193,40 @@ class FleetFront:
 
     def _scrape_loop(self) -> None:
         while not self._closed:
-            for h in self.sup.ready_handles():
+            ready = self.sup.ready_handles()
+            for h in ready:
                 payload = self._scrape_one(h.port)
                 if payload is not None:
                     self._metricz[h.index] = (time.monotonic(), payload)
+            self._prune_replica_state(ready)
             time.sleep(self.config.metricz_interval_s)
 
-    @staticmethod
-    def _scrape_one(port: int) -> Optional[dict]:
+    def _scrape_one(self, port: int) -> Optional[dict]:
         try:
-            status, blob, _ = http_json(port, "/metricz", timeout=2.0)
+            status, blob, _ = http_json(
+                port, "/metricz", timeout=2.0, pool=self._pool)
             if status != 200:
                 return None
             return json.loads(blob)
         except HTTP_ERRORS:
             return None
+
+    def _prune_replica_state(self, ready) -> None:
+        """Autoscale hygiene: per-replica state keyed by index must
+        not accumulate forever as replicas are added and retired over
+        a long-lived fleet — drop scrapes, breakers, and pooled
+        sockets of slots that no longer exist or were STOPPED."""
+        gone_ports = self.sup.stopped_ports()
+        live = self.sup.live_indices()
+        for i in [i for i in list(self._metricz) if i not in live]:
+            self._metricz.pop(i, None)
+        for i in [i for i in list(self._occ_prev) if i not in live]:
+            self._occ_prev.pop(i, None)
+        with self._lock:
+            for i in [i for i in self._breakers if i not in live]:
+                del self._breakers[i]
+        for port in gone_ports:
+            self._pool.drop(port)
 
     def _fresh_metricz(self) -> Dict[int, dict]:
         """Scrapes younger than 3 intervals, restricted to replicas
@@ -225,6 +254,59 @@ class FleetFront:
         cap = sum(int(p.get("max_queue", 0)) for p in fresh.values())
         return cap > 0 and depth >= self.config.shed_queue_frac * cap
 
+    def pressure(self) -> Optional[dict]:
+        """The autoscaler's aggregated signal: instantaneous queue
+        fraction plus WINDOWED batch occupancy (batches dispatched
+        since the previous ``pressure()`` call, weighted by their
+        occupancy) over fresh READY-replica scrapes.  Windowing
+        matters: the replicas report lifetime occupancy means, and a
+        lifetime mean never decays — an idle fleet would look busy
+        forever.  Zero new batches in the window = zero occupancy
+        (no device work IS idle).  None when no fresh signal exists
+        (the autoscaler then holds — never scale blind, the same rule
+        as shedding)."""
+        now = time.monotonic()
+        horizon = 3.0 * self.config.metricz_interval_s
+        ready = {h.index for h in self.sup.ready_handles()}
+        snap = dict(self._metricz)
+        fresh = {
+            i: (t, p) for i, (t, p) in snap.items()
+            if i in ready and (now - t) <= horizon
+        }
+        if not fresh:
+            return None
+        depth = sum(
+            int(p.get("queue_depth", 0)) for _t, p in fresh.values())
+        cap = sum(
+            int(p.get("max_queue", 0)) for _t, p in fresh.values())
+        # occupancy over batches dispatched since the last NEW scrape;
+        # ticks between scrapes HOLD the previous value instead of
+        # reading "no new data yet" as idleness (the controller may
+        # tick faster than the scrape cadence)
+        d_batches = 0
+        d_occ_sum = 0.0
+        saw_new_scrape = False
+        for i, (t, p) in fresh.items():
+            prev_t, pb, po = self._occ_prev.get(i, (None, 0, 0.0))
+            if prev_t is not None and t == prev_t:
+                continue   # same scrape as last pressure() call
+            saw_new_scrape = True
+            batches = int(p.get("batches", 0) or 0)
+            occ_sum = float(p.get("batch_occupancy") or 0.0) * batches
+            if batches >= pb:   # a restarted replica resets counters
+                d_batches += batches - pb
+                d_occ_sum += occ_sum - po
+            self._occ_prev[i] = (t, batches, occ_sum)
+        if saw_new_scrape:
+            occ = (d_occ_sum / d_batches) if d_batches > 0 else 0.0
+            self._held_occupancy = max(occ, 0.0)
+        return {
+            "queue_frac": (depth / cap) if cap else 0.0,
+            "occupancy": self._held_occupancy,
+            "window_batches": d_batches,
+            "ready_replicas": len(fresh),
+        }
+
     # -- routing -------------------------------------------------------
 
     def _pick(self, exclude: set):
@@ -249,7 +331,7 @@ class FleetFront:
     def _forward(self, h, raw: bytes) -> tuple:
         status, blob, _ = http_json(
             h.port, "/query", method="POST", body=raw,
-            timeout=self.config.request_timeout_s,
+            timeout=self.config.request_timeout_s, pool=self._pool,
         )
         return status, blob
 
@@ -385,13 +467,25 @@ class FleetFront:
                 "forward_failures": self.n_forward_failures,
                 "unrouted": self.n_unrouted,
             }
+        # engine-free-path counters, aggregated: the bench's surface
+        # hit-rate / cache hit-rate stamps read these
+        surface_hits = sum(
+            int(p.get("surface_hits", 0) or 0) for p in fresh.values())
+        cache = {"hits": 0, "misses": 0, "stores": 0, "evictions": 0}
+        for p in fresh.values():
+            rc = p.get("result_cache") or {}
+            for k in cache:
+                cache[k] += int(rc.get(k, 0) or 0)
         snap = self._lat.snapshot()
         return {
             "role": "fleet-front",
             "ready_replicas": len(self.sup.ready_handles()),
-            "n_replicas": self.sup.config.n_replicas,
+            "n_replicas": self.sup.live_count(),
             "queue_depth": depth,
             "queue_capacity": cap,
+            "surface_hits": surface_hits,
+            "result_cache": cache,
+            "http_pool": self._pool.stats(),
             "occupancy_weighted": (
                 round(w_occ, 4) if w_occ is not None else None),
             "draining": self.draining,
@@ -431,6 +525,7 @@ class FleetFront:
 
     def close(self) -> None:
         self._closed = True
+        self._pool.close()
 
 
 class _FrontHandler(_JsonHandler):

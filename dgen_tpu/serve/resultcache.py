@@ -1,0 +1,194 @@
+"""Cross-replica exact result cache for served what-if answers.
+
+Answers are **bit-exact within a bucket shape** (the microbatcher's
+coalescing contract), so a cache hit is an EXACT answer, not an
+approximation: the same (year, canonical override key, bucket shape,
+requested rows) through the same configuration produces the same bytes
+every time.  This module caches those answers in a shared directory —
+the same cross-process pattern as ``utils/compilecache.py`` — so a hot
+what-if (a promoted scenario, a widely shared link) is computed once
+per fleet and then served from disk by EVERY replica, including a
+replica that just rebooted after a kill.
+
+Entry contract:
+
+* **key** — sha256 over (provenance key, year index, override key,
+  bucket, the row-index bytes): everything the answer bytes depend on.
+  The provenance key (the serving config hash + git sha) partitions
+  the directory across code/config versions, so a stale entry can
+  never be served after a deploy — it simply stops being addressed.
+* **value** — one ``.npz`` file holding the host result arrays, landed
+  via temp + ``os.replace`` (crash-consistent; a killed writer leaves
+  at most a temp sibling, cleaned opportunistically).
+* **bounded** — at most ``max_entries`` files; insertion evicts the
+  least-recently-USED entries (mtime is touched on every hit).  The
+  eviction scan is on the writer, never the read path.
+
+Concurrent replicas race benignly: a double store writes identical
+bytes; a read racing an eviction counts as a miss.  Counters (hits,
+misses, stores, evictions) surface in ``/metricz`` per replica and
+aggregated at the fleet front.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+_SUFFIX = ".npz"
+
+
+class ResultCache:
+    """Bounded, file-backed, cross-process answer cache.
+
+    Parameters
+    ----------
+    dir_path : shared directory (created if absent).  Replicas of one
+        fleet point at the same directory.
+    provenance_key : partitions keys across code/config versions —
+        pass the serving provenance (config hash + git sha); answers
+        from different versions never alias.
+    max_entries : eviction bound (files), enforced on store.
+    """
+
+    def __init__(
+        self,
+        dir_path: str,
+        provenance_key: str = "",
+        max_entries: int = 512,
+    ) -> None:
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.dir = dir_path
+        self.provenance_key = provenance_key
+        self.max_entries = int(max_entries)
+        os.makedirs(dir_path, exist_ok=True)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.evictions = 0
+
+    # -- keys ----------------------------------------------------------
+
+    def key(
+        self,
+        year_idx: int,
+        override_key: str,
+        bucket: int,
+        rows: np.ndarray,
+    ) -> str:
+        """Canonical entry key: everything the answer bytes depend on
+        given one provenance partition."""
+        h = hashlib.sha256()
+        h.update(self.provenance_key.encode())
+        h.update(f"|{int(year_idx)}|{override_key}|{int(bucket)}|".encode())
+        h.update(np.ascontiguousarray(rows, dtype=np.int32).tobytes())
+        return h.hexdigest()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, key + _SUFFIX)
+
+    # -- read/write ----------------------------------------------------
+
+    def get(self, key: str) -> Optional[Dict[str, np.ndarray]]:
+        """The cached answer dict, or None (counted as a miss).  A
+        file vanishing mid-read (concurrent eviction) or failing to
+        parse (torn write from a pre-atomic writer — cannot happen via
+        :meth:`put`, but the cache must never crash serving) is a
+        miss."""
+        path = self._path(key)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                out = {f: np.array(z[f]) for f in z.files}
+            os.utime(path)   # LRU touch; eviction orders by mtime
+        except (OSError, ValueError, KeyError, EOFError):
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return out
+
+    def put(self, key: str, out: Dict[str, np.ndarray]) -> None:
+        """Store an answer (temp + rename), then enforce the entry
+        bound by evicting least-recently-used files.  Failures are
+        logged, never raised — the cache is an accelerator, not a
+        dependency."""
+        path = self._path(key)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            buf = io.BytesIO()
+            np.savez(buf, **out)
+            with open(tmp, "wb") as f:   # dgenlint: disable=L11
+                f.write(buf.getvalue())
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("result cache store failed: %s", e)
+            try:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+            except OSError:
+                pass
+            return
+        with self._lock:
+            self.stores += 1
+        self._evict()
+
+    def _evict(self) -> None:
+        """Drop oldest-used entries beyond ``max_entries``; stale temp
+        siblings from killed writers are garbage-collected too."""
+        try:
+            entries = []
+            for n in os.listdir(self.dir):
+                p = os.path.join(self.dir, n)
+                try:
+                    if n.endswith(".tmp"):
+                        # a killed writer's leftover; stale after 60 s
+                        if time.time() - os.path.getmtime(p) > 60.0:
+                            os.remove(p)
+                        continue
+                    if n.endswith(_SUFFIX):
+                        entries.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue   # vanished under a concurrent evictor
+            excess = len(entries) - self.max_entries
+            if excess <= 0:
+                return
+            entries.sort()
+            dropped = 0
+            for _mt, p in entries[:excess]:
+                try:
+                    os.remove(p)
+                    dropped += 1
+                except OSError:
+                    continue
+            if dropped:
+                with self._lock:
+                    self.evictions += dropped
+        except OSError as e:
+            logger.warning("result cache eviction scan failed: %s", e)
+
+    # -- ops -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            rec = {
+                "hits": self.hits,
+                "misses": self.misses,
+                "stores": self.stores,
+                "evictions": self.evictions,
+                "max_entries": self.max_entries,
+            }
+        rec["dir"] = self.dir
+        return rec

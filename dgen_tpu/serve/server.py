@@ -388,6 +388,11 @@ class _JsonHandler(BaseHTTPRequestHandler):
 
     protocol_version = "HTTP/1.1"
 
+    #: Nagle + delayed ACK costs ~40 ms per hop on loopback keep-alive
+    #: POSTs (http.client writes headers and body separately); the
+    #: client side sets TCP_NODELAY too (fleet._NoDelayHTTPConnection)
+    disable_nagle_algorithm = True
+
     #: overridden per-app in setup(); BaseHTTPRequestHandler applies it
     #: as the connection's socket timeout
     timeout = 30.0

@@ -1,0 +1,269 @@
+"""The precomputed answer surface: the zero-override question served
+engine-free.
+
+Millions of users mostly ask the DEFAULT question — "this agent, this
+year, no what-if overrides" — and until now every one of those queries
+walked the full jitted engine path.  But the zero-override answer is a
+pure function of (population, scenario inputs, year): a finite table.
+This module sweeps it ONCE offline through the very same
+:func:`~dgen_tpu.serve.engine.query_program` the live engine runs — at
+full bucket width, so the precomputed rows are **bit-exact by
+construction** against what the engine would compute at that bucket
+shape — and persists it as a content-hashed, provenance-stamped,
+memory-mapped columnar table (:mod:`dgen_tpu.io.mmaptable`).  A
+replica then answers surface-covered queries straight out of the mmap
+(microseconds, no device, no queue) and falls through to the compiled
+engine for everything else.  N replicas on one machine mmap the same
+file: one physical copy in the page cache, the same sharing argument
+as the compile cache.
+
+Staleness is the failure mode that matters: a surface is only exact
+for the exact configuration that built it.  The builder stamps
+``git_sha``, a ``config_hash`` over (RunConfig, ScenarioConfig), a
+sha256 of the population identity (agent ids + mask), the year grid,
+and the sizing statics; :meth:`AnswerSurface.load` refuses — with the
+mismatching field NAMED — when any of them differ from the engine it
+is being attached to.  A refused or damaged surface degrades to the
+engine path; it never serves stale answers.
+
+Build workflow (docs/serve.md "Production throughput")::
+
+    python -m dgen_tpu.serve --build-surface runs/surface --agents 8192
+    python -m dgen_tpu.serve --fleet 3 --surface runs/surface ...
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from dgen_tpu.io.export import config_hash, git_sha
+from dgen_tpu.io.mmaptable import MmapTable, MmapTableError, write_table
+from dgen_tpu.resilience.faults import fault_point
+from dgen_tpu.serve.engine import QUERY_FIELDS, ServeEngine
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: header meta tag (bumped if the column contract changes)
+SURFACE_VERSION = 1
+
+
+class SurfaceError(RuntimeError):
+    """The surface directory is missing/corrupt/unreadable (the mmap
+    layer's verdict, re-raised with serving context)."""
+
+
+class StaleSurfaceError(SurfaceError):
+    """The surface was built under a different configuration than the
+    engine it is being attached to; ``reason`` names the mismatching
+    field.  A stale surface is REFUSED, never served."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(f"answer surface refused: {reason}")
+        self.reason = reason
+
+
+def surface_provenance(engine: ServeEngine) -> dict:
+    """What the surface's exactness depends on: the code (git sha),
+    the configuration (RunConfig + ScenarioConfig hash), the exact
+    population (agent ids + mask bytes), the year grid, and the
+    sizing statics.  ServeConfig is deliberately EXCLUDED — queue and
+    timeout knobs cannot change an answer."""
+    sim = engine.sim
+    pop = hashlib.sha256()
+    pop.update(np.ascontiguousarray(sim.host_agent_id).tobytes())
+    pop.update(np.ascontiguousarray(sim.host_mask).tobytes())
+    return {
+        "git_sha": git_sha(),
+        "config_hash": config_hash(sim.run_config, sim.scenario),
+        "population_sha": pop.hexdigest()[:16],
+        "years": [int(y) for y in engine.years],
+        "n_rows": int(np.asarray(sim.host_mask).shape[0]),
+        "econ_years": int(sim.econ_years),
+        "sizing_iters": int(sim.run_config.sizing_iters),
+    }
+
+
+def provenance_key(engine: ServeEngine) -> str:
+    """Compact provenance partition key (config hash + git sha +
+    population) — the result cache's version namespace, so answers
+    computed by different code/config/population can never alias."""
+    p = surface_provenance(engine)
+    return f"{p['config_hash']}|{p['git_sha']}|{p['population_sha']}"
+
+
+def load_and_attach(engine: ServeEngine, dir_path: str) -> Optional[str]:
+    """Load + attach a surface to ``engine``; on refusal (stale,
+    corrupt, missing) log the named reason, record it on the engine
+    for /metricz, and serve engine-only.  Returns the refusal reason,
+    or None on success.  A refused surface degrades availability of
+    the fast path — it NEVER degrades correctness."""
+    try:
+        surf = AnswerSurface.load(dir_path, engine)
+    except Exception as e:  # noqa: BLE001 — refusal must not kill boot
+        reason = str(e)
+        engine.surface_refused = reason
+        logger.error(
+            "%s — serving WITHOUT the answer surface (every query "
+            "takes the compiled engine path)", reason,
+        )
+        return reason
+    engine.attach_surface(surf)
+    logger.info(
+        "answer surface attached: %d years x %d rows (bucket %d, "
+        "content %s)", surf.stats()["years"], surf.stats()["rows"],
+        surf.bucket, surf.stats()["content_hash"],
+    )
+    return None
+
+
+def build_surface(
+    engine: ServeEngine,
+    out_dir: str,
+    bucket: int,
+    year_indices: Optional[Sequence[int]] = None,
+) -> dict:
+    """Sweep the zero-override answer for every (year, table row)
+    through the live engine at ``bucket`` width and persist it as a
+    mmap table at ``out_dir``; returns the written header.
+
+    Every row of the padded table is swept (padding rows are inert
+    per-row math, same as in a live bucket), so lookups index by table
+    row directly.  ``year_indices`` restricts the sweep (tests,
+    incremental rollouts); an unbuilt year simply falls through to the
+    engine at serve time.
+    """
+    n_rows = int(np.asarray(engine.sim.host_mask).shape[0])
+    yis = (
+        list(range(len(engine.years)))
+        if year_indices is None else [int(y) for y in year_indices]
+    )
+    t0 = time.time()
+    per_field: Dict[str, List[np.ndarray]] = {f: [] for f in QUERY_FIELDS}
+    for yi in yis:
+        chunks: Dict[str, List[np.ndarray]] = {f: [] for f in QUERY_FIELDS}
+        for start in range(0, n_rows, bucket):
+            rows = np.arange(
+                start, min(start + bucket, n_rows), dtype=np.int32
+            )
+            out = engine.query_rows(rows, yi, bucket=bucket)
+            for f in QUERY_FIELDS:
+                chunks[f].append(out[f])
+        for f in QUERY_FIELDS:
+            per_field[f].append(np.concatenate(chunks[f], axis=0))
+    columns = {
+        f: np.stack(per_field[f], axis=0) for f in QUERY_FIELDS
+    }
+    meta = {
+        "surface_version": SURFACE_VERSION,
+        "bucket": int(bucket),
+        "year_indices": yis,
+        "provenance": surface_provenance(engine),
+        "build_wall_s": round(time.time() - t0, 3),
+    }
+    header = write_table(out_dir, columns, meta=meta)
+    logger.info(
+        "answer surface built: %d years x %d rows at bucket %d in "
+        "%.1fs -> %s (content %s)",
+        len(yis), n_rows, bucket, meta["build_wall_s"], out_dir,
+        header["content_hash"][:12],
+    )
+    return header
+
+
+class AnswerSurface:
+    """A loaded, provenance-verified surface bound to one engine.
+
+    ``lookup`` is pure host-side numpy fancy-indexing into the mmap —
+    no device program, no queue, no compile.  Hit counting is
+    thread-safe (handler threads share one instance)."""
+
+    def __init__(self, table: MmapTable, meta: dict) -> None:
+        self._table = table
+        self.meta = meta
+        self.bucket = int(meta["bucket"])
+        self._slot = {
+            int(yi): i for i, yi in enumerate(meta["year_indices"])
+        }
+        self._cols = table.columns
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    # -- loading -------------------------------------------------------
+
+    @classmethod
+    def load(cls, dir_path: str, engine: ServeEngine) -> "AnswerSurface":
+        """Open + provenance-gate a surface for ``engine``.  Raises
+        :class:`SurfaceError` (unreadable/damaged) or
+        :class:`StaleSurfaceError` (built under a different
+        config_hash/git_sha/population/grid, reason named)."""
+        # drill hook: torn storage / unreadable mmap at load — the
+        # caller must refuse and fall through, never serve garbage
+        fault_point(
+            "surface_load", path=os.path.join(dir_path, "table.bin")
+        )
+        try:
+            table = MmapTable(dir_path)
+            table.verify()
+        except MmapTableError as e:
+            raise SurfaceError(f"answer surface unusable: {e}") from e
+        meta = table.meta
+        if meta.get("surface_version") != SURFACE_VERSION:
+            raise StaleSurfaceError(
+                f"surface_version {meta.get('surface_version')!r} != "
+                f"{SURFACE_VERSION}"
+            )
+        want = surface_provenance(engine)
+        got = meta.get("provenance") or {}
+        for field in (
+            "config_hash", "git_sha", "population_sha", "years",
+            "n_rows", "econ_years", "sizing_iters",
+        ):
+            if got.get(field) != want[field]:
+                raise StaleSurfaceError(
+                    f"{field} mismatch (surface {got.get(field)!r} != "
+                    f"engine {want[field]!r})"
+                )
+        missing = [f for f in QUERY_FIELDS if f not in table.columns]
+        if missing:
+            raise StaleSurfaceError(
+                f"missing answer column(s) {missing}"
+            )
+        return cls(table, meta)
+
+    # -- serving -------------------------------------------------------
+
+    def covers(self, year_idx: int) -> bool:
+        return int(year_idx) in self._slot
+
+    def lookup(
+        self, rows: np.ndarray, year_idx: int
+    ) -> Dict[str, np.ndarray]:
+        """Answers for ``rows`` at ``year_idx`` — same dict-of-arrays
+        shape :meth:`ServeEngine.query_rows` returns, copied out of
+        the mmap (callers may mutate)."""
+        slot = self._slot[int(year_idx)]
+        rows = np.asarray(rows, dtype=np.int32)
+        out = {
+            f: np.array(self._cols[f][slot][rows]) for f in QUERY_FIELDS
+        }
+        with self._lock:
+            self.hits += 1
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            hits = self.hits
+        return {
+            "years": len(self._slot),
+            "rows": int(self._cols["agent_id"].shape[1]),
+            "bucket": self.bucket,
+            "hits": hits,
+            "content_hash": self._table.content_hash[:12],
+        }

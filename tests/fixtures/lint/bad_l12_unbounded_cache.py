@@ -1,0 +1,40 @@
+"""L12 fixture: request-keyed accumulation into unbounded containers.
+
+``QueryHandler`` grows a dict and a list per request with no eviction
+anywhere in the class (2 findings); ``BoundedHandler`` stores into the
+same shape but evicts, and logs into a ``deque(maxlen=...)`` (clean).
+"""
+
+from collections import deque
+
+
+def expensive(body):
+    return body
+
+
+class QueryHandler:
+    def __init__(self):
+        self._cache = {}
+        self._seen = []
+
+    def handle_query(self, body):
+        key = body["key"]
+        self._cache[key] = expensive(body)   # L12: never evicted
+        return self._cache[key]
+
+    def do_POST(self, raw):
+        self._seen.append(raw)               # L12: never trimmed
+
+
+class BoundedHandler:
+    def __init__(self):
+        self._cache = {}
+        self._log = deque(maxlen=64)
+
+    def handle_query(self, body):
+        key = body["key"]
+        self._cache[key] = expensive(body)   # ok: LRU-evicted below
+        while len(self._cache) > 4:
+            self._cache.popitem()
+        self._log.append(key)                # ok: maxlen-bounded
+        return self._cache[key]

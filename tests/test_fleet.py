@@ -586,21 +586,28 @@ def test_real_fleet_kill_failover_bit_identical(engine, tmp_path):
 
 @pytest.mark.slow
 def test_fleet_drill_end_to_end():
-    """The acceptance drill: kill + hang under closed-loop load; every
-    request answered bit-exactly, bounded 503 retries only, full READY
-    strength restored, zero steady-state compiles on every replica."""
+    """The acceptance drill: kill + hang under closed-loop load with
+    the production-throughput layers armed; every request answered
+    bit-exactly, bounded 503 retries only, full READY strength
+    restored, zero steady-state compiles on every replica, and all
+    three serving paths (surface hit, cache hit, engine fall-through)
+    exercised — cache hits proven through the healed fleet post-kill."""
     from dgen_tpu.resilience.fleetdrill import run_fleet_drill
 
-    rec = run_fleet_drill(requests=48)
+    rec = run_fleet_drill(requests=48, layers=True)
     assert rec["ok"], {
         k: rec[k] for k in (
             "answered", "mismatches", "client_failures",
             "recovered_full_strength", "steady_state_compiles",
-            "kill", "hang", "latency_s",
+            "kill", "hang", "latency_s", "layers",
         )
     }
     assert rec["kill"]["exit_77_seen"]
     assert rec["steady_state_compiles"] == {"0": 0, "1": 0}
+    assert rec["layers"]["surface_hits"] > 0
+    assert rec["layers"]["result_cache"]["hits"] > 0
+    assert rec["layers"]["engine_batches"] > 0
+    assert rec["layers"]["repeat_mismatches"] == []
 
 
 def test_fleet_config_validation_and_env(monkeypatch):

@@ -598,7 +598,8 @@ class _FakeServeEngine:
     def inputs_for(self, overrides):
         return None
 
-    def query_rows(self, rows, year_idx, inputs=None, bucket=None):
+    def query_rows(self, rows, year_idx, inputs=None, bucket=None,
+                   key=None):
         faults.fault_point("serve_query")
         return {"npv": rows.astype(np.float32) * 2.0}
 

@@ -47,6 +47,12 @@ if [ "$mode" != "--test-only" ]; then
     # narrows
     echo "== dgenlint L10 (request-path compile guard) =="
     python -m dgen_tpu.lint --select L10 dgen_tpu/serve || rc=1
+    # L12 guards serving memory (docs/serve.md "Production
+    # throughput"): request-keyed accumulation into an unbounded
+    # container in a request path is a slow leak a long-lived replica
+    # pays for at 3 a.m. — gate the serve layer by name
+    echo "== dgenlint L12 (unbounded request-path caches) =="
+    python -m dgen_tpu.lint --select L12 dgen_tpu/serve || rc=1
     # L11 guards crash consistency (docs/resilience.md): any bare
     # open(...,'w')/to_parquet of a run artifact outside the
     # temp+rename helpers — gate the artifact-writing layers by name
@@ -90,6 +96,14 @@ if [ "$mode" != "--test-only" ]; then
     echo "== serve fleet drill (python -m dgen_tpu.resilience drill --serve-fleet) =="
     JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --serve-fleet \
         --replicas 2 --agents 64 --requests 60 >/tmp/_fleet.json || rc=1
+    # serve autoscale+cache smoke (docs/serve.md "Production
+    # throughput"): a 1-replica fleet scaled 1 -> 2 -> 1 by the
+    # autoscaler under synthetic occupancy, with a shared-result-cache
+    # hit proven byte-identical to the engine answer and the retired
+    # replica draining cleanly (never restarted, never counted dead)
+    echo "== serve scale drill (python -m dgen_tpu.resilience drill --serve-scale) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --serve-scale \
+        --agents 64 >/tmp/_scale.json || rc=1
     # gang smoke drill (docs/resilience.md "Gang runbook"): a
     # 2-process jax.distributed CPU/gloo gang with worker 1 SIGKILLed
     # mid-year — the supervisor must tear the whole gang down, relaunch

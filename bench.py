@@ -1099,6 +1099,14 @@ def main() -> None:
 
     skipped: dict = {}
 
+    # lock-contention telemetry (DGEN_TPU_LOCKTRACE=1): the runtime
+    # sentinel's per-named-lock stats (acquisitions, total/max wait,
+    # max hold) are stamped into the serve and fleet payloads below —
+    # armed here, before any lock of the serving stack is created
+    from dgen_tpu.utils import locktrace
+
+    locktrace.arm_from_env()
+
     # the payload is built incrementally so the SIGALRM backstop can
     # emit whatever is complete if a stage overruns the budget (the
     # driver records only rc and the LAST output line; an externally
@@ -1600,7 +1608,11 @@ def main() -> None:
             skipped["serve"] = "budget"
         else:
             try:
+                locktrace.reset()   # stats scoped to this stage
                 payload["serve"] = _serve_bench(n_agents, qps)
+                if locktrace.is_armed():
+                    payload["serve"]["lock_contention"] = \
+                        locktrace.stats()
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["serve"] = {
                     "qps_target": qps,
@@ -1618,7 +1630,11 @@ def main() -> None:
             skipped["fleet"] = "budget"
         else:
             try:
+                locktrace.reset()   # stats scoped to this stage
                 payload["fleet"] = _fleet_bench(n_agents, n_rep)
+                if locktrace.is_armed():
+                    payload["fleet"]["lock_contention"] = \
+                        locktrace.stats()
                 # the serving trajectory's headline ratio: the full
                 # production stack vs the PR 5 engine-path protocol
                 # (both measured in THIS payload when both knobs are

@@ -27,6 +27,17 @@ XLA compiles per simulation year and fails when a steady-state year
 retraces (imported lazily — the static linter must not initialize a
 backend just to parse files).
 
+Concurrency tier (no jax import; the audited surface is the threaded
+*host* side — serve/, host IO, resilience, timing, parallel)::
+
+    python -m dgen_tpu.lint --conc
+
+:mod:`dgen_tpu.lint.conc` runs rules C1-C6 over thread discipline
+(unguarded cross-thread writes, blocking calls under a lock,
+lock-order cycles, check-then-act, lazy init, orphan threads); its
+runtime half, :mod:`dgen_tpu.utils.locktrace`, is armed with
+``DGEN_TPU_LOCKTRACE=1`` during the check.sh drill legs.
+
 Rules are documented in ``docs/lint.md``; suppress a finding with
 ``# dgenlint: disable=<rule>`` on the flagged line.
 """

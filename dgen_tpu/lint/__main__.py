@@ -11,6 +11,11 @@ error):
   (``JAX_PLATFORMS`` defaults to cpu for the audit; no devices, no
   data) and gates compiled cost fingerprints against
   ``tools/prog_baseline.json``.
+* ``--conc`` — the thread-safety tier (rules C1-C6,
+  :mod:`dgen_tpu.lint.conc`): per-class thread-entry inference + lock
+  dominance over the concurrent host modules (serve/, io/hostio.py,
+  resilience/, utils/timing.py, parallel/ by default; paths override).
+  No jax import either.
 
 ``--json`` emits a machine-readable finding list (one object per
 finding); the default text format is ``path:line: RULE message``, one
@@ -222,6 +227,16 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true",
         help="print the rule ids and summaries, then exit",
     )
+    conc_group = ap.add_argument_group(
+        "concurrency tier (--conc)",
+    )
+    conc_group.add_argument(
+        "--conc", action="store_true",
+        help="audit the threaded host-side modules with the "
+             "thread-safety rules C1-C6 (default roots: serve/, "
+             "io/hostio.py, resilience/, utils/timing.py, parallel/; "
+             "positional paths override) instead of linting source",
+    )
     prog_group = ap.add_argument_group(
         "program auditor (--programs)",
     )
@@ -292,7 +307,34 @@ def main(argv=None) -> int:
 
         for rule_id, summary in PROGRAM_RULE_SUMMARIES.items():
             print(f"{rule_id}  {summary}  (--programs)")
+        # the C-rules share the id space behind --conc; same
+        # dependency-free id-table contract
+        from dgen_tpu.lint.conc_ids import CONC_RULE_SUMMARIES
+
+        for rule_id, summary in CONC_RULE_SUMMARIES.items():
+            print(f"{rule_id}  {summary}  (--conc)")
         return 0
+
+    if args.conc and (args.programs or args.list_programs or args.explain
+                      or args.mesh or args.mesh_shapes):
+        # two different audits answering under one exit code would be
+        # unreadable in a CI log — one tier per invocation
+        print("dgenlint: --conc cannot be combined with the program "
+              "auditor flags", file=sys.stderr)
+        return 2
+    if args.conc:
+        from dgen_tpu.lint.conc import lint_conc_paths
+
+        select = None
+        if args.select:
+            select = [r.strip() for r in args.select.split(",")
+                      if r.strip()]
+        try:
+            findings = lint_conc_paths(args.paths or None, select=select)
+        except (ValueError, OSError, SyntaxError) as e:
+            print(f"dgenlint: {e}", file=sys.stderr)
+            return 2
+        return _findings_out(findings, args.json, "dgenlint-conc")
 
     if args.programs or args.list_programs or args.explain:
         return _run_programs(args)

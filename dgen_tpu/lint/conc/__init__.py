@@ -1,0 +1,80 @@
+"""dgenlint-conc: the thread-safety tier (rules C1-C6).
+
+``python -m dgen_tpu.lint --conc`` runs the static half over the
+threaded host-side modules (the serving plane, host IO, resilience
+supervisors, timing, parallel helpers — :data:`CONC_DEFAULT_ROOTS`):
+
+    C1  cross-thread write to self.* state without the class lock
+    C2  blocking call (sleep/HTTP/subprocess/join/queue) under a lock
+    C3  lock-acquisition order cycle / non-reentrant re-acquire
+    C4  non-atomic check-then-act on a shared container outside a lock
+    C5  unsafe lazy-init / broken double-checked locking
+    C6  thread started without an owner (no daemon=, no join)
+
+The runtime half is :mod:`dgen_tpu.utils.locktrace` — the
+instrumented-lock sentinel the fleet/gang/serve-scale drills run armed
+(tools/check.sh) to verify the *observed* lock-order graph stays
+acyclic.  Rules, suppression semantics and the lock-free allowlist are
+documented in docs/lint.md "The concurrency tier".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional
+
+from dgen_tpu.lint.conc.analyzer import ConcIndex  # noqa: F401
+from dgen_tpu.lint.conc.crules import (  # noqa: F401
+    CONC_RULES,
+    LOCKFREE_ALLOWLIST,
+    run_conc_rules,
+)
+from dgen_tpu.lint.conc_ids import CONC_RULE_SUMMARIES  # noqa: F401
+from dgen_tpu.lint.core import Finding, parse_file, parse_source
+
+_PKG = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: the concurrent host surface the tier audits by default: everything
+#: that starts threads or is called from them
+CONC_DEFAULT_ROOTS = (
+    os.path.join(_PKG, "serve"),
+    os.path.join(_PKG, "io", "hostio.py"),
+    os.path.join(_PKG, "resilience"),
+    os.path.join(_PKG, "utils", "timing.py"),
+    os.path.join(_PKG, "parallel"),
+)
+
+
+def lint_conc_paths(
+    paths: Optional[Iterable[str]] = None,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run C1-C6 over files/directories (default: the concurrent host
+    modules).  The index always includes the default roots so
+    cross-class edges (typed attributes, external thread entries)
+    resolve even when only a subset is linted."""
+    from dgen_tpu.lint import collect_files
+
+    targets = collect_files(
+        list(paths) if paths is not None else list(CONC_DEFAULT_ROOTS))
+    index_files = sorted(
+        set(targets) | set(collect_files(
+            [p for p in CONC_DEFAULT_ROOTS if os.path.exists(p)])))
+    by_path = {}
+    for f in index_files:
+        by_path[os.path.abspath(f)] = parse_file(f)
+    cidx = ConcIndex(by_path.values())
+    mods = [by_path[os.path.abspath(f)] for f in targets]
+    return run_conc_rules(cidx, modules=mods, select=select)
+
+
+def lint_conc_source(
+    src: str,
+    filename: str = "<snippet>",
+    modname: str = "snippet",
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run C1-C6 over one source string (unit tests / fixtures)."""
+    m = parse_source(src, filename=filename, modname=modname)
+    return run_conc_rules(ConcIndex([m]), select=select)

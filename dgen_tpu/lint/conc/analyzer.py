@@ -1,0 +1,824 @@
+"""dgenlint-conc analyzer: per-class concurrency models.
+
+The C rules (:mod:`dgen_tpu.lint.conc.crules`) need, for every class in
+the concurrent host modules, answers to four questions the plain AST
+does not give directly:
+
+* **which methods run on which thread** — the *thread-entry* set:
+  methods handed to ``threading.Thread(target=...)``, executor
+  ``.submit`` callbacks, ``http.server`` handler verbs (every request
+  its own thread), plus the closure of plain ``self.*()`` calls from
+  those entries.  Entries propagate one level across classes through
+  typed attributes (``self._front = front`` with a ``FleetFront``
+  annotation: the autoscaler's control thread *drives*
+  ``FleetFront.pressure``, so ``pressure`` is a thread entry of
+  ``FleetFront`` too).
+* **which locks are held on which AST paths** — ``with self._lock:``
+  dominance, tracked through nested withs, conditionals, loops and
+  try blocks (``self._lock``/``self._cv``/... discovered from
+  ``self.X = threading.Lock()|RLock()|Condition()`` assignments).
+* **what each method acquires, transitively** — for the static
+  lock-order graph (C3), including one level of cross-class calls
+  through typed attributes (``self.pool.checkout()`` acquiring
+  ``HTTPPool._lock`` while ``ReplicaSupervisor._lock`` is held is an
+  order edge between two classes).
+* **what each method may block on, transitively** — for C2
+  (probe-under-lock), so ``with self._lock: self._probe()`` is flagged
+  when ``_probe`` does the HTTP round-trip three frames down.
+
+Everything here is an over-approximation in the same spirit as the jit
+reachability closure in :mod:`dgen_tpu.lint.core`: a method *referenced*
+from a thread entry counts as running on that thread.  The rules then
+err strict, and intentional lock-free designs opt out per line
+(``# dgenlint: disable=C1`` with a why-comment) or through the
+documented :data:`dgen_tpu.lint.conc.crules.LOCKFREE_ALLOWLIST`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from dgen_tpu.lint.core import ModuleInfo, dotted
+
+#: ``self.X = <factory>()`` classifications (resolved through imports)
+LOCK_FACTORIES: Dict[str, str] = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+SEM_FACTORIES = ("threading.Semaphore", "threading.BoundedSemaphore")
+EVENT_FACTORIES = ("threading.Event",)
+QUEUE_FACTORIES = (
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "multiprocessing.Queue",
+)
+
+#: container-mutating method names: ``self.X.append(...)`` is a write
+#: to ``X`` for rule purposes
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear", "sort", "reverse",
+})
+
+
+def resolve(m: ModuleInfo, target: str) -> str:
+    """Expand the leading alias of a dotted name through the module's
+    imports (``th.Lock`` -> ``threading.Lock``)."""
+    head, _, rest = target.partition(".")
+    base = m.imports.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``'x'`` for a ``self.x`` expression, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _self_root(node: ast.AST) -> Optional[str]:
+    """Root attribute of a ``self.``-rooted chain: ``'a'`` for
+    ``self.a``, ``self.a.b``, ``self.a[k]``, ``self.a[k].c`` ..."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        a = _self_attr(node)
+        if a is not None:
+            return a
+        node = node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-method facts
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    line: int
+    held: FrozenSet[str]
+    kind: str                 # "read" | "write"
+    assign: bool = False      # plain ``self.X = ...`` (vs container mutation)
+
+
+@dataclasses.dataclass
+class Acquire:
+    lock: str
+    line: int
+    held_before: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class CallSite:
+    target: str               # raw dotted ("self._probe", "time.sleep")
+    line: int
+    held: FrozenSet[str]
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class CondEvent:
+    """One ``if`` whose test inspects ``self.X`` (membership / truth /
+    is-None) — the raw material for C4 check-then-act and C5 lazy
+    init."""
+
+    kind: str                 # "membership" | "truth" | "none"
+    attr: str
+    line: int
+    held: FrozenSet[str]
+    body_writes: List[Access]
+    rechecked_under_lock: bool
+
+
+@dataclasses.dataclass
+class ThreadSpawn:
+    line: int
+    target: Optional[str]     # dotted target= ("self._loop"), if any
+    daemon_set: bool
+    assigned: Optional[str]   # "self:attr" | "local:name" | None
+
+
+@dataclasses.dataclass
+class MethodModel:
+    name: str
+    node: ast.AST
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    acquires: List[Acquire] = dataclasses.field(default_factory=list)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    conds: List[CondEvent] = dataclasses.field(default_factory=list)
+    spawns: List[ThreadSpawn] = dataclasses.field(default_factory=list)
+    joins: Set[str] = dataclasses.field(default_factory=set)
+    daemon_marks: Set[str] = dataclasses.field(default_factory=set)
+
+
+class ClassModel:
+    """One class's concurrency-relevant facts."""
+
+    def __init__(self, module: ModuleInfo, node: Optional[ast.ClassDef]):
+        self.module = module
+        self.node = node
+        self.name = node.name if node is not None else "<module>"
+        self.qualname = f"{module.modname}.{self.name}"
+        self.bases: List[str] = (
+            [d for d in (dotted(b) for b in node.bases) if d]
+            if node is not None else []
+        )
+        self.lock_attrs: Dict[str, str] = {}     # attr -> Lock/RLock/Condition
+        self.sem_attrs: Set[str] = set()
+        self.attr_kinds: Dict[str, str] = {}     # attr -> Queue/Thread/Event
+        self.attr_types_raw: Dict[str, str] = {} # attr -> resolved dotted class
+        self.attr_types: Dict[str, "ClassModel"] = {}
+        self.methods: Dict[str, MethodModel] = {}
+        #: entry name -> concurrent? (True: several instances of this
+        #: entry can run at once, e.g. per-request handler threads)
+        self.entries: Dict[str, bool] = {}
+        #: method -> frozenset of entry labels whose threads reach it
+        #: (empty = only ever runs on the caller's thread)
+        self.method_groups: Dict[str, FrozenSet[str]] = {}
+
+    def is_handler_class(self) -> bool:
+        return self.name.lower().endswith("handler") or any(
+            b.split(".")[-1].lower().endswith("handler") for b in self.bases
+        )
+
+    def concurrent_entry_in(self, group: FrozenSet[str]) -> bool:
+        return any(self.entries.get(e, False) for e in group)
+
+
+# ---------------------------------------------------------------------------
+# the walker
+# ---------------------------------------------------------------------------
+
+class _MethodWalker:
+    """One pass over a method body tracking the ``with self.<lock>``
+    held-set down every AST path (nested defs/classes excluded — they
+    get their own model or none)."""
+
+    def __init__(self, cls: ClassModel, mm: MethodModel) -> None:
+        self.cls = cls
+        self.m = cls.module
+        self.mm = mm
+        self._pending_assign: Optional[str] = None
+
+    def run(self) -> None:
+        self._stmts(self.mm.node.body, frozenset())
+
+    # -- statements -----------------------------------------------------
+    def _stmts(self, body, held: FrozenSet[str]) -> None:
+        for st in body:
+            self._stmt(st, held)
+
+    def _stmt(self, node: ast.stmt, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                la = _self_attr(item.context_expr)
+                if la is not None and la in self.cls.lock_attrs:
+                    self.mm.acquires.append(
+                        Acquire(la, node.lineno, frozenset(inner)))
+                    inner.add(la)
+                else:
+                    self._expr(item.context_expr, held)
+            self._stmts(node.body, frozenset(inner))
+            return
+        if isinstance(node, ast.If):
+            self._expr(node.test, held)
+            ev = self._cond_event(node, held)
+            if ev is not None:
+                self.mm.conds.append(ev)
+            self._stmts(node.body, held)
+            self._stmts(node.orelse, held)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self._expr(node.iter, held)
+            self._target_write(node.target, held)
+            self._stmts(node.body, held)
+            self._stmts(node.orelse, held)
+            return
+        if isinstance(node, ast.While):
+            self._expr(node.test, held)
+            self._stmts(node.body, held)
+            self._stmts(node.orelse, held)
+            return
+        if isinstance(node, ast.Try):
+            self._stmts(node.body, held)
+            for h in node.handlers:
+                self._stmts(h.body, held)
+            self._stmts(node.orelse, held)
+            self._stmts(node.finalbody, held)
+            return
+        if isinstance(node, ast.Assign):
+            self._handle_assign(node, held)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._assign_one(node.target, node.value, held)
+                self._expr(node.value, held)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._target_write(node.target, held)
+            # ``self.x += 1`` reads x too
+            root = _self_root(node.target)
+            if root is not None:
+                self.mm.accesses.append(
+                    Access(root, node.lineno, held, "read"))
+            self._expr(node.value, held)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._target_write(t, held)
+            return
+        # Expr/Return/Raise/Assert/...: walk child expressions; walk
+        # child statements (shouldn't exist here) defensively
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, held)
+
+    # -- assignment targets ---------------------------------------------
+    def _target_write(self, target: ast.AST, held: FrozenSet[str],
+                      assign: bool = False) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._target_write(el, held, assign)
+            return
+        root = _self_root(target)
+        if root is not None:
+            # ``self.x = ...`` is a plain (re)bind; ``self.x[k] = ...``
+            # and ``self.x.y = ...`` mutate the object x holds
+            plain = assign and _self_attr(target) is not None
+            self.mm.accesses.append(Access(
+                root, target.lineno, held, "write", assign=plain))
+
+    def _handle_assign(self, node: ast.Assign, held: FrozenSet[str]) -> None:
+        for t in node.targets:
+            self._assign_one(t, node.value, held)
+        self._expr(node.value, held)
+        self._pending_assign = None
+
+    def _assign_one(self, target: ast.AST, value: ast.AST,
+                    held: FrozenSet[str]) -> None:
+        # ``t.daemon = True`` / ``self._thread.daemon = True``
+        if isinstance(target, ast.Attribute) and target.attr == "daemon":
+            recv = dotted(target.value)
+            if recv:
+                self.mm.daemon_marks.add(recv)
+            return
+        self._target_write(target, held, assign=True)
+        attr = _self_attr(target)
+        name = target.id if isinstance(target, ast.Name) else None
+        if isinstance(value, ast.Call):
+            d = dotted(value.func)
+            r = resolve(self.m, d) if d else None
+            if r is not None and attr is not None:
+                if r in LOCK_FACTORIES:
+                    self.cls.lock_attrs[attr] = LOCK_FACTORIES[r]
+                elif r in SEM_FACTORIES:
+                    self.cls.sem_attrs.add(attr)
+                elif r in EVENT_FACTORIES:
+                    self.cls.attr_kinds[attr] = "Event"
+                elif r in QUEUE_FACTORIES:
+                    self.cls.attr_kinds[attr] = "Queue"
+                elif r == "threading.Thread":
+                    self.cls.attr_kinds[attr] = "Thread"
+                elif r.rpartition(".")[2][:1].isupper():
+                    # ``self.pool = HTTPPool(...)``: a typed attribute
+                    self.cls.attr_types_raw.setdefault(attr, r)
+            if r == "threading.Thread":
+                self._pending_assign = (
+                    f"self:{attr}" if attr is not None
+                    else (f"local:{name}" if name else None)
+                )
+        elif isinstance(value, ast.Name) and attr is not None:
+            # ``self.sup = supervisor``: typed via the __init__
+            # annotation (resolved by the class builder)
+            self.cls.attr_types_raw.setdefault(
+                attr, f"<param>{value.id}")
+
+    # -- expressions ----------------------------------------------------
+    def _expr(self, node: ast.expr, held: FrozenSet[str]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and isinstance(
+                    sub.ctx, ast.Load):
+                a = _self_attr(sub)
+                if a is not None:
+                    self.mm.accesses.append(
+                        Access(a, sub.lineno, held, "read"))
+            elif isinstance(sub, ast.Call):
+                self._call(sub, held)
+
+    def _call(self, node: ast.Call, held: FrozenSet[str]) -> None:
+        d = dotted(node.func)
+        if not d:
+            return
+        self.mm.calls.append(CallSite(d, node.lineno, held, node))
+        parts = d.split(".")
+        # container mutation through a method: self.X.append(...)
+        if len(parts) == 3 and parts[0] == "self" and parts[2] in MUTATORS:
+            self.mm.accesses.append(
+                Access(parts[1], node.lineno, held, "write"))
+        # thread spawn
+        r = resolve(self.m, d)
+        if r == "threading.Thread":
+            target = None
+            daemon_set = False
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = dotted(kw.value)
+                elif kw.arg == "daemon":
+                    daemon_set = True
+            self.mm.spawns.append(ThreadSpawn(
+                node.lineno, target, daemon_set, self._pending_assign))
+            if target and target.startswith("self."):
+                self.cls.entries.setdefault(target[5:], False)
+        # executor submit: first arg is the entry
+        elif parts[-1] == "submit" and node.args:
+            ref = dotted(node.args[0])
+            if ref and ref.startswith("self."):
+                self.cls.entries.setdefault(ref[5:], False)
+        # thread join bookkeeping (C6); exclude str.join by arg shape:
+        # a real join takes no args or a single numeric/None timeout
+        elif parts[-1] == "join":
+            timeoutish = (
+                not node.args
+                or (len(node.args) == 1
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, (int, float,
+                                                        type(None))))
+            ) and all(kw.arg == "timeout" for kw in node.keywords)
+            recv = ".".join(parts[:-1])
+            if timeoutish and recv:
+                self.mm.joins.add(recv)
+
+    # -- if-test patterns (C4/C5) ---------------------------------------
+    def _cond_test(self, test: ast.expr) -> Optional[Tuple[str, str]]:
+        """(kind, attr) when the test inspects ``self.X``."""
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            op, comp = test.ops[0], test.comparators[0]
+            if isinstance(op, (ast.In, ast.NotIn)):
+                a = _self_attr(comp)
+                if a is not None:
+                    return ("membership", a)
+            if isinstance(op, (ast.Is, ast.IsNot)) and isinstance(
+                    comp, ast.Constant) and comp.value is None:
+                a = _self_attr(test.left)
+                if a is not None:
+                    return ("none", a)
+                # ``self.X.get(k) is None``
+                if isinstance(test.left, ast.Call):
+                    d = dotted(test.left.func)
+                    if d and d.startswith("self.") and d.endswith(".get"):
+                        return ("membership", d.split(".")[1])
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            a = _self_attr(test.operand)
+            if a is not None:
+                return ("truth", a)
+        a = _self_attr(test)
+        if a is not None:
+            return ("truth", a)
+        return None
+
+    def _cond_event(self, node: ast.If,
+                    held: FrozenSet[str]) -> Optional[CondEvent]:
+        hit = self._cond_test(node.test)
+        if hit is None:
+            return None
+        kind, attr = hit
+        writes: List[Access] = []
+        rechecked = False
+
+        def scan(body, inner_held):
+            nonlocal rechecked
+            for st in body:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    h2 = set(inner_held)
+                    for item in st.items:
+                        la = _self_attr(item.context_expr)
+                        if la is not None and la in self.cls.lock_attrs:
+                            h2.add(la)
+                    scan(st.body, frozenset(h2))
+                    continue
+                if isinstance(st, ast.If):
+                    # the double-checked-locking recheck: same attr
+                    # re-tested under a lock before the assignment
+                    h2 = self._cond_test(st.test)
+                    if h2 is not None and h2[1] == attr and inner_held:
+                        rechecked = True
+                    scan(st.body, inner_held)
+                    scan(st.orelse, inner_held)
+                    continue
+                for sub in ast.walk(st):
+                    root = None
+                    plain = False
+                    if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                        targets = (sub.targets
+                                   if isinstance(sub, ast.Assign)
+                                   else [sub.target])
+                        for t in targets:
+                            root = _self_root(t)
+                            if root == attr:
+                                plain = _self_attr(t) is not None and \
+                                    isinstance(sub, ast.Assign)
+                                writes.append(Access(
+                                    attr, sub.lineno, inner_held,
+                                    "write", assign=plain))
+                    elif isinstance(sub, ast.Delete):
+                        for t in sub.targets:
+                            if _self_root(t) == attr:
+                                writes.append(Access(
+                                    attr, sub.lineno, inner_held, "write"))
+                    elif isinstance(sub, ast.Call):
+                        d = dotted(sub.func)
+                        if d:
+                            p = d.split(".")
+                            if (len(p) == 3 and p[0] == "self"
+                                    and p[1] == attr and p[2] in MUTATORS):
+                                writes.append(Access(
+                                    attr, sub.lineno, inner_held, "write"))
+
+        scan(node.body, held)
+        if not writes:
+            return None
+        return CondEvent(kind, attr, node.lineno, held, writes, rechecked)
+
+
+# ---------------------------------------------------------------------------
+# the index
+# ---------------------------------------------------------------------------
+
+def _build_models(m: ModuleInfo) -> List[ClassModel]:
+    out: List[ClassModel] = []
+    # module-level functions get a pseudo-class (C6 needs their spawns)
+    pseudo = ClassModel(m, None)
+    for node in m.tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = ClassModel(m, node)
+            # pass 1: attribute classification (locks must be known
+            # before held-tracking makes sense)
+            for meth in node.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                mm = MethodModel(meth.name, meth)
+                cls.methods[meth.name] = mm
+            for mm in cls.methods.values():
+                for sub in ast.walk(mm.node):
+                    if isinstance(sub, ast.Assign) and isinstance(
+                            sub.value, ast.Call):
+                        d = dotted(sub.value.func)
+                        r = resolve(m, d) if d else None
+                        for t in sub.targets:
+                            a = _self_attr(t)
+                            if a is None or r is None:
+                                continue
+                            if r in LOCK_FACTORIES:
+                                cls.lock_attrs[a] = LOCK_FACTORIES[r]
+                            elif r in SEM_FACTORIES:
+                                cls.sem_attrs.add(a)
+            # pass 2: the full walk
+            for mm in cls.methods.values():
+                _MethodWalker(cls, mm).run()
+            # handler classes: every request runs on its own thread
+            if cls.is_handler_class():
+                for name in cls.methods:
+                    if not name.startswith("__"):
+                        cls.entries[name] = True
+            else:
+                for name in cls.methods:
+                    low = name.lower()
+                    if low.startswith("do_") or "handle" in low:
+                        cls.entries[name] = True
+            # __init__ annotations type the params for attr_types
+            init = cls.methods.get("__init__")
+            ann: Dict[str, str] = {}
+            if init is not None:
+                for arg in list(init.node.args.args) + list(
+                        init.node.args.kwonlyargs):
+                    if arg.annotation is None:
+                        continue
+                    d = dotted(arg.annotation)
+                    if d is None and isinstance(arg.annotation,
+                                                ast.Constant) and \
+                            isinstance(arg.annotation.value, str):
+                        d = arg.annotation.value
+                    if d:
+                        ann[arg.arg] = resolve(m, d)
+            for attr, raw in list(cls.attr_types_raw.items()):
+                if raw.startswith("<param>"):
+                    p = ann.get(raw[len("<param>"):])
+                    if p:
+                        cls.attr_types_raw[attr] = p
+                    else:
+                        del cls.attr_types_raw[attr]
+            out.append(cls)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mm = MethodModel(node.name, node)
+            pseudo.methods[node.name] = mm
+            _MethodWalker(pseudo, mm).run()
+    if pseudo.methods:
+        out.append(pseudo)
+    return out
+
+
+class ConcIndex:
+    """All class models plus the cross-class closures the rules use."""
+
+    def __init__(self, modules) -> None:
+        self.modules: List[ModuleInfo] = list(modules)
+        self.classes: Dict[str, ClassModel] = {}
+        for m in self.modules:
+            for cls in _build_models(m):
+                self.classes[cls.qualname] = cls
+        self._resolve_attr_types()
+        self._close_entries()
+        self._close_acquires()
+        self._close_blocking()
+        self._infer_call_held()
+
+    # -- helpers --------------------------------------------------------
+    def classes_in(self, m: ModuleInfo) -> List[ClassModel]:
+        return [c for c in self.classes.values() if c.module is m]
+
+    def _lookup_class(self, resolved: str) -> Optional[ClassModel]:
+        if resolved in self.classes:
+            return self.classes[resolved]
+        tail = resolved.rpartition(".")[2]
+        hits = [c for q, c in self.classes.items()
+                if q.rpartition(".")[2] == tail and c.node is not None]
+        return hits[0] if len(hits) == 1 else None
+
+    def _resolve_attr_types(self) -> None:
+        for cls in self.classes.values():
+            for attr, raw in cls.attr_types_raw.items():
+                hit = self._lookup_class(raw)
+                if hit is not None and hit is not cls:
+                    cls.attr_types[attr] = hit
+
+    # -- thread-entry closure -------------------------------------------
+    def _groups_for(self, cls: ClassModel) -> Dict[str, FrozenSet[str]]:
+        groups: Dict[str, Set[str]] = {n: set() for n in cls.methods}
+        for entry in cls.entries:
+            if entry not in cls.methods:
+                continue
+            seen: Set[str] = set()
+            work = [entry]
+            while work:
+                name = work.pop()
+                if name in seen or name not in cls.methods:
+                    continue
+                seen.add(name)
+                groups[name].add(entry)
+                for site in cls.methods[name].calls:
+                    p = site.target.split(".")
+                    if len(p) == 2 and p[0] == "self" and \
+                            p[1] in cls.methods:
+                        work.append(p[1])
+        return {n: frozenset(g) for n, g in groups.items()}
+
+    def _close_entries(self) -> None:
+        """Entry groups per class, with one-level cross-class
+        propagation through typed attributes, to a fixpoint."""
+        for cls in self.classes.values():
+            cls.method_groups = self._groups_for(cls)
+        for _ in range(6):
+            changed = False
+            for cls in self.classes.values():
+                for name, mm in cls.methods.items():
+                    group = cls.method_groups.get(name, frozenset())
+                    if not group:
+                        continue
+                    conc = cls.concurrent_entry_in(group)
+                    for site in mm.calls:
+                        p = site.target.split(".")
+                        if len(p) != 3 or p[0] != "self":
+                            continue
+                        target_cls = cls.attr_types.get(p[1])
+                        if target_cls is None or p[2] not in \
+                                target_cls.methods:
+                            continue
+                        prev = target_cls.entries.get(p[2])
+                        if prev is None:
+                            target_cls.entries[p[2]] = conc
+                            changed = True
+                        elif conc and not prev:
+                            target_cls.entries[p[2]] = True
+                            changed = True
+            if not changed:
+                break
+            for cls in self.classes.values():
+                cls.method_groups = self._groups_for(cls)
+
+    # -- transitive acquisitions (C3) -----------------------------------
+    def _close_acquires(self) -> None:
+        """``self.acquire_closure[(clsqual, meth)]`` = set of lock nodes
+        (``Class.attr``) the method may acquire, transitively through
+        self-calls and typed-attribute calls."""
+        ta: Dict[Tuple[str, str], Set[str]] = {}
+        for cls in self.classes.values():
+            for name, mm in cls.methods.items():
+                ta[(cls.qualname, name)] = {
+                    f"{cls.name}.{a.lock}" for a in mm.acquires
+                }
+        for _ in range(8):
+            changed = False
+            for cls in self.classes.values():
+                for name, mm in cls.methods.items():
+                    cur = ta[(cls.qualname, name)]
+                    before = len(cur)
+                    for site in mm.calls:
+                        p = site.target.split(".")
+                        if len(p) == 2 and p[0] == "self" and \
+                                p[1] in cls.methods:
+                            cur |= ta.get((cls.qualname, p[1]), set())
+                        elif len(p) == 3 and p[0] == "self":
+                            tc = cls.attr_types.get(p[1])
+                            if tc is not None and p[2] in tc.methods:
+                                cur |= ta.get((tc.qualname, p[2]), set())
+                    if len(cur) != before:
+                        changed = True
+            if not changed:
+                break
+        self.acquire_closure = ta
+
+    # -- transitive blocking (C2) ---------------------------------------
+    def classify_blocking(self, cls: ClassModel,
+                          site: CallSite) -> Optional[str]:
+        """Why this call may block (message fragment), or None."""
+        d = site.target
+        r = resolve(cls.module, d)
+        if r == "time.sleep":
+            return "time.sleep()"
+        if r in ("subprocess.run", "subprocess.call",
+                 "subprocess.check_call", "subprocess.check_output"):
+            return f"{r}() subprocess wait"
+        if r.endswith(".http_json") or r == "http_json" or r in (
+                "urllib.request.urlopen", "socket.create_connection"):
+            return "HTTP round-trip"
+        parts = d.split(".")
+        last = parts[-1]
+        recv_attr = parts[1] if (len(parts) == 3 and
+                                 parts[0] == "self") else None
+        if last == "wait":
+            if recv_attr is not None and \
+                    cls.lock_attrs.get(recv_attr) == "Condition":
+                # cv.wait releases its own lock; it blocks-under-lock
+                # only w.r.t. OTHER locks — the caller check handles it
+                return ("Condition.wait while holding another lock"
+                        if site.held - {recv_attr} else None)
+            return "blocking .wait()"
+        if last == "join":
+            timeoutish = (
+                not site.node.args
+                or (len(site.node.args) == 1
+                    and isinstance(site.node.args[0], ast.Constant))
+            )
+            return "Thread/process join" if timeoutish else None
+        if last in ("get", "put") and recv_attr is not None and \
+                cls.attr_kinds.get(recv_attr) == "Queue":
+            for kw in site.node.keywords:
+                if kw.arg == "block" and isinstance(
+                        kw.value, ast.Constant) and not kw.value.value:
+                    return None
+            return f"blocking Queue.{last}()"
+        if last == "acquire" and recv_attr in cls.sem_attrs:
+            return "semaphore acquire"
+        if last == "result" and len(site.node.args) <= 1:
+            return "Future.result() wait"
+        return None
+
+    def _close_blocking(self) -> None:
+        """``self.blocking_closure[(clsqual, meth)]`` = (desc, line) of
+        one blocking call the method may reach, else None."""
+        bc: Dict[Tuple[str, str], Optional[Tuple[str, int]]] = {}
+        for cls in self.classes.values():
+            for name, mm in cls.methods.items():
+                hit = None
+                for site in mm.calls:
+                    why = self.classify_blocking(cls, site)
+                    if why is not None and "Condition.wait" not in why:
+                        hit = (why, site.line)
+                        break
+                bc[(cls.qualname, name)] = hit
+        for _ in range(8):
+            changed = False
+            for cls in self.classes.values():
+                for name, mm in cls.methods.items():
+                    if bc[(cls.qualname, name)] is not None:
+                        continue
+                    for site in mm.calls:
+                        p = site.target.split(".")
+                        sub = None
+                        if len(p) == 2 and p[0] == "self" and \
+                                p[1] in cls.methods:
+                            sub = bc.get((cls.qualname, p[1]))
+                        elif len(p) == 3 and p[0] == "self":
+                            tc = cls.attr_types.get(p[1])
+                            if tc is not None and p[2] in tc.methods:
+                                sub = bc.get((tc.qualname, p[2]))
+                        if sub is not None:
+                            bc[(cls.qualname, name)] = (
+                                f"{sub[0]} via {site.target}()", site.line)
+                            changed = True
+                            break
+            if not changed:
+                break
+        self.blocking_closure = bc
+
+    # -- call-site lock context -----------------------------------------
+    def _infer_call_held(self) -> None:
+        """``self.call_held[(clsqual, meth)]`` = locks held at EVERY
+        intra-class call site of a private helper (the Microbatcher
+        ``_take_batch`` pattern: documented "under _cv", never takes
+        the lock itself).  The intersection is sound: an access in the
+        helper is lock-protected iff all callers hold the lock."""
+        ch: Dict[Tuple[str, str], Optional[FrozenSet[str]]] = {}
+        for cls in self.classes.values():
+            sites: Dict[str, List[FrozenSet[str]]] = {}
+            for mm in cls.methods.values():
+                for site in mm.calls:
+                    p = site.target.split(".")
+                    if len(p) == 2 and p[0] == "self" and \
+                            p[1] in cls.methods:
+                        sites.setdefault(p[1], []).append(site.held)
+            for name in cls.methods:
+                held_sets = sites.get(name)
+                if (held_sets and name.startswith("_")
+                        and not name.startswith("__")
+                        and name not in cls.entries):
+                    common = frozenset.intersection(*held_sets)
+                    ch[(cls.qualname, name)] = common or None
+                else:
+                    ch[(cls.qualname, name)] = None
+        self.call_held = ch
+
+    def effective_held(self, cls: ClassModel, meth: str,
+                       held: FrozenSet[str]) -> FrozenSet[str]:
+        """A held-set widened by the caller-side lock context (private
+        helpers whose every call site holds the lock)."""
+        extra = self.call_held.get((cls.qualname, meth))
+        return held | extra if extra else held
+
+    def callee_of(self, cls: ClassModel,
+                  site: CallSite) -> Optional[Tuple[str, str]]:
+        """(class qualname, method) a self-rooted call resolves to."""
+        p = site.target.split(".")
+        if len(p) == 2 and p[0] == "self" and p[1] in cls.methods:
+            return (cls.qualname, p[1])
+        if len(p) == 3 and p[0] == "self":
+            tc = cls.attr_types.get(p[1])
+            if tc is not None and p[2] in tc.methods:
+                return (tc.qualname, p[2])
+        return None

@@ -1,0 +1,460 @@
+"""dgenlint-conc rules C1-C6 over :class:`~.analyzer.ConcIndex` models.
+
+Every rule is a generator ``rule(module, cidx) -> (line, message)``,
+registered in :data:`CONC_RULES` (ids + summaries come from the
+dependency-free :mod:`dgen_tpu.lint.conc_ids` table, same contract as
+the J rules).  :func:`run_conc_rules` applies the standard suppression
+mechanics (``# dgenlint: disable=C1`` on the flagged line, with a
+why-comment — docs/lint.md "The concurrency tier").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Iterable, List, Optional, Tuple
+
+from dgen_tpu.lint.conc.analyzer import (
+    Access,
+    ClassModel,
+    ConcIndex,
+)
+from dgen_tpu.lint.conc_ids import CONC_RULE_SUMMARIES
+from dgen_tpu.lint.core import Finding, ModuleInfo
+
+RuleHit = Tuple[int, str]
+
+#: Intentional lock-free shared state, ``"ClassName.attr" -> why``.
+#: Every entry must carry the safety argument; docs/lint.md lists them
+#: verbatim.  Prefer a line suppression with a why-comment for
+#: one-off cases — the allowlist is for patterns the design DOCS
+#: already promise (single-writer GIL-atomic snapshots).
+LOCKFREE_ALLOWLIST: Dict[str, str] = {
+    "FleetFront._metricz": (
+        "single-writer design: only the scrape thread rebinds whole "
+        "per-port tuples; readers take one dict() snapshot, which is "
+        "one atomic C-level copy under the GIL (docs/serve.md)"
+    ),
+}
+
+
+def _group(cls: ClassModel, meth: str) -> FrozenSet[str]:
+    return cls.method_groups.get(meth, frozenset())
+
+
+def _conflicting(cls: ClassModel, wg: FrozenSet[str],
+                 rg: FrozenSet[str]) -> bool:
+    """Two accesses race when their thread groups differ, or share a
+    group whose entry admits concurrent instances (per-request handler
+    threads)."""
+    if wg != rg:
+        return True
+    if not wg:
+        return False
+    return cls.concurrent_entry_in(wg)
+
+
+def _describe_group(g: FrozenSet[str]) -> str:
+    return "/".join(sorted(g)) if g else "caller thread"
+
+
+def _attr_accesses(cls: ClassModel) -> Dict[str, List[Tuple[str, Access]]]:
+    out: Dict[str, List[Tuple[str, Access]]] = {}
+    for meth, mm in cls.methods.items():
+        for acc in mm.accesses:
+            out.setdefault(acc.attr, []).append((meth, acc))
+    return out
+
+
+def _real_classes(m: ModuleInfo, cidx: ConcIndex) -> List[ClassModel]:
+    return [c for c in cidx.classes_in(m) if c.node is not None]
+
+
+# ---------------------------------------------------------------------------
+# C1 — cross-thread write without the class lock
+# ---------------------------------------------------------------------------
+
+def rule_c1(m: ModuleInfo, cidx: ConcIndex) -> Iterable[RuleHit]:
+    """A write to ``self.X`` outside any ``with self.<lock>`` when some
+    *other* thread entry reads ``X``: the scrape/read dict-race class
+    (PR 9).  ``__init__`` writes are exempt (happen-before the thread
+    start); intentional single-writer designs go through
+    :data:`LOCKFREE_ALLOWLIST` or a line suppression with a
+    why-comment."""
+    for cls in _real_classes(m, cidx):
+        if not cls.entries:
+            continue
+        if cls.is_handler_class():
+            # http.server builds one handler INSTANCE per connection:
+            # self.* is per-thread by construction — shared state goes
+            # through self.server.*, which belongs to the server's class
+            continue
+        for attr, accs in _attr_accesses(cls).items():
+            if attr in cls.lock_attrs or attr in cls.sem_attrs:
+                continue
+            if cls.attr_kinds.get(attr) in ("Event", "Queue", "Thread"):
+                continue  # internally synchronized / owner handles
+            if f"{cls.name}.{attr}" in LOCKFREE_ALLOWLIST:
+                continue
+            reads = [(meth, a) for meth, a in accs
+                     if a.kind == "read" and meth != "__init__"]
+            seen_lines = set()
+            for meth, w in accs:
+                if w.kind != "write" or meth == "__init__":
+                    continue
+                if cidx.effective_held(cls, meth, w.held):
+                    continue
+                if w.line in seen_lines:
+                    continue
+                wg = _group(cls, meth)
+                for rmeth, r in reads:
+                    if r.line == w.line and rmeth == meth:
+                        continue
+                    rg = _group(cls, rmeth)
+                    if _conflicting(cls, wg, rg):
+                        seen_lines.add(w.line)
+                        locks = ", ".join(
+                            f"self.{k}" for k in sorted(cls.lock_attrs)
+                        ) or "a lock (the class has none)"
+                        yield w.line, (
+                            f"`{cls.name}.{attr}` written on "
+                            f"{_describe_group(wg)} without a lock but "
+                            f"read from {_describe_group(rg)} "
+                            f"(`{rmeth}`, line {r.line}) — guard both "
+                            f"sides with {locks}, or document the "
+                            "lock-free design (allowlist / suppression "
+                            "with a why-comment)"
+                        )
+                        break
+
+
+# ---------------------------------------------------------------------------
+# C2 — blocking call while a lock is held
+# ---------------------------------------------------------------------------
+
+def rule_c2(m: ModuleInfo, cidx: ConcIndex) -> Iterable[RuleHit]:
+    """A blocking call (HTTP round-trip, subprocess wait,
+    ``time.sleep``, thread join, blocking queue op) on a path where a
+    lock is held — the probe-under-the-supervisor-lock stall class
+    fixed by hand in PR 11, now a gate.  One level of interprocedural
+    closure: ``with self._lock: self._probe()`` is flagged when
+    ``_probe`` blocks three frames down."""
+    for cls in _real_classes(m, cidx):
+        for meth, mm in cls.methods.items():
+            seen = set()
+            for site in mm.calls:
+                if not site.held:
+                    continue
+                why = cidx.classify_blocking(cls, site)
+                if why is None:
+                    callee = cidx.callee_of(cls, site)
+                    if callee is not None:
+                        sub = cidx.blocking_closure.get(callee)
+                        if sub is not None:
+                            why = f"{sub[0]} inside `{site.target}`"
+                if why is None or (site.line, why) in seen:
+                    continue
+                seen.add((site.line, why))
+                held = ", ".join(f"self.{h}" for h in sorted(site.held))
+                yield site.line, (
+                    f"{why} while holding {held} in "
+                    f"`{cls.name}.{meth}` — every other thread "
+                    "contending on that lock stalls for the full "
+                    "blocking latency; move the call outside the "
+                    "critical section (snapshot under the lock, act "
+                    "after release)"
+                )
+
+
+# ---------------------------------------------------------------------------
+# C3 — lock-order cycles / non-reentrant re-acquire
+# ---------------------------------------------------------------------------
+
+def _order_edges(cidx: ConcIndex):
+    """(from_node, to_node) -> (module, line) witness over all classes,
+    plus direct self-deadlocks [(module, line, lockname)]."""
+    edges: Dict[Tuple[str, str], Tuple[ModuleInfo, int]] = {}
+    self_deadlocks: List[Tuple[ModuleInfo, int, str]] = []
+    for cls in cidx.classes.values():
+        if cls.node is None:
+            continue
+        for meth, mm in cls.methods.items():
+            for acq in mm.acquires:
+                node = f"{cls.name}.{acq.lock}"
+                if acq.lock in acq.held_before:
+                    # re-acquiring a held lock: an RLock cannot block
+                    # here, so it orders nothing; a plain Lock is a
+                    # single-thread self-deadlock
+                    if cls.lock_attrs.get(acq.lock) == "Lock":
+                        self_deadlocks.append(
+                            (cls.module, acq.line, node))
+                    continue
+                for h in acq.held_before:
+                    edges.setdefault(
+                        (f"{cls.name}.{h}", node), (cls.module, acq.line))
+            for site in mm.calls:
+                if not site.held:
+                    continue
+                callee = cidx.callee_of(cls, site)
+                if callee is None:
+                    continue
+                held_nodes = {f"{cls.name}.{h}" for h in site.held}
+                for node in cidx.acquire_closure.get(callee, ()):
+                    if node in held_nodes:
+                        # the callee re-acquires a lock this call site
+                        # already holds: an RLock cannot block (orders
+                        # nothing); a non-reentrant Lock deadlocks
+                        # against its own thread
+                        if cls.lock_attrs.get(
+                                node.rpartition(".")[2]) == "Lock":
+                            self_deadlocks.append(
+                                (cls.module, site.line, node))
+                        continue
+                    for h in site.held:
+                        edges.setdefault(
+                            (f"{cls.name}.{h}", node),
+                            (cls.module, site.line))
+    return edges, self_deadlocks
+
+
+def _cycles(edges) -> List[List[str]]:
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+    out: List[List[str]] = []
+    seen_cycles = set()
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[str, int] = {}
+    path: List[str] = []
+
+    def dfs(n: str) -> None:
+        color[n] = GREY
+        path.append(n)
+        for nxt in graph.get(n, ()):
+            c = color.get(nxt, WHITE)
+            if c == GREY:
+                cyc = path[path.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    out.append(cyc)
+            elif c == WHITE:
+                dfs(nxt)
+        path.pop()
+        color[n] = BLACK
+
+    for n in sorted(graph):
+        if color.get(n, WHITE) == WHITE:
+            dfs(n)
+    return out
+
+
+def rule_c3(m: ModuleInfo, cidx: ConcIndex) -> Iterable[RuleHit]:
+    """A cycle in the static lock-acquisition order graph (two threads
+    interleaving the witnessed paths deadlock), or a non-reentrant
+    ``threading.Lock`` re-acquired on a path that already holds it
+    (single-thread self-deadlock).  The runtime sentinel
+    (:mod:`dgen_tpu.utils.locktrace`) checks the same property on the
+    *observed* graph while the drills run."""
+    edges, self_deadlocks = _order_edges(cidx)
+    for mod, line, node in self_deadlocks:
+        if mod is m:
+            yield line, (
+                f"non-reentrant lock `{node}` acquired on a path that "
+                "already holds it — this thread deadlocks against "
+                "itself; use RLock or restructure so the lock is "
+                "taken once"
+            )
+    for cyc in _cycles(edges):
+        for a, b in zip(cyc, cyc[1:]):
+            mod, line = edges[(a, b)]
+            if mod is m:
+                yield line, (
+                    "lock-order cycle "
+                    + " -> ".join(cyc)
+                    + f" (this line witnesses {a} -> {b}): two threads "
+                    "taking the locks in opposing order deadlock — "
+                    "pick one global order and acquire in it everywhere"
+                )
+
+
+# ---------------------------------------------------------------------------
+# C4 — check-then-act outside a lock
+# ---------------------------------------------------------------------------
+
+def _attr_shared(cidx: ConcIndex, cls: ClassModel, attr: str) -> bool:
+    """Shared iff accessed from two distinct thread groups, from one
+    concurrent-entry group, or guarded by a lock anywhere (the author
+    already believes it's shared)."""
+    groups = set()
+    locked = False
+    for meth, mm in cls.methods.items():
+        if meth == "__init__":
+            continue
+        for acc in mm.accesses:
+            if acc.attr != attr:
+                continue
+            g = _group(cls, meth)
+            groups.add(g)
+            locked = locked or bool(
+                cidx.effective_held(cls, meth, acc.held))
+            if cls.concurrent_entry_in(g):
+                return True
+    return len(groups) > 1 or locked
+
+
+def rule_c4(m: ModuleInfo, cidx: ConcIndex) -> Iterable[RuleHit]:
+    """``if key in self.d: ... self.d[key] = / del self.d[key]`` (or the
+    ``.get() is None`` / truthiness forms) with both the check and the
+    mutation outside a lock, on a container another thread touches:
+    the window between check and act admits interleavings the check
+    was meant to exclude — take the class lock around the pair."""
+    for cls in _real_classes(m, cidx):
+        # same gate as C5: a thread entry OR a lock (the lock is the
+        # author saying "this class is shared")
+        if (not cls.entries and not cls.lock_attrs) or \
+                cls.is_handler_class():
+            continue
+        for meth, mm in cls.methods.items():
+            for ev in mm.conds:
+                if cidx.effective_held(cls, meth, ev.held) or \
+                        ev.kind == "none":
+                    continue
+                if f"{cls.name}.{ev.attr}" in LOCKFREE_ALLOWLIST:
+                    continue
+                writes = [
+                    w for w in ev.body_writes
+                    if not cidx.effective_held(cls, meth, w.held)
+                    and not w.assign
+                ]
+                if not writes or not _attr_shared(cidx, cls, ev.attr):
+                    continue
+                yield ev.line, (
+                    f"non-atomic check-then-act on shared "
+                    f"`{cls.name}.{ev.attr}`: the test and the "
+                    f"mutation (line {writes[0].line}) run outside "
+                    "any lock — another thread can interleave between "
+                    "them; hold the class lock across both"
+                )
+
+
+# ---------------------------------------------------------------------------
+# C5 — unsafe lazy-init / double-checked locking
+# ---------------------------------------------------------------------------
+
+def rule_c5(m: ModuleInfo, cidx: ConcIndex) -> Iterable[RuleHit]:
+    """``if self.x is None: self.x = build()`` (or the ``not self.x``
+    form) with the check outside a lock in a class threads touch: two
+    threads both see None and both build — double work at best, two
+    live objects with split state at worst.  The safe shapes: init
+    eagerly in ``__init__``; or check-lock-RECHECK (the recheck under
+    the lock is what the walker looks for); or ``setdefault`` under
+    the lock."""
+    for cls in _real_classes(m, cidx):
+        if (not cls.entries and not cls.lock_attrs) or \
+                cls.is_handler_class():
+            continue
+        for meth, mm in cls.methods.items():
+            if meth == "__init__":
+                continue
+            for ev in mm.conds:
+                if cidx.effective_held(cls, meth, ev.held) or \
+                        ev.rechecked_under_lock:
+                    continue
+                if ev.kind not in ("none", "truth"):
+                    continue
+                assigns = [w for w in ev.body_writes if w.assign]
+                if not assigns:
+                    continue
+                # single-thread-private state (the autoscaler's
+                # hysteresis windows live on the control thread alone)
+                # cannot race with itself
+                if not _attr_shared(cidx, cls, ev.attr):
+                    continue
+                yield ev.line, (
+                    f"unsafe lazy init of `{cls.name}.{ev.attr}`: "
+                    "checked outside a lock, assigned at line "
+                    f"{assigns[0].line} — two threads can both pass "
+                    "the check and both initialize; init eagerly in "
+                    "__init__, or re-check under the lock "
+                    "(check-lock-recheck), or setdefault under the lock"
+                )
+
+
+# ---------------------------------------------------------------------------
+# C6 — orphan threads
+# ---------------------------------------------------------------------------
+
+def rule_c6(m: ModuleInfo, cidx: ConcIndex) -> Iterable[RuleHit]:
+    """``threading.Thread(...)`` with ``daemon=`` unset and no ``join``
+    (or ``.daemon = True``) reachable for the handle: library code that
+    leaks a non-daemon thread keeps the interpreter alive at shutdown
+    and hides teardown bugs.  Give every thread an owner: mark it
+    daemon, or keep the handle and join it on the teardown path."""
+    for cls in cidx.classes_in(m):
+        all_joins: set = set()
+        all_daemon_marks: set = set()
+        for mm in cls.methods.values():
+            all_joins |= mm.joins
+            all_daemon_marks |= mm.daemon_marks
+        for meth, mm in cls.methods.items():
+            for sp in mm.spawns:
+                if sp.daemon_set:
+                    continue
+                owned = False
+                if sp.assigned is not None:
+                    kind, _, name = sp.assigned.partition(":")
+                    ref = f"self.{name}" if kind == "self" else name
+                    if kind == "self":
+                        owned = ref in all_joins or \
+                            ref in all_daemon_marks
+                    else:
+                        owned = ref in mm.joins or ref in mm.daemon_marks
+                where = (f"`{cls.name}.{meth}`" if cls.node is not None
+                         else f"`{meth}`")
+                if not owned:
+                    yield sp.line, (
+                        f"thread started in {where} without an owner: "
+                        "daemon= unset and the handle is never joined "
+                        "— pass daemon=True, or keep the handle and "
+                        "join it in the teardown path"
+                    )
+
+
+# ---------------------------------------------------------------------------
+# registry + driver
+# ---------------------------------------------------------------------------
+
+_IMPLS = {
+    "C1": rule_c1, "C2": rule_c2, "C3": rule_c3,
+    "C4": rule_c4, "C5": rule_c5, "C6": rule_c6,
+}
+
+#: rule id -> (summary, impl); summaries come from the shared id table
+#: so the CLI's --list-rules and the implementations cannot drift
+CONC_RULES: Dict[str, Tuple[str, object]] = {
+    rid: (CONC_RULE_SUMMARIES[rid], impl) for rid, impl in _IMPLS.items()
+}
+assert set(CONC_RULES) == set(CONC_RULE_SUMMARIES)
+
+
+def run_conc_rules(
+    cidx: ConcIndex,
+    modules: Optional[List[ModuleInfo]] = None,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    mods = modules if modules is not None else cidx.modules
+    sel = {s for s in select} if select else None
+    if sel is not None:
+        unknown = sel - set(CONC_RULES)
+        if unknown:
+            raise ValueError(
+                f"unknown conc rule id(s): {sorted(unknown)}")
+    findings: List[Finding] = []
+    for m in mods:
+        for rid, (_summary, impl) in CONC_RULES.items():
+            if sel is not None and rid not in sel:
+                continue
+            for line, msg in impl(m, cidx):
+                if not m.is_suppressed(rid, line):
+                    findings.append(Finding(rid, m.path, line, msg))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,23 @@
+"""Concurrency-tier rule ids and one-line summaries.
+
+Split out of :mod:`dgen_tpu.lint.conc` for the same reason
+:mod:`dgen_tpu.lint.prog_ids` exists for the J rules: ``--list-rules``
+must print every tier's id table without importing any tier's
+implementation.  (The conc tier is jax-free anyway, but the id table
+staying dependency-free is the invariant worth keeping uniform.)
+:mod:`dgen_tpu.lint.conc.crules` builds its registry from this table so
+the two cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+CONC_RULE_SUMMARIES: Dict[str, str] = {
+    "C1": "cross-thread write to self.* state without the class lock",
+    "C2": "blocking call (sleep/HTTP/subprocess/join/queue) under a lock",
+    "C3": "lock-acquisition order cycle / non-reentrant re-acquire",
+    "C4": "non-atomic check-then-act on a shared container outside a lock",
+    "C5": "unsafe lazy-init / broken double-checked locking",
+    "C6": "thread started without an owner (no daemon=, no join)",
+}

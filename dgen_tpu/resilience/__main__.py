@@ -98,10 +98,37 @@ def _cmd_verify(args) -> int:
     return 0 if ok else 1
 
 
+def _locktrace_verdict(rec: dict) -> dict:
+    """Merge the runtime lock sentinel's verdict into a drill record:
+    any observed lock-order cycle or contended over-ceiling hold fails
+    the drill, with the witness dumped to stderr.  No-op unless the
+    drill ran with ``DGEN_TPU_LOCKTRACE=1`` (tools/check.sh arms the
+    fleet/gang/serve-scale legs)."""
+    from dgen_tpu.utils import locktrace
+
+    if not locktrace.is_armed():
+        return rec
+    report = locktrace.check()
+    rec["locktrace"] = {
+        "ok": report["ok"],
+        "n_locks": len(report["locks"]),
+        "n_edges": report["n_edges"],
+        "cycle": report["cycle"],
+        "hold_violations": report["hold_violations"],
+    }
+    if not report["ok"]:
+        rec["ok"] = False
+        print(locktrace.format_report(report), file=sys.stderr)
+    return rec
+
+
 def _cmd_drill(args) -> int:
     from dgen_tpu.resilience.drill import DRILL_SPECS, run_drill
-    from dgen_tpu.utils import compilecache
+    from dgen_tpu.utils import compilecache, locktrace
 
+    # arm BEFORE the serving stack is constructed: locks created
+    # earlier keep their raw C implementation and go untraced
+    locktrace.arm_from_env()
     compilecache.enable()
     end_year = args.end_year or (2018 if args.gang else 2016)
     if args.gang:
@@ -117,6 +144,7 @@ def _cmd_drill(args) -> int:
             end_year=end_year,
             stall=not args.no_gang_stall,
         )
+        rec = _locktrace_verdict(rec)
         print(json.dumps(rec, indent=1))
         return 0 if rec["ok"] else 1
     if args.quarantine:
@@ -127,6 +155,7 @@ def _cmd_drill(args) -> int:
             root, n_agents=args.agents, end_year=end_year,
             fast=args.fast,
         )
+        rec = _locktrace_verdict(rec)
         print(json.dumps(rec, indent=1))
         return 0 if rec["ok"] else 1
     if args.serve_scale:
@@ -134,6 +163,7 @@ def _cmd_drill(args) -> int:
 
         rec = run_scale_drill(agents=args.agents, end_year=end_year)
         rec.pop("supervisor_events", None)
+        rec = _locktrace_verdict(rec)
         print(json.dumps(rec, indent=1))
         return 0 if rec["ok"] else 1
     if args.serve_fleet:
@@ -146,6 +176,7 @@ def _cmd_drill(args) -> int:
         )
         # the event/boot detail is for logs, not the summary line
         rec.pop("supervisor_events", None)
+        rec = _locktrace_verdict(rec)
         print(json.dumps(rec, indent=1))
         return 0 if rec["ok"] else 1
     root = args.root or tempfile.mkdtemp(prefix="dgen-fault-drill-")
@@ -161,6 +192,7 @@ def _cmd_drill(args) -> int:
     rec = run_drill(
         root, n_agents=args.agents, end_year=end_year, specs=specs,
     )
+    rec = _locktrace_verdict(rec)
     print(json.dumps(rec, indent=1))
     return 0 if rec["ok"] else 1
 

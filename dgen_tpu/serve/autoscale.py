@@ -84,6 +84,9 @@ class Autoscaler:
         self._last_action_at: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # guards the ledger + counters: tick() runs on the control
+        # thread, stats() on whoever asks (bench, metricz, tests)
+        self._lock = threading.Lock()
         self.events: List[dict] = []
         self.n_scale_up = 0
         self.n_scale_down = 0
@@ -92,7 +95,8 @@ class Autoscaler:
 
     def _record(self, action: str, **detail) -> None:
         rec = {"t": round(time.time(), 3), "action": action, **detail}
-        self.events.append(rec)
+        with self._lock:
+            self.events.append(rec)
         self.sup._event(-1, f"autoscale_{action}", **detail)
 
     def _in_cooldown(self, now: float) -> bool:
@@ -130,7 +134,8 @@ class Autoscaler:
             if sustained and not self._in_cooldown(now) \
                     and n < cfg.max_replicas:
                 self.sup.add_replica()
-                self.n_scale_up += 1
+                with self._lock:
+                    self.n_scale_up += 1
                 self._last_action_at = now
                 self._pressure_since = None
                 self._record(
@@ -150,7 +155,8 @@ class Autoscaler:
                 if victim is not None and self.sup.retire_replica(
                     victim, drain_timeout_s=cfg.drain_timeout_s
                 ):
-                    self.n_scale_down += 1
+                    with self._lock:
+                        self.n_scale_down += 1
                     self._last_action_at = now
                     self._idle_since = None
                     self._record(
@@ -196,12 +202,14 @@ class Autoscaler:
             self._thread.join(timeout=5.0)
 
     def stats(self) -> dict:
-        return {
-            "enabled": True,
-            "min_replicas": self.config.min_replicas,
-            "max_replicas": self.config.max_replicas,
-            "live_replicas": self.sup.live_count(),
-            "scale_ups": self.n_scale_up,
-            "scale_downs": self.n_scale_down,
-            "events": list(self.events),
-        }
+        live = self.sup.live_count()
+        with self._lock:
+            return {
+                "enabled": True,
+                "min_replicas": self.config.min_replicas,
+                "max_replicas": self.config.max_replicas,
+                "live_replicas": live,
+                "scale_ups": self.n_scale_up,
+                "scale_downs": self.n_scale_down,
+                "events": list(self.events),
+            }

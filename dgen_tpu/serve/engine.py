@@ -325,10 +325,13 @@ class ServeEngine:
         return self._result_cache
 
     def attach_surface(self, surface) -> None:
-        self._surface = surface
+        # boot-time arming: called once before the HTTP server starts,
+        # then read-only; the rebind itself is one GIL-atomic store
+        self._surface = surface  # dgenlint: disable=C1
 
     def attach_result_cache(self, cache) -> None:
-        self._result_cache = cache
+        # boot-time arming, same contract as attach_surface
+        self._result_cache = cache  # dgenlint: disable=C1
 
     def serve_stats(self) -> dict:
         """Surface/cache counters for /metricz (empty when neither

@@ -465,8 +465,11 @@ class ReplicaSupervisor:
         self._event(h.index, "died", exit_code=rc,
                     restart_in_s=round(backoff, 3))
 
-    def _boot_timeout(self, h: ReplicaHandle) -> None:
-        self._event(h.index, "boot_timeout")
+    def _kill_boot_timeout(self, h: ReplicaHandle):
+        """The blocking half of a boot timeout.  Runs OUTSIDE
+        self._lock: kill + wait can block up to 10 s, and nothing that
+        long may run under the supervisor lock (the same rule as the
+        readiness probes — see _tick)."""
         rc = None
         if h.proc is not None:
             if h.proc.poll() is None:
@@ -476,7 +479,7 @@ class ReplicaSupervisor:
                 except subprocess.TimeoutExpired:
                     pass   # unkillable (D-state); poll again next tick
             rc = h.proc.poll()
-        self._on_death(h, rc)
+        return rc
 
     def _tick(self) -> None:
         # readiness probes are network round-trips (up to 2 s); run
@@ -492,6 +495,7 @@ class ReplicaSupervisor:
         probe_ok = {h.index: self._probe_ready(port)
                     for h, port in to_probe}
         now = time.monotonic()
+        timed_out = []
         with self._lock:
             for h in self.replicas:
                 if h.state == STOPPED:
@@ -518,7 +522,8 @@ class ReplicaSupervisor:
                             h.state = BOOTING
                             self._event(h.index, "bound", port=h.port)
                     elif now - h.spawned_at > self.config.boot_timeout_s:
-                        self._boot_timeout(h)
+                        self._event(h.index, "boot_timeout")
+                        timed_out.append(h)
                 elif h.state == BOOTING:
                     if probe_ok.get(h.index, False):
                         h.state = READY
@@ -535,10 +540,20 @@ class ReplicaSupervisor:
                             ),
                         )
                     elif now - h.spawned_at > self.config.boot_timeout_s:
-                        self._boot_timeout(h)
+                        self._event(h.index, "boot_timeout")
+                        timed_out.append(h)
                 elif h.state == BACKOFF:
                     if h.restart_at is not None and now >= h.restart_at:
                         self._spawn(h)
+        # kill + reap outside the lock (blocking, up to 10 s each),
+        # then reacquire for the state transition.  Only this thread
+        # mutates states, but recheck anyway: retire_replica() may
+        # have STOPPED the handle between the two critical sections.
+        for h in timed_out:
+            rc = self._kill_boot_timeout(h)
+            with self._lock:
+                if h.state in (SPAWNING, BOOTING):
+                    self._on_death(h, rc)
 
     def _monitor_loop(self) -> None:
         while not self._stopping:

@@ -146,7 +146,7 @@ class FleetFront:
         self._breakers: Dict[int, CircuitBreaker] = {}
         self._rr = itertools.count()
         self._lock = threading.Lock()
-        self._closed = False
+        self._closed = threading.Event()
         self._scrape_thread: Optional[threading.Thread] = None
         #: pooled keep-alive connections for forwards + scrapes: the
         #: steady-state front->replica hop pays no TCP handshake
@@ -192,7 +192,7 @@ class FleetFront:
         return self
 
     def _scrape_loop(self) -> None:
-        while not self._closed:
+        while not self._closed.is_set():
             ready = self.sup.ready_handles()
             for h in ready:
                 payload = self._scrape_one(h.port)
@@ -220,9 +220,11 @@ class FleetFront:
         live = self.sup.live_indices()
         for i in [i for i in list(self._metricz) if i not in live]:
             self._metricz.pop(i, None)
-        for i in [i for i in list(self._occ_prev) if i not in live]:
-            self._occ_prev.pop(i, None)
         with self._lock:
+            # _occ_prev is also written by pressure() on the control
+            # thread — pruning races with the window update otherwise
+            for i in [i for i in list(self._occ_prev) if i not in live]:
+                self._occ_prev.pop(i, None)
             for i in [i for i in self._breakers if i not in live]:
                 del self._breakers[i]
         for port in gone_ports:
@@ -286,23 +288,25 @@ class FleetFront:
         d_batches = 0
         d_occ_sum = 0.0
         saw_new_scrape = False
-        for i, (t, p) in fresh.items():
-            prev_t, pb, po = self._occ_prev.get(i, (None, 0, 0.0))
-            if prev_t is not None and t == prev_t:
-                continue   # same scrape as last pressure() call
-            saw_new_scrape = True
-            batches = int(p.get("batches", 0) or 0)
-            occ_sum = float(p.get("batch_occupancy") or 0.0) * batches
-            if batches >= pb:   # a restarted replica resets counters
-                d_batches += batches - pb
-                d_occ_sum += occ_sum - po
-            self._occ_prev[i] = (t, batches, occ_sum)
-        if saw_new_scrape:
-            occ = (d_occ_sum / d_batches) if d_batches > 0 else 0.0
-            self._held_occupancy = max(occ, 0.0)
+        with self._lock:   # vs the scrape thread's _prune_replica_state
+            for i, (t, p) in fresh.items():
+                prev_t, pb, po = self._occ_prev.get(i, (None, 0, 0.0))
+                if prev_t is not None and t == prev_t:
+                    continue   # same scrape as last pressure() call
+                saw_new_scrape = True
+                batches = int(p.get("batches", 0) or 0)
+                occ_sum = float(p.get("batch_occupancy") or 0.0) * batches
+                if batches >= pb:   # a restarted replica resets counters
+                    d_batches += batches - pb
+                    d_occ_sum += occ_sum - po
+                self._occ_prev[i] = (t, batches, occ_sum)
+            if saw_new_scrape:
+                occ = (d_occ_sum / d_batches) if d_batches > 0 else 0.0
+                self._held_occupancy = max(occ, 0.0)
+            held = self._held_occupancy
         return {
             "queue_frac": (depth / cap) if cap else 0.0,
-            "occupancy": self._held_occupancy,
+            "occupancy": held,
             "window_batches": d_batches,
             "ready_replicas": len(fresh),
         }
@@ -524,7 +528,7 @@ class FleetFront:
         return self._drain.wait_idle(timeout)
 
     def close(self) -> None:
-        self._closed = True
+        self._closed.set()
         self._pool.close()
 
 

@@ -1,0 +1,405 @@
+"""Runtime lock-order sentinel: instrumented ``threading.Lock``/``RLock``.
+
+The static concurrency tier (``python -m dgen_tpu.lint --conc``, rules
+C1-C6) proves lock *discipline* on the AST; this module proves lock
+*behaviour* at runtime.  :func:`arm` replaces the ``threading.Lock`` and
+``threading.RLock`` factories with wrappers that record, per thread:
+
+* the **held-set** — which locks the thread holds at each acquisition;
+* the **order graph** — an edge ``A -> B`` whenever a thread acquires
+  ``B`` while holding ``A`` (first sighting keeps a witness: thread
+  name plus a trimmed stack);
+* **contention stats** per lock *site* (acquisition count, total and
+  max wait, max hold) for the bench payloads;
+* **hold violations** — a lock held longer than the configured ceiling
+  while another thread was blocked on it (the PR 11
+  probe-under-the-supervisor-lock class, caught live).
+
+:func:`check` then fails on any cycle in the observed order graph (a
+real, witnessed deadlock *possibility* — two threads interleaving those
+stacks stop forever) or on hold violations.  The fleet, gang and
+serve-scale drills run with the sentinel armed via ``tools/check.sh``
+(``DGEN_TPU_LOCKTRACE=1`` -> :func:`arm_from_env`).
+
+Zero cost when disarmed: nothing is patched, every helper returns
+empty, and code that never calls :func:`arm` pays not one branch.
+Locks created *before* arming keep their raw C implementation and are
+simply invisible to the sentinel — arm first (the drills arm before
+the serving stack is constructed).
+
+Naming: a lock is named by its creation site (``file.py:lineno``), so
+every ``FleetFront`` instance's ``self._lock`` aggregates into one
+named series — which is what a contention report wants.  The aliasing
+is load-bearing for ordering too: nesting two *sibling* locks born at
+the same site records a self-edge, which is the account-transfer
+deadlock (same-class instances locked in no global order) and fails
+:func:`check` like any other cycle.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+# the raw factories, captured before any patching can happen
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+#: default hold-time ceiling (seconds) before a *contended* hold is a
+#: violation — generous enough for a compile-cache-warm batch step,
+#: far below the 2 s readiness-probe round-trip PR 11 evicted from
+#: under the supervisor lock.
+DEFAULT_HOLD_CEILING_S = 1.0
+
+_armed = False
+_hold_ceiling_s = DEFAULT_HOLD_CEILING_S
+_state = _ORIG_LOCK()          # leaf lock guarding the tables below
+_held = threading.local()      # per-thread list of _Held entries
+_edges: Dict[Tuple[str, str], dict] = {}
+_stats: Dict[str, dict] = {}
+_violations: List[dict] = []
+
+
+class _Held:
+    __slots__ = ("wrapper", "t_acq", "depth")
+
+    def __init__(self, wrapper, t_acq: float) -> None:
+        self.wrapper = wrapper
+        self.t_acq = t_acq
+        self.depth = 1
+
+
+def _held_stack() -> List[_Held]:
+    try:
+        return _held.stack
+    except AttributeError:
+        _held.stack = []
+        return _held.stack
+
+
+def _site_name() -> str:
+    """``file.py:lineno`` of the frame that called the lock factory,
+    skipping stdlib ``threading.py`` internals (Condition allocating
+    its RLock should name the Condition's creator, not threading.py)."""
+    f = sys._getframe(2)
+    while f is not None and os.path.basename(f.f_code.co_filename) in (
+        "threading.py", "locktrace.py",
+    ):
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _short_stack(skip: int = 2, limit: int = 8) -> List[str]:
+    frames = traceback.extract_stack()[: -skip][-limit:]
+    return [f"{os.path.basename(fr.filename)}:{fr.lineno}:{fr.name}"
+            for fr in frames]
+
+
+def _stat(name: str) -> dict:
+    s = _stats.get(name)
+    if s is None:
+        s = _stats[name] = {
+            "acquisitions": 0, "total_wait_s": 0.0,
+            "max_wait_s": 0.0, "max_hold_s": 0.0,
+        }
+    return s
+
+
+class _TracedLock:
+    """Wrapper around a raw lock: held-set + order + contention
+    recording.  The plain-Lock variant; deliberately does NOT define
+    ``_release_save``/``_acquire_restore``/``_is_owned`` so
+    ``threading.Condition`` falls back to acquire/release on it."""
+
+    _reentrant = False
+
+    def __init__(self, inner, name: str) -> None:
+        self._inner = inner
+        self._name = name
+        self._nwait = 0
+
+    # -- core bookkeeping ----------------------------------------------
+    def _on_acquired(self, waited: float) -> None:
+        stack = _held_stack()
+        if self._reentrant:
+            for h in stack:
+                if h.wrapper is self:
+                    h.depth += 1
+                    with _state:
+                        s = _stat(self._name)
+                        s["acquisitions"] += 1
+                    return
+        now = time.perf_counter()
+        new_edges = []
+        for h in stack:
+            if h.wrapper is not self:
+                key = (h.wrapper._name, self._name)
+                if key not in _edges:
+                    new_edges.append(key)
+        with _state:
+            s = _stat(self._name)
+            s["acquisitions"] += 1
+            s["total_wait_s"] += waited
+            if waited > s["max_wait_s"]:
+                s["max_wait_s"] = waited
+            for key in new_edges:
+                # first sighting of an order edge keeps the witness
+                _edges.setdefault(key, {
+                    "thread": threading.current_thread().name,
+                    "stack": _short_stack(skip=3),
+                })
+        stack.append(_Held(self, now))
+
+    def _on_release(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            h = stack[i]
+            if h.wrapper is self:
+                if self._reentrant and h.depth > 1:
+                    h.depth -= 1
+                    return
+                hold = time.perf_counter() - h.t_acq
+                del stack[i]
+                with _state:
+                    s = _stat(self._name)
+                    if hold > s["max_hold_s"]:
+                        s["max_hold_s"] = hold
+                    if hold > _hold_ceiling_s and self._nwait > 0:
+                        _violations.append({
+                            "lock": self._name,
+                            "hold_s": round(hold, 4),
+                            "ceiling_s": _hold_ceiling_s,
+                            "waiters": self._nwait,
+                            "thread": threading.current_thread().name,
+                            "stack": _short_stack(skip=3),
+                        })
+                return
+
+    # -- lock protocol --------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = time.perf_counter()
+        with _state:
+            self._nwait += 1
+        try:
+            got = self._inner.acquire(blocking, timeout)
+        finally:
+            with _state:
+                self._nwait -= 1
+        if got:
+            self._on_acquired(time.perf_counter() - t0)
+        return got
+
+    def release(self) -> None:
+        self._on_release()
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<locktrace {self._name} of {self._inner!r}>"
+
+
+class _TracedRLock(_TracedLock):
+    """RLock variant: reentrancy-aware, and Condition-compatible via
+    ``_release_save``/``_acquire_restore``/``_is_owned`` (Condition.wait
+    fully releases the lock — the held-set must drop the entry and
+    restore it with its depth on wakeup)."""
+
+    _reentrant = True
+
+    def _release_save(self):
+        stack = _held_stack()
+        depth = 1
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].wrapper is self:
+                depth = stack[i].depth
+                hold = time.perf_counter() - stack[i].t_acq
+                del stack[i]
+                with _state:
+                    s = _stat(self._name)
+                    if hold > s["max_hold_s"]:
+                        s["max_hold_s"] = hold
+                break
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, state):
+        inner_state, depth = state
+        self._inner._acquire_restore(inner_state)
+        h = _Held(self, time.perf_counter())
+        h.depth = depth
+        _held_stack().append(h)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def _lock_factory():
+    return _TracedLock(_ORIG_LOCK(), _site_name())
+
+
+def _rlock_factory():
+    return _TracedRLock(_ORIG_RLOCK(), _site_name())
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def arm(hold_ceiling_s: Optional[float] = None) -> None:
+    """Patch the ``threading.Lock``/``RLock`` factories; idempotent.
+    Locks created from here on are traced."""
+    global _armed, _hold_ceiling_s
+    if hold_ceiling_s is not None:
+        _hold_ceiling_s = float(hold_ceiling_s)
+    if _armed:
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _armed = True
+
+
+def disarm() -> None:
+    """Restore the raw factories (recorded data is kept — call
+    :func:`reset` to drop it).  Already-created traced locks keep
+    working; they just stop being joined by new ones."""
+    global _armed
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _armed = False
+
+
+def is_armed() -> bool:
+    return _armed
+
+
+def arm_from_env(env: str = "DGEN_TPU_LOCKTRACE") -> bool:
+    """Arm when ``$DGEN_TPU_LOCKTRACE`` is a truthy value ("", "0",
+    "false" hold fire); ceiling override via
+    ``$DGEN_TPU_LOCKTRACE_HOLD_S``.  Returns whether armed."""
+    val = os.environ.get(env, "").strip().lower()
+    if val in ("", "0", "false", "no"):
+        return False
+    ceiling = os.environ.get(f"{env}_HOLD_S")
+    arm(float(ceiling) if ceiling else None)
+    return True
+
+
+def reset() -> None:
+    """Drop all recorded edges/stats/violations (stays armed)."""
+    with _state:
+        _edges.clear()
+        _stats.clear()
+        del _violations[:]
+
+
+def stats() -> Dict[str, dict]:
+    """Per-named-lock ``{acquisitions, total_wait_s, max_wait_s,
+    max_hold_s}`` (names are creation sites, ``file.py:lineno``)."""
+    with _state:
+        return {
+            k: dict(v, total_wait_s=round(v["total_wait_s"], 6),
+                    max_wait_s=round(v["max_wait_s"], 6),
+                    max_hold_s=round(v["max_hold_s"], 6))
+            for k, v in sorted(_stats.items())
+        }
+
+
+def order_edges() -> List[Tuple[str, str]]:
+    with _state:
+        return sorted(_edges.keys())
+
+
+def _find_cycle() -> Optional[List[str]]:
+    """One cycle in the observed order graph (DFS back-edge), as the
+    node list ``[a, b, ..., a]``; None when acyclic."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in order_edges():
+        graph.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    path: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GREY
+        path.append(n)
+        for m in graph.get(n, ()):  # noqa: B023 — local recursion
+            c = color.get(m, WHITE)
+            if c == GREY:
+                return path[path.index(m):] + [m]
+            if c == WHITE:
+                found = dfs(m)
+                if found:
+                    return found
+        path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in list(graph):
+        if color.get(n, WHITE) == WHITE:
+            found = dfs(n)
+            if found:
+                return found
+    return None
+
+
+def check() -> dict:
+    """The sentinel's verdict: ``ok`` is False on any observed
+    lock-order cycle or hold violation; the report carries the witness
+    (thread, stack, lock names) for each."""
+    cycle = _find_cycle()
+    witnesses = []
+    if cycle:
+        with _state:
+            for a, b in zip(cycle, cycle[1:]):
+                w = _edges.get((a, b))
+                if w:
+                    witnesses.append({"edge": [a, b], **w})
+    with _state:
+        violations = [dict(v) for v in _violations]
+    return {
+        "ok": cycle is None and not violations,
+        "armed": _armed,
+        "hold_ceiling_s": _hold_ceiling_s,
+        "cycle": cycle,
+        "cycle_witnesses": witnesses,
+        "hold_violations": violations,
+        "locks": stats(),
+        "n_edges": len(order_edges()),
+    }
+
+
+def format_report(report: dict) -> str:
+    """Human lines for a failing :func:`check` (drill logs)."""
+    lines: List[str] = []
+    if report.get("cycle"):
+        lines.append(
+            "locktrace: LOCK-ORDER CYCLE " + " -> ".join(report["cycle"])
+        )
+        for w in report.get("cycle_witnesses", ()):
+            a, b = w["edge"]
+            lines.append(f"  edge {a} -> {b}  [thread {w['thread']}]")
+            for fr in w.get("stack", ()):
+                lines.append(f"    {fr}")
+    for v in report.get("hold_violations", ()):
+        lines.append(
+            f"locktrace: HOLD VIOLATION {v['lock']} held "
+            f"{v['hold_s']}s > {v['ceiling_s']}s with {v['waiters']} "
+            f"waiter(s)  [thread {v['thread']}]"
+        )
+        for fr in v.get("stack", ()):
+            lines.append(f"    {fr}")
+    return "\n".join(lines)

@@ -1,0 +1,47 @@
+"""C1 fixture: state written on a background thread, read caller-side,
+with the class lock never taken (the PR 9 metricz-dict race shape)."""
+
+import threading
+
+
+class Ticker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.events = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            # C1: written on the ticker thread, read from stats()
+            self.count += 1
+            self.events.append({"n": self.count})
+
+    def stats(self):
+        return {"count": self.count, "events": list(self.events)}
+
+
+class GuardedTicker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:   # fine: every access under the lock
+                self.count += 1
+
+    def stats(self):
+        with self._lock:
+            return {"count": self.count}

@@ -1,0 +1,45 @@
+"""C2 fixture: blocking calls while a lock is held (the PR 11
+probe-under-supervisor-lock class)."""
+
+import subprocess
+import threading
+import time
+
+
+class Prober:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.healthy = {}
+
+    def probe_all(self, ports):
+        with self._lock:
+            for port in ports:
+                # C2: a network round-trip under the lock — every
+                # reader of self._lock stalls behind the slowest probe
+                self.healthy[port] = self._probe(port)
+
+    def _probe(self, port):
+        from dgen_tpu.io.hostio import http_json
+        status, _, _ = http_json(port, "/healthz", timeout=2.0)
+        return status == 200
+
+    def backoff_then_clear(self):
+        with self._lock:
+            time.sleep(0.5)   # C2: sleeping while holding the lock
+            self.healthy.clear()
+
+    def reap(self, proc):
+        with self._lock:
+            proc.wait(timeout=10.0)   # C2: child reap under the lock
+
+    def shell_out(self):
+        with self._lock:
+            subprocess.run(["true"])   # C2: subprocess under the lock
+
+    def probe_all_snapshot(self, ports):
+        # fine: snapshot under lock -> probe outside -> reacquire
+        with self._lock:
+            todo = list(ports)
+        results = {p: self._probe(p) for p in todo}
+        with self._lock:
+            self.healthy.update(results)

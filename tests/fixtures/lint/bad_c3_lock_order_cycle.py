@@ -1,0 +1,50 @@
+"""C3 fixture: two locks acquired in opposite orders on different
+paths — the classic AB/BA deadlock — plus a non-reentrant
+self-re-acquire."""
+
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._journal = threading.Lock()
+
+    def debit(self, n):
+        with self._accounts:
+            with self._journal:   # order: accounts -> journal
+                return n
+
+    def audit(self):
+        with self._journal:
+            with self._accounts:   # C3: journal -> accounts (cycle)
+                return True
+
+
+class Reacquire:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            return self._inner()
+
+    def _inner(self):
+        with self._lock:   # C3: non-reentrant Lock re-acquired
+            return 1
+
+
+class Nested:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def consistent(self):
+        with self._a:
+            with self._b:   # fine: every path agrees a -> b
+                return 0
+
+    def also_consistent(self):
+        with self._a:
+            with self._b:
+                return 1

@@ -1,0 +1,31 @@
+"""C4 fixture: non-atomic check-then-act on a shared container — the
+check and the act race between threads unless both sit under the
+lock."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = {}
+
+    def claim(self, key, owner):
+        # C4: membership test then insert, lock never taken — two
+        # threads can both pass the check and both "win" the slot
+        if key not in self._slots:
+            self._slots[key] = owner
+            return True
+        return False
+
+    def release(self, key):
+        with self._lock:   # the attr IS locked elsewhere: it's shared
+            self._slots.pop(key, None)
+
+    def claim_atomic(self, key, owner):
+        # fine: check and act inside one critical section
+        with self._lock:
+            if key not in self._slots:
+                self._slots[key] = owner
+                return True
+            return False

@@ -1,0 +1,38 @@
+"""C5 fixture: unsafe lazy-init — the None-check and the build race,
+so two threads can construct (and leak) two engines."""
+
+import threading
+
+
+class Holder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._engine = None
+
+    def engine(self):
+        # C5: check outside the lock, build outside the lock
+        if self._engine is None:
+            self._engine = object()
+        return self._engine
+
+    def reset(self):
+        with self._lock:   # locked elsewhere: the attr is shared
+            self._engine = None
+
+
+class SafeHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._engine = None
+
+    def engine(self):
+        # fine: double-checked — rechecked under the lock before build
+        if self._engine is None:
+            with self._lock:
+                if self._engine is None:
+                    self._engine = object()
+        return self._engine
+
+    def reset(self):
+        with self._lock:
+            self._engine = None

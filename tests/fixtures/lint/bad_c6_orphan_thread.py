@@ -1,0 +1,27 @@
+"""C6 fixture: threads started with no owner — neither daemon= (dies
+with the process) nor a join path (reaped on shutdown) — so process
+exit can hang forever on a forgotten worker."""
+
+import threading
+
+
+def fire_and_forget(work):
+    # C6: anonymous non-daemon thread, never joined
+    threading.Thread(target=work).start()
+
+
+class Pool:
+    def __init__(self, work):
+        # C6: assigned but the class never joins it and never marks
+        # it daemon — shutdown blocks on this thread
+        self._orphan = threading.Thread(target=work)
+        self._orphan.start()
+        # fine: daemon thread dies with the process
+        self._bg = threading.Thread(target=work, daemon=True)
+        self._bg.start()
+        # fine: joined in stop()
+        self._worker = threading.Thread(target=work)
+        self._worker.start()
+
+    def stop(self):
+        self._worker.join(timeout=5.0)

@@ -1,0 +1,460 @@
+"""dgenlint-conc unit tests: every C rule with at least one positive
+(known-bad snippet -> finding) and one negative (idiomatic code ->
+clean), thread-entry inference, suppression comments, the allowlist,
+the fixture files, the CLI, and — the enforcement contract — the
+concurrent host surface of dgen_tpu linting clean."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dgen_tpu.lint.conc import (
+    LOCKFREE_ALLOWLIST,
+    lint_conc_paths,
+    lint_conc_source,
+)
+from dgen_tpu.lint.conc_ids import CONC_RULE_SUMMARIES
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "lint"
+)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HEADER = "import threading\nimport time\n"
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# C1 — cross-thread write without the class lock
+# ---------------------------------------------------------------------------
+
+C1_BAD = HEADER + (
+    "class Ticker:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.count = 0\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._loop, daemon=True).start()\n"
+    "    def _loop(self):\n"
+    "        self.count += 1\n"
+    "    def stats(self):\n"
+    "        return self.count\n"
+)
+
+
+def test_c1_positive_thread_write_caller_read():
+    hits = [f for f in lint_conc_source(C1_BAD) if f.rule == "C1"]
+    assert len(hits) == 1 and hits[0].line == 10
+
+
+def test_c1_negative_both_sides_locked():
+    src = C1_BAD.replace(
+        "        self.count += 1\n",
+        "        with self._lock:\n            self.count += 1\n",
+    ).replace(
+        "        return self.count\n",
+        "        with self._lock:\n            return self.count\n",
+    )
+    assert "C1" not in rules_of(lint_conc_source(src))
+
+
+def test_c1_negative_init_writes_are_exempt():
+    src = HEADER + (
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self.state = {}\n"
+        "        threading.Thread(target=self._go, daemon=True).start()\n"
+        "    def _go(self):\n"
+        "        return len(self.state)\n"
+    )
+    assert "C1" not in rules_of(lint_conc_source(src))
+
+
+def test_c1_executor_submit_is_a_thread_entry():
+    src = HEADER + (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class Fan:\n"
+        "    def __init__(self):\n"
+        "        self.done = []\n"
+        "        self._ex = ThreadPoolExecutor(4)\n"
+        "    def go(self):\n"
+        "        self._ex.submit(self._work)\n"
+        "    def _work(self):\n"
+        "        self.done.append(1)\n"
+        "    def report(self):\n"
+        "        return list(self.done)\n"
+    )
+    hits = [f for f in lint_conc_source(src) if f.rule == "C1"]
+    assert hits and hits[0].line == 11
+
+
+def test_c1_handler_classes_are_per_connection():
+    """http.server builds one handler INSTANCE per connection: self.*
+    is per-thread, never shared."""
+    src = HEADER + (
+        "class MyHandler:\n"
+        "    def do_GET(self):\n"
+        "        self.n = 1\n"
+        "    def do_POST(self):\n"
+        "        return self.n\n"
+    )
+    assert "C1" not in rules_of(lint_conc_source(src))
+
+
+def test_c1_event_attrs_are_internally_synchronized():
+    src = HEADER + (
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._stop = threading.Event()\n"
+        "        threading.Thread(target=self._go, daemon=True).start()\n"
+        "    def _go(self):\n"
+        "        while not self._stop.is_set():\n"
+        "            pass\n"
+        "    def stop(self):\n"
+        "        self._stop.set()\n"
+    )
+    assert "C1" not in rules_of(lint_conc_source(src))
+
+
+def test_c1_suppression_comment_with_why():
+    src = C1_BAD.replace(
+        "        self.count += 1\n",
+        "        # single writer, reader tolerates staleness\n"
+        "        self.count += 1  # dgenlint: disable=C1\n",
+    )
+    assert "C1" not in rules_of(lint_conc_source(src))
+
+
+def test_allowlist_entries_carry_their_why():
+    assert "FleetFront._metricz" in LOCKFREE_ALLOWLIST
+    for why in LOCKFREE_ALLOWLIST.values():
+        assert len(why) > 20   # a real safety argument, not a shrug
+
+
+# ---------------------------------------------------------------------------
+# C2 — blocking call under a lock
+# ---------------------------------------------------------------------------
+
+def test_c2_positive_sleep_and_probe_under_lock():
+    src = HEADER + (
+        "from dgen_tpu.io.hostio import http_json\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.5)\n"
+        "    def b(self, port):\n"
+        "        with self._lock:\n"
+        "            http_json(port, '/healthz', timeout=2.0)\n"
+    )
+    hits = [f for f in lint_conc_source(src) if f.rule == "C2"]
+    assert {h.line for h in hits} == {9, 12}
+
+
+def test_c2_interprocedural_one_level():
+    src = HEADER + (
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self._inner()\n"
+        "    def _inner(self):\n"
+        "        time.sleep(1.0)\n"
+    )
+    hits = [f for f in lint_conc_source(src) if f.rule == "C2"]
+    assert hits and hits[0].line == 8
+
+
+def test_c2_negative_snapshot_then_act():
+    src = HEADER + (
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.todo = []\n"
+        "    def run(self):\n"
+        "        with self._lock:\n"
+        "            todo = list(self.todo)\n"
+        "        for _ in todo:\n"
+        "            time.sleep(0.01)\n"
+    )
+    assert "C2" not in rules_of(lint_conc_source(src))
+
+
+def test_c2_negative_condition_wait_releases_its_lock():
+    src = HEADER + (
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self.items = []\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            while not self.items:\n"
+        "                self._cv.wait(1.0)\n"
+        "            return self.items.pop()\n"
+    )
+    assert "C2" not in rules_of(lint_conc_source(src))
+
+
+def test_c2_nonblocking_queue_ops_are_fine():
+    src = HEADER + (
+        "import queue\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "    def drain(self):\n"
+        "        with self._lock:\n"
+        "            return self._q.get(block=False)\n"
+    )
+    assert "C2" not in rules_of(lint_conc_source(src))
+
+
+# ---------------------------------------------------------------------------
+# C3 — lock-order cycles / self-deadlock
+# ---------------------------------------------------------------------------
+
+def test_c3_positive_ab_ba_cycle():
+    src = HEADER + (
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def x(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def y(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    hits = [f for f in lint_conc_source(src) if f.rule == "C3"]
+    assert len(hits) == 2
+    assert all("cycle" in h.message for h in hits)
+
+
+def test_c3_positive_nonreentrant_reacquire_via_helper():
+    src = HEADER + (
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self._inner()\n"
+        "    def _inner(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    hits = [f for f in lint_conc_source(src) if f.rule == "C3"]
+    assert hits and "deadlocks against itself" in hits[0].message
+
+
+def test_c3_negative_rlock_reacquire_and_consistent_order():
+    src = HEADER + (
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            with self._b:\n"
+        "                self._inner()\n"
+        "    def _inner(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    assert "C3" not in rules_of(lint_conc_source(src))
+
+
+# ---------------------------------------------------------------------------
+# C4 — check-then-act outside a lock
+# ---------------------------------------------------------------------------
+
+C4_BAD = HEADER + (
+    "class Reg:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._slots = {}\n"
+    "    def claim(self, k):\n"
+    "        if k not in self._slots:\n"
+    "            self._slots[k] = 1\n"
+    "    def drop(self, k):\n"
+    "        with self._lock:\n"
+    "            self._slots.pop(k, None)\n"
+)
+
+
+def test_c4_positive_membership_then_insert():
+    hits = [f for f in lint_conc_source(C4_BAD) if f.rule == "C4"]
+    assert hits and hits[0].line == 8
+
+
+def test_c4_negative_pair_under_lock():
+    src = C4_BAD.replace(
+        "        if k not in self._slots:\n"
+        "            self._slots[k] = 1\n",
+        "        with self._lock:\n"
+        "            if k not in self._slots:\n"
+        "                self._slots[k] = 1\n",
+    )
+    assert "C4" not in rules_of(lint_conc_source(src))
+
+
+def test_c4_negative_unshared_attr():
+    """No lock anywhere, no second thread group: private state."""
+    src = HEADER + (
+        "class Memo:\n"
+        "    def __init__(self):\n"
+        "        self._seen = {}\n"
+        "    def visit(self, k):\n"
+        "        if k not in self._seen:\n"
+        "            self._seen[k] = 1\n"
+    )
+    assert "C4" not in rules_of(lint_conc_source(src))
+
+
+# ---------------------------------------------------------------------------
+# C5 — lazy init / double-checked locking
+# ---------------------------------------------------------------------------
+
+C5_BAD = HEADER + (
+    "class H:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._engine = None\n"
+    "    def engine(self):\n"
+    "        if self._engine is None:\n"
+    "            self._engine = object()\n"
+    "        return self._engine\n"
+    "    def reset(self):\n"
+    "        with self._lock:\n"
+    "            self._engine = None\n"
+)
+
+
+def test_c5_positive_unlocked_lazy_init():
+    hits = [f for f in lint_conc_source(C5_BAD) if f.rule == "C5"]
+    assert hits and hits[0].line == 8
+
+
+def test_c5_negative_check_lock_recheck():
+    src = C5_BAD.replace(
+        "        if self._engine is None:\n"
+        "            self._engine = object()\n",
+        "        if self._engine is None:\n"
+        "            with self._lock:\n"
+        "                if self._engine is None:\n"
+        "                    self._engine = object()\n",
+    )
+    assert "C5" not in rules_of(lint_conc_source(src))
+
+
+def test_c5_negative_single_thread_hysteresis_state():
+    """The autoscaler pattern: None-windows touched by the control
+    thread alone (no lock, no second group) are not lazy init."""
+    src = HEADER + (
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._since = None\n"
+        "        threading.Thread(target=self._loop, daemon=True).start()\n"
+        "    def _loop(self):\n"
+        "        if self._since is None:\n"
+        "            self._since = time.monotonic()\n"
+    )
+    assert "C5" not in rules_of(lint_conc_source(src))
+
+
+# ---------------------------------------------------------------------------
+# C6 — orphan threads
+# ---------------------------------------------------------------------------
+
+def test_c6_positive_fire_and_forget():
+    src = HEADER + (
+        "def go(work):\n"
+        "    threading.Thread(target=work).start()\n"
+    )
+    hits = [f for f in lint_conc_source(src) if f.rule == "C6"]
+    assert hits and hits[0].line == 4
+
+
+def test_c6_negative_daemon_or_joined():
+    src = HEADER + (
+        "class P:\n"
+        "    def __init__(self, work):\n"
+        "        self._bg = threading.Thread(target=work, daemon=True)\n"
+        "        self._bg.start()\n"
+        "        self._w = threading.Thread(target=work)\n"
+        "        self._w.start()\n"
+        "    def stop(self):\n"
+        "        self._w.join(timeout=5.0)\n"
+    )
+    assert "C6" not in rules_of(lint_conc_source(src))
+
+
+# ---------------------------------------------------------------------------
+# fixtures, codebase, CLI
+# ---------------------------------------------------------------------------
+
+def test_bad_fixture_files_each_trigger_their_rule():
+    findings = lint_conc_paths([FIXTURES])
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, set()).add(os.path.basename(f.path))
+    for n, rid in enumerate(sorted(CONC_RULE_SUMMARIES), start=1):
+        assert rid in by_rule, f"{rid} not triggered by its fixture"
+        assert any(p.startswith(f"bad_c{n}_") for p in by_rule[rid]), (
+            f"{rid} did not fire in its own fixture: {by_rule[rid]}"
+        )
+
+
+def test_concurrent_host_surface_is_clean():
+    """The enforcement contract: serve/, resilience/, hostio, timing
+    and parallel/ lint conc-clean, so any new finding is a regression
+    introduced by the change under review."""
+    findings = lint_conc_paths()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_select_rejects_unknown_rule_ids():
+    with pytest.raises(ValueError, match="unknown conc rule"):
+        lint_conc_source(C1_BAD, select=["C99"])
+
+
+def test_cli_conc_exit_codes_and_output():
+    bad = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.lint", "--conc", FIXTURES],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert bad.returncode == 1
+    assert "C1" in bad.stdout and "findings" in bad.stderr
+
+    clean = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.lint", "--conc"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+
+def test_cli_conc_conflicts_with_programs_mode():
+    r = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.lint", "--conc", "--programs"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 2
+
+
+def test_cli_list_rules_includes_conc_tier():
+    r = subprocess.run(
+        [sys.executable, "-m", "dgen_tpu.lint", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0
+    for rid in CONC_RULE_SUMMARIES:
+        assert rid in r.stdout

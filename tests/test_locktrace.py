@@ -1,0 +1,235 @@
+"""Runtime lock-order sentinel tests (dgen_tpu.utils.locktrace):
+zero-cost-when-disarmed, factory patching, contention stats, the
+observed order graph with cycle witnesses, hold-time violations, and
+Condition/RLock compatibility (the shim must not break the stdlib
+synchronization primitives it wraps)."""
+
+import threading
+import time
+
+import pytest
+
+from dgen_tpu.utils import locktrace
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    """Every test starts and ends disarmed with empty tables — the
+    factories are process globals and must never leak across tests."""
+    locktrace.disarm()
+    locktrace.reset()
+    yield
+    locktrace.disarm()
+    locktrace.reset()
+
+
+# ---------------------------------------------------------------------------
+# arming / disarming
+# ---------------------------------------------------------------------------
+
+def test_disarmed_is_invisible():
+    assert not locktrace.is_armed()
+    assert threading.Lock is locktrace._ORIG_LOCK
+    lk = threading.Lock()
+    with lk:
+        pass
+    assert locktrace.stats() == {}
+    assert locktrace.order_edges() == []
+    rep = locktrace.check()
+    assert rep["ok"] and not rep["armed"]
+
+
+def test_arm_patches_factories_and_disarm_restores():
+    locktrace.arm()
+    assert locktrace.is_armed()
+    lk = threading.Lock()
+    assert isinstance(lk, locktrace._TracedLock)
+    rlk = threading.RLock()
+    assert isinstance(rlk, locktrace._TracedRLock)
+    locktrace.disarm()
+    assert threading.Lock is locktrace._ORIG_LOCK
+    assert threading.RLock is locktrace._ORIG_RLOCK
+    # locks created while armed keep working after disarm
+    with lk, rlk:
+        pass
+
+
+def test_arm_from_env_falsy_and_truthy(monkeypatch):
+    for v in ("", "0", "false", "no"):
+        monkeypatch.setenv("DGEN_TPU_LOCKTRACE", v)
+        assert not locktrace.arm_from_env()
+        assert not locktrace.is_armed()
+    monkeypatch.setenv("DGEN_TPU_LOCKTRACE", "1")
+    monkeypatch.setenv("DGEN_TPU_LOCKTRACE_HOLD_S", "2.5")
+    assert locktrace.arm_from_env()
+    assert locktrace.is_armed()
+    assert locktrace.check()["hold_ceiling_s"] == 2.5
+
+
+# ---------------------------------------------------------------------------
+# stats + naming
+# ---------------------------------------------------------------------------
+
+def test_stats_count_acquisitions_by_creation_site():
+    locktrace.arm()
+    lk = threading.Lock()
+    for _ in range(5):
+        with lk:
+            pass
+    st = locktrace.stats()
+    (name, rec), = st.items()
+    assert name.startswith("test_locktrace.py:")
+    assert rec["acquisitions"] == 5
+    assert rec["max_hold_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# order graph: edges, cycles, witnesses
+# ---------------------------------------------------------------------------
+
+def test_consistent_order_is_ok():
+    locktrace.arm()
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = locktrace.check()
+    assert rep["ok"]
+    assert rep["n_edges"] == 1
+    assert rep["cycle"] is None
+
+
+def test_injected_cycle_fails_with_witnesses():
+    """The AB/BA interleaving: each order individually completes, but
+    two threads running them concurrently can deadlock — the sentinel
+    must fail on the observed graph alone."""
+    locktrace.arm()
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab, name="ab-thread")
+    t1.start()
+    t1.join()
+    ba()
+    rep = locktrace.check()
+    assert not rep["ok"]
+    assert rep["cycle"] is not None
+    assert rep["cycle"][0] == rep["cycle"][-1]
+    # every cycle edge carries its witness: thread name + trimmed stack
+    assert rep["cycle_witnesses"]
+    for w in rep["cycle_witnesses"]:
+        assert w["thread"]
+        assert any("test_locktrace.py" in fr for fr in w["stack"])
+    text = locktrace.format_report(rep)
+    assert "LOCK-ORDER CYCLE" in text and "edge" in text
+
+
+def test_same_site_siblings_nested_is_the_transfer_hazard():
+    """Two locks born at the SAME creation site share a name, so
+    nesting one inside the other reads as a self-edge — which is
+    exactly the account-transfer deadlock (no global order between
+    same-class sibling locks) and must fail the check."""
+    locktrace.arm()
+    a, b = threading.Lock(), threading.Lock()   # one site, two locks
+    with a:
+        with b:
+            pass
+    rep = locktrace.check()
+    assert not rep["ok"]
+    assert rep["cycle"] is not None and len(set(rep["cycle"])) == 1
+
+
+# ---------------------------------------------------------------------------
+# hold violations
+# ---------------------------------------------------------------------------
+
+def test_contended_overlong_hold_is_a_violation():
+    locktrace.arm(hold_ceiling_s=0.05)
+    lk = threading.Lock()
+    entered = threading.Event()
+
+    def contender():
+        entered.set()
+        with lk:
+            pass
+
+    with lk:
+        t = threading.Thread(target=contender, name="contender")
+        t.start()
+        entered.wait(5.0)
+        time.sleep(0.2)   # hold well past the ceiling while t blocks
+    t.join(5.0)
+    rep = locktrace.check()
+    assert not rep["ok"]
+    (v,) = [v for v in rep["hold_violations"] if v["waiters"] > 0]
+    assert v["hold_s"] > 0.05
+    assert "HOLD VIOLATION" in locktrace.format_report(rep)
+
+
+def test_uncontended_long_hold_is_fine():
+    """Ceiling applies only while someone is BLOCKED on the lock — a
+    long quiet hold stalls nobody."""
+    locktrace.arm(hold_ceiling_s=0.05)
+    lk = threading.Lock()
+    with lk:
+        time.sleep(0.1)
+    assert locktrace.check()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# stdlib compatibility: RLock reentrancy, Condition.wait
+# ---------------------------------------------------------------------------
+
+def test_rlock_reentrancy_counts_one_held_entry():
+    locktrace.arm()
+    rlk = threading.RLock()
+    with rlk:
+        with rlk:
+            assert len([h for h in locktrace._held_stack()
+                        if h.wrapper is rlk]) == 1
+        assert rlk._is_owned()
+    assert locktrace.stats()[rlk._name]["acquisitions"] == 2
+
+
+def test_condition_wait_notify_roundtrip():
+    """Condition allocates its lock via the patched RLock factory;
+    wait() must fully release (dropping the held-set entry) and
+    restore on wakeup, or every waiter deadlocks the notifier."""
+    locktrace.arm()
+    cv = threading.Condition()
+    box = []
+
+    def producer():
+        with cv:
+            box.append(1)
+            cv.notify_all()
+
+    with cv:
+        threading.Thread(target=producer, name="producer").start()
+        got = cv.wait_for(lambda: box, timeout=5.0)
+    assert got and box == [1]
+    # the held-set is balanced: nothing left on this thread
+    assert not [h for h in locktrace._held_stack()]
+    assert locktrace.check()["ok"]
+
+
+def test_reset_drops_data_but_stays_armed():
+    locktrace.arm()
+    with threading.Lock():
+        pass
+    assert locktrace.stats()
+    locktrace.reset()
+    assert locktrace.stats() == {}
+    assert locktrace.is_armed()

@@ -13,6 +13,8 @@ separately, with the tolerance documented in docs/serve.md.
 
 import json
 import os
+import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -180,6 +182,64 @@ def test_submit_validates_on_caller_thread(engine):
         assert bat.stats()["queue_depth"] == 0
     finally:
         bat.close()
+
+
+def test_concurrent_producers_lose_and_duplicate_nothing(engine):
+    """N producer threads race M submits each through one batcher:
+    every accepted request resolves exactly once with its own agent's
+    row, and the queue accounting balances — requests == resolved,
+    rejected == observed rejections, final depth 0.  This is the
+    runtime contract behind the dgenlint C1/C4 audit of submit()'s
+    admission path."""
+    n_threads, per_thread = 8, 24
+    bat = Microbatcher(
+        engine,
+        ServeConfig(max_batch=8, min_bucket=1, max_wait_ms=5.0,
+                    max_queue=64, port=0),
+    )
+    futures = {}      # agent_id -> Future (ids are globally unique)
+    fut_lock = threading.Lock()
+    rejections = []
+    barrier = threading.Barrier(n_threads)
+
+    def produce(t):
+        barrier.wait()   # maximal contention on the first submit
+        for k in range(per_thread):
+            aid = t * per_thread + k
+            while True:
+                try:
+                    f = bat.submit([aid], year=2016)
+                except QueueFullError:
+                    rejections.append(aid)
+                    time.sleep(0.002)
+                    continue
+                with fut_lock:
+                    assert aid not in futures, f"duplicate accept {aid}"
+                    futures[aid] = f
+                break
+
+    threads = [threading.Thread(target=produce, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120.0)
+    try:
+        total = n_threads * per_thread
+        assert len(futures) == total
+        for aid, f in futures.items():
+            got = f.result(60.0)
+            assert list(got["agent_id"]) == [aid]
+    finally:
+        bat.close()
+    stats = bat.stats()
+    total = n_threads * per_thread
+    assert stats["requests"] == total     # every accept resolved once
+    assert stats["rows"] == total         # no lost or duplicated rows
+    # list.append is GIL-atomic, so the rejection tally is exact
+    assert stats["rejected"] == len(rejections)
+    assert stats["queue_depth"] == 0
+    assert stats["batches"] >= total // 8
 
 
 # ---------------------------------------------------------------------------

@@ -70,6 +70,14 @@ if [ "$mode" != "--test-only" ]; then
     # vs HBM budget) and J10 (per-mesh-shape program hashes)
     echo "== dgenlint-prog (python -m dgen_tpu.lint --programs --mesh) =="
     JAX_PLATFORMS=cpu python -m dgen_tpu.lint --programs --mesh || rc=1
+    # concurrency auditor (docs/lint.md "The concurrency tier"): rules
+    # C1-C6 over the threaded host surface (serve/, hostio, resilience,
+    # timing, parallel) — unguarded cross-thread writes, blocking calls
+    # under a lock, lock-order cycles, check-then-act races, unsafe
+    # lazy init, orphan threads. The runtime half (locktrace) runs
+    # armed in the fleet/gang/serve-scale drill legs below.
+    echo "== dgenlint-conc (python -m dgen_tpu.lint --conc) =="
+    python -m dgen_tpu.lint --conc || rc=1
     # supervisor smoke drill (docs/resilience.md): one injected
     # mid-run failure + one injected checkpoint-save failure must be
     # retried/resumed with bit-exact artifacts and a verifying
@@ -93,8 +101,14 @@ if [ "$mode" != "--test-only" ]; then
     # hang the other under closed-loop load, and assert self-healing —
     # every request answered bit-exactly vs a single-replica oracle,
     # full READY strength restored, zero steady-state compiles
+    # DGEN_TPU_LOCKTRACE=1 arms the runtime lock-order sentinel
+    # (dgen_tpu.utils.locktrace) for the fleet/scale/gang legs: any
+    # observed lock-order cycle or contended over-ceiling hold in the
+    # host-side supervisor/front/autoscaler fails the drill with a
+    # witness (thread, stack, lock names) on stderr
     echo "== serve fleet drill (python -m dgen_tpu.resilience drill --serve-fleet) =="
-    JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --serve-fleet \
+    JAX_PLATFORMS=cpu DGEN_TPU_LOCKTRACE=1 \
+        python -m dgen_tpu.resilience drill --serve-fleet \
         --replicas 2 --agents 64 --requests 60 >/tmp/_fleet.json || rc=1
     # serve autoscale+cache smoke (docs/serve.md "Production
     # throughput"): a 1-replica fleet scaled 1 -> 2 -> 1 by the
@@ -102,7 +116,8 @@ if [ "$mode" != "--test-only" ]; then
     # hit proven byte-identical to the engine answer and the retired
     # replica draining cleanly (never restarted, never counted dead)
     echo "== serve scale drill (python -m dgen_tpu.resilience drill --serve-scale) =="
-    JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --serve-scale \
+    JAX_PLATFORMS=cpu DGEN_TPU_LOCKTRACE=1 \
+        python -m dgen_tpu.resilience drill --serve-scale \
         --agents 64 >/tmp/_scale.json || rc=1
     # gang smoke drill (docs/resilience.md "Gang runbook"): a
     # 2-process jax.distributed CPU/gloo gang with worker 1 SIGKILLed
@@ -112,7 +127,8 @@ if [ "$mode" != "--test-only" ]; then
     # merged-manifest verify (the full P=4 -> P'=2 elastic drill runs
     # in the slow tier / tests/test_gang.py)
     echo "== gang drill smoke (python -m dgen_tpu.resilience drill --gang) =="
-    JAX_PLATFORMS=cpu python -m dgen_tpu.resilience drill --gang \
+    JAX_PLATFORMS=cpu DGEN_TPU_LOCKTRACE=1 \
+        python -m dgen_tpu.resilience drill --gang \
         --gang-processes 2 --gang-shrink 0 --no-gang-stall \
         --agents 48 --end-year 2016 >/tmp/_gang.json || rc=1
     # national-generator smoke (docs/userguide.md "National-scale

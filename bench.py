@@ -113,6 +113,12 @@ Knobs (env):
                           overlap target is measured against; stamps
                           walls, host_blocked_wall and
                           overlap_efficiency into the payload
+  DGEN_TPU_BENCH_GRAD     1: A/B the gradient sizing path
+                          (dgen_tpu.grad) — grid-search vs batched
+                          Newton wall on the same envs, objective-
+                          eval counts, kw parity vs xatol, plus one
+                          Gauss-Newton calibration round's loss
+                          curve (docs/grad.md)
 
 Weak/strong scaling curves vs DEVICE COUNT (1M/10M national tables,
 agent-years/sec, the SCALE_r*.json trajectory) live in their own
@@ -163,6 +169,8 @@ _BENCH_FAULTS = os.environ.get(
     "DGEN_TPU_BENCH_FAULTS", "") not in ("", "0", "false")
 _BENCH_SENTINEL = os.environ.get(
     "DGEN_TPU_BENCH_SENTINEL", "") not in ("", "0", "false")
+_BENCH_GRAD = os.environ.get(
+    "DGEN_TPU_BENCH_GRAD", "") not in ("", "0", "false")
 # "0"/"false" disable, same convention as the sibling flags above
 _BENCH_SERVE = os.environ.get("DGEN_TPU_BENCH_SERVE", "").strip()
 if _BENCH_SERVE in ("0", "false"):
@@ -492,6 +500,82 @@ def _sentinel_ab(n_agents: int) -> dict:
         "wall_on_s": round(on_s, 3),
         "overhead_frac": round(on_s / max(off_s, 1e-9) - 1.0, 4),
         "breaches": (sim.health_report or {}).get("breaches", {}),
+    }
+
+
+def _grad_ab(n_agents: int) -> dict:
+    """A/B the gradient sizing path (dgen_tpu.grad): the hard
+    grid-search fast path vs batched damped Newton on the smooth twin
+    over the SAME first-year envs — steady-state wall per sizing call,
+    the analytic objective-evaluation counts behind it (two
+    16-candidate refine rounds vs one coarse seed sweep plus one
+    value-and-grad kernel per Newton step), and kw parity. Plus one
+    small Gauss-Newton calibration round's convergence curve — the
+    trajectory's first end-to-end-differentiation numbers
+    (docs/grad.md)."""
+    import numpy as _np
+
+    from dgen_tpu.grad import calibrate, newton
+    from dgen_tpu.grad.__main__ import _world_envs
+    from dgen_tpu.ops import sizing as sizing_ops
+
+    # 64 rows: the unrolled Newton program (8 steps x (grad + jvp))
+    # costs minutes of fresh XLA:CPU compile at larger batch shapes,
+    # and the A/B is per-call wall + analytic eval counts, not scale
+    n = min(n_agents, 64)
+    envs, meta = _world_envs(n, 7, newton.DEFAULT_TAU)
+    p, y, nb = meta["n_periods"], meta["n_years"], meta["net_billing"]
+    iters = 8
+
+    def grid_call():
+        return sizing_ops.size_agents(
+            envs, n_periods=p, n_years=y, n_iters=iters,
+            net_billing=nb, impl="xla",
+        ).system_kw
+
+    def newton_call():
+        return newton.newton_size(
+            envs, p, y, soft_tau=newton.DEFAULT_TAU, net_billing=nb,
+        )
+
+    kw_g = grid_call()
+    kw_g.block_until_ready()            # compile warmup, both paths
+    res_n = newton_call()
+    res_n.system_kw.block_until_ready()
+    t0 = time.time()
+    grid_call().block_until_ready()
+    grid_s = time.time() - t0
+    t0 = time.time()
+    newton_call().system_kw.block_until_ready()
+    newton_s = time.time() - t0
+
+    diff = _np.abs(_np.asarray(res_n.system_kw) - _np.asarray(kw_g))
+    xatol = float(_np.min(_np.asarray(
+        newton.reference_xatol(res_n.lo, res_n.hi))))
+    cal = calibrate.recover_pq(64, steps=4)
+    return {
+        "agents": n,
+        "grid_wall_s": round(grid_s, 4),
+        "newton_wall_s": round(newton_s, 4),
+        "speedup_x": round(grid_s / max(newton_s, 1e-9), 3),
+        # batched objective sweeps per sizing call (per agent-year):
+        # the grid path prices 16 candidates per refine round; Newton
+        # prices one coarse seed row plus one value-and-grad per step
+        "objective_evals": {
+            "grid": iters * 16,
+            "newton": newton.DEFAULT_INIT_K
+            + newton.DEFAULT_STEPS,
+        },
+        "max_abs_diff_kw": float(diff.max()),
+        "xatol_kw": xatol,
+        "within_xatol": bool(float(diff.max()) <= xatol),
+        "n_fallback": int(_np.asarray(res_n.fallback).sum()),
+        "calibration": {
+            "steps": cal["steps"],
+            "loss_curve": [round(v, 8) for v in cal["loss_curve"]],
+            "rel_err_p": cal["rel_err_p"],
+            "rel_err_q": cal["rel_err_q"],
+        },
     }
 
 
@@ -1126,6 +1210,7 @@ def main() -> None:
         # DGEN_TPU_BENCH_ASYNC is set
         "async_host_io": _RC().async_io_enabled,
         "async_io": None if _BENCH_ASYNC else {"skipped": "knob off"},
+        "grad": None if _BENCH_GRAD else {"skipped": "knob off"},
     }
 
     # static J6 cost fingerprints of the entry points this bench drives
@@ -1557,6 +1642,21 @@ def main() -> None:
                 payload["sentinel"] = _sentinel_ab(n_agents)
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["sentinel"] = {
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- gradient-path A/B (DGEN_TPU_BENCH_GRAD=1): grid-search vs
+    # Newton sizing wall + objective-eval counts, and one small
+    # calibration round's convergence curve (docs/grad.md) ---
+    if _BENCH_GRAD:
+        if not spendable(point_est * 3 + 120.0):
+            skipped["grad"] = "budget"
+        else:
+            try:
+                payload["grad"] = _grad_ab(n_agents)
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["grad"] = {
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

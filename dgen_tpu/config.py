@@ -144,6 +144,24 @@ class RunConfig:
     #: ahead of each agent's program. TPU only — elsewhere the XLA
     #: twin runs (same math). Off by default. Env: DGEN_TPU_STREAM.
     stream_segments: bool = False
+    #: differentiable smooth-boundary twin (dgen_tpu.grad): replace the
+    #: objective's non-differentiable kinks — tariff-tier / TOU-bucket
+    #: edges, the hard relu import/export splits, the payback rounding
+    #: and the payback->MMS table snap — with temperature-controlled
+    #: softplus/soft-min surrogates (plus straight-through estimators
+    #: at the deliberate hard gates), so the NPV objective and the full
+    #: multi-year rollout support jax.grad. Off by default — the f32
+    #: full-hour hard path stays the bit-exact oracle and the committed
+    #: program fingerprints never move. Smooth runs force the plain XLA
+    #: f32 kernels (no daylight/pack/quant/bf16/pallas). Env:
+    #: DGEN_TPU_SOFT.
+    soft_boundaries: bool = False
+    #: smoothing temperature for soft_boundaries, in the objective's
+    #: native units (kW at the hourly import/export splits, kWh at the
+    #: monthly tier edges, years at the payback gates). Smaller tracks
+    #: the hard objective tighter; larger smooths gradients further
+    #: from each kink. Env: DGEN_TPU_SOFT_TAU.
+    soft_tau: float = 0.1
     #: background host-IO pipeline (io.hostio.HostPipeline): per-year
     #: result collection, RunExporter parquet writes and orbax
     #: checkpoint saves run on worker threads against one batched
@@ -207,11 +225,29 @@ class RunConfig:
         _check(4 <= self.sizing_iters <= 64, "sizing_iters out of range")
         _check(self.agent_chunk is None or self.agent_chunk >= 0,
                "agent_chunk must be None (auto) or >= 0")
+        _check(self.soft_tau > 0.0, "soft_tau must be > 0")
+        if self.soft_boundaries:
+            _check(
+                not (self.daylight_compact or self.bf16_banks
+                     or self.quant_banks or self.pack_once
+                     or self.stream_segments),
+                "soft_boundaries requires the plain f32 full-hour XLA "
+                "path (no daylight_compact/bf16_banks/quant_banks/"
+                "pack_once/stream_segments)",
+            )
         if self.quarantine_ids is not None:
             _check(
                 all(int(a) == a for a in self.quarantine_ids),
                 "quarantine_ids must be integer agent ids",
             )
+
+    @property
+    def soft_tau_static(self) -> Optional[float]:
+        """The static smoothing temperature the compiled programs key
+        on: the float when ``soft_boundaries`` is set, else ``None``
+        (the hard path — every kernel lowers its original bit-exact
+        program)."""
+        return float(self.soft_tau) if self.soft_boundaries else None
 
     @property
     def async_io_enabled(self) -> bool:
@@ -272,6 +308,11 @@ class RunConfig:
             overrides["pack_once"] = True
         if "stream_segments" not in overrides and flag("DGEN_TPU_STREAM"):
             overrides["stream_segments"] = True
+        if "soft_boundaries" not in overrides and flag("DGEN_TPU_SOFT"):
+            overrides["soft_boundaries"] = True
+        if "soft_tau" not in overrides and \
+                os.environ.get("DGEN_TPU_SOFT_TAU"):
+            overrides["soft_tau"] = float(os.environ["DGEN_TPU_SOFT_TAU"])
         if "faults" not in overrides and os.environ.get("DGEN_TPU_FAULTS"):
             overrides["faults"] = os.environ["DGEN_TPU_FAULTS"].strip()
         # async_host_io deliberately NOT baked from the env here: the

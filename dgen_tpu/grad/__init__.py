@@ -1,0 +1,43 @@
+"""dgen_tpu.grad: the differentiable twin of the adoption model.
+
+The hot loop of the paper is a per-agent scalar NPV optimization
+(bracketed candidate-grid search, :mod:`dgen_tpu.ops.sizing`) feeding a
+payback -> Bass diffusion step (:mod:`dgen_tpu.models.market`). Both are
+pure JAX already — what blocks ``jax.grad`` is a handful of
+non-differentiable kinks: tariff-tier and TOU-bucket edges in the bill
+kernels, the hard relu import/export splits, the payback rounding and
+the payback -> max-market-share table snap, and the argmax that picks
+the winning candidate.
+
+This package removes them behind one config gate
+(``RunConfig.soft_boundaries`` / env ``DGEN_TPU_SOFT``):
+
+* :mod:`~dgen_tpu.grad.smooth` — temperature-controlled softplus /
+  soft-min surrogates plus straight-through estimators for the
+  deliberate hard gates. Every kernel keeps its hard path bit-exact
+  when the temperature is ``None``.
+* :mod:`~dgen_tpu.grad.newton` — gradient-based sizing: a few batched,
+  damped Newton steps on the smooth NPV objective (one value_and_grad
+  kernel call per step instead of two 16-candidate refine rounds),
+  bracket-projected, with a per-agent grid fallback where curvature is
+  degenerate.
+* :mod:`~dgen_tpu.grad.calibrate` — calibration as a workload:
+  differentiate the full multi-year ``year_step`` rollout (lax.scan
+  with checkpointed remat) to fit Bass p/q and an adoption elasticity
+  against observed state-level adoption.
+* :mod:`~dgen_tpu.grad.policy` — gradient search over an incentive
+  level to hit an adoption target (the inverse-design demo).
+
+CLI: ``python -m dgen_tpu.grad {size,calibrate,policy,check}``.
+Runbook: docs/grad.md.
+"""
+
+from dgen_tpu.grad.smooth import (  # noqa: F401  (public API)
+    clip0_t,
+    lerp_lookup,
+    min0_t,
+    relu_t,
+    ste_gate,
+)
+
+__all__ = ["relu_t", "clip0_t", "min0_t", "ste_gate", "lerp_lookup"]

@@ -1,0 +1,241 @@
+"""CLI for the differentiable twin: ``python -m dgen_tpu.grad <cmd>``.
+
+Four subcommands, all printing a single JSON document to stdout:
+
+``size``
+    Newton-vs-bracketed-oracle sizing parity on a synthetic world:
+    reports the max |kw| deviation against the reference ``fast=False``
+    golden-section oracle and whether it is inside the oracle's own
+    ``xatol``.
+``calibrate``
+    Recover seeded Bass p/q scales from synthetic adoption targets by
+    differentiating the multi-year rollout (Gauss-Newton by default).
+``policy``
+    Solve the capex-incentive fraction that hits an adoption-uplift
+    target by Newton on the differentiable rollout.
+``check``
+    Fast CI gate (wired into tools/check.sh): finite-difference
+    gradcheck of the smooth NPV objective plus a small calibration
+    round that must recover seeded p/q to <= 5% relative error.
+    Exits nonzero on failure.
+
+Flag defaults read the ``DGEN_TPU_GRAD_*`` environment (same
+conventions as ``RunConfig.from_env``): ``DGEN_TPU_GRAD_AGENTS``
+(--n-agents), ``DGEN_TPU_GRAD_TAU`` (--tau), ``DGEN_TPU_GRAD_SEED``
+(--seed), ``DGEN_TPU_GRAD_STEPS`` (--steps, every subcommand) — so the
+check.sh gate and CI wrappers can rescale without editing call sites.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.grad import calibrate, newton, policy
+from dgen_tpu.models import simulation as sim
+from dgen_tpu.models.scenario import apply_year
+from dgen_tpu.ops import sizing as sizing_ops
+
+#: acceptance bar for the calibration gate (relative error on each of
+#: the recovered p/q scales)
+CHECK_PQ_RTOL = 0.05
+#: acceptance bar for the finite-difference gradcheck (relative error
+#: vs central differences, away from STE gate edges)
+CHECK_GRAD_RTOL = 2e-2
+
+
+def _world_envs(n_agents: int, seed: int, soft_tau: float):
+    """First-year ``AgentEconInputs`` (plus static flags) for the same
+    synthetic world the calibration gate runs on."""
+    pop, inputs, step_kw, _ = calibrate.build_world(
+        n_agents, seed=seed, soft_tau=soft_tau,
+    )
+    table, profiles, tariffs = pop.table, pop.profiles, pop.tariffs
+    ya = apply_year(table, inputs, 0)
+    state_kw_last = sim.starting_state_kw(table, inputs)
+    nem_allowed = sim.compute_nem_allowed(table, inputs, 0, state_kw_last)
+    rate_switch = bool(step_kw.get("rate_switch", False))
+    envs = sim.build_econ_inputs(
+        table, profiles, tariffs, ya, nem_allowed, table.incentives,
+        rate_switch=rate_switch,
+    )
+    return envs, {
+        "n_periods": int(step_kw["n_periods"]),
+        "n_years": int(step_kw["econ_years"]),
+        "net_billing": bool(step_kw.get("net_billing", True)),
+    }
+
+
+def cmd_size(args) -> dict:
+    envs, meta = _world_envs(args.n_agents, args.seed, args.tau)
+    res = newton.newton_size(
+        envs, meta["n_periods"], meta["n_years"],
+        soft_tau=args.tau, n_steps=args.steps,
+        net_billing=meta["net_billing"],
+    )
+    oracle = sizing_ops.size_agents(
+        envs, n_periods=meta["n_periods"], n_years=meta["n_years"],
+        fast=False, n_iters=20, net_billing=meta["net_billing"],
+    )
+    xatol = np.asarray(newton.reference_xatol(res.lo, res.hi))
+    diff = np.abs(np.asarray(res.system_kw) - np.asarray(oracle.system_kw))
+    return {
+        "n_agents": args.n_agents,
+        "newton_steps": args.steps,
+        "soft_tau": args.tau,
+        "max_abs_diff_kw": float(diff.max()),
+        "xatol_kw": float(xatol.min()),
+        "within_xatol": bool(np.all(diff <= xatol)),
+        "n_fallback": int(np.asarray(res.fallback).sum()),
+        "mean_kw_newton": float(np.asarray(res.system_kw).mean()),
+        "mean_kw_oracle": float(np.asarray(oracle.system_kw).mean()),
+    }
+
+
+def cmd_calibrate(args) -> dict:
+    out = calibrate.recover_pq(
+        args.n_agents, steps=args.steps, soft_tau=args.tau,
+        seed=args.seed, method=args.method,
+    )
+    return out
+
+
+def cmd_policy(args) -> dict:
+    return policy.solve_incentive(
+        args.n_agents, target_uplift=args.uplift, steps=args.steps,
+        soft_tau=args.tau, seed=args.seed,
+    )
+
+
+def gradcheck(n_agents: int = 8, seed: int = 7, tau: float = 0.1) -> dict:
+    """Central-difference check of the smooth NPV objective's gradient.
+
+    Evaluated at three points across the sizing bracket. Agents whose
+    evaluation point sits within ``5 * tau`` of a rate-switch window
+    edge are excluded from the max: the STE gates there are hard in the
+    forward pass by design, so finite differences of the primal cannot
+    (and should not) match the straight-through derivative.
+    """
+    envs, meta = _world_envs(n_agents, seed, tau)
+    npv_fn, lo, hi = sizing_ops.make_npv_objective(
+        envs, meta["n_periods"], meta["n_years"],
+        net_billing=meta["net_billing"], soft_tau=tau,
+    )
+    total = lambda kw: jnp.sum(npv_fn(kw))
+    grad_fn = jax.jit(jax.grad(total))
+    f = jax.jit(npv_fn)
+
+    h = tau / 4.0
+    worst = 0.0
+    per_point = []
+    for frac in (0.3, 0.6, 0.9):
+        kw = lo + frac * (hi - lo)
+        g = np.asarray(grad_fn(kw))
+        fd = np.asarray((f(kw + h) - f(kw - h)) / (2.0 * h))
+        rel = np.abs(g - fd) / (np.abs(fd) + 1.0)
+        near_gate = (
+            (np.abs(np.asarray(kw - envs.switch_min_kw)) < 5 * tau)
+            | (np.abs(np.asarray(kw - envs.switch_max_kw)) < 5 * tau)
+        )
+        rel_ok = np.where(near_gate, 0.0, rel)
+        worst = max(worst, float(rel_ok.max()))
+        per_point.append({
+            "frac": frac,
+            "max_rel_err": float(rel_ok.max()),
+            "n_gate_excluded": int(near_gate.sum()),
+        })
+    return {
+        "n_agents": n_agents,
+        "fd_step": h,
+        "max_rel_err": worst,
+        "points": per_point,
+        "ok": worst < CHECK_GRAD_RTOL,
+    }
+
+
+def cmd_check(args) -> dict:
+    gc = gradcheck(n_agents=8, seed=args.seed, tau=args.tau)
+    cal = calibrate.recover_pq(
+        args.n_agents, steps=args.steps, soft_tau=args.tau,
+        seed=args.seed, method="gn",
+    )
+    cal_ok = (
+        cal["rel_err_p"] <= CHECK_PQ_RTOL
+        and cal["rel_err_q"] <= CHECK_PQ_RTOL
+    )
+    out = {
+        "gradcheck": gc,
+        "calibration": {
+            "rel_err_p": cal["rel_err_p"],
+            "rel_err_q": cal["rel_err_q"],
+            "loss_last": cal["loss_last"],
+            "ok": cal_ok,
+        },
+        "ok": bool(gc["ok"] and cal_ok),
+    }
+    return out
+
+
+def _env_num(name: str, default, cast):
+    v = os.environ.get(name, "")
+    return cast(v) if v else default
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dgen_tpu.grad",
+        description="Differentiable-twin workloads: sizing, "
+                    "calibration, policy search.",
+    )
+    p.add_argument(
+        "--n-agents", type=int,
+        default=_env_num("DGEN_TPU_GRAD_AGENTS",
+                         calibrate.CHECK_N_AGENTS, int))
+    p.add_argument(
+        "--tau", type=float,
+        default=_env_num("DGEN_TPU_GRAD_TAU", calibrate.DEFAULT_TAU, float),
+        help="smoothing temperature (kW / native units)")
+    p.add_argument(
+        "--seed", type=int, default=_env_num("DGEN_TPU_GRAD_SEED", 7, int))
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def steps(sp, default):
+        sp.add_argument(
+            "--steps", type=int,
+            default=_env_num("DGEN_TPU_GRAD_STEPS", default, int))
+
+    ps = sub.add_parser("size", help="Newton sizing vs bracketed oracle")
+    steps(ps, newton.DEFAULT_STEPS)
+    ps.set_defaults(fn=cmd_size)
+
+    pc = sub.add_parser("calibrate", help="recover seeded Bass p/q")
+    steps(pc, 6)
+    pc.add_argument("--method", choices=("gn", "adam"), default="gn")
+    pc.set_defaults(fn=cmd_calibrate)
+
+    pp = sub.add_parser("policy", help="solve incentive for a target")
+    steps(pp, 6)
+    pp.add_argument("--uplift", type=float, default=1.25)
+    pp.set_defaults(fn=cmd_policy)
+
+    pk = sub.add_parser("check", help="CI gate: gradcheck + calibration")
+    steps(pk, 5)
+    pk.set_defaults(fn=cmd_check)
+
+    args = p.parse_args(argv)
+    out = args.fn(args)
+    print(json.dumps(out, indent=1, default=float))
+    ok = out.get("ok", True)
+    if not ok:
+        print("dgen_tpu.grad: FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

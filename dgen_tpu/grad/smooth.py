@@ -1,0 +1,113 @@
+"""Smoothing primitives for the differentiable objective twin.
+
+Every surrogate here is parameterized by a temperature ``tau`` in the
+argument's native units and converges to its hard counterpart as
+``tau -> 0``. The kernel call sites (:mod:`dgen_tpu.ops.bill`,
+:mod:`dgen_tpu.ops.billpallas`, :mod:`dgen_tpu.ops.sizing`,
+:mod:`dgen_tpu.models.market`) take ``soft_tau=None`` by default and
+lower their ORIGINAL hard expressions in that case — the smooth twin is
+additive, never a rewrite of the oracle.
+
+Two families:
+
+* softplus surrogates (:func:`relu_t`, :func:`clip0_t`, :func:`min0_t`)
+  for the import/export splits and the tariff-tier segment clips —
+  places where smoothing the VALUE is acceptable inside the smoothing
+  radius and a useful gradient matters more than the last 0.1% of bill
+  accuracy.
+* straight-through estimators (:func:`ste_gate`) for gates whose
+  forward value must stay HARD (the rate-switch window, the TOU-sell
+  presence test): forward evaluates the exact 0/1 gate, backward
+  substitutes a sigmoid bump so the boundary position still receives
+  gradient. These are the deliberate J11 suppression sites (see
+  docs/lint.md).
+
+:func:`lerp_lookup` replaces a round-to-grid table gather with linear
+interpolation between the two bracketing rows; its floor/int-cast pair
+is piecewise constant by construction (the gradient flows through the
+interpolation weight, which is exactly the a.e. derivative).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def relu_t(x: jax.Array, tau: float) -> jax.Array:
+    """Soft relu: ``tau * softplus(x / tau)`` — smooth max(x, 0).
+
+    Overestimates the hard relu by at most ``tau * log(2)`` (at x=0)
+    and converges exponentially fast outside a few ``tau`` of the kink.
+    """
+    return tau * jax.nn.softplus(x / tau)
+
+
+def min0_t(x: jax.Array, tau: float) -> jax.Array:
+    """Smooth min(x, 0) = ``-relu_t(-x, tau)``."""
+    return -relu_t(-x, tau)
+
+
+def clip0_t(x: jax.Array, width: jax.Array, tau: float) -> jax.Array:
+    """Smooth ``clip(x, 0, width)`` as a difference of soft relus.
+
+    Exact for ``width >> tau`` away from both edges; at ``width = 0``
+    (a degenerate tariff tier) the two softplus terms cancel to 0 like
+    the hard clip.
+    """
+    return relu_t(x, tau) - relu_t(x - width, tau)
+
+
+@partial(jax.custom_jvp, nondiff_argnums=(1,))
+def _ste_gate(x: jax.Array, tau: float) -> jax.Array:
+    return (x >= 0.0).astype(jnp.float32)
+
+
+@_ste_gate.defjvp
+def _ste_gate_jvp(tau, primals, tangents):
+    (x,) = primals
+    (dx,) = tangents
+    s = jax.nn.sigmoid(x / tau)
+    # d/dx sigmoid(x/tau) = s(1-s)/tau: a bump of width ~tau replacing
+    # the true (zero-a.e.) derivative of the step. Defined as a
+    # custom_jvp (NOT custom_vjp) because the Newton path takes
+    # forward-over-reverse second derivatives (jvp of grad) through the
+    # objective; the rule is linear in ``dx``, so reverse mode still
+    # derives automatically by transposition.
+    return _ste_gate(x, tau), dx * s * (1.0 - s) / tau
+
+
+def ste_gate(x: jax.Array, tau: float | None) -> jax.Array:
+    """Heaviside step ``float(x >= 0)`` with a straight-through
+    derivative.
+
+    ``tau=None`` returns the plain hard comparison (no custom-AD rule
+    in the program — the oracle path lowers byte-identically). With a
+    temperature, the forward value is STILL the exact hard gate — only
+    the derivative substitutes a sigmoid bump, so gate boundaries
+    (rate-switch kW windows, NEM availability) receive gradient without
+    perturbing the priced bill.
+    """
+    if tau is None:
+        return (x >= 0.0).astype(jnp.float32)
+    return _ste_gate(x, tau * 1.0)
+
+
+def lerp_lookup(table: jax.Array, idx_float: jax.Array) -> jax.Array:
+    """Linearly interpolated gather along ``table``'s LAST axis.
+
+    ``idx_float`` is a continuous (already clipped/scaled) grid
+    coordinate; leading axes of ``table`` must have been gathered away
+    by the caller (e.g. ``mms_table[sector_idx]`` -> [N, GRID]).
+    Gradient w.r.t. ``idx_float`` is ``table[hi] - table[lo]`` — the
+    a.e. derivative of the piecewise-linear interpolant.
+    """
+    n = table.shape[-1]
+    x = jnp.clip(idx_float, 0.0, n - 1.0)
+    lo = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, n - 2)
+    frac = x - lo.astype(x.dtype)
+    v_lo = jnp.take_along_axis(table, lo[..., None], axis=-1)[..., 0]
+    v_hi = jnp.take_along_axis(table, (lo + 1)[..., None], axis=-1)[..., 0]
+    return v_lo + frac * (v_hi - v_lo)

@@ -277,6 +277,107 @@ def rule_j9(
         )
 
 
+# J11: primitives whose VJP/JVP rule is zero (or undefined) almost
+# everywhere — inside a differentiated program each one silently
+# zeroes every upstream parameter's gradient. ``convert_element_type``
+# is flagged separately (only float -> int truncation kills gradients;
+# widening/narrowing float casts are fine).
+_J11_KILLERS = {
+    "round", "floor", "ceil", "nearbyint",
+    "argmax", "argmin", "stop_gradient",
+}
+#: custom-AD wrappers are the SANCTIONED escape hatch: a kink wrapped
+#: in custom_jvp/custom_vjp declared its derivative explicitly (the
+#: straight-through sites in dgen_tpu.grad.smooth), so J11 neither
+#: descends into their rule bodies nor flags casts of their outputs
+_J11_CUSTOM_AD = ("custom_jvp_call", "custom_vjp_call")
+
+
+def _is_float_to_int(eqn) -> bool:
+    import numpy as np
+
+    if eqn.primitive.name != "convert_element_type":
+        return False
+    try:
+        src = np.dtype(eqn.invars[0].aval.dtype)
+        dst = np.dtype(eqn.outvars[0].aval.dtype)
+    except (AttributeError, TypeError):
+        return False
+    return src.kind == "f" and dst.kind in ("i", "u")
+
+
+def rule_j11(audit: ProgramAudit) -> Iterable[str]:
+    """Gradient-killing ops reachable inside a grad-marked entry.
+
+    A grad-marked spec's bound IS the differentiated program (a
+    ``value_and_grad`` or jvp-of-grad wrapper), so every primitive in
+    its jaxpr participates in differentiation: a ``round``/``floor``/
+    ``argmax``/``stop_gradient`` or a float->int cast there has a
+    zero-a.e. derivative and silently disconnects every parameter
+    upstream of it — the smooth-twin bug class where a loss LOOKS
+    differentiable but one table lookup zeroes the fit.
+
+    Custom-AD call bodies are exempt (their derivative is declared, not
+    derived — the deliberate straight-through sites in
+    :mod:`dgen_tpu.grad.smooth`), as are float->int casts of a
+    custom-AD output (the tangent was already explicitly routed).
+    Remaining deliberate sites — e.g. a forward-hard argmax winner
+    selection whose gradient flows through the gathered winner — carry
+    ``# dgenlint: disable=J11`` at the entry anchor with a comment
+    saying why.
+    """
+    if not audit.spec.grad:
+        return
+    seen: set = set()
+    stack = [(audit.jaxpr.jaxpr, frozenset())]
+    visited: set = set()
+    while stack:
+        j, sanctioned = stack.pop()
+        if id(j) in visited:
+            continue
+        visited.add(id(j))
+        local = set(sanctioned)
+        for eqn in j.eqns:
+            prim = eqn.primitive.name
+            if any(prim.startswith(c) for c in _J11_CUSTOM_AD):
+                local.update(map(id, eqn.outvars))
+                continue
+            if prim in _J11_KILLERS and prim not in seen:
+                seen.add(prim)
+                yield (
+                    f"`{prim}` reachable inside this differentiated "
+                    "program: its derivative is zero almost everywhere, "
+                    "so every parameter upstream of it silently stops "
+                    "receiving gradient — smooth it (dgen_tpu.grad."
+                    "smooth), wrap it in a custom_jvp declaring the "
+                    "intended derivative, or suppress here if the "
+                    "straight-through behavior is deliberate"
+                )
+            if (
+                _is_float_to_int(eqn)
+                and id(eqn.invars[0]) not in local
+                and "convert_f2i" not in seen
+            ):
+                seen.add("convert_f2i")
+                yield (
+                    "float->int `convert_element_type` reachable inside "
+                    "this differentiated program truncates with a zero "
+                    "derivative — if this is a deliberate index "
+                    "computation (a lerp_lookup-style gather), route it "
+                    "through a custom_jvp so the zero tangent is "
+                    "declared, or suppress here"
+                )
+            for p in eqn.params.values():
+                for sub in _subjaxprs_j11(p):
+                    stack.append((sub, frozenset(local)))
+
+
+def _subjaxprs_j11(p) -> List:
+    from dgen_tpu.lint.prog.spec import _subjaxprs
+
+    return _subjaxprs(p)
+
+
 #: rule id -> (summary, per-audit impl); J5 takes the cross-audit map,
 #: J9 takes the budget, J6/J7/J10 live in dgen_tpu.lint.prog.baseline
 #: (they need the baseline file). Summaries come from the jax-free id
@@ -284,7 +385,7 @@ def rule_j9(
 _IMPLS = {
     "J0": None, "J1": rule_j1, "J2": rule_j2, "J3": rule_j3,
     "J4": rule_j4, "J5": rule_j5, "J6": None, "J7": None,
-    "J8": rule_j8, "J9": rule_j9, "J10": None,
+    "J8": rule_j8, "J9": rule_j9, "J10": None, "J11": rule_j11,
 }
 PROGRAM_RULES: Dict[str, Tuple[str, object]] = {
     rule_id: (summary, _IMPLS[rule_id])
@@ -310,7 +411,7 @@ def run_program_rules(
     select: Optional[Iterable[str]] = None,
     j9_budget_bytes: Optional[int] = None,
 ) -> List[Finding]:
-    """J0-J5 + the per-audit mesh rules J8/J9 over a set of audits
+    """J0-J5, J11 + the per-audit mesh rules J8/J9 over a set of audits
     (J6/J7/J10 are applied by the baseline module, which owns the
     comparisons): suppression comments at each entry's anchor line are
     honored, L-rule style. Findings are prefixed with the
@@ -342,7 +443,7 @@ def run_program_rules(
                     "point or its abstract-spec builder is broken"
                 ))
             continue
-        for rule in ("J1", "J2", "J3", "J4", "J8"):
+        for rule in ("J1", "J2", "J3", "J4", "J8", "J11"):
             if rule not in chosen:
                 continue
             _summary, impl = PROGRAM_RULES[rule]

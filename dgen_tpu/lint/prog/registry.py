@@ -28,6 +28,11 @@ Entries (see ``docs/lint.md`` for the operator-facing table):
                       layout and bf16-bank input variants)
 ``import_sums_pair``  the rate-switch fused twin
 ``bucket_sums``       the full-reduction engine (battery forward runs)
+``size_agents_soft``  the smooth sizing twin (``soft_tau`` set)
+``newton_step``       one damped Newton step on the smooth NPV
+                      objective (grad-marked: J11 audits it)
+``calib_loss``        value_and_grad of the calibration loss through
+                      the rollout (grad-marked; reduced audit scale)
 ====================  =====================================================
 
 Grid depth: ``grid="fast"`` audits each entry's base point only (test
@@ -262,39 +267,94 @@ def _serve_bound(daylight, year: int) -> Bound:
     return _serve_bound_for(_world(daylight, False), year)
 
 
-def _size_agents_bound_for(sim, net_billing) -> Bound:
+def _audit_envs_for(sim):
+    """The first-year econ envs of an audit world — the envs build runs
+    eagerly on tiny arrays (host-side spec construction, not part of
+    the audited program)."""
     from dgen_tpu.models.scenario import apply_year
     from dgen_tpu.models.simulation import (
         build_econ_inputs,
         compute_nem_allowed,
         starting_state_kw,
     )
-    from dgen_tpu.ops import sizing as sizing_ops
 
-    # the envs build runs eagerly on tiny arrays — host-side spec
-    # construction, not part of the audited program
     ya = apply_year(sim.table, sim.inputs, _yi(0))
     state_kw = starting_state_kw(sim.table, sim.inputs)
     nem = compute_nem_allowed(sim.table, sim.inputs, _yi(0), state_kw)
-    envs = build_econ_inputs(
+    return build_econ_inputs(
         sim.table, sim.profiles, sim.tariffs, ya, nem,
         sim.table.incentives, rate_switch=sim._rate_switch,
     )
+
+
+def _size_agents_bound_for(sim, net_billing, soft_tau=None) -> Bound:
+    from dgen_tpu.ops import sizing as sizing_ops
+
+    envs = _audit_envs_for(sim)
     fn = jax.jit(partial(
         sizing_ops.size_agents,
         n_periods=sim.tariffs.max_periods, n_years=sim.econ_years,
         n_iters=AUDIT_SIZING_ITERS, keep_hourly=False, impl="xla",
         net_billing=net_billing, daylight=sim._daylight, mesh=sim.mesh,
-        pack_once=sim.run_config.pack_once,
+        pack_once=sim.run_config.pack_once, soft_tau=soft_tau,
     ))
     return Bound(fn=fn, args=(envs,), kwargs={})
 
 
 def _size_agents_bound(net_billing, daylight, bf16, quant=False,
-                       pack=False) -> Bound:
+                       pack=False, soft_tau=None) -> Bound:
     return _size_agents_bound_for(
-        _world(daylight, bf16, quant=quant, pack=pack), net_billing
+        _world(daylight, bf16, quant=quant, pack=pack), net_billing,
+        soft_tau=soft_tau,
     )
+
+
+#: the audited smoothing temperature — matches the grad stack's
+#: DEFAULT_TAU (dgen_tpu.grad); part of the baseline contract like the
+#: AUDIT_* shape constants
+AUDIT_SOFT_TAU = 0.1
+
+
+def _newton_step_bound() -> Bound:
+    """One damped Newton sizing step over the smooth NPV objective —
+    the jvp-of-grad program dgen_tpu.grad.newton dispatches per
+    refinement iteration (the J11 subject: its backward path must stay
+    free of undeclared gradient-killers). The envs are TRACED (the
+    objective's precomputed bill summaries rebuild inside the program)
+    so the audited program carries no baked-in streams, mirroring how
+    a jitted production caller would wrap newton_size."""
+    from dgen_tpu.grad import newton
+    from dgen_tpu.ops import sizing as sizing_ops
+
+    sim = _world()
+    n_periods = sim.tariffs.max_periods
+    n_years = sim.econ_years
+
+    def step(envs):
+        npv_fn, lo, hi = sizing_ops.make_npv_objective(
+            envs, n_periods, n_years,
+            net_billing=True, soft_tau=AUDIT_SOFT_TAU,
+        )
+        kw0 = 0.5 * (lo + hi)
+        return newton.newton_refine(npv_fn, kw0, lo, hi, n_steps=1)
+
+    return Bound(fn=jax.jit(step), args=(_audit_envs_for(sim),),
+                 kwargs={})
+
+
+def _calib_loss_bound() -> Bound:
+    """value_and_grad of the calibration loss through the full
+    checkpointed rollout — audited at a REDUCED scale (2 model years,
+    4 econ years, 2 sizing iters): the backward of the full rollout is
+    the most expensive program in the registry to compile, and the
+    J5/J6/J11 properties being gated are scale-independent."""
+    from dgen_tpu.grad import calibrate
+
+    vg, params = calibrate.calib_loss_entry(
+        AUDIT_N_AGENTS, soft_tau=AUDIT_SOFT_TAU,
+        end_year=2016, econ_years=4, sizing_iters=2,
+    )
+    return Bound(fn=jax.jit(vg), args=(params,), kwargs={})
 
 
 def _kernel_arrays(bf16: bool):
@@ -554,6 +614,38 @@ def build_registry(grid: str = "default") -> List[ProgramSpec]:
                               quant, pack),
                 anchor=sz_anchor, cost=True,
             ))
+
+    # the differentiable twin (ISSUE 18): the smooth sizing program,
+    # the Newton refinement step and the calibration loss are
+    # committed J5/J6 entries like any other production program — a
+    # change to the smoothing primitives or the rollout's AD
+    # structure lands as a reviewable baseline diff. newton_step and
+    # calib_loss are grad-marked: J11 walks their (differentiated)
+    # programs for undeclared gradient-killers. Default grid only:
+    # the calibration backward is the most expensive compile in the
+    # registry, outside the fast tier's budget.
+    if grid == "default":
+        from dgen_tpu.grad import calibrate as grad_calibrate
+        from dgen_tpu.grad import newton as grad_newton
+
+        specs.append(ProgramSpec(
+            entry="size_agents_soft", variant="dl0-bf0-nb1-tau01",
+            build=partial(_size_agents_bound, True, False, False,
+                          soft_tau=AUDIT_SOFT_TAU),
+            anchor=sz_anchor, cost=True,
+        ))
+        specs.append(ProgramSpec(
+            entry="newton_step", variant="tau01",
+            build=_newton_step_bound,
+            anchor=anchor_for(grad_newton.newton_refine),
+            cost=True, grad=True,
+        ))
+        specs.append(ProgramSpec(
+            entry="calib_loss", variant="tau01-small",
+            build=_calib_loss_bound,
+            anchor=anchor_for(grad_calibrate.calib_loss_entry),
+            cost=True, grad=True,
+        ))
 
     # bill kernels (XLA engine pinned: the audit fingerprints must not
     # depend on which backend happens to trace them)

@@ -85,7 +85,9 @@ class ProgramSpec:
     index); J5 requires it to lower to the identical program.
     ``expect_same_as``: spec_id whose fingerprint this one must equal
     (the loop-mode sweep's zero-extra-compile invariant). ``cost``
-    marks the J6 baseline entries.
+    marks the J6 baseline entries. ``grad`` marks entries whose bound
+    IS a differentiated program (a ``value_and_grad``/``jvp``-of-grad
+    wrapper) — J11 walks them for gradient-killing primitives.
     """
 
     entry: str
@@ -96,6 +98,7 @@ class ProgramSpec:
     steady: Optional[Callable[[], Bound]] = None
     expect_same_as: Optional[str] = None
     cost: bool = False
+    grad: bool = False
     max_const_bytes: int = MAX_CONST_BYTES
     #: mesh-tier specs (``--programs --mesh``): the (hosts, devices)
     #: grid this spec lowers under — the bound's world is built over

@@ -22,4 +22,5 @@ PROGRAM_RULE_SUMMARIES: Dict[str, str] = {
     "J8": "sharding propagation: agent axis must stay partitioned",
     "J9": "static per-device memory vs HBM budget + planner model",
     "J10": "per-mesh-shape program fingerprint identity (baseline)",
+    "J11": "gradient-killing ops inside a grad-marked entry",
 }

@@ -36,6 +36,7 @@ def max_market_share(
     payback_period: jax.Array,
     sector_idx: jax.Array,
     mms_table: jax.Array,
+    interp: bool = False,
 ) -> jax.Array:
     """Look up max market share from the payback curve.
 
@@ -43,7 +44,21 @@ def max_market_share(
     payback grid. The reference discretizes payback to an integer
     factor (x100) and merges against its lookup table
     (financial_functions.py:1290-1307); a gather is the dense analogue.
+
+    ``interp=True`` (the differentiable twin, dgen_tpu.grad) replaces
+    the round-to-grid snap with linear interpolation between the two
+    bracketing table rows: the gradient of share w.r.t. payback is the
+    table's local slope instead of zero-a.e., and the gradient w.r.t.
+    ``mms_table`` itself spreads over both rows (what the calibration
+    elasticity rides). Values differ from the hard lookup by at most
+    half a grid step of curve movement.
     """
+    if interp:
+        from dgen_tpu.grad.smooth import lerp_lookup
+
+        return lerp_lookup(
+            mms_table[sector_idx], payback_period / PAYBACK_GRID_STEP
+        )
     idx = jnp.clip(
         jnp.round(payback_period / PAYBACK_GRID_STEP).astype(jnp.int32),
         0,

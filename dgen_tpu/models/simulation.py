@@ -579,6 +579,8 @@ def year_step_impl(
     net_billing: bool = True,
     daylight=None,
     pack_once: bool = False,
+    soft_tau: Optional[float] = None,
+    anchor: bool = True,
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
 
@@ -587,6 +589,17 @@ def year_step_impl(
     search's import kernels run daylight-compacted; None keeps the
     full-hour oracle path. ``pack_once``: gather the month-positional
     candidate streams once per sizing call (RunConfig.pack_once).
+    ``soft_tau``: the differentiable smooth-boundary twin
+    (RunConfig.soft_boundaries -> :mod:`dgen_tpu.grad`): soft
+    import/export splits and tier clips inside sizing, an unrounded
+    payback, and linear interpolation through the max-market-share
+    table instead of the round-to-decile gather — so the whole year
+    step is differentiable w.r.t. scenario leaves. ``None`` (default)
+    traces the bit-exact hard program. ``anchor=False`` (static) drops
+    the historical-anchoring blend entirely — the calibration rollout
+    (:mod:`dgen_tpu.grad.calibrate`) fits the UNanchored model to
+    observations, and the anchor rescale's tiny-denominator guards
+    produce 0/0 tangents under linearization.
 
     Mirrors the reference's per-year sequence (dgen_model.py:242-438):
     trajectory application -> sizing -> max market share -> (initial
@@ -634,7 +647,7 @@ def year_step_impl(
                 envs_c, n_periods=n_periods, n_years=econ_years,
                 n_iters=sizing_iters, keep_hourly=False, impl=sizing_impl,
                 mesh=mesh, net_billing=net_billing, daylight=daylight,
-                pack_once=pack_once,
+                pack_once=pack_once, soft_tau=soft_tau,
             )
             return None, res_c
 
@@ -653,12 +666,13 @@ def year_step_impl(
             envs, n_periods=n_periods, n_years=econ_years,
             n_iters=sizing_iters, keep_hourly=with_hourly, impl=sizing_impl,
             mesh=mesh, net_billing=net_billing, daylight=daylight,
-            pack_once=pack_once,
+            pack_once=pack_once, soft_tau=soft_tau,
         )
 
     # --- market step ---
     mms = max_market_share(
-        res.payback_period, table.sector_idx, inputs.mms_table
+        res.payback_period, table.sector_idx, inputs.mms_table,
+        interp=soft_tau is not None,
     ) * table.mask
 
     if first_year:
@@ -684,14 +698,19 @@ def year_step_impl(
     )
 
     # --- historical anchoring (blend; anchor_years_mask selects) ---
-    am = inputs.anchor_years_mask[year_idx]
-    kw_anch, adopt_anch, share_anch = anchor_to_observed(
-        out.system_kw_cum, g, inputs.observed_kw[year_idx],
-        (table.sector_idx == 0), ya.developable_agent_weight, n_groups,
-    )
-    kw_cum = am * kw_anch + (1.0 - am) * out.system_kw_cum
-    adopters = am * adopt_anch + (1.0 - am) * out.number_of_adopters
-    share = am * share_anch + (1.0 - am) * out.market_share
+    if anchor:
+        am = inputs.anchor_years_mask[year_idx]
+        kw_anch, adopt_anch, share_anch = anchor_to_observed(
+            out.system_kw_cum, g, inputs.observed_kw[year_idx],
+            (table.sector_idx == 0), ya.developable_agent_weight, n_groups,
+        )
+        kw_cum = am * kw_anch + (1.0 - am) * out.system_kw_cum
+        adopters = am * adopt_anch + (1.0 - am) * out.number_of_adopters
+        share = am * share_anch + (1.0 - am) * out.market_share
+    else:
+        kw_cum = out.system_kw_cum
+        adopters = out.number_of_adopters
+        share = out.market_share
     new_adopters = jnp.maximum(adopters - mstate.adopters_cum, 0.0)
     new_kw = jnp.maximum(kw_cum - mstate.system_kw_cum, 0.0)
 
@@ -855,7 +874,7 @@ YEAR_STEP_STATIC_ARGNAMES = (
     "n_periods", "econ_years", "sizing_iters", "first_year",
     "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
     "rate_switch", "mesh", "agent_chunk", "net_billing", "daylight",
-    "pack_once",
+    "pack_once", "soft_tau", "anchor",
 )
 
 #: the jitted one-year program. The cross-year carry is threaded
@@ -1305,6 +1324,7 @@ class Simulation:
             net_billing=self._net_billing,
             daylight=self._daylight,
             pack_once=self.run_config.pack_once,
+            soft_tau=self.run_config.soft_tau_static,
         )
 
     #: legacy private alias — internal call sites (and tests that

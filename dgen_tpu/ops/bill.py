@@ -106,7 +106,8 @@ def monthly_period_sums(x: jax.Array, hour_period: jax.Array, n_periods: int) ->
     return jnp.stack(per_period, axis=-1)  # [12, P]
 
 
-def tiered_charge(sums: jax.Array, price: jax.Array, tier_cap: jax.Array) -> jax.Array:
+def tiered_charge(sums: jax.Array, price: jax.Array, tier_cap: jax.Array,
+                  soft_tau: float | None = None) -> jax.Array:
     """Proper cumulative tiered energy charge.
 
     ``sums``: [12, P] monthly energy per period (kWh, may be negative
@@ -114,22 +115,35 @@ def tiered_charge(sums: jax.Array, price: jax.Array, tier_cap: jax.Array) -> jax
     the monthly caps; negative energy is credited at the period's tier-1
     price (oracle semantics, reference tariff_functions.py:687).
     Returns [12] monthly charges.
+
+    ``soft_tau`` (kWh) smooths the tier-edge clips with softplus
+    surrogates (grad.smooth) so marginal prices are differentiable
+    across tier boundaries; ``None`` (default) lowers the exact hard
+    clip.
     """
     lower = jnp.concatenate([jnp.zeros_like(tier_cap[:1]), tier_cap[:-1]])  # [T]
     width = tier_cap - lower
-    # [12, P, T]: energy falling inside each tier
-    seg = jnp.clip(sums[..., None] - lower, 0.0, width)
+    if soft_tau is None:
+        # [12, P, T]: energy falling inside each tier
+        seg = jnp.clip(sums[..., None] - lower, 0.0, width)
+        neg_sums = jnp.minimum(sums, 0.0)
+    else:
+        from dgen_tpu.grad.smooth import clip0_t, min0_t
+
+        seg = clip0_t(sums[..., None] - lower, width, soft_tau)
+        neg_sums = min0_t(sums, soft_tau)
     pos = jnp.einsum("mpt,pt->m", seg, price)
-    neg = jnp.einsum("mp,p->m", jnp.minimum(sums, 0.0), price[:, 0])
+    neg = jnp.einsum("mp,p->m", neg_sums, price[:, 0])
     return pos + neg
 
 
-@partial(jax.jit, static_argnames=("n_periods",))
+@partial(jax.jit, static_argnames=("n_periods", "soft_tau"))
 def annual_bill(
     net_load: jax.Array,
     tariff: AgentTariff,
     ts_sell: jax.Array,
     n_periods: int,
+    soft_tau: float | None = None,
 ) -> jax.Array:
     """Annual bill for one agent given a signed hourly net grid load.
 
@@ -141,18 +155,31 @@ def annual_bill(
     Both metering styles are evaluated and selected per agent (the
     metering option is data, not structure, so agents with different
     compensation styles batch together under vmap).
+
+    ``soft_tau`` (static) selects the differentiable twin: soft
+    import/export splits (kW units) and soft tier clips (the same tau
+    in kWh — monthly sums are O(100x) the hourly scale, so tier edges
+    smooth proportionally tighter). ``None`` = the bit-exact hard path.
     """
     hp = tariff.hour_period
 
     # --- Net metering: signed monthly netting at retail ---
     sums_signed = monthly_period_sums(net_load, hp, n_periods)
-    bill_nem = jnp.sum(tiered_charge(sums_signed, tariff.price, tariff.tier_cap))
+    bill_nem = jnp.sum(tiered_charge(
+        sums_signed, tariff.price, tariff.tier_cap, soft_tau))
 
     # --- Net billing: imports billed, exports credited at sell rate ---
-    imports = jnp.maximum(net_load, 0.0)
-    exports = jnp.maximum(-net_load, 0.0)
+    if soft_tau is None:
+        imports = jnp.maximum(net_load, 0.0)
+        exports = jnp.maximum(-net_load, 0.0)
+    else:
+        from dgen_tpu.grad.smooth import relu_t
+
+        imports = relu_t(net_load, soft_tau)
+        exports = relu_t(-net_load, soft_tau)
     sums_imp = monthly_period_sums(imports, hp, n_periods)
-    import_charges = jnp.sum(tiered_charge(sums_imp, tariff.price, tariff.tier_cap))
+    import_charges = jnp.sum(tiered_charge(
+        sums_imp, tariff.price, tariff.tier_cap, soft_tau))
     # Hourly sell rate: TOU sell if the tariff defines one, else the TS
     # rate (static period select, see select_by_period).
     tou_sell_hourly = select_by_period(hp, tariff.sell_price, ts_sell)
@@ -182,7 +209,7 @@ def degradation_factors(n_years: int, degradation: jax.Array) -> jax.Array:
     return (1.0 - degradation) ** y
 
 
-@partial(jax.jit, static_argnames=("n_periods", "n_years"))
+@partial(jax.jit, static_argnames=("n_periods", "n_years", "soft_tau"))
 def bill_series(
     load: jax.Array,
     system_out: jax.Array,
@@ -194,6 +221,7 @@ def bill_series(
     n_periods: int,
     n_years: int,
     tariff_wo: AgentTariff | None = None,
+    soft_tau: float | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(bills_with_sys [Y], bills_without_sys [Y]) in nominal dollars.
 
@@ -217,13 +245,14 @@ def bill_series(
     df = degradation_factors(n_years, degradation)              # [Y]
 
     bill_wo_y1 = annual_bill(
-        load, tariff if tariff_wo is None else tariff_wo, ts_sell, n_periods
+        load, tariff if tariff_wo is None else tariff_wo, ts_sell, n_periods,
+        soft_tau,
     )
     bills_wo = bill_wo_y1 * pf
 
     def year_bill(deg_f):
         net = load - system_out * deg_f
-        return annual_bill(net, tariff, ts_sell, n_periods)
+        return annual_bill(net, tariff, ts_sell, n_periods, soft_tau)
 
     bills_w = jax.vmap(year_bill)(df) * pf
     return bills_w, bills_wo

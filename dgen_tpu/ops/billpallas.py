@@ -1139,7 +1139,7 @@ def _sums_pallas_dot(load, gen, sell, bucket_id, scales, with_signed,
 
 
 def _sums_xla(load, gen, sell, bucket_id, scales, packed=None, *,
-              n_buckets, with_signed, layout=None):
+              n_buckets, with_signed, layout=None, soft_tau=None):
     """Pure-XLA twin (CPU tests, sharded runs): one [N, H] pass per
     scale via lax.map, bucketed with per-period masked matmuls against
     the SHARED month one-hot — no per-agent [H, B] one-hot is ever
@@ -1196,7 +1196,14 @@ def _sums_xla(load, gen, sell, bucket_id, scales, packed=None, *,
 
     def per_scale(s_r):
         net = load_c - s_r[:, None] * gen_c                  # [N, Hc]
-        pos = jnp.maximum(net, 0.0)
+        if soft_tau is None:
+            pos = jnp.maximum(net, 0.0)
+        else:
+            # the differentiable twin (dgen_tpu.grad): soft
+            # import/export split, kW-unit temperature
+            from dgen_tpu.grad.smooth import relu_t
+
+            pos = relu_t(net, soft_tau)
         imports = bucketize(pos)
         imp_sell = jnp.sum(pos * sell_c, axis=1)
         if with_signed:
@@ -1282,7 +1289,27 @@ def _check_buckets(n_buckets: int) -> None:
 #: serve.engine.QUERY_STATIC_ARGNAMES): the program auditor
 #: (dgen_tpu.lint.prog) lowers these kernels over the same set, so the
 #: audited bill-kernel programs are the ones production compiles
-SUMS_STATIC_ARGNAMES = ("n_buckets", "impl", "bf16", "mesh", "layout")
+SUMS_STATIC_ARGNAMES = (
+    "n_buckets", "impl", "bf16", "mesh", "layout", "soft_tau",
+)
+
+
+def _check_soft(soft_tau, resolved, layout, packed) -> None:
+    """The smooth twin prices on the plain f32 full-hour XLA path only:
+    the Pallas engines have no VJP and the compacted/packed layouts'
+    night-sum split assumes the HARD relu's exact zeros."""
+    if soft_tau is None:
+        return
+    if resolved != "xla":
+        raise ValueError(
+            f"soft_tau requires impl='xla' (got '{resolved}'); the "
+            "smooth twin has no Pallas lowering"
+        )
+    if layout is not None or packed is not None:
+        raise ValueError(
+            "soft_tau is incompatible with daylight layouts / packed "
+            "streams (their night-sum split assumes hard-relu zeros)"
+        )
 
 
 @partial(jax.jit, static_argnames=SUMS_STATIC_ARGNAMES)
@@ -1300,6 +1327,7 @@ def import_sums(
     packed: Optional[PackedStreams] = None,
     load_scale: Optional[jax.Array] = None,   # [N] int8 dequant scales
     gen_scale: Optional[jax.Array] = None,
+    soft_tau: Optional[float] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(imports [N,R,B], imp_sell [N,R]): positive-part bucket sums and
     the sell-weighted positive-part sum for R net-load scales.
@@ -1319,9 +1347,12 @@ def import_sums(
     int8 quantized banks (:func:`_quant_fold`); the kernels run in
     quantized units (f32 upcast + accumulate) and outputs rescale
     once. ``impl="pallas_stream"`` selects the double-buffered
-    (agent-block x month-segment) engine on TPU (XLA twin elsewhere)."""
+    (agent-block x month-segment) engine on TPU (XLA twin elsewhere).
+    ``soft_tau`` (static): the differentiable twin's soft relu split —
+    XLA engine only, no layout/packed (see :func:`_check_soft`)."""
     _check_buckets(n_buckets)
     resolved = _resolve_impl(impl)
+    _check_soft(soft_tau, resolved, layout, packed)
     scales_eff, post = _quant_fold(scales, load_scale, gen_scale)
     if resolved == "pallas":
         fn = partial(_sums_pallas, with_signed=False,
@@ -1337,7 +1368,7 @@ def import_sums(
         fn = partial(_sums_pallas_dot, with_signed=False, bf16=bf16)
     else:
         fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=False,
-                     layout=layout)
+                     layout=layout, soft_tau=soft_tau)
     args = (load, gen, sell, bucket_id, scales_eff)
     if packed is not None:
         args = args + (packed,)
@@ -1364,6 +1395,7 @@ def import_sums_pair(
     packed: Optional[PackedStreams] = None,
     load_scale: Optional[jax.Array] = None,
     gen_scale: Optional[jax.Array] = None,
+    soft_tau: Optional[float] = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """(imports_a [N,R,B], imp_sell_a [N,R], imports_b, imp_sell_b):
     the rate-switch search's two tariff structures priced over ONE
@@ -1377,6 +1409,7 @@ def import_sums_pair(
     month kernel (still one shared net grid)."""
     _check_buckets(n_buckets)
     resolved = _resolve_impl(impl)
+    _check_soft(soft_tau, resolved, layout, packed)
     scales_eff, post = _quant_fold(scales, load_scale, gen_scale)
     if resolved in ("pallas", "pallas_stream"):
         fn = partial(_sums_pallas_pair, n_periods=n_buckets // MONTHS,
@@ -1396,7 +1429,8 @@ def import_sums_pair(
             fa = partial(_sums_pallas_dot, with_signed=False)
         else:
             fa = partial(_sums_xla, n_buckets=n_buckets,
-                         with_signed=False, layout=layout)
+                         with_signed=False, layout=layout,
+                         soft_tau=soft_tau)
         args_a = (load, gen, sell_a, bucket_a, scales_eff)
         args_b = (load, gen, sell_b, bucket_b, scales_eff)
         if packed is not None:
@@ -1424,6 +1458,7 @@ def bucket_sums(
     impl: str = "auto",
     mesh=None,
     packed: Optional[PackedStreams] = None,
+    soft_tau: Optional[float] = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """(signed [N,R,B], imports [N,R,B], export_credit [N,R]) — the full
     reduction set (battery forward runs, tests).
@@ -1437,6 +1472,7 @@ def bucket_sums(
     battery path prices dequantized f32 streams)."""
     _check_buckets(n_buckets)
     resolved = _resolve_impl(impl)
+    _check_soft(soft_tau, resolved, None, packed)
     if resolved == "pallas":
         fn = partial(_sums_pallas, with_signed=True,
                      n_periods=n_buckets // MONTHS)
@@ -1447,7 +1483,8 @@ def bucket_sums(
         _reject_packed_for_dot(packed)
         fn = partial(_sums_pallas_dot, with_signed=True)
     else:
-        fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=True)
+        fn = partial(_sums_xla, n_buckets=n_buckets, with_signed=True,
+                     soft_tau=soft_tau)
     args = (load, gen, sell, bucket_id, scales)
     if packed is not None:
         args = args + (packed,)
@@ -1521,7 +1558,7 @@ def sell_rate_hourly(tariff, ts_sell: jax.Array) -> jax.Array:
     return jnp.where(has_tou, tou, ts_sell).astype(ts_sell.dtype)
 
 
-def _tier_charge_batched(sums_mp, tariff):
+def _tier_charge_batched(sums_mp, tariff, soft_tau=None):
     """[N, R, 12, P] monthly sums -> [N, R] annual tiered charges.
 
     Same semantics as ``bill.tiered_charge`` but written as a static
@@ -1529,7 +1566,24 @@ def _tier_charge_batched(sums_mp, tariff):
     [N, R, 12, P] — the vmap-of-vmap formulation materializes an extra
     T axis ([N, R, 12, P, T]), several GB at 16k+ agents x 250 scales,
     and HBM pressure there is what capped population scaling.
+
+    ``soft_tau`` (kWh): smooth the tier-edge clips for the
+    differentiable twin (dgen_tpu.grad); ``None`` = exact hard clip.
     """
+    if soft_tau is None:
+        def seg_fn(x, w):
+            return jnp.clip(x, 0.0, w)
+
+        def neg_fn(x):
+            return jnp.minimum(x, 0.0)
+    else:
+        from dgen_tpu.grad.smooth import clip0_t, min0_t
+
+        def seg_fn(x, w):
+            return clip0_t(x, w, soft_tau)
+
+        def neg_fn(x):
+            return min0_t(x, soft_tau)
     price = tariff.price          # [N, P, T]
     caps = tariff.tier_cap        # [N, T]
     n_tiers = price.shape[-1]
@@ -1540,11 +1594,11 @@ def _tier_charge_batched(sums_mp, tariff):
     total = jnp.zeros(sums_mp.shape[:2], dtype=sums_mp.dtype)   # [N, R]
     for t in range(n_tiers):
         lo = lower[:, t][:, None, None, None]
-        seg = jnp.clip(sums_mp - lo, 0.0, width[:, t][:, None, None, None])
+        seg = seg_fn(sums_mp - lo, width[:, t][:, None, None, None])
         total = total + jnp.einsum("nrmp,np->nr", seg, price[:, :, t])
     # negative (net-metered export) months credit at tier-1 price
     total = total + jnp.einsum(
-        "nrmp,np->nr", jnp.minimum(sums_mp, 0.0), price[:, :, 0]
+        "nrmp,np->nr", neg_fn(sums_mp), price[:, :, 0]
     )
     return total
 
@@ -1555,21 +1609,22 @@ def bills_from_sums(
     credit: jax.Array,    # [N, R]
     tariff,               # batched AgentTariff (leaves [N, ...])
     n_periods: int,
+    soft_tau: float | None = None,
 ) -> jax.Array:
     """Annual bills [N, R] from full bucket sums (tier structure +
     metering selection + fixed charges; bill.annual_bill semantics)."""
     n, r, _ = signed.shape
     bill_nem = _tier_charge_batched(
-        signed.reshape(n, r, MONTHS, n_periods), tariff)
+        signed.reshape(n, r, MONTHS, n_periods), tariff, soft_tau)
     bill_nb = _tier_charge_batched(
-        imports.reshape(n, r, MONTHS, n_periods), tariff) - credit
+        imports.reshape(n, r, MONTHS, n_periods), tariff, soft_tau) - credit
 
     is_nb = (tariff.metering == NET_BILLING)[:, None]
     energy_bill = jnp.where(is_nb, bill_nb, bill_nem)
     return energy_bill + MONTHS * tariff.fixed_monthly[:, None]
 
 
-def _nem_energy_bill(lin, scales, tariff, n_periods):
+def _nem_energy_bill(lin, scales, tariff, n_periods, soft_tau=None):
     """[N, R] annual NEM energy bills via the linear identity
     ``signed(s) = S_load - s * S_gen`` (no fixed charges) — the single
     definition shared by the all-NEM fast path and the mixed-metering
@@ -1578,7 +1633,7 @@ def _nem_energy_bill(lin, scales, tariff, n_periods):
     n, r = scales.shape
     signed = s_load[:, None, :] - scales[:, :, None] * s_gen[:, None, :]
     return _tier_charge_batched(
-        signed.reshape(n, r, MONTHS, n_periods), tariff)
+        signed.reshape(n, r, MONTHS, n_periods), tariff, soft_tau)
 
 
 def bills_linear_nem(
@@ -1586,13 +1641,14 @@ def bills_linear_nem(
     scales: jax.Array,    # [N, R]
     tariff,
     n_periods: int,
+    soft_tau: float | None = None,
 ) -> jax.Array:
     """Annual bills [N, R] for an all-NET-METERING population: the
     pure linear identity — NO hourly kernel work at all. Callers must
     guarantee no agent prices on a net-billing tariff (the driver
     derives that statically from the tariffs the population actually
     references plus the NEM gate's never-closes proof)."""
-    bill = _nem_energy_bill(lin, scales, tariff, n_periods)
+    bill = _nem_energy_bill(lin, scales, tariff, n_periods, soft_tau)
     return bill + MONTHS * tariff.fixed_monthly[:, None]
 
 
@@ -1603,6 +1659,7 @@ def bills_linear_nb(
     scales: jax.Array,    # [N, R]
     tariff,
     n_periods: int,
+    soft_tau: float | None = None,
 ) -> jax.Array:
     """Annual bills [N, R] from the search path's reduced outputs:
     NEM via the linear identity, net billing via import sums + the
@@ -1610,11 +1667,11 @@ def bills_linear_nb(
     s_l_sell, s_g_sell = lin[2], lin[3]
     n, r, _ = imports.shape
 
-    bill_nem = _nem_energy_bill(lin, scales, tariff, n_periods)
+    bill_nem = _nem_energy_bill(lin, scales, tariff, n_periods, soft_tau)
 
     credit = imp_sell - (s_l_sell[:, None] - scales * s_g_sell[:, None])
     bill_nb = _tier_charge_batched(
-        imports.reshape(n, r, MONTHS, n_periods), tariff) - credit
+        imports.reshape(n, r, MONTHS, n_periods), tariff, soft_tau) - credit
 
     is_nb = (tariff.metering == NET_BILLING)[:, None]
     energy_bill = jnp.where(is_nb, bill_nb, bill_nem)

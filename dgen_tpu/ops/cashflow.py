@@ -275,7 +275,7 @@ def cashflow(
     }
 
 
-def payback_period(cf: jax.Array) -> jax.Array:
+def payback_period(cf: jax.Array, soft: bool = False) -> jax.Array:
     """Fractional payback year from a [Y+1] cashflow (year 0 = equity).
 
     Semantics match the reference's vectorized implementation
@@ -286,6 +286,13 @@ def payback_period(cf: jax.Array) -> jax.Array:
     the parity target), linearly interpolated within that year;
     ``PAYBACK_NEVER`` (30.1) if it never turns positive; 0 if the
     cumulative flow is positive from year 0; rounded to 0.1.
+
+    ``soft=True`` (the differentiable twin, dgen_tpu.grad) skips the
+    final round-to-0.1: the crossing-year selection is a
+    piecewise-constant gather (zero gradient, deliberately — the
+    envelope through the selected year's ``cum`` values carries the
+    payback gradient), and the within-year interpolation ``frac`` is
+    smooth in the cashflow, so dropping the snap is all grad needs.
     """
     cum = jnp.cumsum(cf)
     n = cf.shape[0] - 1  # tech lifetime
@@ -304,4 +311,6 @@ def payback_period(cf: jax.Array) -> jax.Array:
     frac = base_val / (base_val - next_val + 1e-9)
     pp = base_year + frac
     pp = jnp.where(no_payback, PAYBACK_NEVER, jnp.where(instant, 0.0, pp))
+    if soft:
+        return pp
     return jnp.round(pp * 10.0) / 10.0

@@ -124,6 +124,29 @@ def _switch_active(env: AgentEconInputs, kw: jax.Array) -> jax.Array:
     return (kw >= mn) & (kw < mx)
 
 
+def _switch_weight(
+    env: AgentEconInputs, kw: jax.Array, soft_tau: float | None
+) -> jax.Array:
+    """Float [0, 1] DG-rate-switch indicator at system size ``kw``.
+
+    ``soft_tau=None`` is the exact hard window cast to f32. Under soft
+    it is a straight-through gate pair (grad.smooth.ste_gate): forward
+    still evaluates the window test (closed at the upper edge — a
+    measure-zero difference from the hard strict ``<``), backward
+    carries a sigmoid bump of width ``soft_tau`` kW so the switch
+    boundary is visible to gradients instead of a dead zero."""
+    mn, mx = env.switch_min_kw, env.switch_max_kw
+    if mn is None:
+        return jnp.ones_like(kw)
+    if kw.ndim == mn.ndim + 1:
+        mn, mx = mn[..., None], mx[..., None]
+    if soft_tau is None:
+        return ((kw >= mn) & (kw < mx)).astype(kw.dtype)
+    from dgen_tpu.grad.smooth import ste_gate
+
+    return ste_gate(kw - mn, soft_tau) * ste_gate(mx - kw, soft_tau)
+
+
 def _npv_given_system_out(
     env: AgentEconInputs,
     system_kw: jax.Array,
@@ -344,7 +367,8 @@ def size_one_agent(
 @partial(
     jax.jit,
     static_argnames=("n_periods", "n_years", "n_iters", "keep_hourly", "impl",
-                     "mesh", "net_billing", "daylight", "pack_once"),
+                     "mesh", "net_billing", "daylight", "pack_once",
+                     "soft_tau"),
 )
 def _size_agents_fast(
     envs: AgentEconInputs,
@@ -357,6 +381,7 @@ def _size_agents_fast(
     net_billing: bool = True,
     daylight=None,
     pack_once: bool = False,
+    soft_tau: float | None = None,
 ) -> SizingResult:
     """Table-level sizing via two refining candidate-grid rounds.
 
@@ -373,6 +398,19 @@ def _size_agents_fast(
     n = envs.load.shape[0]
     f32 = jnp.float32
     k = max(int(n_iters), 4)
+
+    # the smooth twin prices on the plain f32 full-hour path only:
+    # quantized codes round-trip through hard thresholds, and the
+    # compacted/packed layouts' night-sum split assumes the hard relu's
+    # exact zeros (config.RunConfig.soft_boundaries rejects these
+    # upstream; this guard covers direct callers)
+    if soft_tau is not None and (
+        envs.load_scale is not None or daylight is not None or pack_once
+    ):
+        raise ValueError(
+            "soft_tau requires plain f32 full-hour banks (no "
+            "quant_banks / daylight_compact / pack_once)"
+        )
 
     # the stream engine pipelines uniform (agent-block x month-segment)
     # blocks; a compacted layout is padded to its longest month once,
@@ -448,7 +486,8 @@ def _size_agents_fast(
         )
     imp0 = lin_wo[0][:, None, :]       # imports at s=0 == S_load buckets
     bills_wo = billpallas.bills_linear_nb(
-        lin_wo, imp0, lin_wo[2][:, None], zeros1, envs.tariff, n_periods
+        lin_wo, imp0, lin_wo[2][:, None], zeros1, envs.tariff, n_periods,
+        soft_tau,
     )[:, 0:1] * pf                                                # [N, Y]
 
     cashflow_v = jax.vmap(
@@ -473,9 +512,15 @@ def _size_agents_fast(
         # The one-time (interconnection) charge applies only where the
         # DG-rate switch takes effect (reference elec.py:857-860).
         unsq = (lambda x: x[:, None]) if kw.ndim == 2 else (lambda x: x)
-        otc = jnp.where(
-            _switch_active(envs, kw), unsq(envs.one_time_charge), 0.0
-        )
+        if soft_tau is None:
+            otc = jnp.where(
+                _switch_active(envs, kw), unsq(envs.one_time_charge), 0.0
+            )
+        else:
+            # STE gate: forward identical, backward sees the boundary
+            otc = _switch_weight(envs, kw, soft_tau) * unsq(
+                envs.one_time_charge
+            )
         return (
             unsq(envs.system_capex_per_kw) * kw * unsq(envs.cap_cost_multiplier)
             + otc
@@ -513,21 +558,21 @@ def _size_agents_fast(
         """
         if not net_billing:
             bills_sw = billpallas.bills_linear_nem(
-                lin, scales, tw, n_periods)
+                lin, scales, tw, n_periods, soft_tau)
             if not has_switch:
                 return bills_sw, None
             return bills_sw, billpallas.bills_linear_nem(
-                lin_wo, scales, envs.tariff, n_periods)
+                lin_wo, scales, envs.tariff, n_periods, soft_tau)
         none_if_packed = lambda a: None if packed is not None else a
         if not has_switch:
             imports, imp_sell = billpallas.import_sums(
                 none_if_packed(envs.load), none_if_packed(gen_shape),
                 none_if_packed(sell), none_if_packed(bucket), scales,
                 n_buckets, impl, mesh=mesh, layout=daylight,
-                packed=packed, **kq,
+                packed=packed, soft_tau=soft_tau, **kq,
             )
             return billpallas.bills_linear_nb(
-                lin, imports, imp_sell, scales, tw, n_periods
+                lin, imports, imp_sell, scales, tw, n_periods, soft_tau
             ), None
         # switch populations price every candidate on BOTH tariffs over
         # the same relu(net) grid — one fused kernel call (the net build
@@ -538,14 +583,15 @@ def _size_agents_fast(
                 none_if_packed(sell), none_if_packed(bucket),
                 none_if_packed(sell_wo), none_if_packed(bucket_wo),
                 scales, n_buckets, impl, mesh=mesh, layout=daylight,
-                packed=packed, **kq,
+                packed=packed, soft_tau=soft_tau, **kq,
             )
         )
         bills_sw = billpallas.bills_linear_nb(
-            lin, imports, imp_sell, scales, tw, n_periods
+            lin, imports, imp_sell, scales, tw, n_periods, soft_tau
         )
         bills_o = billpallas.bills_linear_nb(
-            lin_wo, imports_o, imp_sell_o, scales, envs.tariff, n_periods
+            lin_wo, imports_o, imp_sell_o, scales, envs.tariff, n_periods,
+            soft_tau,
         )
         return bills_sw, bills_o
 
@@ -558,9 +604,17 @@ def _size_agents_fast(
         scales = (kw_grid[:, :, None] * df[:, None, :]).reshape(n, k * n_years)
         bills_sw, bills_o = candidate_bills(scales)
         if has_switch:
-            in_w = _switch_active(envs, kw_grid)                  # [N, K]
-            sel = jnp.repeat(in_w, n_years, axis=1)               # [N, K*Y]
-            bills = jnp.where(sel, bills_sw, bills_o)
+            if soft_tau is None:
+                in_w = _switch_active(envs, kw_grid)              # [N, K]
+                sel = jnp.repeat(in_w, n_years, axis=1)           # [N, K*Y]
+                bills = jnp.where(sel, bills_sw, bills_o)
+            else:
+                # STE-weighted blend: forward matches the hard select,
+                # backward carries the window boundary
+                w = jnp.repeat(
+                    _switch_weight(envs, kw_grid, soft_tau), n_years, axis=1
+                )
+                bills = w * bills_sw + (1.0 - w) * bills_o
         else:
             bills = bills_sw
         bills = bills.reshape(n, k, n_years) * pf[:, None, :]     # [N, K, Y]
@@ -603,7 +657,9 @@ def _size_agents_fast(
     )[:, 0, :]                                                    # [N, Y]
     out_n = econ(bills_w_n, kw_star, pv_cost(kw_star), jnp.zeros(n, f32),
                  kw_star * INV_EFF * naep)
-    payback = jax.vmap(cf_ops.payback_period)(out_n["cf"])
+    payback = jax.vmap(
+        partial(cf_ops.payback_period, soft=soft_tau is not None)
+    )(out_n["cf"])
 
     # --- Forward run with battery at fixed ratio ---
     batt_kw, batt_kwh = dispatch_ops.batt_size_from_pv(kw_star)
@@ -652,9 +708,10 @@ def _size_agents_fast(
         None if batt_packed is not None else sell_star,
         None if batt_packed is not None else bucket_star,
         df, n_buckets, impl, mesh=mesh, packed=batt_packed,
+        soft_tau=soft_tau,
     )
     bills_w_b = billpallas.bills_from_sums(
-        s_b, i_b, c_b, tariff_star, n_periods
+        s_b, i_b, c_b, tariff_star, n_periods, soft_tau
     ) * pf
     out_w = econ(bills_w_b, kw_star, cost_w, envs.value_of_resiliency_usd,
                  jnp.sum(dr.system_out, axis=1))
@@ -692,6 +749,28 @@ def _size_agents_fast(
     )
 
 
+def _fill_env_defaults(envs: AgentEconInputs) -> AgentEconInputs:
+    """Fill the legacy ``None`` sentinels with their dense encodings:
+    unlimited NEM bracket (1e30) and an always-on switch window when a
+    ``tariff_w`` was supplied (switch_min_kw=0) / never-on otherwise."""
+    if (envs.nem_kw_cap is not None and envs.switch_min_kw is not None
+            and envs.switch_max_kw is not None):
+        return envs
+    n = envs.load.shape[0]
+    big = jnp.full(n, 1e30, jnp.float32)
+    return dataclasses.replace(
+        envs,
+        nem_kw_cap=big if envs.nem_kw_cap is None else envs.nem_kw_cap,
+        switch_min_kw=(
+            (jnp.zeros(n, jnp.float32) if envs.tariff_w is not None else big)
+            if envs.switch_min_kw is None else envs.switch_min_kw
+        ),
+        switch_max_kw=(
+            big if envs.switch_max_kw is None else envs.switch_max_kw
+        ),
+    )
+
+
 def size_agents(
     envs: AgentEconInputs,
     n_periods: int,
@@ -704,6 +783,7 @@ def size_agents(
     net_billing: bool = True,
     daylight=None,
     pack_once: bool = False,
+    soft_tau: float | None = None,
 ) -> SizingResult:
     """Sizing over the whole agent table (leading axis).
 
@@ -725,6 +805,9 @@ def size_agents(
     streams once per call (:class:`billpallas.PackedStreams`) instead
     of once per engine call — the refine rounds (and, where the
     layouts line up, the battery run) then read pre-packed lanes.
+    ``soft_tau``: the differentiable smooth-boundary twin
+    (:mod:`dgen_tpu.grad`) — soft import/export splits, tier clips and
+    STE switch gates inside the search objective; fast path only.
     """
     if envs.load_scale is not None and not fast:
         raise ValueError(
@@ -732,29 +815,19 @@ def size_agents(
             "fast-path representation; the per-agent oracle prices "
             "full-precision streams — dequantize or use fast=True"
         )
-    if (envs.nem_kw_cap is None or envs.switch_min_kw is None
-            or envs.switch_max_kw is None):
-        n = envs.load.shape[0]
-        big = jnp.full(n, 1e30, jnp.float32)
-        # legacy default: unlimited NEM bracket; switch (if any tariff_w
-        # was supplied) applies at every size
-        envs = dataclasses.replace(
-            envs,
-            nem_kw_cap=big if envs.nem_kw_cap is None else envs.nem_kw_cap,
-            switch_min_kw=(
-                (jnp.zeros(n, jnp.float32) if envs.tariff_w is not None else big)
-                if envs.switch_min_kw is None else envs.switch_min_kw
-            ),
-            switch_max_kw=(
-                big if envs.switch_max_kw is None else envs.switch_max_kw
-            ),
+    if soft_tau is not None and not fast:
+        raise ValueError(
+            "soft_tau is a fast-path knob; the per-agent oracle stays "
+            "the exact hard reference (use fast=True, or "
+            "make_npv_objective for a differentiable per-size objective)"
         )
+    envs = _fill_env_defaults(envs)
     if fast:
         return _size_agents_fast(
             envs, n_periods=n_periods, n_years=n_years, n_iters=n_iters,
             keep_hourly=keep_hourly, impl=impl, mesh=mesh,
             net_billing=net_billing, daylight=daylight,
-            pack_once=pack_once,
+            pack_once=pack_once, soft_tau=soft_tau,
         )
     fn = partial(
         size_one_agent,
@@ -764,3 +837,161 @@ def size_agents(
         keep_hourly=keep_hourly,
     )
     return jax.vmap(fn)(envs)
+
+
+def make_npv_objective(
+    envs: AgentEconInputs,
+    n_periods: int,
+    n_years: int,
+    *,
+    net_billing: bool = True,
+    soft_tau: float | None = None,
+    impl: str = "xla",
+):
+    """Build the batched differentiable sizing objective for
+    :mod:`dgen_tpu.grad.newton`.
+
+    Returns ``(npv_fn, lo, hi)``: ``npv_fn(kw)`` maps per-agent system
+    sizes ``[N]`` (or a candidate grid ``[N, K]``) to NPV of the same
+    shape. The per-agent prologue — linear bill structure, no-system
+    bills, price/degradation factors — is computed ONCE here and closed
+    over, so each objective evaluation costs what one refine-round
+    column of :func:`_size_agents_fast` does: a single import-sums
+    kernel call (none at all for an all-NEM population). One
+    ``jax.value_and_grad(npv_fn)`` step therefore replaces a whole
+    16-candidate search round.
+
+    With ``soft_tau`` set, every boundary inside the objective — the
+    hourly import/export split, tier-cap clips, the DG-rate-switch
+    window (straight-through gate) — is the smooth surrogate from
+    :mod:`dgen_tpu.grad.smooth`, so ``jax.grad`` sees a usable
+    derivative everywhere. With ``soft_tau=None`` the surface is the
+    same piecewise-smooth objective the grid search evaluates
+    (differentiable a.e., kinked at the boundaries).
+
+    Quantized / daylight-compacted / pre-packed bank representations
+    are not supported: build plain f32 envs
+    (``RunConfig.soft_boundaries`` enforces this upstream).
+    """
+    if envs.load_scale is not None:
+        raise ValueError(
+            "make_npv_objective prices full-precision streams; "
+            "dequantize the banks first (quant_banks is incompatible "
+            "with the differentiable objective)"
+        )
+    envs = _fill_env_defaults(envs)
+    n = envs.load.shape[0]
+    f32 = jnp.float32
+
+    naep = jnp.sum(envs.gen_per_kw.astype(f32), axis=1)           # [N]
+    max_system = envs.load_kwh_per_customer / jnp.maximum(naep, 1e-9)
+    lo = max_system * SIZE_LO_FRAC
+    hi = jnp.minimum(max_system * SIZE_HI_FRAC, envs.nem_kw_cap)
+    lo = jnp.minimum(lo, hi)
+
+    n_buckets = 12 * n_periods
+    has_switch = envs.tariff_w is not None
+    tw = envs.tariff if envs.tariff_w is None else envs.tariff_w
+    bucket = billpallas.hourly_bucket_ids(tw.hour_period, n_periods)
+    sell = billpallas.sell_rate_hourly(tw, envs.ts_sell)
+    gen_shape = envs.gen_per_kw * INV_EFF
+
+    yr = jnp.arange(n_years, dtype=f32)[None, :]                  # [1, Y]
+    pf = (
+        (1.0 + envs.fin.inflation_rate[:, None])
+        * (1.0 + envs.elec_price_escalator[:, None])
+    ) ** yr                                                       # [N, Y]
+    df = (1.0 - envs.pv_degradation[:, None]) ** yr               # [N, Y]
+
+    lin = billpallas.linear_sums(
+        envs.load, gen_shape, sell, tw.hour_period, n_periods
+    )
+    zeros1 = jnp.zeros((n, 1), f32)
+    if envs.tariff_w is None:
+        lin_wo, sell_wo, bucket_wo = lin, sell, bucket
+    else:
+        sell_wo = billpallas.sell_rate_hourly(envs.tariff, envs.ts_sell)
+        lin_wo = billpallas.linear_sums(
+            envs.load, gen_shape, sell_wo, envs.tariff.hour_period, n_periods
+        )
+        bucket_wo = billpallas.hourly_bucket_ids(
+            envs.tariff.hour_period, n_periods
+        )
+    imp0 = lin_wo[0][:, None, :]
+    bills_wo = billpallas.bills_linear_nb(
+        lin_wo, imp0, lin_wo[2][:, None], zeros1, envs.tariff, n_periods,
+        soft_tau,
+    )[:, 0:1] * pf                                                # [N, Y]
+
+    cashflow_v = jax.vmap(
+        lambda ev, cost, fin, kw, kwh, deg, inc: cf_ops.cashflow(
+            ev, cost, fin, n_years, system_kw=kw, annual_kwh=kwh,
+            degradation=deg, inc=inc,
+        )
+    )
+
+    def npv_fn(kw: jax.Array) -> jax.Array:
+        squeeze = kw.ndim == 1
+        kw2 = kw[:, None] if squeeze else kw                      # [N, K]
+        kk = kw2.shape[1]
+        scales = (kw2[:, :, None] * df[:, None, :]).reshape(n, kk * n_years)
+        if not net_billing:
+            bills_sw = billpallas.bills_linear_nem(
+                lin, scales, tw, n_periods, soft_tau)
+            bills_o = (
+                billpallas.bills_linear_nem(
+                    lin_wo, scales, envs.tariff, n_periods, soft_tau)
+                if has_switch else None
+            )
+        elif not has_switch:
+            imports, imp_sell = billpallas.import_sums(
+                envs.load, gen_shape, sell, bucket, scales, n_buckets,
+                impl, soft_tau=soft_tau,
+            )
+            bills_sw = billpallas.bills_linear_nb(
+                lin, imports, imp_sell, scales, tw, n_periods, soft_tau
+            )
+            bills_o = None
+        else:
+            imports, imp_sell, imports_o, imp_sell_o = (
+                billpallas.import_sums_pair(
+                    envs.load, gen_shape, sell, bucket, sell_wo, bucket_wo,
+                    scales, n_buckets, impl, soft_tau=soft_tau,
+                )
+            )
+            bills_sw = billpallas.bills_linear_nb(
+                lin, imports, imp_sell, scales, tw, n_periods, soft_tau
+            )
+            bills_o = billpallas.bills_linear_nb(
+                lin_wo, imports_o, imp_sell_o, scales, envs.tariff,
+                n_periods, soft_tau,
+            )
+        if has_switch:
+            w = jnp.repeat(
+                _switch_weight(envs, kw2, soft_tau), n_years, axis=1
+            )
+            bills = w * bills_sw + (1.0 - w) * bills_o
+        else:
+            bills = bills_sw
+        bills = bills.reshape(n, kk, n_years) * pf[:, None, :]    # [N, K, Y]
+
+        ev = (bills_wo[:, None, :] - bills).reshape(n * kk, n_years)
+        kw_f = kw2.reshape(n * kk)
+        rep1 = lambda x: jnp.repeat(x, kk)
+        otc = _switch_weight(envs, kw2, soft_tau).reshape(n * kk) * rep1(
+            envs.one_time_charge
+        )
+        cost = (
+            rep1(envs.system_capex_per_kw) * kw_f
+            * rep1(envs.cap_cost_multiplier) + otc
+        )
+        rep = lambda x: jnp.repeat(x, kk, axis=0)
+        out = cashflow_v(
+            ev, cost, jax.tree.map(rep, envs.fin), kw_f,
+            kw_f * INV_EFF * rep1(naep), rep1(envs.pv_degradation),
+            jax.tree.map(rep, envs.inc),
+        )
+        npv = out["npv"].reshape(n, kk)
+        return npv[:, 0] if squeeze else npv
+
+    return npv_fn, lo, hi

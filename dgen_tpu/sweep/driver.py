@@ -95,6 +95,8 @@ def sweep_year_step(
     net_billing: bool = True,
     daylight=None,
     pack_once: bool = False,
+    soft_tau=None,
+    anchor: bool = True,
 ):
     """One model year for S scenarios as a single device program: the
     un-jitted :func:`year_step_impl` vmapped over the scenario axis of
@@ -112,7 +114,7 @@ def sweep_year_step(
             year_step_len=year_step_len, sizing_impl=sizing_impl,
             rate_switch=rate_switch, mesh=mesh, agent_chunk=agent_chunk,
             net_billing=net_billing, daylight=daylight,
-            pack_once=pack_once,
+            pack_once=pack_once, soft_tau=soft_tau, anchor=anchor,
         )
 
     return jax.vmap(one)(inputs_s, carry)

@@ -1,0 +1,276 @@
+"""dgen_tpu.grad tests: the smooth primitives against their hard
+counterparts, finite-difference gradcheck of the differentiable NPV
+objective at the boundary-heavy synthetic world, Newton sizing parity
+with the bracketed per-agent oracle, calibration recovering a seeded
+(p, q), the J11 gradient-killer rule (positive/negative/exemption
+cases), soft-mode steady-state retrace cleanliness, and — the
+fingerprint contract — a committed hard-path cost entry lowering to
+the exact committed program hash with the grad machinery imported."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dgen_tpu.config import RunConfig
+from dgen_tpu.grad import calibrate, newton, smooth
+from dgen_tpu.grad.__main__ import CHECK_GRAD_RTOL, _world_envs, gradcheck
+from dgen_tpu.lint.prog import lower_spec, run_program_rules
+from dgen_tpu.lint.prog.registry import build_registry
+from dgen_tpu.lint.prog.spec import Bound, ProgramSpec, anchor_for
+from dgen_tpu.ops import sizing
+
+from test_simulation import make_sim
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "prog_baseline.json")
+
+
+# ---------------------------------------------------------------------------
+# smooth primitives: hard limits, STE forward exactness, lerp gradient
+# ---------------------------------------------------------------------------
+
+def test_relu_t_converges_to_relu():
+    x = jnp.linspace(-5.0, 5.0, 41)
+    hard = jnp.maximum(x, 0.0)
+    for tau in (0.5, 0.1, 0.01):
+        soft = smooth.relu_t(x, tau)
+        # softplus overestimates by at most tau*log(2), at the kink
+        assert float(jnp.max(jnp.abs(soft - hard))) <= tau * 0.6932
+    # smooth everywhere: gradient at the kink is exactly 1/2
+    g = jax.grad(lambda v: smooth.relu_t(v, 0.1))(jnp.float32(0.0))
+    assert abs(float(g) - 0.5) < 1e-6
+
+
+def test_clip0_t_matches_hard_clip_away_from_edges():
+    x = jnp.linspace(-3.0, 8.0, 45)
+    width = jnp.float32(5.0)
+    hard = jnp.clip(x, 0.0, width)
+    soft = smooth.clip0_t(x, width, 0.05)
+    inside = (jnp.abs(x) > 0.5) & (jnp.abs(x - width) > 0.5)
+    assert float(jnp.max(jnp.where(inside, jnp.abs(soft - hard), 0.0))) < 1e-3
+    # degenerate tier (width=0) collapses to 0 like the hard clip
+    z = smooth.clip0_t(jnp.float32(2.0), jnp.float32(0.0), 0.05)
+    assert abs(float(z)) < 1e-6
+
+
+def test_ste_gate_forward_is_hard_backward_is_bump():
+    x = jnp.asarray([-1.0, -1e-4, 0.0, 1e-4, 1.0], dtype=jnp.float32)
+    hard = (x >= 0.0).astype(jnp.float32)
+    # tau=None is the oracle path: plain comparison
+    np.testing.assert_array_equal(np.asarray(smooth.ste_gate(x, None)),
+                                  np.asarray(hard))
+    # with a temperature the VALUE is still exactly hard ...
+    np.testing.assert_array_equal(np.asarray(smooth.ste_gate(x, 0.1)),
+                                  np.asarray(hard))
+    # ... but the derivative is the sigmoid bump s(1-s)/tau
+    tau = 0.1
+    g = jax.vmap(jax.grad(lambda v: smooth.ste_gate(v, tau)))(x)
+    s = jax.nn.sigmoid(x / tau)
+    np.testing.assert_allclose(np.asarray(g),
+                               np.asarray(s * (1 - s) / tau), rtol=1e-5)
+    # forward-over-reverse (the Newton Hessian path) must not error
+    h = jax.grad(jax.grad(lambda v: smooth.ste_gate(v, tau) * v))(
+        jnp.float32(0.3))
+    assert np.isfinite(float(h))
+
+
+def test_lerp_lookup_interpolates_and_differentiates():
+    table = jnp.asarray([[0.0, 10.0, 40.0, 90.0]], dtype=jnp.float32)
+    mid = smooth.lerp_lookup(table, jnp.asarray([1.5]))
+    assert abs(float(mid[0]) - 25.0) < 1e-5
+    # gradient w.r.t. the coordinate is the bracketing slope
+    g = jax.grad(
+        lambda i: smooth.lerp_lookup(table, i[None])[0]
+    )(jnp.float32(1.5))
+    assert abs(float(g) - 30.0) < 1e-4
+    # out-of-range coordinates clamp to the end rows
+    ends = smooth.lerp_lookup(table, jnp.asarray([-3.0, 99.0]))
+    np.testing.assert_allclose(np.asarray(ends), [0.0, 90.0], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# finite-difference gradcheck of the smooth NPV objective
+# ---------------------------------------------------------------------------
+
+def test_gradcheck_smooth_objective_against_central_differences():
+    """jax.grad of the soft objective matches central differences at
+    interior sizes AND within a few tau of tariff-tier/TOU boundary
+    crossings (agents inside the STE switch window are excluded — the
+    forward there is deliberately hard)."""
+    out = gradcheck(n_agents=8, seed=7, tau=0.1)
+    assert out["ok"], out
+    assert out["max_rel_err"] < CHECK_GRAD_RTOL
+
+
+# ---------------------------------------------------------------------------
+# Newton sizing vs the bracketed oracle
+# ---------------------------------------------------------------------------
+
+def test_newton_size_matches_bracketed_oracle_within_xatol():
+    envs, meta = _world_envs(16, 7, newton.DEFAULT_TAU)
+    res = newton.newton_size(
+        envs, meta["n_periods"], meta["n_years"],
+        soft_tau=newton.DEFAULT_TAU, net_billing=meta["net_billing"],
+    )
+    oracle = sizing.size_agents(
+        envs, n_periods=meta["n_periods"], n_years=meta["n_years"],
+        fast=False, n_iters=20, net_billing=meta["net_billing"],
+    )
+    xatol = np.asarray(newton.reference_xatol(res.lo, res.hi))
+    diff = np.abs(np.asarray(res.system_kw) - np.asarray(oracle.system_kw))
+    assert np.all(diff <= xatol), (diff.max(), xatol.min())
+    # bracket projection held
+    kw = np.asarray(res.system_kw)
+    assert np.all(kw >= np.asarray(res.lo) - 1e-5)
+    assert np.all(kw <= np.asarray(res.hi) + 1e-5)
+    # the fallback mask is a safety valve, not the common case
+    assert int(np.asarray(res.fallback).sum()) < kw.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# calibration: gradient descent through the rollout recovers (p, q)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_calibration_recovers_seeded_bass_parameters():
+    """The end-to-end workload: differentiate the multi-year rollout,
+    Gauss-Newton on (p, q) against synthetic observed adoption, recover
+    the seeded coefficients within the check.sh gate tolerance."""
+    out = calibrate.recover_pq(64, steps=5, method="gn")
+    assert out["rel_err_p"] <= 0.05, out
+    assert out["rel_err_q"] <= 0.05, out
+    # the loss actually went DOWN along the way
+    curve = out["loss_curve"]
+    assert curve[-1] < curve[0]
+
+
+# ---------------------------------------------------------------------------
+# soft mode composes with the retrace guard: steady years stay cached
+# ---------------------------------------------------------------------------
+
+def test_soft_mode_steady_state_years_do_not_retrace():
+    """soft_boundaries threads a STATIC float temperature into the step
+    kwargs; after the first_year pair compiles, later soft years must be
+    cache hits exactly like the hard path (guard arms the invariant)."""
+    sim, pop = make_sim(
+        n_agents=64, states=("DE",), end_year=2022,
+        run_config=RunConfig(
+            sizing_iters=6, guard_retrace=True,
+            soft_boundaries=True, soft_tau=0.1,
+        ),
+    )
+    res = sim.run()
+    assert len(res.years) == 5
+
+
+# ---------------------------------------------------------------------------
+# J11: gradient-killing ops inside grad-marked entries
+# ---------------------------------------------------------------------------
+
+def _grad_spec(fn, name, grad=True):
+    return ProgramSpec(
+        entry=name, variant="t",
+        build=lambda: Bound(jax.jit(fn), (jnp.ones(8, jnp.float32),), {}),
+        anchor=anchor_for(fn), grad=grad,
+    )
+
+
+def test_j11_flags_killers_only_in_grad_entries():
+    def rounds(x):
+        return jnp.round(x) * x
+
+    def stops(x):
+        return jax.lax.stop_gradient(x) * x
+
+    def argmaxes(x):
+        return x * jnp.argmax(x).astype(jnp.float32)
+
+    def casts(x):
+        return x.astype(jnp.int32).astype(jnp.float32) * x
+
+    for fn, token in ((rounds, "round"), (stops, "stop_gradient"),
+                      (argmaxes, "argmax"), (casts, "convert")):
+        findings = run_program_rules([lower_spec(_grad_spec(fn, token))])
+        assert {f.rule for f in findings} == {"J11"}, token
+        assert any(token in f.message for f in findings), token
+        # same program, grad=False: rule does not apply
+        assert run_program_rules(
+            [lower_spec(_grad_spec(fn, token, grad=False))]
+        ) == [], token
+
+
+def test_j11_clean_program_and_custom_ad_exemption():
+    def clean(x):
+        return jnp.sum(jnp.tanh(x) * x)
+
+    assert run_program_rules([lower_spec(_grad_spec(clean, "clean"))]) == []
+
+    def gated(x):
+        # STE gate is a custom_jvp: its internal hard comparison (and
+        # any f->i cast of its output) is a sanctioned AD site
+        return jnp.sum(smooth.ste_gate(x - 0.5, 0.1) * x)
+
+    assert run_program_rules([lower_spec(_grad_spec(gated, "gated"))]) == []
+
+    def lerped(x):
+        # lerp_lookup's floor/int-cast pair is piecewise-constant by
+        # construction, but it is NOT custom-AD: J11 must flag it so
+        # deliberate sites carry the suppression comment
+        table = jnp.linspace(0.0, 1.0, 16)[None, :] * jnp.ones((8, 1))
+        return jnp.sum(smooth.lerp_lookup(table, x * 10.0))
+
+    findings = run_program_rules([lower_spec(_grad_spec(lerped, "lerped"))])
+    assert {f.rule for f in findings} == {"J11"}
+
+
+def test_j11_registry_grad_entries_audit_clean():
+    """The committed grad-marked entries (newton_step, calib_loss) carry
+    exactly the sanctioned suppressions: lowering them through the rule
+    stack yields no findings. calib_loss is the expensive one and its
+    compile cost is covered by the slow full-grid gate; here we check
+    newton_step, the one with ZERO suppressions."""
+    specs = {s.spec_id: s for s in build_registry("default")}
+    assert "newton_step@tau01" in specs
+    assert "calib_loss@tau01-small" in specs
+    assert specs["newton_step@tau01"].grad
+    assert specs["calib_loss@tau01-small"].grad
+    audit = lower_spec(specs["newton_step@tau01"])
+    assert audit.error is None
+    findings = run_program_rules([audit])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint contract: the hard path did not move
+# ---------------------------------------------------------------------------
+
+def test_hard_path_fingerprint_unchanged_vs_committed_baseline():
+    """With dgen_tpu.grad imported and the soft knobs default-off, the
+    committed size_agents base entry must lower to the EXACT committed
+    StableHLO hash — the smooth twin is additive, never a rewrite."""
+    with open(BASELINE, "r", encoding="utf-8") as f:
+        base = json.load(f)
+    entries = base["entries"]
+    for sid in ("size_agents_soft@dl0-bf0-nb1-tau01", "newton_step@tau01",
+                "calib_loss@tau01-small"):
+        assert sid in entries, f"missing committed baseline for {sid}"
+    specs = {s.spec_id: s for s in build_registry("default")}
+    sid = "size_agents@dl0-bf0-nb1"
+    audit = lower_spec(specs[sid])
+    assert audit.error is None
+    assert audit.fingerprint == entries[sid]["program_hash"], (
+        "hard sizing program drifted from the committed baseline — "
+        "the soft_tau=None path must lower byte-identically"
+    )
+    # and the soft variant is genuinely a DIFFERENT program
+    soft = lower_spec(specs["size_agents_soft@dl0-bf0-nb1-tau01"])
+    assert soft.error is None
+    assert soft.fingerprint != audit.fingerprint
+    assert soft.fingerprint == (
+        entries["size_agents_soft@dl0-bf0-nb1-tau01"]["program_hash"]
+    )

@@ -131,6 +131,16 @@ if [ "$mode" != "--test-only" ]; then
         python -m dgen_tpu.resilience drill --gang \
         --gang-processes 2 --gang-shrink 0 --no-gang-stall \
         --agents 48 --end-year 2016 >/tmp/_gang.json || rc=1
+    # gradient gate (docs/grad.md): finite-difference gradcheck of the
+    # smooth NPV objective (away from the deliberate STE gate edges)
+    # plus a 64-agent calibration round differentiating the multi-year
+    # rollout — the recovered Bass p/q scales must land within 5%
+    # relative error of the seeded truth. Catches the silent failure
+    # J11 guards statically: a refactor that leaves values right but
+    # zeroes the gradient somewhere in the chain.
+    echo "== gradient gate (python -m dgen_tpu.grad check) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.grad check \
+        >/tmp/_grad_check.json || rc=1
     # national-generator smoke (docs/userguide.md "National-scale
     # synthetic runs"): generate a 10k-agent state-stratified world,
     # step 2 model years through the PRODUCTION 2-D placement path on a

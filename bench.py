@@ -119,6 +119,16 @@ Knobs (env):
                           eval counts, kw parity vs xatol, plus one
                           Gauss-Newton calibration round's loss
                           curve (docs/grad.md)
+  DGEN_TPU_BENCH_TARIFF   1: A/B the tariff-clustering path
+                          (ops.tariffcluster) — one mixed-corpus
+                          national world stepped with
+                          RunConfig.cluster_tariffs on vs off, plus
+                          the all-NEM floor world; stamps steady-year
+                          walls, agent-years/sec, the cluster
+                          histogram and modeled lane savings
+                          (docs/perf.md "Tariff clustering"; target:
+                          mixed clustered within ~2-3x of the NEM
+                          floor at national scale)
 
 Weak/strong scaling curves vs DEVICE COUNT (1M/10M national tables,
 agent-years/sec, the SCALE_r*.json trajectory) live in their own
@@ -171,6 +181,8 @@ _BENCH_SENTINEL = os.environ.get(
     "DGEN_TPU_BENCH_SENTINEL", "") not in ("", "0", "false")
 _BENCH_GRAD = os.environ.get(
     "DGEN_TPU_BENCH_GRAD", "") not in ("", "0", "false")
+_BENCH_TARIFF = os.environ.get(
+    "DGEN_TPU_BENCH_TARIFF", "") not in ("", "0", "false")
 # "0"/"false" disable, same convention as the sibling flags above
 _BENCH_SERVE = os.environ.get("DGEN_TPU_BENCH_SERVE", "").strip()
 if _BENCH_SERVE in ("0", "false"):
@@ -500,6 +512,70 @@ def _sentinel_ab(n_agents: int) -> dict:
         "wall_on_s": round(on_s, 3),
         "overhead_frac": round(on_s / max(off_s, 1e-9) - 1.0, 4),
         "breaches": (sim.health_report or {}).get("breaches", {}),
+    }
+
+
+def _tariff_ab(n_agents: int) -> dict:
+    """A/B the tariff-clustering path (docs/perf.md "Tariff
+    clustering"): the SAME mixed-corpus national world stepped with
+    ``RunConfig.cluster_tariffs`` on vs off, plus the all-NEM floor
+    world — the cheapest honest protocol the clustered mixed run is
+    budgeted against (target at national scale: within ~2-3x).
+    Stamps steady-year walls, agent-years/sec, the structural cluster
+    histogram and the analyzer's modeled per-lane savings."""
+    from dgen_tpu.config import RunConfig, ScenarioConfig
+    from dgen_tpu.models import scenario as scen
+    from dgen_tpu.models import synth as msynth
+    from dgen_tpu.models.simulation import Simulation
+    from dgen_tpu.ops import tariffcluster
+
+    def _world_sim(mix: str, cluster: bool):
+        spec = msynth.NationalSpec(
+            n_agents=n_agents, seed=7, tariff_mix=mix)
+        world = msynth.generate_world(spec)
+        cfg = ScenarioConfig(name="tariff-ab", start_year=2014,
+                             end_year=2022, anchor_years=())
+        inputs = scen.uniform_inputs(
+            cfg, n_groups=world.table.n_groups,
+            n_regions=spec.n_regions)
+        sim = Simulation(
+            world.table, world.profiles, world.tariffs, inputs, cfg,
+            RunConfig(sizing_iters=10, cluster_tariffs=cluster))
+        return sim, world
+
+    def _point(mix: str, cluster: bool) -> dict:
+        sim, _world = _world_sim(mix, cluster)
+        step_s = _time_steps(sim, n_rep=2)
+        return {
+            "tariff_mix": mix,
+            "clustered": cluster,
+            "steady_year_s": round(step_s, 3),
+            "agent_years_per_sec": round(n_agents / max(step_s, 1e-9)),
+        }
+
+    mixed_on = _point("mixed", True)
+    mixed_off = _point("mixed", False)
+    nem = _point("nem", False)
+
+    spec = msynth.NationalSpec(n_agents=n_agents, seed=7,
+                               tariff_mix="mixed")
+    world = msynth.generate_world(spec)
+    report = tariffcluster.cluster_report(
+        world.tariffs, np.asarray(world.table.tariff_idx),
+        np.asarray(world.table.mask))
+    return {
+        "agents": n_agents,
+        "mixed_clustered": mixed_on,
+        "mixed_unclustered": mixed_off,
+        "nem_floor": nem,
+        "clustered_speedup_x": round(
+            mixed_off["steady_year_s"]
+            / max(mixed_on["steady_year_s"], 1e-9), 3),
+        "clustered_vs_nem_x": round(
+            mixed_on["steady_year_s"]
+            / max(nem["steady_year_s"], 1e-9), 3),
+        "clusters": report["clusters"],
+        "modeled_lane_savings": report["modeled_lane_savings"],
     }
 
 
@@ -1211,6 +1287,7 @@ def main() -> None:
         "async_host_io": _RC().async_io_enabled,
         "async_io": None if _BENCH_ASYNC else {"skipped": "knob off"},
         "grad": None if _BENCH_GRAD else {"skipped": "knob off"},
+        "tariff": None if _BENCH_TARIFF else {"skipped": "knob off"},
     }
 
     # static J6 cost fingerprints of the entry points this bench drives
@@ -1657,6 +1734,21 @@ def main() -> None:
                 payload["grad"] = _grad_ab(n_agents)
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["grad"] = {
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- tariff-clustering A/B (DGEN_TPU_BENCH_TARIFF=1): mixed
+    # clustered vs mixed unclustered vs the all-NEM floor, cluster
+    # histogram stamped (docs/perf.md "Tariff clustering") ---
+    if _BENCH_TARIFF:
+        if not spendable(point_est * 6):
+            skipped["tariff"] = "budget"
+        else:
+            try:
+                payload["tariff"] = _tariff_ab(n_agents)
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["tariff"] = {
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

@@ -162,6 +162,20 @@ class RunConfig:
     #: the hard objective tighter; larger smooths gradients further
     #: from each kink. Env: DGEN_TPU_SOFT_TAU.
     soft_tau: float = 0.1
+    #: tariff-clustered sizing (ops.tariffcluster, docs/perf.md "Tariff
+    #: clustering"): canonicalize the compiled tariff corpus into
+    #: structural clusters keyed by (metering, true periods, true
+    #: tiers, demand presence), reorder agents cluster-major within
+    #: each device shard, and run the sizing kernel once per cluster at
+    #: the cluster's tight pad widths with shared deduplicated rate
+    #: banks — single-period clusters skip the TOU scatter, flat/NEM
+    #: clusters route to the linear program. One compiled program per
+    #: structural signature, results keyed by agent_id unchanged.
+    #: Auto-disabled (with a log line) when rate switching is active —
+    #: a base/switch tariff pair can straddle clusters. Off by default;
+    #: the global-bank path stays the parity oracle and the committed
+    #: program fingerprints never move. Env: DGEN_TPU_CLUSTER.
+    cluster_tariffs: bool = False
     #: background host-IO pipeline (io.hostio.HostPipeline): per-year
     #: result collection, RunExporter parquet writes and orbax
     #: checkpoint saves run on worker threads against one batched
@@ -234,6 +248,11 @@ class RunConfig:
                 "soft_boundaries requires the plain f32 full-hour XLA "
                 "path (no daylight_compact/bf16_banks/quant_banks/"
                 "pack_once/stream_segments)",
+            )
+            _check(
+                not self.cluster_tariffs,
+                "soft_boundaries requires the plain f32 full-hour XLA "
+                "path (no cluster_tariffs)",
             )
         if self.quarantine_ids is not None:
             _check(
@@ -310,6 +329,8 @@ class RunConfig:
             overrides["stream_segments"] = True
         if "soft_boundaries" not in overrides and flag("DGEN_TPU_SOFT"):
             overrides["soft_boundaries"] = True
+        if "cluster_tariffs" not in overrides and flag("DGEN_TPU_CLUSTER"):
+            overrides["cluster_tariffs"] = True
         if "soft_tau" not in overrides and \
                 os.environ.get("DGEN_TPU_SOFT_TAU"):
             overrides["soft_tau"] = float(os.environ["DGEN_TPU_SOFT_TAU"])

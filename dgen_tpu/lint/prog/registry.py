@@ -81,7 +81,7 @@ _WORLDS: Dict[tuple, object] = {}
 
 def _build_world(daylight: bool, bf16: bool, chunk: int,
                  mesh_shape: Optional[tuple], quant: bool = False,
-                 pack: bool = False):
+                 pack: bool = False, cluster: bool = False):
     """ONE construction path for every audit world — single-device
     grid AND mesh tier — over the fixed tiny synthetic population, so
     the two tiers cannot silently audit divergent worlds. Simulation's
@@ -119,6 +119,7 @@ def _build_world(daylight: bool, bf16: bool, chunk: int,
         agent_pad_multiple=32, daylight_compact=daylight,
         bf16_banks=bf16, quant_banks=quant, pack_once=pack,
         partition_by_state=mesh_shape is None,
+        cluster_tariffs=cluster,
     )
     mesh = None
     if mesh_shape is not None:
@@ -132,13 +133,15 @@ def _build_world(daylight: bool, bf16: bool, chunk: int,
 
 
 def _world(daylight: bool = False, bf16: bool = False, chunk: int = 0,
-           quant: bool = False, pack: bool = False):
+           quant: bool = False, pack: bool = False,
+           cluster: bool = False):
     """The memoized single-device audit world per (daylight, bf16,
-    chunk, quant, pack) grid point."""
-    key = (daylight, bf16, chunk, quant, pack)
+    chunk, quant, pack, cluster) grid point."""
+    key = (daylight, bf16, chunk, quant, pack, cluster)
     if key not in _WORLDS:
         _WORLDS[key] = _build_world(daylight, bf16, chunk, None,
-                                    quant=quant, pack=pack)
+                                    quant=quant, pack=pack,
+                                    cluster=cluster)
     return _WORLDS[key]
 
 
@@ -159,6 +162,9 @@ def _year_step_bound_for(sim, net_billing, first_year,
 
     kwargs = sim.step_kwargs(first_year)
     kwargs["net_billing"] = net_billing
+    # clustered worlds carry traced operands (compact banks + local
+    # indices) alongside their static layout; empty otherwise
+    kwargs.update(sim.step_operands())
     carry = SimCarry.zeros(sim.table.n_agents)
     return Bound(
         fn=year_step,
@@ -182,6 +188,18 @@ def _year_step_qp_bound(year: int) -> Bound:
     return _year_step_bound_for(
         _world(quant=True, pack=True), True, False, year
     )
+
+
+def _year_step_cluster_bound(first_year: bool, year: int) -> Bound:
+    """The tariff-clustered year step (ISSUE 19, ops.tariffcluster):
+    the production program of a mixed-tariff national run — sizing
+    runs once per tariff cluster at the cluster's tight pad widths
+    against its compact shared bank. Default-grid-only (like the
+    quant+pack entry); single-device covers the per-cluster program
+    structure, the mesh tier's GSPMD propagation is unchanged by the
+    host-side row permutation."""
+    return _year_step_bound_for(_world(cluster=True), True, first_year,
+                                year)
 
 
 def _sweep_bound_for(sim, net_billing, first_year, year: int) -> Bound:
@@ -529,6 +547,24 @@ def build_registry(grid: str = "default") -> List[ProgramSpec]:
             build=partial(_year_step_qp_bound, 1),
             steady=partial(_year_step_qp_bound, 2),
             anchor=ys_anchor, donate_args=(4,), cost=True,
+        ))
+
+    # tariff-clustered year step (ISSUE 19): one sizing program per
+    # tariff cluster at tight pad widths — the committed J6 entry
+    # proves the per-cluster specialization is what actually lowers
+    # (flat/NEM clusters carry no bucket-sums kernel), and the steady
+    # pair proves one-compile-per-signature across years
+    if grid == "default":
+        specs.append(ProgramSpec(
+            entry="year_step", variant="dl0-bf0-nb1-cl1-fy0",
+            build=partial(_year_step_cluster_bound, False, 1),
+            steady=partial(_year_step_cluster_bound, False, 2),
+            anchor=ys_anchor, donate_args=(4,), cost=True,
+        ))
+        specs.append(ProgramSpec(
+            entry="year_step", variant="dl0-bf0-nb1-cl1-fy1",
+            build=partial(_year_step_cluster_bound, True, 0),
+            anchor=ys_anchor, donate_args=(4,),
         ))
 
     # sweep vmap mode (scenario axis S=2)

@@ -557,6 +557,121 @@ def _constrain_chunked(mesh: Mesh, a: jax.Array) -> jax.Array:
     return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
 
 
+def _cluster_slice(x: jax.Array, d: int, local: int, off: int,
+                   seg: int) -> jax.Array:
+    """Slice one cluster's per-device segment out of a cluster-major
+    [N, ...] leaf: rows [off, off+seg) of EACH device's ``local`` rows,
+    re-flattened so the result keeps one contiguous block per device
+    (the shape the agent sharding expects)."""
+    if d == 1:
+        return x[off:off + seg]
+    y = x.reshape((d, local) + x.shape[1:])
+    y = y[:, off:off + seg]
+    return y.reshape((d * seg,) + x.shape[1:])
+
+
+def _cluster_concat(parts: list, d: int) -> jax.Array:
+    """Inverse of :func:`_cluster_slice` over all clusters: concatenate
+    per-cluster [d*seg_c, ...] results back into the cluster-major
+    device layout (each device's segments contiguous again)."""
+    if d == 1:
+        return jnp.concatenate(parts, axis=0)
+    segs = [p.reshape((d, p.shape[0] // d) + p.shape[1:]) for p in parts]
+    cat = jnp.concatenate(segs, axis=1)
+    return cat.reshape((cat.shape[0] * cat.shape[1],) + cat.shape[2:])
+
+
+def _size_clustered(
+    table: AgentTable,
+    profiles: ProfileBank,
+    ya,
+    nem_allowed: jax.Array,
+    cluster,
+    cluster_banks,
+    cluster_tidx: jax.Array,
+    *,
+    econ_years: int,
+    sizing_iters: int,
+    keep_hourly: bool,
+    sizing_impl: str,
+    mesh: Optional[Mesh],
+    n_dev: int,
+    agent_chunk: int,
+    net_billing: bool,
+    daylight,
+    pack_once: bool,
+    soft_tau: Optional[float],
+):
+    """Cluster-batched sizing: run the engine once per tariff cluster
+    at the cluster's TIGHT pad widths (ops.tariffcluster) against its
+    shared compact rate bank — single-period clusters statically skip
+    the TOU period scatter, single-tier clusters the tier clip, and
+    flat/NEM clusters route to the linear program via their proven
+    per-cluster ``net_billing`` flag. The table is already laid out
+    cluster-major within each device shard, so every slice is a static
+    per-device block and the concatenated result is in table order."""
+    local = cluster.local_len
+    parts = []
+    for spec, bank in zip(cluster.clusters, cluster_banks):
+        sl = partial(_cluster_slice, d=n_dev, local=local,
+                     off=spec.offset, seg=spec.seg_len)
+        tbl_c, ya_c, nem_c, tidx_c = jax.tree.map(
+            sl, (table, ya, nem_allowed, cluster_tidx)
+        )
+        tbl_c = dataclasses.replace(
+            tbl_c, tariff_idx=tidx_c, tariff_switch_idx=tidx_c
+        )
+        # a globally-False flag (a pinned sweep group / an all-NEM run)
+        # wins over the per-cluster proof; True per-cluster flags stay
+        # exact either way (False is only a compile-time skip)
+        nb_c = net_billing and spec.net_billing
+        n_chunks_c = _n_chunks(n_dev * spec.seg_len, n_dev, agent_chunk)
+
+        def _size_one(tbl_i, ya_i, nem_i, hourly, nb=nb_c, bank=bank,
+                      n_per=spec.n_periods):
+            envs_i = build_econ_inputs(
+                tbl_i, profiles, bank, ya_i, nem_i, tbl_i.incentives,
+                rate_switch=False,
+            )
+            return sizing_ops.size_agents(
+                envs_i, n_periods=n_per, n_years=econ_years,
+                n_iters=sizing_iters, keep_hourly=hourly,
+                impl=sizing_impl, mesh=mesh, net_billing=nb,
+                daylight=daylight, pack_once=pack_once, soft_tau=soft_tau,
+            )
+
+        if n_chunks_c > 1:
+            xs = jax.tree.map(
+                lambda a: _to_chunks(a, n_dev, n_chunks_c),
+                (tbl_c, ya_c, nem_c),
+            )
+            if mesh is not None:
+                xs = jax.tree.map(partial(_constrain_chunked, mesh), xs)
+
+            def _chunk(_, xs_i):
+                t_i, y_i, m_i = xs_i
+                return None, _size_one(t_i, y_i, m_i, False)
+
+            _, res_k = jax.lax.scan(_chunk, None, xs)
+            res_c = jax.tree.map(
+                lambda a: _from_chunks(a, n_dev, n_chunks_c), res_k
+            )
+        else:
+            if mesh is not None:
+                def _pin_seg(a):
+                    return jax.lax.with_sharding_constraint(
+                        a, NamedSharding(mesh, agent_spec(mesh, a.ndim))
+                    )
+
+                tbl_c, ya_c, nem_c = jax.tree.map(
+                    _pin_seg, (tbl_c, ya_c, nem_c))
+            res_c = _size_one(tbl_c, ya_c, nem_c, keep_hourly)
+        parts.append(res_c)
+    return jax.tree.map(
+        lambda *ps: _cluster_concat(list(ps), n_dev), *parts
+    )
+
+
 def year_step_impl(
     table: AgentTable,
     profiles: ProfileBank,
@@ -581,6 +696,9 @@ def year_step_impl(
     pack_once: bool = False,
     soft_tau: Optional[float] = None,
     anchor: bool = True,
+    cluster=None,
+    cluster_banks=None,
+    cluster_tidx: Optional[jax.Array] = None,
 ) -> tuple[SimCarry, YearOutputs]:
     """One model year as a single device program.
 
@@ -595,7 +713,12 @@ def year_step_impl(
     payback, and linear interpolation through the max-market-share
     table instead of the round-to-decile gather — so the whole year
     step is differentiable w.r.t. scenario leaves. ``None`` (default)
-    traces the bit-exact hard program. ``anchor=False`` (static) drops
+    traces the bit-exact hard program. ``cluster``: optional STATIC
+    ops.tariffcluster.ClusterLayout — the table is laid out
+    cluster-major per device shard and sizing runs once per tariff
+    cluster at tight pad widths against the traced ``cluster_banks``
+    (compact TariffBanks) indexed by ``cluster_tidx`` ([N] local rows);
+    requires ``rate_switch=False``. ``anchor=False`` (static) drops
     the historical-anchoring blend entirely — the calibration rollout
     (:mod:`dgen_tpu.grad.calibrate`) fits the UNanchored model to
     observations, and the anchor rescale's tiny-denominator guards
@@ -626,7 +749,26 @@ def year_step_impl(
     n_dev = int(mesh.devices.size) if mesh is not None else 1
     n_chunks = _n_chunks(table.n_agents, n_dev, agent_chunk)
 
-    if n_chunks > 1:
+    if cluster is not None:
+        if rate_switch:
+            raise ValueError(
+                "cluster layouts cannot price rate-switch runs: a "
+                "base/switch tariff pair can straddle two clusters"
+            )
+        # --- cluster-batched sizing: one program per tariff cluster at
+        # the cluster's tight pad widths (ops.tariffcluster); hourly
+        # profiles stay dropped when the global layout chunks (the
+        # remat branch below rebuilds them) ---
+        res = _size_clustered(
+            table, profiles, ya, nem_allowed, cluster, cluster_banks,
+            cluster_tidx,
+            econ_years=econ_years, sizing_iters=sizing_iters,
+            keep_hourly=with_hourly and n_chunks == 1,
+            sizing_impl=sizing_impl, mesh=mesh, n_dev=n_dev,
+            agent_chunk=agent_chunk, net_billing=net_billing,
+            daylight=daylight, pack_once=pack_once, soft_tau=soft_tau,
+        )
+    elif n_chunks > 1:
         # --- streaming hot loop: scan agent chunks through the sizing
         # engine; XLA reuses one chunk's [C, 8760] buffers so peak HBM
         # stays bounded regardless of N ---
@@ -874,7 +1016,7 @@ YEAR_STEP_STATIC_ARGNAMES = (
     "n_periods", "econ_years", "sizing_iters", "first_year",
     "with_hourly", "storage_enabled", "year_step_len", "sizing_impl",
     "rate_switch", "mesh", "agent_chunk", "net_billing", "daylight",
-    "pack_once", "soft_tau", "anchor",
+    "pack_once", "soft_tau", "anchor", "cluster",
 )
 
 #: the jitted one-year program. The cross-year carry is threaded
@@ -1228,6 +1370,75 @@ class Simulation:
                            chunk * n_dev)),
             )
 
+        # --- tariff-clustered layout (config-gated; ops.tariffcluster):
+        # canonicalize the compiled bank into structural clusters, then
+        # re-permute each device shard cluster-major so sizing runs one
+        # program per cluster at tight pad widths. Layered AFTER the
+        # state partition / chunk padding (rows never move across
+        # devices, so the straddle-psum locality of partition_by_state
+        # survives) and BEFORE host attribute capture (exporters key on
+        # the clustered order's agent_id, results stay order-invariant).
+        self._cluster_layout = None
+        self._cluster_banks = None
+        self._cluster_tidx = None
+        self._cluster_host = None
+        if self.run_config.cluster_tariffs and self._rate_switch:
+            logger.info(
+                "cluster_tariffs requested but rate switching is live "
+                "(base/switch pairs can straddle clusters); running the "
+                "unclustered program"
+            )
+        elif self.run_config.cluster_tariffs:
+            from dgen_tpu.ops import tariffcluster
+
+            pad_mult = int(np.lcm(
+                self.run_config.agent_pad_multiple, chunk or 1
+            ))
+            plan = tariffcluster.analyze_bank(tariffs)
+            layout, gather, valid, ctidx = tariffcluster.plan_layout(
+                plan,
+                np.asarray(table.tariff_idx),
+                np.asarray(table.mask),
+                n_dev,
+                pad_mult,
+            )
+            n_old = table.n_agents
+
+            def _cluster_gather(x):
+                x = np.asarray(x)
+                if x.ndim >= 1 and x.shape[0] == n_old:
+                    return x[gather]
+                return x
+
+            table = jax.tree.map(_cluster_gather, table)
+            table = dataclasses.replace(
+                table,
+                mask=np.asarray(table.mask) * valid,
+            )
+            self._cluster_host = dict(
+                cid=layout.cluster_of_rows(),
+                real=np.asarray(table.mask) > 0,
+                state_idx=np.asarray(table.state_idx),
+                nem_first_year=np.asarray(table.nem_first_year),
+                nem_sunset_year=np.asarray(table.nem_sunset_year),
+                nem_kw_limit=np.asarray(table.nem_kw_limit),
+            )
+            self._cluster_banks = tariffcluster.banks_for_layout(
+                plan, layout
+            )
+            self._cluster_tidx = jnp.asarray(ctidx)
+            self._cluster_layout = layout
+            self._cluster_layout = layout.with_flags(
+                self._cluster_flags(inputs)
+            )
+            logger.info(
+                "tariff clusters: %d signatures over %d tariffs, "
+                "segments %s rows/device (was %d rows/device global-pad)",
+                layout.n_clusters, tariffs.n_tariffs,
+                [c.seg_len for c in self._cluster_layout.clusters],
+                n_old // n_dev,
+            )
+
         # streaming year step: only engage when the table is actually
         # larger than one chunk per device
         self._agent_chunk = (
@@ -1284,6 +1495,17 @@ class Simulation:
             profiles = jax.tree.map(lambda x: put(x, repl), profiles)
             tariffs = jax.tree.map(lambda x: put(x, repl), tariffs)
             inputs = jax.tree.map(lambda x: put(x, repl), inputs)
+            if self._cluster_tidx is not None:
+                # compact per-cluster indices ride the agent axis; the
+                # tight shared banks are small — replicate them
+                self._cluster_tidx = put(
+                    self._cluster_tidx,
+                    NamedSharding(mesh, agent_spec(mesh, 1)),
+                )
+                self._cluster_banks = tuple(
+                    jax.tree.map(lambda x: put(x, repl), b)
+                    for b in self._cluster_banks
+                )
             self._shard = shard
             self._put = put
         else:
@@ -1325,11 +1547,51 @@ class Simulation:
             daylight=self._daylight,
             pack_once=self.run_config.pack_once,
             soft_tau=self.run_config.soft_tau_static,
+            cluster=self._cluster_layout,
         )
 
     #: legacy private alias — internal call sites (and tests that
     #: monkeypatch the instance attribute) resolve through this name
     _step_kwargs = step_kwargs
+
+    def step_operands(self) -> dict:
+        """The traced (non-static) operands that ride alongside a
+        cluster layout — the compact shared banks and the per-row
+        compact tariff indices. Empty when the run is unclustered, so
+        call sites can always splat it into :func:`year_step`."""
+        if self._cluster_layout is None:
+            return {}
+        return dict(
+            cluster_banks=self._cluster_banks,
+            cluster_tidx=self._cluster_tidx,
+        )
+
+    def _cluster_flags(self, inputs: ScenarioInputs) -> tuple:
+        """Per-cluster net-billing flags for the current scenario: a
+        net-metered cluster prices by the linear identity only when its
+        own members' NEM gate provably never closes (the per-cluster
+        refinement of :func:`run_static_flags` — a whole-run ``True``
+        often splits into mostly-``False`` clusters)."""
+        h = self._cluster_host
+        caps = np.asarray(inputs.nem_cap_kw)
+        flags = []
+        for ci, spec in enumerate(
+            self._cluster_layout.clusters if self._cluster_layout
+            else ()
+        ):
+            if spec.metering == NET_BILLING:
+                flags.append(True)
+                continue
+            sel = (h["cid"] == ci) & h["real"]
+            flags.append(not nem_gate_never_closes(
+                h["state_idx"][sel],
+                caps,
+                h["nem_first_year"][sel],
+                h["nem_sunset_year"][sel],
+                h["nem_kw_limit"][sel],
+                self.years,
+            ))
+        return tuple(flags)
 
     def _hbm_check(self) -> Optional[dict]:
         """Modeled-vs-actual device memory: compare the chunk model's
@@ -1505,9 +1767,20 @@ class Simulation:
                 f"inputs cover {inputs.n_years} years but this "
                 f"simulation has {len(self.years)}"
             )
+        pinned = net_billing is not None
         if net_billing is None:
             _, net_billing = run_static_flags(
                 self.table, self.tariffs, inputs, self.years
+            )
+        # per-cluster flags track the scenario too: a pinned group flag
+        # pins every cluster the same way (True is exact, False means
+        # the planner PROVED no member scenario can close a gate), an
+        # unpinned sibling re-proves each cluster's gate on host
+        cluster = self._cluster_layout
+        if cluster is not None:
+            cluster = (
+                cluster.pin_net_billing(net_billing) if pinned
+                else cluster.with_flags(self._cluster_flags(inputs))
             )
         if self.mesh is not None:
             repl = NamedSharding(self.mesh, P())
@@ -1515,6 +1788,7 @@ class Simulation:
         sib = copy.copy(self)
         sib.inputs = inputs
         sib._net_billing = net_billing
+        sib._cluster_layout = cluster
         sib.timing_ctx = timing_ctx
         return sib
 
@@ -1550,6 +1824,7 @@ class Simulation:
             self.table, self.profiles, self.tariffs, self.inputs, carry,
             jnp.asarray(year_idx, dtype=jnp.int32),
             **self._step_kwargs(first_year),
+            **self.step_operands(),
         )
 
     def run(

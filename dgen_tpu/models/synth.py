@@ -51,7 +51,7 @@ from dgen_tpu.io.synth import (
     make_wholesale_prices,
 )
 from dgen_tpu.models.agents import AgentTable, ProfileBank, build_agent_table
-from dgen_tpu.ops.tariff import NET_METERING, compile_tariffs
+from dgen_tpu.ops.tariff import NET_BILLING, NET_METERING, compile_tariffs
 
 #: approximate 2020-census population shares (percent) over the
 #: contiguous-US + DC modeling universe (io.synth.STATES) — the strata
@@ -150,6 +150,19 @@ def _state_bounds(spec: NationalSpec) -> np.ndarray:
     return np.cumsum(state_counts(spec))
 
 
+#: the documented residential cluster-shape distribution of a
+#: ``tariff_mix="mixed"`` world (ops.tariffcluster's structural keys):
+#: flat (1 period, 1 tier), tiered (1 period, 2 tiers), TOU (2
+#: periods, 1 tier), TOU+tiers (2 periods, 2 tiers). Weights follow
+#: the heavy collapse of real URDB corpora — mostly flat/tiered, a
+#: TOU band, a thin TOU+tiered tail. Pools index
+#: :func:`make_national_tariffs`'s "mixed" corpus order; stamped into
+#: ``world.json`` by :func:`save_world` with the realized histogram.
+MIXED_SHAPE_CLASSES = ("flat", "tiered", "tou", "tou_tiered")
+MIXED_SHAPE_WEIGHTS = (0.35, 0.30, 0.25, 0.10)
+MIXED_SHAPE_POOLS = ((0, 1), (2,), (3, 4), (6,))
+
+
 def make_national_tariffs(mix: str) -> list:
     """The tariff corpus for a mix (raw spec dicts, io.package-ready).
 
@@ -157,10 +170,26 @@ def make_national_tariffs(mix: str) -> list:
     — with the table's default always-open NEM window this statically
     drops the bucket-sums kernel (models.simulation.run_static_flags),
     the cheapest honest national protocol.
+
+    ``"mixed"`` is the full io.synth corpus plus a TOU+tiered
+    residential net-billing rate (the fourth shape class of
+    :data:`MIXED_SHAPE_POOLS`), inserted BEFORE the DG rate — the DG
+    rate must stay last (``_chunk_columns`` resolves it as
+    ``n_tariffs - 1``). The audit corpus (io.synth.make_tariff_specs)
+    is deliberately untouched: program fingerprints key on its shapes.
     """
     specs = make_tariff_specs()
     if mix == "mixed":
-        return specs
+        wkday = np.zeros((12, 24), dtype=int)
+        wkday[:, 16:21] = 1
+        tou_tiered = {
+            "price": [[0.11, 0.17], [0.26, 0.33]],
+            "tier_cap": [600.0, 1e38],
+            "e_wkday_12by24": wkday,
+            "e_wkend_12by24": np.zeros((12, 24), dtype=int),
+            "fixed_charge": 10.0, "metering": NET_BILLING,
+        }
+        return specs[:-1] + [tou_tiered] + specs[-1:]
     return [s for s in specs if s.get("metering") == NET_METERING]
 
 
@@ -208,9 +237,23 @@ def _chunk_columns(spec: NationalSpec, ci: int, bounds: np.ndarray,
         rng.uniform(np.log(50.0), np.log(5000.0), n)).astype(np.float32)
     developable = rng.uniform(0.2, 0.95, n).astype(np.float32)
 
+    if isinstance(res_tariffs, tuple):
+        # mixed worlds: seeded two-draw scheme — a shape class by the
+        # documented weights (MIXED_SHAPE_WEIGHTS), then a uniform
+        # member of the class pool; the wide-range draw + modulo keeps
+        # the RNG call count independent of the pool sizes, so adding
+        # a tariff to a pool never shifts later columns' draws
+        pool_arr, pool_len, wts = res_tariffs
+        shape_cls = rng.choice(len(pool_len), size=n, p=wts)
+        member = rng.integers(0, 1 << 62, n)
+        res_draw = pool_arr[shape_cls, member % pool_len[shape_cls]]
+    else:
+        # nem worlds: the original single uniform draw (byte-frozen:
+        # gang shards and world manifests pin this call sequence)
+        res_draw = res_tariffs[rng.integers(0, len(res_tariffs), n)]
     tariff_idx = np.where(
         sector == 0,
-        res_tariffs[rng.integers(0, len(res_tariffs), n)],
+        res_draw,
         np.where(
             sector == 1,
             com_tariffs[rng.integers(0, len(com_tariffs), n)],
@@ -254,9 +297,19 @@ def _tariff_pools(spec: NationalSpec) -> tuple:
         # corpus: [flat NEM, tiered NEM, commercial TOU NEM, DG rate]
         return n, np.asarray([0, 1], np.int32), \
             np.asarray([1, 2], np.int32), 2
-    # full corpus (io.synth.make_tariff_specs order)
-    return n, np.arange(0, 5, dtype=np.int32), \
-        np.asarray([1, 3, 5], np.int32), 5
+    # full corpus + TOU+tiered (make_national_tariffs "mixed" order):
+    # residential draws follow the documented cluster-shape
+    # distribution — the pool triple (padded 2-D pools, lengths,
+    # weights) selects the weighted branch in _chunk_columns
+    width = max(len(p) for p in MIXED_SHAPE_POOLS)
+    pool_arr = np.zeros((len(MIXED_SHAPE_POOLS), width), np.int32)
+    pool_len = np.zeros(len(MIXED_SHAPE_POOLS), np.int64)
+    for i, p in enumerate(MIXED_SHAPE_POOLS):
+        pool_arr[i, :len(p)] = p
+        pool_len[i] = len(p)
+    res = (pool_arr, pool_len,
+           np.asarray(MIXED_SHAPE_WEIGHTS, np.float64))
+    return n, res, np.asarray([1, 3, 5], np.int32), 5
 
 
 def generate_columns(
@@ -436,6 +489,21 @@ def save_world(
             f: _file_sha256(os.path.join(out_dir, f)) for f in _PKG_FILES
         },
     }
+    if spec.tariff_mix == "mixed":
+        # the documented cluster-shape distribution + what the seed
+        # actually realized (residential rows only; commercial and
+        # industrial draws are pool-uniform as before)
+        t = cols["tariff_idx"][cols["sector_idx"] == 0]
+        manifest["tariff_shape_mix"] = {
+            "classes": list(MIXED_SHAPE_CLASSES),
+            "weights": list(MIXED_SHAPE_WEIGHTS),
+            "pools": [list(p) for p in MIXED_SHAPE_POOLS],
+            "residential_histogram": {
+                name: int(np.isin(t, pool).sum())
+                for name, pool in zip(MIXED_SHAPE_CLASSES,
+                                      MIXED_SHAPE_POOLS)
+            },
+        }
     atomic_write_json(os.path.join(out_dir, WORLD_MANIFEST), manifest)
     return manifest
 

@@ -1,0 +1,442 @@
+"""Tariff-corpus clustering: shared rate banks at tight pad widths.
+
+``compile_tariffs`` (ops.tariff) pads every tariff to the corpus-global
+``max_periods`` / ``max_tiers``, so one 4-period 3-tier outlier makes
+every flat-rate agent pay 12x the bucket lanes it needs — the
+bucket-sums kernel's minor axis is ``12 * n_periods`` buckets and its
+tier clip loops ``n_tiers`` times. Real URDB corpora collapse heavily:
+a handful of structural shapes covers almost all rows. This module is
+the layout half of the fix (AMBER's columnar-layout-first argument,
+PAPERS.md [2], applied to the rate dimension):
+
+* :func:`analyze_bank` canonicalizes compiled ``TariffBank`` rows into
+  K structural clusters keyed by ``(metering mode, true period count,
+  true tier count, demand-charge presence)``. Every member of a
+  cluster shares exact tight extents, so the cluster's SHARED dense
+  rate bank is sliced at its own pad widths — and byte-identical
+  canonical rows are deduplicated, so N tariffs collapse to the few
+  distinct rate structures the corpus actually contains.
+* :func:`plan_layout` computes the cluster-major agent permutation,
+  layered on the state-major device packing (parallel.partition):
+  within each device shard, agents are stably reordered
+  cluster-major (cluster within state within host) and each
+  per-(device, cluster) segment padded to a uniform length with
+  masked filler rows — the same gather/valid-mask idiom
+  ``partition_table`` uses, so compiled shapes stay static across
+  devices and results keyed by ``agent_id`` are invariant.
+
+The compute half lives in models.simulation: ``year_step`` runs the
+sizing kernel once per cluster at the cluster's tight ``n_periods``
+with the cluster's ``net_billing`` flag, so single-period clusters
+statically skip the TOU period scatter, single-tier clusters skip the
+tier clip, and flat/NEM clusters route to the linear program — one
+compiled program per structural signature, budgeted like sweep groups
+(docs/perf.md "Tariff clustering").
+
+CLI: ``python -m dgen_tpu.ops.tariffcluster --report`` prints the
+cluster histogram + modeled lane-op savings for a package or a
+synthetic world (wired into tools/check.sh as a smoke).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.ops.tariff import NET_BILLING, TariffBank
+
+
+class ClusterSpec(NamedTuple):
+    """Static (hashable) signature of one cluster — part of year_step's
+    ``cluster`` static argument, so two tables with the same cluster
+    structure share every compiled program."""
+
+    metering: int     # NET_METERING | NET_BILLING
+    n_periods: int    # true TOU period count (tight pad width)
+    n_tiers: int      # true tier count (tight pad width)
+    has_demand: bool  # always False today (SKIP_DEMAND_CHARGES)
+    n_rates: int      # deduplicated rate rows in the shared bank
+    seg_len: int      # per-device rows of this cluster's segment
+    offset: int       # per-device row offset of the segment
+    #: statically proven per-cluster net-billing flag: False routes the
+    #: whole cluster to the linear-NEM program (run_static_flags logic
+    #: applied cluster-locally)
+    net_billing: bool
+
+
+class ClusterLayout(NamedTuple):
+    """Static description of a cluster-major agent layout (the
+    ``cluster`` static of year_step). All traced data — the compact
+    banks and the per-row local tariff indices — travels separately."""
+
+    clusters: Tuple[ClusterSpec, ...]
+    n_dev: int
+    local_len: int    # per-device rows = sum of segment lengths
+
+    @property
+    def n_clusters(self) -> int:
+        return len(self.clusters)
+
+    @property
+    def n_rows(self) -> int:
+        return self.n_dev * self.local_len
+
+    def with_flags(self, flags: Tuple[bool, ...]) -> "ClusterLayout":
+        """Replace the per-cluster net-billing flags (after the host
+        proves them against a specific set of scenario inputs)."""
+        if len(flags) != self.n_clusters:
+            raise ValueError(
+                f"{len(flags)} flags for {self.n_clusters} clusters")
+        return self._replace(clusters=tuple(
+            c._replace(net_billing=bool(f))
+            for c, f in zip(self.clusters, flags)))
+
+    def pin_net_billing(self, net_billing: bool) -> "ClusterLayout":
+        """Conservatively pin every cluster to one global flag — the
+        sweep planner's one-compile-per-group contract (a pinned-True
+        group must not compile per-scenario cluster programs; True is
+        exact for every cluster, it only skips the linear shortcut)."""
+        return self.with_flags((bool(net_billing),) * self.n_clusters)
+
+    def cluster_of_rows(self) -> np.ndarray:
+        """[n_dev * local_len] int32: cluster id of each laid-out row."""
+        per_dev = np.empty(self.local_len, dtype=np.int32)
+        for ci, c in enumerate(self.clusters):
+            per_dev[c.offset:c.offset + c.seg_len] = ci
+        return np.tile(per_dev, self.n_dev)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterPlan:
+    """Host-side corpus analysis: global tariff index -> (cluster,
+    local row of the cluster's shared compact bank)."""
+
+    keys: Tuple[Tuple[int, int, int, bool], ...]
+    members: Tuple[Tuple[int, ...], ...]   # global tariff ids per cluster
+    banks: Tuple[TariffBank, ...]          # compact, deduplicated banks
+    cluster_of_tariff: np.ndarray          # [K_global] int32
+    local_of_tariff: np.ndarray            # [K_global] int32
+
+    @property
+    def n_clusters(self) -> int:
+        return len(self.keys)
+
+
+def analyze_bank(tariffs: TariffBank) -> ClusterPlan:
+    """Canonicalize a compiled bank into structural clusters.
+
+    Two tariffs land in one cluster iff they share
+    ``(metering, n_periods, n_tiers, has_demand)`` — so every member's
+    tight slice has identical shape and the cluster bank pads nothing.
+    Within a cluster, tariffs whose canonical bytes (tight price /
+    caps / sell / schedule / fixed / metering) match are deduplicated
+    onto one shared bank row.
+    """
+    met = np.asarray(tariffs.metering)
+    n_p = np.asarray(tariffs.n_periods)
+    n_t = np.asarray(tariffs.n_tiers)
+    price = np.asarray(tariffs.price)
+    tier_cap = np.asarray(tariffs.tier_cap)
+    sell = np.asarray(tariffs.sell_price)
+    sched = np.asarray(tariffs.hour_period)
+    fixed = np.asarray(tariffs.fixed_monthly)
+
+    K = tariffs.n_tariffs
+    keys: list = []
+    key_of: Dict[Tuple[int, int, int, bool], int] = {}
+    members: list = []
+    dedup: list = []        # per cluster: canonical bytes -> local row
+    rows: list = []         # per cluster: list of global source rows
+    cluster_of = np.zeros(K, dtype=np.int32)
+    local_of = np.zeros(K, dtype=np.int32)
+
+    for k in range(K):
+        P, T = int(n_p[k]), int(n_t[k])
+        key = (int(met[k]), P, T, False)
+        ci = key_of.get(key)
+        if ci is None:
+            ci = len(keys)
+            key_of[key] = ci
+            keys.append(key)
+            members.append([])
+            dedup.append({})
+            rows.append([])
+        canon = b"".join((
+            np.ascontiguousarray(price[k, :P, :T]).tobytes(),
+            np.ascontiguousarray(tier_cap[k, :T]).tobytes(),
+            np.ascontiguousarray(sell[k, :P]).tobytes(),
+            np.ascontiguousarray(sched[k]).tobytes(),
+            np.float32(fixed[k]).tobytes(),
+        ))
+        li = dedup[ci].get(canon)
+        if li is None:
+            li = len(rows[ci])
+            dedup[ci][canon] = li
+            rows[ci].append(k)
+        members[ci].append(k)
+        cluster_of[k] = ci
+        local_of[k] = li
+
+    banks = []
+    for (m, P, T, _), src in zip(keys, rows):
+        src = np.asarray(src, dtype=np.int64)
+        banks.append(TariffBank(
+            price=jnp.asarray(price[src][:, :P, :T]),
+            tier_cap=jnp.asarray(tier_cap[src][:, :T]),
+            sell_price=jnp.asarray(sell[src][:, :P]),
+            hour_period=jnp.asarray(sched[src]),
+            fixed_monthly=jnp.asarray(fixed[src]),
+            metering=jnp.asarray(met[src]),
+            n_periods=jnp.asarray(n_p[src]),
+            n_tiers=jnp.asarray(n_t[src]),
+        ))
+    return ClusterPlan(
+        keys=tuple(tuple(k) for k in keys),
+        members=tuple(tuple(m) for m in members),
+        banks=tuple(banks),
+        cluster_of_tariff=cluster_of,
+        local_of_tariff=local_of,
+    )
+
+
+def plan_layout(
+    plan: ClusterPlan,
+    tariff_idx: np.ndarray,
+    mask: np.ndarray,
+    n_dev: int,
+    pad_mult: int,
+) -> Tuple[ClusterLayout, np.ndarray, np.ndarray, np.ndarray]:
+    """Cluster-major layout of an (already device-partitioned) table.
+
+    Within each device shard of ``n_dev`` equal shards, REAL rows
+    (``mask > 0``) are stably reordered by cluster id — preserving the
+    state-major order within each cluster — and each per-(device,
+    cluster) segment is padded to a device-uniform, ``pad_mult``-rounded
+    length. Padding slots gather a real in-segment row with valid 0
+    (the partition_table idiom), so every compiled shape is static.
+
+    Returns ``(layout, gather, valid, cluster_tidx)``:
+
+    * ``gather`` [N'] int64 — new position -> source row of the input
+      layout (the permutation; its inverse is :func:`original_positions`)
+    * ``valid`` [N'] float32 — 1 for real rows, 0 for cluster padding
+    * ``cluster_tidx`` [N'] int32 — per-row LOCAL index into the row's
+      cluster bank (0 on padding slots)
+
+    Only clusters with at least one real member row appear in the
+    layout (in plan order), so unused corpus tariffs cost nothing.
+    """
+    tariff_idx = np.asarray(tariff_idx)
+    mask = np.asarray(mask)
+    N = len(tariff_idx)
+    if n_dev < 1 or N % n_dev:
+        raise ValueError(f"{N} rows not divisible into {n_dev} shards")
+    local = N // n_dev
+    cid = plan.cluster_of_tariff[tariff_idx]
+    real = mask > 0
+
+    # per-device stable grouping by cluster id
+    seg_rows = [[None] * plan.n_clusters for _ in range(n_dev)]
+    counts = np.zeros((n_dev, plan.n_clusters), dtype=np.int64)
+    for d in range(n_dev):
+        sl = slice(d * local, (d + 1) * local)
+        rows_d = np.nonzero(real[sl])[0] + d * local
+        cid_d = cid[rows_d]
+        for ci in range(plan.n_clusters):
+            seg = rows_d[cid_d == ci]
+            seg_rows[d][ci] = seg
+            counts[d, ci] = len(seg)
+
+    kept = [ci for ci in range(plan.n_clusters) if counts[:, ci].max() > 0]
+    specs = []
+    off = 0
+    for ci in kept:
+        need = int(counts[:, ci].max())
+        seg_len = max(-(-need // pad_mult) * pad_mult, pad_mult)
+        m, P, T, hd = plan.keys[ci]
+        specs.append(ClusterSpec(
+            metering=m, n_periods=P, n_tiers=T, has_demand=hd,
+            n_rates=plan.banks[ci].n_tariffs, seg_len=seg_len,
+            offset=off, net_billing=m == NET_BILLING,
+        ))
+        off += seg_len
+    local_len = off
+
+    gather = np.zeros(n_dev * local_len, dtype=np.int64)
+    valid = np.zeros(n_dev * local_len, dtype=np.float32)
+    for d in range(n_dev):
+        # padding filler must stay in-shard: any real row works (the
+        # mask zeroes its contribution), prefer one from the segment's
+        # own cluster so even the dead lanes run in-range gathers
+        shard_real = np.nonzero(real[d * local:(d + 1) * local])[0]
+        shard_fill = (shard_real[0] + d * local) if len(shard_real) \
+            else d * local
+        for spec, ci in zip(specs, kept):
+            seg = seg_rows[d][ci]
+            fill = seg[0] if len(seg) else shard_fill
+            o = d * local_len + spec.offset
+            gather[o:o + len(seg)] = seg
+            gather[o + len(seg):o + spec.seg_len] = fill
+            valid[o:o + len(seg)] = 1.0
+
+    cluster_tidx = plan.local_of_tariff[tariff_idx[gather]].astype(np.int32)
+    # a filler gathered from another cluster (empty segment on this
+    # device) would index out of the segment's compact bank — clamp it
+    # to row 0; the slot is masked either way
+    gathered_cid = cid[gather]
+    layout = ClusterLayout(clusters=tuple(specs), n_dev=n_dev,
+                           local_len=local_len)
+    own_cid = np.asarray(
+        [kept[c] for c in layout.cluster_of_rows()], dtype=np.int64)
+    cluster_tidx = np.where(gathered_cid == own_cid, cluster_tidx, 0)
+    return layout, gather, valid, cluster_tidx
+
+
+def banks_for_layout(
+    plan: ClusterPlan, layout: ClusterLayout
+) -> Tuple[TariffBank, ...]:
+    """The layout's compact banks, in layout cluster order.
+
+    ``plan_layout`` drops clusters with no real member rows, so the
+    layout's clusters are a (plan-ordered) subset of the plan's —
+    matched here by structural key, which is unique per cluster."""
+    by_key = {k: b for k, b in zip(plan.keys, plan.banks)}
+    return tuple(
+        by_key[(c.metering, c.n_periods, c.n_tiers, c.has_demand)]
+        for c in layout.clusters
+    )
+
+
+def original_positions(gather: np.ndarray, valid: np.ndarray,
+                       n_original: int) -> np.ndarray:
+    """[n_original] int64: position of each source row in the laid-out
+    order (-1 for source rows that were dropped, i.e. masked padding of
+    the input layout). The inverse permutation — gathering a laid-out
+    result at these positions restores source order bit-exactly."""
+    pos = np.full(n_original, -1, dtype=np.int64)
+    idx = np.nonzero(np.asarray(valid) > 0)[0]
+    pos[np.asarray(gather)[idx]] = idx
+    return pos
+
+
+# ---------------------------------------------------------------------------
+# Reporting: cluster histogram + modeled lane-op savings
+# ---------------------------------------------------------------------------
+
+def cluster_report(
+    tariffs: TariffBank,
+    tariff_idx: Optional[np.ndarray] = None,
+    mask: Optional[np.ndarray] = None,
+) -> dict:
+    """Cluster histogram + modeled bucket-lane savings.
+
+    The bucket-sums kernel's per-agent lane work scales with its bucket
+    minor axis, ``12 * n_periods`` (ops.billpallas); linear/NEM
+    clusters run the closed-form program with zero kernel lanes. The
+    model compares ``sum_c agents_c * 12 * P_c`` (net-billing clusters
+    only, at tight pads) against every agent paying
+    ``12 * max_periods`` in one global kernel — the unclustered cost
+    whenever the corpus has any net-billing tariff. NEM clusters are
+    counted as linear (their gate-closure proof is input-dependent;
+    docs/perf.md "Tariff clustering" covers the conservative case).
+    """
+    plan = analyze_bank(tariffs)
+    if tariff_idx is None:
+        agents_of = {
+            ci: len(m) for ci, m in enumerate(plan.members)}
+        n_agents = tariffs.n_tariffs
+    else:
+        tariff_idx = np.asarray(tariff_idx)
+        if mask is not None:
+            tariff_idx = tariff_idx[np.asarray(mask) > 0]
+        cnt = np.bincount(plan.cluster_of_tariff[tariff_idx],
+                          minlength=plan.n_clusters)
+        agents_of = {ci: int(cnt[ci]) for ci in range(plan.n_clusters)}
+        n_agents = int(tariff_idx.shape[0])
+
+    clusters = []
+    lanes_clustered = 0
+    for ci, (m, P, T, hd) in enumerate(plan.keys):
+        nb = m == NET_BILLING
+        lanes = agents_of[ci] * 12 * P if nb else 0
+        lanes_clustered += lanes
+        clusters.append({
+            "metering": int(m),
+            "n_periods": int(P),
+            "n_tiers": int(T),
+            "has_demand": bool(hd),
+            "n_tariffs": len(plan.members[ci]),
+            "n_rates": plan.banks[ci].n_tariffs,
+            "n_agents": agents_of[ci],
+            "net_billing": nb,
+            "bucket_lanes": lanes,
+        })
+    any_nb = any(c["net_billing"] for c in clusters)
+    lanes_global = n_agents * 12 * tariffs.max_periods if any_nb else 0
+    return {
+        "n_tariffs": tariffs.n_tariffs,
+        "n_clusters": plan.n_clusters,
+        "n_agents": n_agents,
+        "global_pad": {"n_periods": tariffs.max_periods,
+                       "n_tiers": tariffs.max_tiers},
+        "clusters": clusters,
+        "bucket_lanes_global": int(lanes_global),
+        "bucket_lanes_clustered": int(lanes_clustered),
+        "modeled_lane_savings": round(
+            1.0 - lanes_clustered / lanes_global, 4) if lanes_global else 0.0,
+    }
+
+
+def main(argv=None) -> int:
+    """``python -m dgen_tpu.ops.tariffcluster --report``: the cluster
+    histogram of a saved agent package or a synthetic national world
+    (tools/check.sh smoke)."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        prog="python -m dgen_tpu.ops.tariffcluster",
+        description="tariff-corpus cluster histogram + modeled lane-op "
+                    "savings (docs/perf.md 'Tariff clustering')",
+    )
+    p.add_argument("--report", action="store_true", required=True,
+                   help="print the cluster report as JSON")
+    p.add_argument("--package", default="",
+                   help="agent package dir (io.package); default: a "
+                        "synthetic world")
+    p.add_argument("--agents", type=int, default=4096,
+                   help="synthetic world size (ignored with --package)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tariff-mix", default="mixed",
+                   help="synthetic corpus selector (models.synth)")
+    args = p.parse_args(argv)
+
+    if args.package:
+        # CLI-only, lazy: the kernel layer stays importable
+        # without the IO/model stack
+        from dgen_tpu.io.package import load_population  # dgenlint: disable=L5
+
+        pop = load_population(args.package)
+        src = {"package": args.package}
+    else:
+        from dgen_tpu.models.synth import (  # dgenlint: disable=L5
+            NationalSpec, generate_world)
+
+        pop = generate_world(NationalSpec(
+            n_agents=args.agents, seed=args.seed,
+            tariff_mix=args.tariff_mix))
+        src = {"synthetic": {"agents": args.agents, "seed": args.seed,
+                             "tariff_mix": args.tariff_mix}}
+    report = cluster_report(
+        pop.tariffs, np.asarray(pop.table.tariff_idx),
+        np.asarray(pop.table.mask))
+    report["source"] = src
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -122,6 +122,7 @@ def query_program(
     rate_switch: bool = False,
     net_billing: bool = True,
     daylight=None,
+    cluster_tidx: Optional[jax.Array] = None,
 ) -> QueryOutputs:
     """One query bucket as a single device program: gather the B
     requested rows from the resident table, rebuild their one-year
@@ -137,8 +138,19 @@ def query_program(
     bit-identical whether it was computed alone or inside a coalesced
     bucket (per-row math only; the one cross-agent term, the NEM state
     cap, depends on inputs alone).
+
+    ``cluster_tidx``: per-row COMPACT tariff indices of a clustered
+    Simulation (ops.tariffcluster) — the engine passes it together
+    with one cluster's compact bank as ``tariffs`` and the cluster's
+    tight ``n_periods``, so a mono-cluster bucket runs the specialized
+    program; ``None`` (mixed buckets, unclustered sims) prices against
+    the full bank at global pads.
     """
     sub = jax.tree.map(lambda a: a[idx], table)
+    if cluster_tidx is not None:
+        tidx = cluster_tidx[idx]
+        sub = dataclasses.replace(
+            sub, tariff_idx=tidx, tariff_switch_idx=tidx)
     ya = apply_year(sub, inputs, year_idx)
     state_kw = starting_state_kw(table, inputs)
     nem_allowed = compute_nem_allowed(sub, inputs, year_idx, state_kw)
@@ -289,6 +301,23 @@ class ServeEngine:
             if rep is not None else {}
         )
         self._static_kwargs = query_static_kwargs(sim)
+        # per-cluster serving (ops.tariffcluster): a clustered sim's
+        # mono-cluster buckets run the cluster's specialized program —
+        # compact bank, tight n_periods — and mixed buckets fall back
+        # to the full-bank program (exact either way; docs/serve.md)
+        layout = getattr(sim, "_cluster_layout", None)
+        self._cluster = None
+        if layout is not None:
+            self._cluster = dict(
+                cid=layout.cluster_of_rows(),
+                banks=sim._cluster_banks,
+                tidx=sim._cluster_tidx,
+                statics=tuple(
+                    dict(self._static_kwargs, n_periods=c.n_periods,
+                         rate_switch=False)
+                    for c in layout.clusters
+                ),
+            )
         self._override_cache: "OrderedDict[str, ScenarioInputs]" = (
             OrderedDict()
         )
@@ -427,9 +456,10 @@ class ServeEngine:
 
         ``bucket=None`` runs the direct single-shot program at the
         exact request shape (the parity oracle); ``bucket=B`` pads the
-        rows to B (repeating row 0 — per-row math, so padding rows
-        change nothing) and slices the first n answers back out. The
-        two paths are bit-identical per row.
+        rows to B (repeating the first requested row — per-row math,
+        so padding rows change nothing, and the pad stays inside the
+        request's tariff cluster) and slices the first n answers back
+        out. The two paths are bit-identical per row.
 
         ``key`` is the request's canonical override key when known
         (``""`` = zero-override): it unlocks the engine-free layers —
@@ -471,14 +501,28 @@ class ServeEngine:
         if bucket is not None:
             if bucket < n:
                 raise ValueError(f"bucket {bucket} < {n} requested rows")
+            # pad by repeating the FIRST requested row (not table row
+            # 0): per-row math, so padding changes no answer, and it
+            # keeps a mono-cluster bucket mono-cluster
+            fill = rows[0] if n else 0
             rows = np.concatenate(
-                [rows, np.zeros(bucket - n, dtype=np.int32)]
+                [rows, np.full(bucket - n, fill, dtype=np.int32)]
             )
+        statics = self._static_kwargs
+        tariffs = self.sim.tariffs
+        operands = {}
+        if self._cluster is not None and rows.size:
+            cids = self._cluster["cid"][rows]
+            ci = int(cids[0])
+            if np.all(cids == ci):
+                statics = self._cluster["statics"][ci]
+                tariffs = self._cluster["banks"][ci]
+                operands = dict(cluster_tidx=self._cluster["tidx"])
         out = query_program(
-            self.sim.table, self.sim.profiles, self.sim.tariffs,
+            self.sim.table, self.sim.profiles, tariffs,
             inputs if inputs is not None else self.sim.inputs,
             jnp.asarray(rows), jnp.asarray(year_idx, dtype=jnp.int32),
-            **self._static_kwargs,
+            **statics, **operands,
         )
         with self._override_lock:
             self._warm.add(int(rows.shape[0]))
@@ -511,9 +555,27 @@ class ServeEngine:
     def warmup(self, buckets: Sequence[int], year_idx: int = 0) -> None:
         """Compile (and execute once) every bucket program so no live
         request pays a compile. Row content is irrelevant to the
-        compiled shape; row 0 repeated is enough."""
+        compiled shape; row 0 repeated is enough — except under a
+        clustered sim, where each cluster owns a specialized program
+        (warm one representative bucket per cluster) and mixed buckets
+        compile the full-bank fallback (warm one of those too)."""
+        reps = [0]
+        mixed = None
+        if self._cluster is not None:
+            cid = self._cluster["cid"]
+            reps = [int(np.flatnonzero(cid == ci)[0])
+                    for ci in range(len(self._cluster["banks"]))]
+            if len(reps) > 1:
+                mixed = reps[:2]
         for b in buckets:
-            self.query_rows(
-                np.zeros(b, dtype=np.int32), year_idx, bucket=None
-            )
+            for r in reps:
+                self.query_rows(
+                    np.full(b, r, dtype=np.int32), year_idx, bucket=None
+                )
+            if mixed is not None and b > 1:
+                self.query_rows(
+                    np.asarray(mixed * (b // 2) + [mixed[0]] * (b % 2),
+                               dtype=np.int32),
+                    year_idx, bucket=None,
+                )
             logger.info("serve warmup: bucket %d compiled", b)

@@ -97,13 +97,19 @@ def sweep_year_step(
     pack_once: bool = False,
     soft_tau=None,
     anchor: bool = True,
+    cluster=None,
+    cluster_banks=None,
+    cluster_tidx=None,
 ):
     """One model year for S scenarios as a single device program: the
     un-jitted :func:`year_step_impl` vmapped over the scenario axis of
     (inputs, carry), with the table and the banks closed over UNMAPPED
     — XLA sees one copy of every [N, 8760] gather source. Static
     arguments mirror ``year_step`` exactly, so the two programs share
-    the compile-flag vocabulary."""
+    the compile-flag vocabulary. The cluster layout (and its compact
+    banks/indices) is scenario-invariant, so it stays unmapped like the
+    table; the planner pins its per-cluster flags per group, exactly
+    like ``net_billing``."""
 
     def one(inputs, c):
         return year_step_impl(
@@ -115,6 +121,8 @@ def sweep_year_step(
             rate_switch=rate_switch, mesh=mesh, agent_chunk=agent_chunk,
             net_billing=net_billing, daylight=daylight,
             pack_once=pack_once, soft_tau=soft_tau, anchor=anchor,
+            cluster=cluster, cluster_banks=cluster_banks,
+            cluster_tidx=cluster_tidx,
         )
 
     return jax.vmap(one)(inputs_s, carry)
@@ -203,6 +211,8 @@ class SweepSimulation:
             bank_quant=self.run_config.quant_banks,
             mesh=mesh,
             max_vmap_scenarios=max_vmap_scenarios,
+            cluster=self.run_config.cluster_tariffs,
+            agent_pad_multiple=self.run_config.agent_pad_multiple,
         )
 
         # the base Simulation does all the one-time work — static
@@ -304,6 +314,15 @@ class SweepSimulation:
         # planner sends >1-device meshes to loop mode); dropping it
         # keeps sharding constraints out of the batched trace
         kwargs["mesh"] = None
+        # one compiled program per group: the group flag pins every
+        # cluster flag the same way (with_inputs does the same for the
+        # loop-mode siblings), so member scenarios cannot split the
+        # per-cluster executables either
+        if kwargs.get("cluster") is not None:
+            kwargs["cluster"] = kwargs["cluster"].pin_net_billing(
+                group.net_billing
+            )
+        kwargs.update(self.base.step_operands())
 
         carry = self._init_stacked_carry(s)
         start_idx = 0

@@ -149,6 +149,8 @@ def plan_sweep(
     hbm_bytes: Optional[int] = -1,
     max_vmap_scenarios: Optional[int] = None,
     enforce_budget: bool = True,
+    cluster: bool = False,
+    agent_pad_multiple: int = 128,
 ) -> SweepPlan:
     """Plan an S-scenario sweep over one shared population.
 
@@ -156,6 +158,19 @@ def plan_sweep(
     ``-1`` reads the live device (:func:`default_hbm_bytes`), ``None``
     means explicitly unknown (mode decisions then fall back to the
     :data:`DEFAULT_MAX_VMAP_SCENARIOS` width cap).
+
+    ``cluster``: budget for a tariff-clustered layout
+    (RunConfig.cluster_tariffs; ops.tariffcluster). The PER-ROW model
+    is unchanged — the bucket buckets are padded to a fixed minor axis
+    (``B_PAD``) regardless of ``n_periods``, and the per-row hour
+    arrays don't depend on the rate structure — but the clustered
+    table itself is wider: every per-(device, cluster) segment rounds
+    up to the layout pad multiple, so the planner adds a
+    ``K x agent_pad_multiple`` per-device row allowance (the upper
+    bound of the segment round-up; the layout's true multiple also
+    folds in the streaming chunk, whose padding the unclustered table
+    pays too). Rate-switch corpora ignore the flag, exactly like
+    Simulation does.
 
     Raises :class:`~dgen_tpu.models.scenario.ScenarioStackError` when
     scenarios disagree on a static field (the error names it), and
@@ -195,6 +210,19 @@ def plan_sweep(
     n_dev = int(mesh.devices.size) if mesh is not None else 1
     mesh_shape = mesh_shape_of(mesh) if mesh is not None else (1, 1)
     n_local = max(table.n_agents // n_dev, 1)
+    if cluster and not rate_switch:
+        # per-device row allowance for the cluster-major layout's
+        # segment padding: only clusters with live member rows appear
+        # in the layout (plan_layout drops the rest)
+        from dgen_tpu.ops.tariffcluster import analyze_bank
+
+        import numpy as np
+
+        plan_c = analyze_bank(tariffs)
+        live = np.unique(plan_c.cluster_of_tariff[
+            np.asarray(table.tariff_idx)[np.asarray(table.mask) > 0]
+        ])
+        n_local += len(live) * int(agent_pad_multiple)
 
     def check_chunk_floor(group_scenarios: int, per_agent_b: int,
                           what: str) -> None:

@@ -305,6 +305,42 @@ def test_golden_pack_once_parity(golden_run):
         assert rel < 1e-4, f"{k}: pack-once drift {rel:.3e}"
 
 
+def test_golden_cluster_parity(golden_run):
+    """Tariff clustering (RunConfig.cluster_tariffs, docs/perf.md
+    "Tariff clustering") against the unclustered golden oracle: the
+    cluster-major permutation + per-cluster tight-pad programs only
+    re-associate f32 sums and statically drop dead pad lanes, so the
+    full 19-year e2e must agree to <= 1e-5 relative on national
+    curves and keep the id-weighted adoption checksum (a
+    between-agent reshuffle under a conserving total fails it).
+
+    The clustered sim runs (and reports) in cluster-major packed order
+    — exporters key on agent_id — so the clustered side is summarized
+    with its OWN permuted mask/ids; the id-weighted checksum is
+    permutation-invariant and pins per-agent identity across the two
+    orderings."""
+    pop, res_f, _ = golden_run
+    sim_c, res_c = _rerun_golden(
+        pop, RunConfig(sizing_iters=8, cluster_tariffs=True))
+    assert sim_c._cluster_layout is not None
+    assert len(sim_c._cluster_layout.clusters) == 2
+    mask = np.asarray(pop.table.mask)
+    ids = np.asarray(pop.table.agent_id)
+    mask_c = np.asarray(sim_c.table.mask)
+    ids_c = np.asarray(sim_c.table.agent_id)
+    s_f = res_f.summary(mask)
+    s_c = res_c.summary(mask_c)
+    for k in ("adopters", "system_kw_cum", "batt_kwh_cum"):
+        ref = np.maximum(np.abs(np.asarray(s_f[k], np.float64)), 1e-6)
+        rel = np.max(np.abs(s_c[k] - s_f[k]) / ref)
+        assert rel < 1e-5, f"{k}: cluster drift {rel:.3e}"
+    chk_f = float((res_f.agent["number_of_adopters"][-1] * mask
+                   * (ids % 97 + 1)).sum())
+    chk_c = float((res_c.agent["number_of_adopters"][-1] * mask_c
+                   * (ids_c % 97 + 1)).sum())
+    assert abs(chk_c - chk_f) <= 1e-5 * max(abs(chk_f), 1.0)
+
+
 def test_golden_bf16_banks_within_tolerance(golden_run):
     """bf16 profile banks against the f32 golden run: the documented
     envelope is 2% on national adoption curves (inputs carry ~0.4%

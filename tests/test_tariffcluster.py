@@ -1,0 +1,255 @@
+"""Tariff structural clustering (dgen_tpu.ops.tariffcluster) and the
+cluster-batched sizing path: corpus analysis, cluster-major layout
+round-trips, clustered-vs-unclustered parity (masked rows, the 2x4
+mesh), and the one-compile-per-signature retrace contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+from dgen_tpu.ops import tariffcluster as tc
+from dgen_tpu.ops.tariff import NET_BILLING, NET_METERING, compile_tariffs
+from dgen_tpu.parallel.mesh import make_mesh
+
+N = 96
+STATES = ("DE", "CA", "TX")
+
+
+def _bank():
+    return compile_tariffs(synth.make_tariff_specs())
+
+
+def make_sim(n_agents=N, states=STATES, end_year=2016, mesh=None,
+             run_config=None, **kw):
+    cfg = ScenarioConfig(name="tc", start_year=2014, end_year=end_year,
+                         anchor_years=())
+    pop = synth.generate_population(
+        n_agents, states=list(states), seed=7, pad_multiple=32)
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions)
+    sim = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg,
+        run_config or RunConfig(sizing_iters=8), mesh=mesh, **kw)
+    return sim, pop
+
+
+# ---------------------------------------------------------------------------
+# corpus analysis
+# ---------------------------------------------------------------------------
+
+def test_analyze_bank_structural_keys():
+    plan = tc.analyze_bank(_bank())
+    # the io.synth corpus: 7 tariffs collapsing to 5 structural
+    # signatures (the two flat-NEM rates share one cluster)
+    assert plan.n_clusters == 5
+    assert set(plan.keys) == {
+        (NET_METERING, 1, 1, False),   # flat NEM x2 (incl. DG rate)
+        (NET_BILLING, 1, 1, False),    # flat NB
+        (NET_METERING, 1, 2, False),   # tiered NEM
+        (NET_BILLING, 2, 1, False),    # TOU NB x2
+        (NET_METERING, 2, 1, False),   # commercial TOU NEM
+    }
+    # every tariff maps into its cluster's compact bank
+    assert plan.cluster_of_tariff.shape == (7,)
+    for t in range(7):
+        ci = plan.cluster_of_tariff[t]
+        assert plan.local_of_tariff[t] < plan.banks[ci].n_tariffs
+
+
+def test_compact_banks_are_tight_and_faithful():
+    bank = _bank()
+    plan = tc.analyze_bank(bank)
+    for key, cb in zip(plan.keys, plan.banks):
+        m, P, T, _hd = key
+        assert cb.price.shape[1:] == (P, T)
+        assert int(np.max(np.asarray(cb.metering))) == m
+    # a compact bank row reproduces the source tariff's live rates
+    for t in range(bank.n_tariffs):
+        ci = plan.cluster_of_tariff[t]
+        _m, P, T, _hd = plan.keys[ci]
+        cb = plan.banks[ci]
+        lt = plan.local_of_tariff[t]
+        np.testing.assert_array_equal(
+            np.asarray(cb.price)[lt],
+            np.asarray(bank.price)[t, :P, :T])
+        np.testing.assert_array_equal(
+            np.asarray(cb.fixed_monthly)[lt],
+            np.asarray(bank.fixed_monthly)[t])
+
+
+# ---------------------------------------------------------------------------
+# layout round-trip
+# ---------------------------------------------------------------------------
+
+def _random_rows(rng, n, n_tariffs):
+    tariff_idx = rng.integers(0, n_tariffs, n).astype(np.int32)
+    mask = (rng.random(n) < 0.8).astype(np.float32)
+    return tariff_idx, mask
+
+
+@pytest.mark.parametrize("n_dev", [1, 4])
+def test_layout_inverse_permutation_bit_exact(n_dev):
+    rng = np.random.default_rng(0)
+    plan = tc.analyze_bank(_bank())
+    n = 64 * n_dev
+    tariff_idx, mask = _random_rows(rng, n, 7)
+    layout, gather, valid, ctidx = tc.plan_layout(
+        plan, tariff_idx, mask, n_dev, pad_mult=8)
+    assert len(gather) == layout.n_dev * layout.local_len
+    pos = tc.original_positions(gather, valid, n)
+
+    real = mask > 0
+    # dropped source rows are exactly the masked ones
+    np.testing.assert_array_equal(pos >= 0, real)
+    # gather then inverse-permute restores source order bit-exactly
+    x = rng.standard_normal(n).astype(np.float32)
+    packed = x[gather]
+    np.testing.assert_array_equal(packed[pos[real]], x[real])
+    # every laid-out row's tariff belongs to its segment's cluster
+    # (real rows) and its compact index is in range (all rows)
+    cid_rows = layout.cluster_of_rows()
+    for i in range(len(gather)):
+        spec = layout.clusters[cid_rows[i]]
+        assert ctidx[i] < spec.n_rates
+        if valid[i] > 0:
+            key = plan.keys[plan.cluster_of_tariff[tariff_idx[gather[i]]]]
+            assert key == (spec.metering, spec.n_periods,
+                           spec.n_tiers, spec.has_demand)
+    # padding filler stays in-shard (compiled gathers never cross
+    # device shards)
+    local = n // n_dev
+    for d in range(n_dev):
+        sl = gather[d * layout.local_len:(d + 1) * layout.local_len]
+        assert np.all((sl >= d * local) & (sl < (d + 1) * local))
+
+
+def test_layout_drops_empty_clusters_and_pads_uniformly():
+    plan = tc.analyze_bank(_bank())
+    # all rows on one tariff -> a single kept cluster
+    tariff_idx = np.full(128, 3, dtype=np.int32)
+    mask = np.ones(128, dtype=np.float32)
+    layout, gather, valid, _ = tc.plan_layout(
+        plan, tariff_idx, mask, 4, pad_mult=32)
+    assert len(layout.clusters) == 1
+    assert layout.clusters[0].n_periods == 2
+    assert layout.local_len == 32
+    assert valid.sum() == 128
+    banks = tc.banks_for_layout(plan, layout)
+    assert len(banks) == 1 and banks[0].price.shape[1:] == (2, 1)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end parity: clustered vs unclustered
+# ---------------------------------------------------------------------------
+
+def _keyed(sim, res, field="system_kw_cum"):
+    keep = np.asarray(sim.table.mask) > 0
+    ids = np.asarray(sim.table.agent_id)[keep]
+    order = np.argsort(ids)
+    return ids[order], res.agent[field][:, keep][:, order]
+
+
+def _parity(mesh):
+    rc = dict(sizing_iters=8)
+    sim_c, pop = make_sim(
+        mesh=mesh, run_config=RunConfig(cluster_tariffs=True, **rc))
+    sim_u, _ = make_sim(mesh=mesh, run_config=RunConfig(**rc))
+    assert sim_c._cluster_layout is not None
+    assert len(sim_c._cluster_layout.clusters) > 1
+    res_c = sim_c.run()
+    res_u = sim_u.run()
+
+    for field in ("system_kw_cum", "number_of_adopters", "npv",
+                  "batt_kwh_cum"):
+        ids_c, v_c = _keyed(sim_c, res_c, field)
+        ids_u, v_u = _keyed(sim_u, res_u, field)
+        np.testing.assert_array_equal(ids_c, ids_u)
+        np.testing.assert_allclose(v_c, v_u, rtol=1e-5, atol=1e-5,
+                                   err_msg=field)
+    # masked rows (synthetic pad + cluster filler) stay inert
+    pad = np.asarray(sim_c.table.mask) == 0.0
+    assert pad.any(), "fixture should have masked rows"
+    assert np.all(res_c.agent["new_adopters"][:, pad] == 0.0)
+    assert np.all(res_c.agent["system_kw_cum"][:, pad] == 0.0)
+
+
+def test_clustered_matches_unclustered():
+    _parity(mesh=None)
+
+
+@pytest.mark.slow
+def test_clustered_matches_unclustered_2x4_mesh():
+    mesh = make_mesh(shape=(2, 4))
+    assert mesh.devices.size == 8
+    _parity(mesh=mesh)
+
+
+def test_clustered_quarantined_rows_stay_inert():
+    """Rows masked before construction (the quarantine path) are
+    dropped from the cluster layout entirely — their ids never appear
+    on a real row — and the survivors still match the unclustered
+    quarantined oracle."""
+    import dataclasses
+
+    def build(cluster):
+        cfg = ScenarioConfig(name="tcq", start_year=2014, end_year=2016,
+                             anchor_years=())
+        pop = synth.generate_population(
+            N, states=list(STATES), seed=7, pad_multiple=32)
+        mask = np.array(np.asarray(pop.table.mask))
+        kill = np.nonzero(mask > 0)[0][::7]    # quarantine every 7th
+        mask[kill] = 0.0
+        table = dataclasses.replace(pop.table, mask=mask)
+        inputs = scen.uniform_inputs(
+            cfg, n_groups=table.n_groups, n_regions=pop.n_regions)
+        sim = Simulation(
+            table, pop.profiles, pop.tariffs, inputs, cfg,
+            RunConfig(sizing_iters=8, cluster_tariffs=cluster))
+        return sim, np.asarray(pop.table.agent_id)[kill]
+
+    sim_c, killed_ids = build(True)
+    sim_u, _ = build(False)
+    # no real (mask > 0) row of the clustered table carries a
+    # quarantined id: the layout drops them, filler slots are masked
+    real = np.asarray(sim_c.table.mask) > 0
+    assert not np.isin(
+        np.asarray(sim_c.table.agent_id)[real], killed_ids).any()
+
+    res_c = sim_c.run()
+    res_u = sim_u.run()
+    ids_c, v_c = _keyed(sim_c, res_c)
+    ids_u, v_u = _keyed(sim_u, res_u)
+    np.testing.assert_array_equal(ids_c, ids_u)
+    assert not np.isin(ids_c, killed_ids).any()
+    np.testing.assert_allclose(v_c, v_u, rtol=1e-5, atol=1e-5)
+
+
+def test_clustered_steady_years_do_not_retrace():
+    """One compiled program per cluster signature, then cache hits:
+    guard_retrace=True fails the run if any steady year recompiles."""
+    sim, _pop = make_sim(
+        end_year=2020,
+        run_config=RunConfig(sizing_iters=8, cluster_tariffs=True,
+                             guard_retrace=True))
+    res = sim.run()
+    assert len(res.years) == 4
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_report_cli(capsys):
+    rc = tc.main(["--report", "--agents", "256", "--seed", "3",
+                  "--tariff-mix", "mixed"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["n_clusters"] >= 5
+    assert rep["n_tariffs"] == 8
+    assert sum(c["n_agents"] for c in rep["clusters"]) <= rep["n_agents"]
+    assert 0.0 < rep["modeled_lane_savings"] < 1.0

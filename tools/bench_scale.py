@@ -38,6 +38,7 @@ Env knobs::
     DGEN_TPU_BENCH_SCALE_BIG_ROWS     4000000     1-year protocol at/above
     DGEN_TPU_BENCH_SCALE_CHUNK        4096        agent_chunk rows/device
     DGEN_TPU_BENCH_SCALE_TARIFF_MIX   nem         nem | mixed
+    DGEN_TPU_BENCH_SCALE_CLUSTER      0           RunConfig.cluster_tariffs
     DGEN_TPU_BENCH_SCALE_SIZING_ITERS 4
     DGEN_TPU_BENCH_SCALE_ECON_YEARS   8
     DGEN_TPU_BENCH_SCALE_MESH2D       1           2-D parity point on/off
@@ -77,6 +78,7 @@ YEARS = _env_int("DGEN_TPU_BENCH_SCALE_YEARS", 2)
 BIG_ROWS = _env_int("DGEN_TPU_BENCH_SCALE_BIG_ROWS", 4_000_000)
 CHUNK = _env_int("DGEN_TPU_BENCH_SCALE_CHUNK", 4096)
 TARIFF_MIX = os.environ.get("DGEN_TPU_BENCH_SCALE_TARIFF_MIX", "nem")
+CLUSTER = _env_int("DGEN_TPU_BENCH_SCALE_CLUSTER", 0)
 SIZING_ITERS = _env_int("DGEN_TPU_BENCH_SCALE_SIZING_ITERS", 4)
 ECON_YEARS = _env_int("DGEN_TPU_BENCH_SCALE_ECON_YEARS", 8)
 MESH2D = _env_int("DGEN_TPU_BENCH_SCALE_MESH2D", 1)
@@ -117,6 +119,7 @@ def main() -> int:
         "protocol": {
             "generator": "models.synth national (state-stratified)",
             "tariff_mix": TARIFF_MIX,
+            "cluster_tariffs": bool(CLUSTER),
             "sizing_iters": SIZING_ITERS,
             "econ_years": ECON_YEARS,
             "agent_chunk_per_device": CHUNK,
@@ -184,7 +187,8 @@ def main() -> int:
         t0 = time.time()
         sim = Simulation(
             world.table, world.profiles, world.tariffs, inputs, cfg,
-            RunConfig(sizing_iters=SIZING_ITERS, agent_chunk=CHUNK),
+            RunConfig(sizing_iters=SIZING_ITERS, agent_chunk=CHUNK,
+                      cluster_tariffs=bool(CLUSTER)),
             mesh=mesh, econ_years=ECON_YEARS,
         )
         build_s = time.time() - t0

@@ -149,6 +149,15 @@ if [ "$mode" != "--test-only" ]; then
     echo "== national synth smoke (python -m dgen_tpu.models.synth smoke) =="
     JAX_PLATFORMS=cpu python -m dgen_tpu.models.synth smoke \
         --agents 10240 --mesh 1x8 >/tmp/_synth_smoke.json || rc=1
+    # tariff-cluster smoke (docs/perf.md "Tariff clustering"): the
+    # corpus analyzer over a mixed synthetic world must report the
+    # expected structural histogram (6 signatures on the mixed
+    # national corpus) with positive modeled lane savings — the
+    # clustered sizing path's static planner cannot rot silently
+    echo "== tariff cluster smoke (python -m dgen_tpu.ops.tariffcluster --report) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.ops.tariffcluster --report \
+        --agents 4096 --seed 3 --tariff-mix mixed \
+        >/tmp/_tariffcluster.json || rc=1
 fi
 
 if [ "$mode" != "--lint-only" ]; then

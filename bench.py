@@ -62,6 +62,12 @@ Knobs (env):
   DGEN_TPU_BENCH_STREAM   run with RunConfig.stream_segments=1 (the
                           double-buffered month-segment kernels; TPU
                           only — the XLA twin runs elsewhere)
+  DGEN_TPU_BENCH_ENSEMBLE <E>: also run an E-member Monte-Carlo
+                          ensemble (dgen_tpu.ensemble, DEFAULT_DRAWS)
+                          A/B'd against E independent runs — stamps
+                          per-member wall, amortization, the on-device
+                          quantile-reduction overhead and the planner's
+                          vmap/loop decision (docs/ensemble.md)
   DGEN_TPU_BENCH_SWEEP    <S>: also run an S-way identical-scenario
                           sweep (dgen_tpu.sweep) vs one single run and
                           stamp S, per-scenario wall, bank-bytes-shared
@@ -1687,6 +1693,92 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — probe, don't kill
                 payload["sweep"] = {
                     "s": s_way,
+                    ("oom" if _is_oom(e) else "failed"):
+                        True if _is_oom(e) else str(e)[:300],
+                }
+
+    # --- E-member Monte-Carlo ensemble A/B (DGEN_TPU_BENCH_ENSEMBLE=
+    # <E>): the seed-vmapped member axis (one compiled program, one
+    # bank upload) vs E independent full runs, plus the standalone
+    # on-device quantile-reduction wall — the per-year stats program
+    # is the only work the ensemble adds over a sweep ---
+    ens_env = os.environ.get("DGEN_TPU_BENCH_ENSEMBLE", "").strip()
+    if ens_env:
+        e_way = int(ens_env)
+        if not spendable(point_est * 3):
+            skipped["ensemble"] = "budget"
+        else:
+            try:
+                import dataclasses as _dc
+
+                from dgen_tpu.ensemble import (
+                    DEFAULT_DRAWS,
+                    EnsembleSimulation,
+                )
+                from dgen_tpu.ensemble import stats as estats
+                from dgen_tpu.models.simulation import YearOutputs
+
+                sim_en, pop_en = _build(n_agents, 2022)
+                t0 = time.time()
+                sim_en.run(collect=False)
+                single_s = time.time() - t0
+                ens = EnsembleSimulation(
+                    pop_en.table, pop_en.profiles, pop_en.tariffs,
+                    sim_en.inputs, sim_en.scenario, sim_en.run_config,
+                    n_members=e_way, draws=DEFAULT_DRAWS,
+                )
+                t0 = time.time()
+                res_en = ens.run(collect=False)
+                wall = time.time() - t0
+                band = res_en.quantiles.band("adopters")
+                # the quantile-reduction program timed standalone on
+                # representative [E, N] operands (member_aggregates +
+                # year_quantiles — the per-year host fetch stays [Q])
+                n_pad = ens.base.table.n_agents
+                outs0 = YearOutputs(**{
+                    f.name: (
+                        jnp.zeros((0, 0), jnp.float32)
+                        if f.name == "state_hourly_net_mw"
+                        else jnp.zeros((e_way, n_pad), jnp.float32)
+                    )
+                    for f in _dc.fields(YearOutputs)
+                })
+                qs_dev = jnp.asarray(ens.quantiles, jnp.float32)
+
+                def _stats_once():
+                    nat, st = estats.member_aggregates(
+                        outs0, ens.base.table.mask,
+                        ens.base.table.state_idx,
+                        n_states=ens.base.table.n_states,
+                    )
+                    return (estats.year_quantiles(nat, qs_dev),
+                            estats.year_quantiles(st, qs_dev))
+
+                jax.block_until_ready(_stats_once())     # compile
+                t0 = time.time()
+                reps = 5
+                for _ in range(reps):
+                    jax.block_until_ready(_stats_once())
+                q_s = (time.time() - t0) / reps
+                payload["ensemble"] = {
+                    "e": e_way,
+                    "mode": ens.mode,
+                    "wall_s": round(wall, 2),
+                    "per_member_wall_s": round(wall / e_way, 3),
+                    "single_run_wall_s": round(single_s, 2),
+                    "amortization_x": round(
+                        single_s * e_way / max(wall, 1e-9), 2),
+                    "quantile_reduction_s_per_year": round(q_s, 4),
+                    "bank_bytes_shared": int(ens.bank_bytes_shared),
+                    "adopters_band_final": {
+                        k: round(float(v[-1]), 1)
+                        for k, v in band.items()
+                    },
+                }
+                del sim_en, pop_en, ens, res_en
+            except Exception as e:  # noqa: BLE001 — probe, don't kill
+                payload["ensemble"] = {
+                    "e": e_way,
                     ("oom" if _is_oom(e) else "failed"):
                         True if _is_oom(e) else str(e)[:300],
                 }

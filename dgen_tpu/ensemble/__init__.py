@@ -1,0 +1,50 @@
+"""dgen_tpu.ensemble — stochastic Monte-Carlo ensembles + dynamic
+agent populations over one placed table (ISSUE 20).
+
+The reference answers policy questions with one deterministic
+trajectory; decision-makers need adoption *bands* under diffusion and
+price uncertainty, over populations that change mid-horizon (new
+construction, electrification load growth). This package runs E
+seed-deterministic ensemble members in ONE compiled program against
+one placed agent table and one HBM-resident copy of the profile
+banks:
+
+* :mod:`~dgen_tpu.ensemble.draws` — per-member stochastic axes (Bass
+  p/q, retail/wholesale price paths, tech-cost trajectories) as pure
+  functions of ``(base ScenarioInputs, member key)`` built from
+  ``jax.random.fold_in`` — restart-stable and identical across
+  loop/vmap execution modes;
+* :mod:`~dgen_tpu.ensemble.cohorts` — cohort entry on the alive-mask
+  data plane: future-construction rows sit pre-placed and masked in
+  the fixed-capacity table, and a per-year jitted mask update flips
+  them alive at their entry year (masked rows contribute exact zeros
+  — the PR 13 quarantine proof — so the compiled programs never move);
+* :mod:`~dgen_tpu.ensemble.stats` — on-device per-member reductions +
+  per-year p10/p50/p90 quantiles, so host traffic stays O(quantiles)
+  per year instead of O(E x N) agent rows;
+* :mod:`~dgen_tpu.ensemble.driver` — :class:`EnsembleSimulation`,
+  riding the sweep engine's vmap/loop duality and ``plan_sweep``'s
+  mesh-global HBM byte model (``n_members`` term): vmap mode batches
+  the member axis in one program, loop mode reuses ONE compiled
+  executable member-major when E doesn't fit, and E=1 with zero-width
+  draws is byte-identical to :meth:`Simulation.run`.
+
+See docs/ensemble.md.
+"""
+
+from dgen_tpu.ensemble.cohorts import (  # noqa: F401
+    COHORT_NEVER,
+    CohortSchedule,
+    cohort_alive_mask,
+)
+from dgen_tpu.ensemble.draws import (  # noqa: F401
+    DEFAULT_DRAWS,
+    DrawSpec,
+    draw_members,
+    member_key,
+)
+from dgen_tpu.ensemble.driver import (  # noqa: F401
+    EnsembleSimulation,
+    ensemble_year_step,
+)
+from dgen_tpu.ensemble.stats import EnsembleStats  # noqa: F401

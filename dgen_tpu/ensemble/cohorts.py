@@ -1,0 +1,152 @@
+"""Dynamic agent populations on the alive-mask data plane.
+
+JAX programs cannot grow arrays mid-horizon, so "new construction
+enters in 2032" becomes: the cohort rows exist in the fixed-capacity
+table from year 0 — placed, partitioned, clustered, and quantized with
+everyone else — but carry ``mask = 0`` until their entry year, when a
+tiny jitted mask update flips them alive. PR 13's quarantine proof is
+what makes this free: masked rows contribute exact zeros to every
+reduction, so the compiled year-step program is literally the same
+program before and after entry (the mask is a traced operand, never a
+shape). This is the padded-table + alive-mask pattern ABMax
+(PAPERS.md) uses for birth/death in JAX ABMs, applied to dGen's
+fixed-horizon sweep.
+
+Entry scheduling is one f32 row vector ``entry_year`` aligned with the
+PLACED table (use :func:`align_entry` to push an input-table-order
+vector through ``Simulation.host_row_origin``):
+
+* ``0.0`` — alive from the start (every pre-existing row);
+* calendar year (e.g. ``2032.0``) — flips alive when the model year
+  reaches it;
+* :data:`COHORT_NEVER` — never alive (padding / quarantined rows).
+
+Electrification / EV load growth rides the existing year-indexed
+``load_growth`` trajectory rather than mutating profile banks:
+:func:`electrified_load_growth` compounds an extra annual growth rate
+into the [Y, R, S] multiplier, which ``apply_year`` already gathers
+per agent — no new compiled program, no bank copies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Entry-year sentinel for rows that never become alive (padding and
+#: quarantined rows). Far above any calendar year yet exactly
+#: representable in f32, so ``entry_year <= year`` is a clean compare.
+COHORT_NEVER = 9.0e9
+
+
+@dataclasses.dataclass(frozen=True)
+class CohortSchedule:
+    """Host-side description of a cohort entry plan: ``entry_year[i]``
+    is the calendar year input-table row i becomes alive (0.0 =
+    alive-at-start, COHORT_NEVER = never)."""
+
+    entry_year: np.ndarray  # [N_input] f32, input-table row order
+
+    def __post_init__(self) -> None:
+        e = np.asarray(self.entry_year, dtype=np.float32)
+        object.__setattr__(self, "entry_year", e)
+        if e.ndim != 1:
+            raise ValueError(f"entry_year must be 1-D, got shape {e.shape}")
+
+    @property
+    def n_cohort_rows(self) -> int:
+        e = self.entry_year
+        return int(np.sum((e > 0.0) & (e < COHORT_NEVER)))
+
+    def counts_by_year(self) -> Dict[int, int]:
+        """{calendar year: rows entering} for logging / world.json."""
+        e = self.entry_year
+        sel = (e > 0.0) & (e < COHORT_NEVER)
+        ys, cs = np.unique(e[sel].astype(np.int64), return_counts=True)
+        return {int(y): int(c) for y, c in zip(ys, cs)}
+
+
+@jax.jit
+def cohort_alive_mask(
+    mask_pot: jax.Array, entry_year: jax.Array, year_f: jax.Array
+) -> jax.Array:
+    """[N] alive mask for model year ``year_f`` (f32 0-d): the
+    potential mask gated by ``entry_year <= year``. This is the whole
+    per-year population dynamics program — registered in the prog-audit
+    registry (entry ``cohort_mask_update``) so its fingerprint is
+    pinned like every other compiled program in the system."""
+    return mask_pot * (entry_year <= year_f).astype(mask_pot.dtype)
+
+
+def alive_mask_np(
+    mask_pot: np.ndarray, entry_year: np.ndarray, year: float
+) -> np.ndarray:
+    """NumPy oracle for :func:`cohort_alive_mask` (tests)."""
+    return np.asarray(mask_pot, np.float32) * (
+        np.asarray(entry_year, np.float32) <= np.float32(year)
+    ).astype(np.float32)
+
+
+def potential_mask(
+    base_mask: np.ndarray, entry_year: np.ndarray
+) -> np.ndarray:
+    """[N] f32 potential-population mask: base-alive rows PLUS every
+    cohort row that will ever enter. The ensemble driver hands
+    ``Simulation`` a table carrying THIS mask so placement decisions
+    (state partitioning, tariff clustering, net-billing flags, chunk
+    padding) are made once over the full potential population —
+    conservative and numerically exact, since pre-entry rows are
+    re-masked to zero each year by :func:`cohort_alive_mask`."""
+    base = np.asarray(base_mask, np.float32)
+    e = np.asarray(entry_year, np.float32)
+    will_enter = ((e > 0.0) & (e < COHORT_NEVER)).astype(np.float32)
+    return np.maximum(base, will_enter)
+
+
+def align_entry(
+    entry_input: np.ndarray, host_row_origin: np.ndarray
+) -> np.ndarray:
+    """Push an input-table-order entry vector through the composed
+    placement permutation (``Simulation.host_row_origin``): placed rows
+    inherit their origin row's entry year; rows with origin -1
+    (per-shard / chunk padding) get :data:`COHORT_NEVER`."""
+    origin = np.asarray(host_row_origin, np.int64)
+    entry = np.asarray(entry_input, np.float32)
+    out = np.full(origin.shape, COHORT_NEVER, dtype=np.float32)
+    sel = origin >= 0
+    out[sel] = entry[origin[sel]]
+    return out
+
+
+def electrified_load_growth(
+    load_growth: np.ndarray,
+    years: Sequence[int],
+    annual_rate: float,
+    start_year: int | None = None,
+    sectors: Sequence[int] | None = None,
+) -> jnp.ndarray:
+    """[Y, R, S] load-growth multiplier with electrification / EV
+    uptake compounded on top: from ``start_year`` (default: the first
+    model year) each subsequent year multiplies demand by
+    ``(1 + annual_rate)``. ``sectors`` restricts the transform (e.g.
+    ``(0,)`` = residential EV charging); default applies to all.
+
+    Pure input transform — ``apply_year`` gathers it like any other
+    trajectory, so dynamic demand costs zero new compiled programs.
+    """
+    lg = np.array(load_growth, dtype=np.float32, copy=True)
+    ys = np.asarray(list(years), dtype=np.int64)
+    y0 = int(start_year) if start_year is not None else int(ys[0])
+    exponent = np.maximum(ys - y0, 0).astype(np.float32)
+    factor = (1.0 + float(annual_rate)) ** exponent      # [Y]
+    s_sel = (
+        np.asarray(list(sectors), np.int64)
+        if sectors is not None
+        else np.arange(lg.shape[2])
+    )
+    lg[:, :, s_sel] *= factor[:, None, None]
+    return jnp.asarray(lg)

@@ -1,0 +1,214 @@
+"""Per-member stochastic axes for Monte-Carlo ensembles.
+
+Every ensemble member m is a pure function of ``(base ScenarioInputs,
+member key)`` where the key is ``jax.random.fold_in(PRNGKey(seed), m)``
+— no sequential RNG state, so member 7 draws the same trajectories
+whether it runs first, last, vmapped alongside 63 siblings, or alone
+after a checkpoint restart (the restart-stability contract the tests
+pin). Within a member, each stochastic axis folds in its own constant,
+so adding an axis never reshuffles the draws of existing ones.
+
+Axes (all mean-preserving so the ensemble median tracks the base case):
+
+* **Bass diffusion** — per-group lognormal perturbations of ``bass_p``
+  / ``bass_q``, the reference's most-cited calibration uncertainty;
+* **retail price path** — a [Y] geometric random walk on
+  ``elec_price_multiplier`` (year 0 pinned at the observed base year),
+  with ``elec_price_escalator`` re-derived from the shocked multiplier
+  via :func:`~dgen_tpu.models.scenario.escalator_from_multipliers` so
+  the two stay mutually consistent the way the reference computes them;
+* **wholesale price path** — an independent [Y] walk on
+  ``wholesale_multiplier`` (shared across regions: wholesale shocks
+  are systemic, not regional);
+* **tech cost** — one lognormal scale per technology applied to every
+  coupled capex field (pv standalone + combined; battery $/kWh, $/kW,
+  and combined) so PV-vs-storage relative economics shift coherently.
+
+``nem_cap_kw`` is NEVER drawn: it feeds the net-billing static flag
+(models.flags), and perturbing it could flip a compiled-program shape
+decision between members that must share one executable.
+
+A zero-width spec returns the base :class:`ScenarioInputs` OBJECT
+(identity, not a copy) — the hook that makes the E=1 ensemble
+byte-identical to ``Simulation.run``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.models.scenario import (
+    ScenarioInputs,
+    escalator_from_multipliers,
+)
+
+# fold_in constants, one per stochastic axis: member key -> axis key.
+# Frozen — reordering or renumbering changes every committed draw.
+AXIS_BASS = 0
+AXIS_RETAIL = 1
+AXIS_WHOLESALE = 2
+AXIS_TECH = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class DrawSpec:
+    """Standard deviations of the per-member stochastic axes (all in
+    log space; 0.0 disables an axis exactly — it consumes no RNG and
+    perturbs nothing)."""
+
+    bass_p_sd: float = 0.0      # per-group lognormal on bass_p
+    bass_q_sd: float = 0.0      # per-group lognormal on bass_q
+    retail_sd: float = 0.0      # per-year retail price walk step
+    wholesale_sd: float = 0.0   # per-year wholesale price walk step
+    pv_capex_sd: float = 0.0    # one lognormal scale per member
+    batt_capex_sd: float = 0.0  # one lognormal scale per member
+
+    def __post_init__(self) -> None:
+        for f in dataclasses.fields(self):
+            v = float(getattr(self, f.name))
+            if not np.isfinite(v) or v < 0.0:
+                raise ValueError(f"DrawSpec.{f.name} must be >= 0, got {v}")
+
+    @property
+    def is_zero(self) -> bool:
+        """True when every axis is disabled — the byte-parity contract:
+        :func:`draw_member` returns the base inputs object unchanged."""
+        return all(
+            float(getattr(self, f.name)) == 0.0
+            for f in dataclasses.fields(self)
+        )
+
+    def to_json(self) -> Dict[str, float]:
+        return {
+            f.name: float(getattr(self, f.name))
+            for f in dataclasses.fields(self)
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, float]) -> "DrawSpec":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: float(v) for k, v in d.items() if k in known})
+
+
+#: Calibrated-magnitude default: ~±20% Bass p, ~±15% q (the spread of
+#: the reference's state-level pq calibrations), 1%/yr retail and
+#: 3%/yr wholesale walk steps, ±5% technology cost levels.
+DEFAULT_DRAWS = DrawSpec(
+    bass_p_sd=0.20,
+    bass_q_sd=0.15,
+    retail_sd=0.01,
+    wholesale_sd=0.03,
+    pv_capex_sd=0.05,
+    batt_capex_sd=0.05,
+)
+
+
+def member_key(seed: int, member: int) -> jax.Array:
+    """Restart-stable key for ensemble member ``member``."""
+    return jax.random.fold_in(jax.random.PRNGKey(int(seed)), int(member))
+
+
+def _lognormal(key: jax.Array, sd: float, shape) -> np.ndarray:
+    """Mean-preserving lognormal factors: E[exp(sd*z - sd^2/2)] = 1."""
+    z = np.asarray(jax.random.normal(key, shape, dtype=jnp.float32))
+    return np.exp(sd * z - 0.5 * sd * sd).astype(np.float32)
+
+
+def _walk(key: jax.Array, sd: float, n_years: int) -> np.ndarray:
+    """[Y] geometric random-walk factors, year 0 pinned at 1.0 (the
+    base year is observed, not uncertain). Median-preserving
+    (exp of a zero-mean walk); the p50 band therefore tracks the base
+    trajectory, which is what the quantile tests assert."""
+    z = np.asarray(
+        jax.random.normal(key, (n_years - 1,), dtype=jnp.float32)
+    )
+    steps = np.concatenate([[0.0], np.cumsum(sd * z)])
+    return np.exp(steps).astype(np.float32)
+
+
+def draw_member(
+    base: ScenarioInputs, spec: DrawSpec, key: jax.Array
+) -> ScenarioInputs:
+    """One ensemble member's :class:`ScenarioInputs`, drawn from
+    ``key`` (host-side: the perturbed trajectories are tiny O(Y x G)
+    arrays, and the escalator re-derivation is numpy).
+
+    Zero-width spec => returns ``base`` itself (object identity), so
+    downstream byte-parity holds with no float round-trip at all.
+    """
+    if spec.is_zero:
+        return base
+
+    years_np = np.asarray(base.years)
+    n_years = int(years_np.shape[0])
+    repl: Dict[str, jax.Array] = {}
+
+    if spec.bass_p_sd > 0.0 or spec.bass_q_sd > 0.0:
+        k = jax.random.fold_in(key, AXIS_BASS)
+        kp, kq = jax.random.split(k)
+        g = base.bass_p.shape
+        if spec.bass_p_sd > 0.0:
+            repl["bass_p"] = jnp.asarray(
+                np.asarray(base.bass_p) * _lognormal(kp, spec.bass_p_sd, g)
+            )
+        if spec.bass_q_sd > 0.0:
+            repl["bass_q"] = jnp.asarray(
+                np.asarray(base.bass_q) * _lognormal(kq, spec.bass_q_sd, g)
+            )
+
+    if spec.retail_sd > 0.0 and n_years > 1:
+        k = jax.random.fold_in(key, AXIS_RETAIL)
+        walk = _walk(k, spec.retail_sd, n_years)          # [Y]
+        mult = np.asarray(base.elec_price_multiplier) * walk[:, None, None]
+        repl["elec_price_multiplier"] = jnp.asarray(mult)
+        # keep the forward-CAGR escalator consistent with the shocked
+        # path — the reference derives one from the other, never both
+        repl["elec_price_escalator"] = jnp.asarray(
+            escalator_from_multipliers(mult, years_np.astype(np.int64))
+        )
+
+    if spec.wholesale_sd > 0.0 and n_years > 1:
+        k = jax.random.fold_in(key, AXIS_WHOLESALE)
+        walk = _walk(k, spec.wholesale_sd, n_years)       # [Y]
+        repl["wholesale_multiplier"] = jnp.asarray(
+            np.asarray(base.wholesale_multiplier) * walk[:, None]
+        )
+
+    if spec.pv_capex_sd > 0.0 or spec.batt_capex_sd > 0.0:
+        k = jax.random.fold_in(key, AXIS_TECH)
+        kpv, kb = jax.random.split(k)
+        if spec.pv_capex_sd > 0.0:
+            s = float(_lognormal(kpv, spec.pv_capex_sd, ()))
+            for f in ("pv_capex_per_kw", "pv_capex_per_kw_combined"):
+                repl[f] = jnp.asarray(np.asarray(getattr(base, f)) * s)
+        if spec.batt_capex_sd > 0.0:
+            s = float(_lognormal(kb, spec.batt_capex_sd, ()))
+            for f in (
+                "batt_capex_per_kwh",
+                "batt_capex_per_kw",
+                "batt_capex_per_kwh_combined",
+            ):
+                repl[f] = jnp.asarray(np.asarray(getattr(base, f)) * s)
+
+    return dataclasses.replace(base, **repl)
+
+
+def draw_members(
+    base: ScenarioInputs,
+    spec: DrawSpec,
+    n_members: int,
+    seed: int,
+) -> List[ScenarioInputs]:
+    """All E members' inputs. Member m depends only on ``(seed, m)`` —
+    the list is stable under reordering, truncation, and restart."""
+    if n_members < 1:
+        raise ValueError(f"n_members must be >= 1, got {n_members}")
+    return [
+        draw_member(base, spec, member_key(seed, m))
+        for m in range(int(n_members))
+    ]

@@ -1,0 +1,762 @@
+"""The ensemble driver: E Monte-Carlo members in one program against
+one placed table, with dynamic cohort populations.
+
+Rides the sweep engine's machinery end to end: ``plan_sweep`` budgets
+the member axis through its ``n_members`` term (members batch exactly
+like scenarios — one [E, N] carry row-set, one shared copy of the
+multi-GB banks), and execution is the same vmap/loop duality:
+
+* **vmap mode** — :func:`ensemble_year_step` vmaps ``year_step_impl``
+  over the member axis of (inputs, carry) with table/banks closed over
+  UNMAPPED; when cohorts are scheduled the shared alive mask is
+  computed ONCE inside the program (members never disagree about who
+  exists) and fused ahead of the vmap;
+* **loop mode** — member-major over the ONE compiled single-member
+  executable (``with_inputs`` siblings) when E doesn't fit the HBM
+  model; member 1..E-1 must compile NOTHING (cross-member
+  RetraceGuard). ``E == 1`` is FORCED onto this path so that a
+  zero-width-draw ensemble is byte-identical to ``Simulation.run`` —
+  the member loop then drives the base Simulation itself, stepping the
+  very same compiled program with the very same operands.
+
+Per-year statistics reduce on device (:mod:`dgen_tpu.ensemble.stats`):
+vmap mode fetches [Q]-sized quantile blocks, loop mode one scalar
+block per (member, year) — host traffic is O(quantiles), never
+O(E x N). Checkpoint/resume is (member, year)-grained: loop mode lays
+out ``mem=<m>/`` subdirectories (:func:`dgen_tpu.io.checkpoint.
+member_dir`), vmap mode saves the stacked [E, N] carry like a vmapped
+sweep group, and the partial statistics ride the checkpoint directory
+as a JSON sidecar so a resumed run's quantiles cover the full horizon.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.ensemble import stats as estats
+from dgen_tpu.ensemble.cohorts import (
+    CohortSchedule,
+    align_entry,
+    cohort_alive_mask,
+    potential_mask,
+)
+from dgen_tpu.ensemble.draws import DrawSpec, draw_members
+from dgen_tpu.models.scenario import ScenarioInputs, stack_scenarios
+from dgen_tpu.models.simulation import (
+    YEAR_STEP_STATIC_ARGNAMES,
+    SimCarry,
+    SimResults,
+    Simulation,
+    YearOutputs,
+    year_step_impl,
+)
+from dgen_tpu.resilience.atomic import atomic_write_json
+from dgen_tpu.sweep.driver import bank_nbytes
+from dgen_tpu.sweep.plan import MODE_LOOP, MODE_VMAP, SweepPlan, plan_sweep
+from dgen_tpu.sweep.results import SweepResults
+from dgen_tpu.utils import timing
+from dgen_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+#: env knobs (documented in docs/userguide.md): default member count
+#: and draw seed when the constructor arguments are omitted
+ENV_MEMBERS = "DGEN_TPU_ENSEMBLE"
+ENV_SEED = "DGEN_TPU_ENSEMBLE_SEED"
+
+#: stats sidecar in the checkpoint directory — partial per-year
+#: aggregates persisted incrementally so (member, year) resume can
+#: still produce full-horizon quantiles
+STATS_FILE = "ensemble_stats.json"
+
+#: vmap mode's stacked-carry checkpoint subdirectory key (the analogue
+#: of a sweep group's ``scn=<group>/``)
+_VMAP_CKPT_KEY = "members"
+
+
+@partial(
+    jax.jit,
+    static_argnames=YEAR_STEP_STATIC_ARGNAMES,
+    donate_argnames=("carry",),
+)
+def ensemble_year_step(
+    table,
+    profiles,
+    tariffs,
+    inputs_e,           # ScenarioInputs with [E, ...] leaves
+    entry_year,         # [N] f32 cohort entry years, or None
+    year_f,             # 0-d f32 calendar year, or None
+    carry,              # SimCarry with [E, N] leaves
+    year_idx,
+    *,
+    n_periods: int,
+    econ_years: int,
+    sizing_iters: int,
+    first_year: bool,
+    with_hourly: bool,
+    storage_enabled: bool,
+    year_step_len: float,
+    sizing_impl: str = "auto",
+    rate_switch: bool = False,
+    mesh=None,
+    agent_chunk: int = 0,
+    net_billing: bool = True,
+    daylight=None,
+    pack_once: bool = False,
+    soft_tau=None,
+    anchor: bool = True,
+    cluster=None,
+    cluster_banks=None,
+    cluster_tidx=None,
+):
+    """One model year for E ensemble members as a single device
+    program: ``year_step_impl`` vmapped over the member axis of
+    (inputs, carry), table and banks closed over UNMAPPED — the member
+    analogue of ``sweep_year_step``, plus the cohort data plane: when
+    ``entry_year`` is given, the shared alive mask
+    ``mask * (entry_year <= year)`` is computed ONCE ahead of the vmap
+    (members share one population, so aliveness is member-invariant).
+    ``year_f`` is a traced 0-d f32 — the year value changes every step
+    without retracing, exactly like ``year_idx``."""
+    if entry_year is not None:
+        table = dataclasses.replace(
+            table,
+            mask=table.mask * (entry_year <= year_f).astype(table.mask.dtype),
+        )
+
+    def one(inputs, c):
+        return year_step_impl(
+            table, profiles, tariffs, inputs, c, year_idx,
+            n_periods=n_periods, econ_years=econ_years,
+            sizing_iters=sizing_iters, first_year=first_year,
+            with_hourly=with_hourly, storage_enabled=storage_enabled,
+            year_step_len=year_step_len, sizing_impl=sizing_impl,
+            rate_switch=rate_switch, mesh=mesh, agent_chunk=agent_chunk,
+            net_billing=net_billing, daylight=daylight,
+            pack_once=pack_once, soft_tau=soft_tau, anchor=anchor,
+            cluster=cluster, cluster_banks=cluster_banks,
+            cluster_tidx=cluster_tidx,
+        )
+
+    return jax.vmap(one)(inputs_e, carry)
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else default
+
+
+class EnsembleSimulation:
+    """Run an E-member Monte-Carlo ensemble over one shared population
+    (the ensemble analogue of ``SweepSimulation``).
+
+    Parameters
+    ----------
+    table, profiles, tariffs : the shared population and banks, placed
+        once through Simulation's placement path.
+    inputs : the BASE ScenarioInputs; member m perturbs it per
+        ``draws`` with the restart-stable key ``fold_in(seed, m)``.
+    scenario : ScenarioConfig.
+    n_members : ensemble width E (default: env ``DGEN_TPU_ENSEMBLE``,
+        else 1).
+    seed : draw seed (default: env ``DGEN_TPU_ENSEMBLE_SEED``, else 0).
+    draws : DrawSpec; the default zero-width spec perturbs nothing —
+        members are then literal copies of the base (the byte-parity
+        configuration).
+    entry_year : optional cohort schedule (CohortSchedule or [N] f32
+        in INPUT-table row order): 0 = alive at start, calendar year =
+        cohort entry, COHORT_NEVER = never. The driver hands Simulation
+        the potential-population mask so placement sees every row that
+        will ever exist, then re-derives aliveness per year.
+    quantiles : per-year quantile levels (default p10/p50/p90).
+    max_vmap_members : forwarded to the planner's vmap width cap.
+    Other parameters match Simulation.
+    """
+
+    def __init__(
+        self,
+        table,
+        profiles,
+        tariffs,
+        inputs: ScenarioInputs,
+        scenario: ScenarioConfig,
+        run_config: Optional[RunConfig] = None,
+        *,
+        n_members: Optional[int] = None,
+        seed: Optional[int] = None,
+        draws: Optional[DrawSpec] = None,
+        entry_year: Union[CohortSchedule, np.ndarray, None] = None,
+        mesh=None,
+        with_hourly: bool = False,
+        econ_years: int = 25,
+        quantiles: Sequence[float] = estats.DEFAULT_QUANTILES,
+        max_vmap_members: Optional[int] = None,
+        plan: Optional[SweepPlan] = None,
+    ) -> None:
+        self.n_members = (
+            int(n_members) if n_members is not None
+            else _env_int(ENV_MEMBERS, 1)
+        )
+        if self.n_members < 1:
+            raise ValueError(f"n_members must be >= 1, got {self.n_members}")
+        self.seed = int(seed) if seed is not None else _env_int(ENV_SEED, 0)
+        self.draws = draws if draws is not None else DrawSpec()
+        self.inputs = inputs
+        self.scenario = scenario
+        self.run_config = run_config or RunConfig()
+        self.mesh = mesh
+        self.with_hourly = with_hourly
+        self.quantiles = tuple(float(q) for q in quantiles)
+        self.labels = [f"mem{m:03d}" for m in range(self.n_members)]
+
+        if isinstance(entry_year, CohortSchedule):
+            entry_input = entry_year.entry_year
+        elif entry_year is not None:
+            entry_input = np.asarray(entry_year, np.float32)
+        else:
+            entry_input = None
+        if entry_input is not None:
+            if len(entry_input) != table.n_agents:
+                raise ValueError(
+                    f"entry_year covers {len(entry_input)} rows but the "
+                    f"table has {table.n_agents}"
+                )
+            # placement must see the POTENTIAL population: every row
+            # that will ever be alive participates in partitioning,
+            # clustering, static-flag proofs and chunk padding (all
+            # conservative over a superset); per-year aliveness is then
+            # re-derived from entry_year on the data plane
+            table = dataclasses.replace(
+                table,
+                mask=jnp.asarray(
+                    potential_mask(np.asarray(table.mask), entry_input)
+                ),
+            )
+        self._entry_input = entry_input
+
+        #: member m's ScenarioInputs — pure function of (base, seed, m);
+        #: with a zero-width DrawSpec every element IS the base object
+        self.members: List[ScenarioInputs] = draw_members(
+            inputs, self.draws, self.n_members, self.seed
+        )
+
+        years = list(scenario.model_years)
+        self.plan = plan if plan is not None else plan_sweep(
+            [inputs], years,
+            table=table, tariffs=tariffs,
+            with_hourly=with_hourly, econ_years=econ_years,
+            sizing_iters=self.run_config.sizing_iters,
+            bank_bf16=self.run_config.bf16_banks,
+            bank_quant=self.run_config.quant_banks,
+            mesh=mesh,
+            max_vmap_scenarios=max_vmap_members,
+            cluster=self.run_config.cluster_tariffs,
+            agent_pad_multiple=self.run_config.agent_pad_multiple,
+            n_members=self.n_members,
+        )
+
+        rc = self.run_config
+        if self.plan.agent_chunk is not None and rc.agent_chunk is None:
+            rc = dataclasses.replace(rc, agent_chunk=self.plan.agent_chunk)
+        self.base = Simulation(
+            table, profiles, tariffs, inputs, scenario, rc,
+            mesh=mesh, with_hourly=with_hourly, econ_years=econ_years,
+        )
+        self.years = self.base.years
+        self.bank_bytes_shared = bank_nbytes(self.base.profiles)
+
+        group = self.plan.groups[0]
+        self.net_billing = group.net_billing
+        # E=1 is pinned to the member-major loop: the single member
+        # then steps the base Simulation's own compiled program with
+        # its own operands — byte-identical to Simulation.run, which a
+        # vmapped E=1 program (different executable) could not promise
+        self.mode = MODE_LOOP if self.n_members == 1 else group.mode
+
+        # cohort operands on device, aligned with the PLACED row order
+        # (host_row_origin composes partition/chunk/cluster gathers)
+        if entry_input is not None:
+            aligned = align_entry(entry_input, self.base.host_row_origin)
+            entry_dev = jnp.asarray(aligned)
+            mask_pot = self.base.table.mask
+            if self.base._shard is not None:
+                entry_dev = self.base._put(entry_dev, self.base._shard)
+            self._entry_dev = entry_dev
+            self._mask_pot_dev = mask_pot
+        else:
+            self._entry_dev = None
+            self._mask_pot_dev = None
+
+        logger.info(
+            "ensemble: E=%d seed=%d draws=%s cohorts=%s -> %s mode "
+            "(net_billing=%s, agent_chunk=%s)",
+            self.n_members, self.seed,
+            "zero" if self.draws.is_zero else "on",
+            "none" if entry_input is None else
+            f"{int(np.sum((entry_input > 0) & (entry_input < 1e9)))} rows",
+            self.mode, self.net_billing, self.plan.agent_chunk,
+        )
+
+    # -- stats sidecar --------------------------------------------------
+
+    def _stats_path(self, checkpoint_dir: str) -> str:
+        return os.path.join(checkpoint_dir, STATS_FILE)
+
+    def _load_stats_state(self, checkpoint_dir: Optional[str],
+                          mode: str) -> Optional[dict]:
+        if not checkpoint_dir:
+            return None
+        path = self._stats_path(checkpoint_dir)
+        if not os.path.exists(path):
+            return None
+        import json
+
+        with open(path) as f:
+            state = json.load(f)
+        if (
+            state.get("mode") != mode
+            or int(state.get("n_members", -1)) != self.n_members
+            or list(state.get("quantiles", [])) != list(self.quantiles)
+        ):
+            logger.warning(
+                "ensemble stats sidecar %s does not match this run "
+                "(mode/members/quantiles); ignoring it", path,
+            )
+            return None
+        return state
+
+    # -- loop mode ------------------------------------------------------
+
+    def _run_loop(self, collect: bool, checkpoint_dir: Optional[str],
+                  resume: bool):
+        from dgen_tpu.io import checkpoint as ckpt
+
+        E = self.n_members
+        years = self.years
+        n_states = self.base.table.n_states
+        state_idx = self.base.table.state_idx
+        rc = self.run_config
+        agent_fields = [
+            f.name for f in dataclasses.fields(YearOutputs)
+            if f.name != "state_hourly_net_mw"
+        ]
+
+        nat_curves = {
+            m: np.full((E, len(years)), np.nan, np.float64)
+            for m in estats.METRIC_FIELDS
+        }
+        st_curves = {
+            m: np.full((E, len(years), n_states), np.nan, np.float64)
+            for m in estats.STATE_METRICS
+        }
+        if resume:
+            state = self._load_stats_state(checkpoint_dir, "loop")
+            if state is not None:
+                for m, v in state.get("national", {}).items():
+                    nat_curves[m][:] = np.asarray(v, np.float64)
+                for m, v in state.get("state", {}).items():
+                    st_curves[m][:] = np.asarray(v, np.float64)
+
+        def persist() -> None:
+            if not checkpoint_dir:
+                return
+            atomic_write_json(self._stats_path(checkpoint_dir), {
+                "mode": "loop",
+                "n_members": E,
+                "quantiles": list(self.quantiles),
+                "national": {m: v.tolist() for m, v in nat_curves.items()},
+                "state": {m: v.tolist() for m, v in st_curves.items()},
+            })
+
+        results: List[SimResults] = []
+        cross_guard = None
+        try:
+            for mi in range(E):
+                member = self.members[mi]
+                if member is self.inputs and self._entry_dev is None:
+                    # zero-width draws, no cohorts: the member IS the
+                    # base — drive the base Simulation itself, so the
+                    # E=1 ensemble is byte-identical to Simulation.run
+                    sib = self.base
+                else:
+                    sib = self.base.with_inputs(
+                        member, net_billing=self.net_billing,
+                        timing_ctx=self.labels[mi],
+                    )
+                mdir = (
+                    ckpt.member_dir(checkpoint_dir, mi)
+                    if checkpoint_dir else None
+                )
+                start_idx = 0
+                carry = sib.init_carry()
+                if resume and mdir:
+                    last = ckpt.latest_year(mdir)
+                    if last is not None and last not in years:
+                        raise ValueError(
+                            f"checkpointed year {last} of member {mi} is "
+                            f"not on the year grid {years}; refusing to "
+                            "resume"
+                        )
+                    if last is not None:
+                        _, carry = ckpt.restore_year(
+                            mdir, self.base.table.n_agents, last,
+                            sharding=self.base._shard,
+                        )
+                        start_idx = years.index(last) + 1
+                        logger.info(
+                            "ensemble member %d: resuming after year %d",
+                            mi, last,
+                        )
+                writer = ckpt.Writer(mdir) if mdir else None
+                collected: Dict[str, list] = {k: [] for k in agent_fields}
+                hourly: List[np.ndarray] = []
+                steady_guard = None
+                try:
+                    for yi, year in enumerate(years):
+                        if yi < start_idx:
+                            continue
+                        if (
+                            rc.guard_retrace and steady_guard is None
+                            and cross_guard is None
+                            and yi - start_idx >= 2
+                        ):
+                            from dgen_tpu.lint.guard import RetraceGuard
+
+                            steady_guard = RetraceGuard(
+                                context="ensemble member steady state"
+                            ).start()
+                        if self._entry_dev is not None:
+                            alive = cohort_alive_mask(
+                                self._mask_pot_dev, self._entry_dev,
+                                jnp.asarray(float(year), jnp.float32),
+                            )
+                            sib.table = dataclasses.replace(
+                                sib.table, mask=alive
+                            )
+                        else:
+                            alive = sib.table.mask
+                        with timing.timer(
+                            "ensemble_year_step", ctx=self.labels[mi]
+                        ):
+                            carry, outs = sib.step(
+                                carry, yi, first_year=(yi == 0)
+                            )
+                        nat, st = estats.member_aggregates(
+                            outs, alive, state_idx, n_states=n_states
+                        )
+                        # a scalar block per (member, year) — the
+                        # O(quantiles) contract, not a bulk D2H copy
+                        host = jax.device_get(  # dgenlint: disable=L9
+                            {"nat": nat, "st": st}
+                        )
+                        for k, v in host["nat"].items():
+                            nat_curves[k][mi, yi] = float(v)
+                        for k, v in host["st"].items():
+                            st_curves[k][mi, yi] = np.asarray(v)
+                        if collect:
+                            fetch = {
+                                k: getattr(outs, k) for k in agent_fields
+                            }
+                            if self.with_hourly:
+                                fetch["_hourly"] = outs.state_hourly_net_mw
+                            h = jax.device_get(fetch)  # dgenlint: disable=L9
+                            for k in agent_fields:
+                                collected[k].append(h[k])
+                            if self.with_hourly:
+                                hourly.append(h["_hourly"])
+                        if writer is not None:
+                            writer.save(year, carry)
+                            persist()
+                        if steady_guard is not None:
+                            steady_guard.check(f"year {year}")
+                        if cross_guard is not None:
+                            cross_guard.check(
+                                f"member {mi} year {year}"
+                            )
+                finally:
+                    if steady_guard is not None:
+                        steady_guard.stop()
+                    if writer is not None:
+                        writer.close()
+                run_years = years[start_idx:]
+                agent = (
+                    {k: np.stack(v) for k, v in collected.items()}
+                    if collect and collected[agent_fields[0]] else {}
+                )
+                results.append(SimResults(
+                    years=list(run_years),
+                    agent=agent,
+                    state_hourly_net_mw=(
+                        np.stack(hourly) if hourly else None
+                    ),
+                ))
+                if (
+                    rc.guard_retrace and cross_guard is None
+                    and mi == 0 and E > 1
+                ):
+                    # member 0 compiled the program set; every later
+                    # member must compile NOTHING
+                    from dgen_tpu.lint.guard import RetraceGuard
+
+                    cross_guard = RetraceGuard(
+                        context="ensemble cross-member"
+                    ).start()
+        finally:
+            if cross_guard is not None:
+                cross_guard.stop()
+        persist()
+
+        if any(np.isnan(v).any() for v in nat_curves.values()):
+            logger.warning(
+                "ensemble stats are incomplete (resumed without a "
+                "stats sidecar?) — quantiles will carry NaNs"
+            )
+        stats = estats.stats_from_member_aggregates(
+            years, self.quantiles, nat_curves, st_curves
+        )
+        return results, stats
+
+    # -- vmap mode ------------------------------------------------------
+
+    def _init_stacked_carry(self) -> SimCarry:
+        n = self.base.table.n_agents
+        zeros = SimCarry.zeros(n)
+        return jax.tree.map(
+            lambda x: jnp.zeros((self.n_members,) + x.shape, x.dtype),
+            zeros,
+        )
+
+    def _run_vmap(self, collect: bool, checkpoint_dir: Optional[str],
+                  resume: bool):
+        from dgen_tpu.io import checkpoint as ckpt
+
+        E = self.n_members
+        years = self.years
+        rc = self.run_config
+        n_states = self.base.table.n_states
+        state_idx = self.base.table.state_idx
+        inputs_e = stack_scenarios(self.members).inputs
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            inputs_e = jax.tree.map(
+                lambda x: self.base._put(x, repl), inputs_e
+            )
+
+        kwargs = self.base.step_kwargs(first_year=True)
+        kwargs["net_billing"] = self.net_billing
+        # the planner routes >1-device meshes to loop mode; a 1-device
+        # mesh adds nothing inside the vmapped body (same as sweeps)
+        kwargs["mesh"] = None
+        if kwargs.get("cluster") is not None:
+            kwargs["cluster"] = kwargs["cluster"].pin_net_billing(
+                self.net_billing
+            )
+        kwargs.update(self.base.step_operands())
+
+        carry = self._init_stacked_carry()
+        start_idx = 0
+        writer = None
+        if resume:
+            if not checkpoint_dir:
+                raise ValueError("resume=True requires checkpoint_dir")
+            last = ckpt.latest_year(checkpoint_dir, scenario=_VMAP_CKPT_KEY)
+            if last is not None and last not in years:
+                raise ValueError(
+                    f"checkpointed year {last} is not on the year grid "
+                    f"{years}; refusing to resume"
+                )
+            if last is not None:
+                _, carry = ckpt.restore_year(
+                    checkpoint_dir, self.base.table.n_agents, last,
+                    scenario=_VMAP_CKPT_KEY, n_scenarios=E,
+                )
+                start_idx = years.index(last) + 1
+                logger.info(
+                    "ensemble (vmap): resuming after year %d", last
+                )
+        if checkpoint_dir is not None:
+            writer = ckpt.Writer(checkpoint_dir, scenario=_VMAP_CKPT_KEY)
+
+        blocks: Dict[int, dict] = {}
+        if resume:
+            state = self._load_stats_state(checkpoint_dir, "vmap")
+            if state is not None:
+                blocks = {
+                    int(k): {
+                        "national": {
+                            m: np.asarray(a, np.float32)
+                            for m, a in v["national"].items()
+                        },
+                        "state": {
+                            m: np.asarray(a, np.float32)
+                            for m, a in v["state"].items()
+                        },
+                    }
+                    for k, v in state.get("blocks", {}).items()
+                }
+
+        def persist() -> None:
+            if not checkpoint_dir:
+                return
+            atomic_write_json(self._stats_path(checkpoint_dir), {
+                "mode": "vmap",
+                "n_members": E,
+                "quantiles": list(self.quantiles),
+                "blocks": {
+                    str(k): {
+                        "national": {
+                            m: np.asarray(a).tolist()
+                            for m, a in v["national"].items()
+                        },
+                        "state": {
+                            m: np.asarray(a).tolist()
+                            for m, a in v["state"].items()
+                        },
+                    }
+                    for k, v in blocks.items()
+                },
+            })
+
+        qs_dev = jnp.asarray(self.quantiles, jnp.float32)
+        agent_fields = [
+            f.name for f in dataclasses.fields(YearOutputs)
+            if f.name != "state_hourly_net_mw"
+        ]
+        collected: Dict[str, list] = {k: [] for k in agent_fields}
+        hourly: List[np.ndarray] = []
+
+        guard = None
+        try:
+            for yi, year in enumerate(years):
+                if yi < start_idx:
+                    continue
+                if (
+                    rc.guard_retrace and guard is None
+                    and yi - start_idx >= 2
+                ):
+                    from dgen_tpu.lint.guard import RetraceGuard
+
+                    guard = RetraceGuard(
+                        context="ensemble vmap steady state"
+                    ).start()
+                kwargs["first_year"] = (yi == 0)
+                year_f = (
+                    jnp.asarray(float(year), jnp.float32)
+                    if self._entry_dev is not None else None
+                )
+                with timing.timer("ensemble_year_step", ctx="vmap"):
+                    carry, outs = ensemble_year_step(
+                        self.base.table, self.base.profiles,
+                        self.base.tariffs, inputs_e,
+                        self._entry_dev, year_f, carry,
+                        jnp.asarray(yi, dtype=jnp.int32), **kwargs,
+                    )
+                alive = (
+                    cohort_alive_mask(
+                        self._mask_pot_dev, self._entry_dev, year_f
+                    )
+                    if self._entry_dev is not None
+                    else self.base.table.mask
+                )
+                nat, st = estats.member_aggregates(
+                    outs, alive, state_idx, n_states=n_states
+                )
+                q_nat = estats.year_quantiles(nat, qs_dev)
+                q_st = estats.year_quantiles(st, qs_dev)
+                # the whole per-year host fetch: a handful of [Q] /
+                # [Q, n_states] blocks, O(quantiles) not O(E x N)
+                host = jax.device_get(  # dgenlint: disable=L9
+                    {"national": q_nat, "state": q_st}
+                )
+                blocks[yi] = host
+                if collect:
+                    fetch = {k: getattr(outs, k) for k in agent_fields}
+                    if self.with_hourly:
+                        fetch["_hourly"] = outs.state_hourly_net_mw
+                    h = jax.device_get(fetch)  # dgenlint: disable=L9
+                    for k in agent_fields:
+                        collected[k].append(h[k])
+                    if self.with_hourly:
+                        hourly.append(h["_hourly"])
+                if writer is not None:
+                    writer.save(year, carry)
+                    persist()
+                if guard is not None:
+                    guard.check(f"year {year}")
+        finally:
+            if guard is not None:
+                guard.stop()
+            if writer is not None:
+                writer.close()
+        persist()
+
+        run_years = years[start_idx:]
+        results: List[SimResults] = []
+        for m in range(E):
+            agent = (
+                {k: np.stack([v[m] for v in vs])
+                 for k, vs in collected.items()}
+                if collect and collected[agent_fields[0]] else {}
+            )
+            results.append(SimResults(
+                years=list(run_years),
+                agent=agent,
+                state_hourly_net_mw=(
+                    np.stack([h[m] for h in hourly]) if hourly else None
+                ),
+            ))
+        stats = estats.stats_from_year_blocks(
+            years, self.quantiles, E, blocks
+        )
+        return results, stats
+
+    # -- the ensemble ---------------------------------------------------
+
+    def run(
+        self,
+        collect: bool = False,
+        checkpoint_dir: Optional[str] = None,
+        resume: bool = False,
+    ) -> SweepResults:
+        """Run every member of every model year; returns
+        :class:`SweepResults` whose ``quantiles`` block carries the
+        per-year p10/p50/p90 bands (:class:`EnsembleStats`).
+
+        ``collect`` defaults to False — the ensemble's contract is
+        quantile bands with O(quantiles) host traffic; flip it on for
+        per-member agent-level outputs (tests, small worlds).
+
+        ``checkpoint_dir`` lays out (member, year)-grained resume:
+        per-member ``mem=<m>/`` subdirectories in loop mode, one
+        stacked ``scn=members/`` in vmap mode, plus the incremental
+        stats sidecar so a resumed run still reports the full horizon.
+        """
+        if self.mode == MODE_VMAP:
+            results, stats = self._run_vmap(collect, checkpoint_dir, resume)
+        else:
+            results, stats = self._run_loop(collect, checkpoint_dir, resume)
+        rep_q = getattr(self.base, "quarantine_report", None)
+        return SweepResults(
+            labels=list(self.labels),
+            baseline=0,
+            runs=results,
+            plan=self.plan,
+            bank_bytes_shared=self.bank_bytes_shared,
+            host_mask=self.base.host_mask,
+            host_agent_id=self.base.host_agent_id,
+            quarantine=(
+                rep_q.summary()
+                if rep_q is not None and not rep_q.is_clean else None
+            ),
+            quantiles=stats,
+        )

@@ -1,0 +1,222 @@
+"""On-device ensemble reductions: per-member aggregates + per-year
+quantiles, so host traffic stays O(quantiles) per year — never O(E x N)
+agent rows.
+
+The contract with the driver: for each model year, the [E, N] (or, in
+loop mode, [N]) :class:`YearOutputs` leaves are reduced ON DEVICE to
+per-member national/state aggregates (:func:`member_aggregates` — the
+same mask-weighted sums as ``SimResults.summary``), and in vmap mode
+the member axis is further collapsed to quantiles on device
+(:func:`year_quantiles`), so the per-year fetch is a handful of [Q]
+vectors. Loop mode fetches one scalar block per (member, year) and
+quantiles on the host at the end — both paths use linear-interpolation
+quantiles (``jnp.quantile`` == ``np.quantile`` default), which the
+small-E NumPy-reference test pins.
+
+Per-state aggregates use ``jax.ops.segment_sum`` over ``state_idx``
+(vmapped over members) — NOT a one-hot matmul, which at 10M agents x
+51 states would materialize a 2 TB intermediate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: metric name -> YearOutputs field behind it. The four headline
+#: curves, matching ``SimResults.summary`` exactly.
+METRIC_FIELDS: Dict[str, str] = {
+    "adopters": "number_of_adopters",
+    "system_kw_cum": "system_kw_cum",
+    "batt_kwh_cum": "batt_kwh_cum",
+    "new_adopters": "new_adopters",
+}
+
+#: metrics also reduced per state (kept to the two the NEM cap and
+#: state policy questions need; each costs [E, n_states] on device)
+STATE_METRICS: Tuple[str, ...] = ("adopters", "system_kw_cum")
+
+DEFAULT_QUANTILES: Tuple[float, ...] = (0.1, 0.5, 0.9)
+
+
+@partial(jax.jit, static_argnames=("n_states",))
+def member_aggregates(outs, mask, state_idx, *, n_states: int):
+    """(national, state) aggregate dicts for one model year.
+
+    ``outs`` leaves may be [N] (loop mode: one member) or [E, N] (vmap
+    mode: the whole ensemble); ``mask``/``state_idx`` are [N], shared —
+    members never disagree about who is alive. Returns national sums
+    shaped [] / [E] and state sums [n_states] / [E, n_states].
+    """
+    mask = mask.astype(jnp.float32)
+
+    def seg(x):
+        return jax.ops.segment_sum(
+            x * mask, state_idx, num_segments=n_states
+        )
+
+    national = {}
+    state = {}
+    for name, field in METRIC_FIELDS.items():
+        leaf = getattr(outs, field)
+        if leaf.ndim == 2:
+            national[name] = jnp.sum(leaf * mask[None, :], axis=1)
+        else:
+            national[name] = jnp.sum(leaf * mask)
+        if name in STATE_METRICS:
+            state[name] = jax.vmap(seg)(leaf) if leaf.ndim == 2 else seg(leaf)
+    return national, state
+
+
+@jax.jit
+def year_quantiles(agg, qs: jax.Array):
+    """Collapse the leading member axis of every aggregate leaf to
+    quantiles ``qs`` on device: [E] -> [Q], [E, n_states] ->
+    [Q, n_states] (linear interpolation, numpy-default semantics)."""
+    return jax.tree.map(lambda a: jnp.quantile(a, qs, axis=0), agg)
+
+
+def quantiles_np(curves: np.ndarray, qs: Sequence[float]) -> np.ndarray:
+    """NumPy reference: ``curves`` [E, ...] -> [Q, ...] (tests pin the
+    device path against this at small E)."""
+    return np.quantile(
+        np.asarray(curves, np.float64), np.asarray(qs), axis=0
+    ).astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class EnsembleStats:
+    """The ensemble's answer: per-year quantile bands of the headline
+    adoption curves, national and per state.
+
+    ``national[metric]`` is [Y, Q]; ``state[metric]`` is
+    [Y, Q, n_states]; ``quantiles`` orders the Q axis.
+    """
+
+    years: np.ndarray                   # [Y] calendar years, int64
+    quantiles: Tuple[float, ...]
+    n_members: int
+    national: Dict[str, np.ndarray]
+    state: Dict[str, np.ndarray]
+
+    def band(self, metric: str = "adopters") -> Dict[str, np.ndarray]:
+        """{"p10": [Y], ...} for one national metric — the headline
+        "10th-90th percentile adoption band" accessor."""
+        arr = self.national[metric]
+        return {
+            f"p{round(q * 100):02d}": arr[:, i]
+            for i, q in enumerate(self.quantiles)
+        }
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "years": [int(y) for y in np.asarray(self.years)],
+            "quantiles": [float(q) for q in self.quantiles],
+            "n_members": int(self.n_members),
+            "national": {
+                k: np.asarray(v, np.float64).tolist()
+                for k, v in self.national.items()
+            },
+            "state": {
+                k: np.asarray(v, np.float64).tolist()
+                for k, v in self.state.items()
+            },
+        }
+
+    @classmethod
+    def from_json(cls, d: Dict[str, object]) -> "EnsembleStats":
+        return cls(
+            years=np.asarray(d["years"], np.int64),
+            quantiles=tuple(float(q) for q in d["quantiles"]),
+            n_members=int(d["n_members"]),
+            national={
+                k: np.asarray(v, np.float32)
+                for k, v in d.get("national", {}).items()
+            },
+            state={
+                k: np.asarray(v, np.float32)
+                for k, v in d.get("state", {}).items()
+            },
+        )
+
+    def frame(self):
+        """Long-form pandas frame (one row per year x quantile, one
+        column per national metric) for parquet export."""
+        import pandas as pd
+
+        years = np.asarray(self.years)
+        rows = {
+            "year": np.repeat(years, len(self.quantiles)),
+            "quantile": np.tile(np.asarray(self.quantiles), len(years)),
+        }
+        for k, v in self.national.items():
+            rows[k] = np.asarray(v, np.float64).reshape(-1)
+        return pd.DataFrame(rows)
+
+
+def stats_from_year_blocks(
+    years: Sequence[int],
+    quantiles: Sequence[float],
+    n_members: int,
+    blocks: Dict[int, Dict[str, Dict[str, np.ndarray]]],
+) -> EnsembleStats:
+    """Assemble :class:`EnsembleStats` from vmap-mode per-year quantile
+    fetches: ``blocks[year_idx] = {"national": {m: [Q]}, "state":
+    {m: [Q, n_states]}}``. Missing years raise — a resume that skipped
+    a year is a bug, not a gap to interpolate."""
+    years = np.asarray(list(years), np.int64)
+    missing = [i for i in range(len(years)) if i not in blocks]
+    if missing:
+        raise ValueError(f"missing ensemble stats for year indices {missing}")
+    national = {
+        m: np.stack([np.asarray(blocks[i]["national"][m]) for i in range(len(years))])
+        for m in METRIC_FIELDS
+    }
+    state = {
+        m: np.stack([np.asarray(blocks[i]["state"][m]) for i in range(len(years))])
+        for m in STATE_METRICS
+    }
+    return EnsembleStats(
+        years=years,
+        quantiles=tuple(float(q) for q in quantiles),
+        n_members=int(n_members),
+        national=national,
+        state=state,
+    )
+
+
+def stats_from_member_aggregates(
+    years: Sequence[int],
+    quantiles: Sequence[float],
+    national_curves: Dict[str, np.ndarray],
+    state_curves: Dict[str, np.ndarray],
+) -> EnsembleStats:
+    """Assemble :class:`EnsembleStats` from loop-mode per-member
+    fetches: ``national_curves[m]`` is [E, Y], ``state_curves[m]`` is
+    [E, Y, n_states]; quantiles taken on host with the same linear
+    interpolation the device path uses."""
+    qs = tuple(float(q) for q in quantiles)
+    some = next(iter(national_curves.values()))
+    n_members = int(np.asarray(some).shape[0])
+    national = {
+        # [E, Y] -> [Q, Y] -> [Y, Q]
+        m: quantiles_np(v, qs).transpose(1, 0)
+        for m, v in national_curves.items()
+    }
+    state = {
+        # [E, Y, n_st] -> [Q, Y, n_st] -> [Y, Q, n_st]
+        m: quantiles_np(v, qs).transpose(1, 0, 2)
+        for m, v in state_curves.items()
+    }
+    return EnsembleStats(
+        years=np.asarray(list(years), np.int64),
+        quantiles=qs,
+        n_members=n_members,
+        national=national,
+        state=state,
+    )

@@ -45,6 +45,14 @@ def scenario_dir(directory: str, scenario: Optional[str]) -> str:
     return os.path.join(directory, f"scn={scenario}")
 
 
+def member_dir(directory: str, member: int) -> str:
+    """Per-ensemble-member checkpoint subdirectory: member ``m`` of a
+    loop-mode ensemble (dgen_tpu.ensemble) checkpoints into
+    ``directory/mem=<m>/``, so a killed ensemble resumes at (member,
+    year) — the member-axis analogue of :func:`scenario_dir`."""
+    return os.path.join(directory, f"mem={int(member):03d}")
+
+
 def _mgr(directory: str) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(
         os.path.abspath(directory),

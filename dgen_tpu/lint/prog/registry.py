@@ -265,6 +265,66 @@ def _sweep_loop_bound(year: int) -> Bound:
     return _sweep_loop_bound_for(_world(False, False), year)
 
 
+def _ensemble_bound_for(sim, net_billing, first_year, year: int,
+                        cohorts: bool) -> Bound:
+    """The ensemble driver's vmap-mode program at E=2 members, built
+    through the SAME kwarg path the driver uses (step_kwargs + the
+    per-run net-billing pin + step_operands); the cohort variant adds
+    the entry-year/year operands, so the fused shared-mask compute is
+    part of the audited program."""
+    from dgen_tpu.ensemble.driver import ensemble_year_step
+    from dgen_tpu.models.scenario import stack_scenarios
+    from dgen_tpu.models.simulation import SimCarry
+
+    members = [
+        sim.inputs,
+        dataclasses.replace(sim.inputs, bass_p=sim.inputs.bass_p * 1.2),
+    ]
+    inputs_e = stack_scenarios(members).inputs
+    kwargs = sim.step_kwargs(first_year)
+    kwargs["net_billing"] = net_billing
+    kwargs["mesh"] = None
+    kwargs.update(sim.step_operands())
+    zeros = SimCarry.zeros(sim.table.n_agents)
+    carry = jax.tree.map(
+        lambda x: jnp.zeros((2,) + x.shape, x.dtype), zeros
+    )
+    entry_dev = year_f = None
+    if cohorts:
+        entry = np.zeros(sim.table.n_agents, np.float32)
+        entry[-32:] = 2016.0
+        entry_dev = jnp.asarray(entry)
+        year_f = jnp.asarray(2015.0, jnp.float32)
+    return Bound(
+        fn=ensemble_year_step,
+        args=(sim.table, sim.profiles, sim.tariffs, inputs_e,
+              entry_dev, year_f, carry, _yi(year)),
+        kwargs=kwargs,
+    )
+
+
+def _ensemble_bound(first_year, year: int, cohorts: bool = False) -> Bound:
+    return _ensemble_bound_for(
+        _world(False, False), True, first_year, year, cohorts
+    )
+
+
+def _cohort_mask_bound() -> Bound:
+    """The per-year population-dynamics program: the whole of it — one
+    compare and one multiply over [N] (dgen_tpu.ensemble.cohorts)."""
+    from dgen_tpu.ensemble.cohorts import cohort_alive_mask
+
+    sim = _world()
+    entry = np.zeros(sim.table.n_agents, np.float32)
+    entry[-32:] = 2016.0
+    return Bound(
+        fn=cohort_alive_mask,
+        args=(sim.table.mask, jnp.asarray(entry),
+              jnp.asarray(2015.0, jnp.float32)),
+        kwargs={},
+    )
+
+
 def _serve_bound_for(sim, year: int) -> Bound:
     from dgen_tpu.serve.engine import query_program, query_static_kwargs
 
@@ -595,6 +655,33 @@ def build_registry(grid: str = "default") -> List[ProgramSpec]:
         build=partial(_sweep_loop_bound, 1),
         anchor=sw_anchor, donate_args=(4,),
         expect_same_as="year_step@dl0-bf0-nb1-fy0",
+    ))
+
+    # ensemble vmap mode (ISSUE 20, member axis E=2) + the cohort
+    # mask-update program: the base point carries the steady pair and
+    # the J6 cost fingerprint; the cohort variant (default grid) lowers
+    # the entry-year data plane fused ahead of the member vmap
+    from dgen_tpu.ensemble.cohorts import cohort_alive_mask
+    from dgen_tpu.ensemble.driver import ensemble_year_step
+
+    en_anchor = anchor_for(ensemble_year_step)
+    specs.append(ProgramSpec(
+        entry="ensemble_year_step", variant="dl0-bf0-nb1-fy0",
+        build=partial(_ensemble_bound, False, 1),
+        steady=partial(_ensemble_bound, False, 2),
+        anchor=en_anchor, donate_args=(6,), cost=True,
+    ))
+    if grid == "default":
+        specs.append(ProgramSpec(
+            entry="ensemble_year_step", variant="dl0-bf0-nb1-co1-fy0",
+            build=partial(_ensemble_bound, False, 1, True),
+            steady=partial(_ensemble_bound, False, 2, True),
+            anchor=en_anchor, donate_args=(6,), cost=True,
+        ))
+    specs.append(ProgramSpec(
+        entry="cohort_mask_update", variant="base",
+        build=_cohort_mask_bound,
+        anchor=anchor_for(cohort_alive_mask), cost=True,
     ))
 
     # serve query program (net_billing pinned True by the engine)

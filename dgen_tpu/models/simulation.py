@@ -1337,6 +1337,14 @@ class Simulation:
                     chunk, table.n_agents,
                 )
         self.partition = None
+        # host row-origin map, composed through every host-side row
+        # permutation below (state partition, chunk padding, cluster
+        # layout): final row -> row of the INPUT table, -1 for padding
+        # rows created along the way. Side arrays aligned with the
+        # input table (the ensemble's cohort entry years) ride it;
+        # agent_id cannot serve (padding rows carry the fill id 0,
+        # ambiguous with real agent 0).
+        origin = np.arange(table.n_agents, dtype=np.int64)
         if (
             mesh is not None and mesh.devices.size > 1
             and self.run_config.partition_by_state
@@ -1355,6 +1363,9 @@ class Simulation:
                 table, int(mesh.devices.size), pad_mult,
                 mesh_shape=mesh_shape_of(mesh),
             )
+            # the partition drops mask-0 rows and re-pads per shard;
+            # gather_rows is its exact origin record
+            origin = np.asarray(self.partition.gather_rows)
             logger.info(
                 "partitioned %d agents into %d state-local shards of %d "
                 "(mesh %dx%d)",
@@ -1369,6 +1380,11 @@ class Simulation:
                 int(np.lcm(self.run_config.agent_pad_multiple,
                            chunk * n_dev)),
             )
+            if table.n_agents > len(origin):
+                origin = np.concatenate([
+                    origin,
+                    np.full(table.n_agents - len(origin), -1, np.int64),
+                ])
 
         # --- tariff-clustered layout (config-gated; ops.tariffcluster):
         # canonicalize the compiled bank into structural clusters, then
@@ -1415,6 +1431,7 @@ class Simulation:
                 table,
                 mask=np.asarray(table.mask) * valid,
             )
+            origin = np.where(valid > 0, origin[gather], -1)
             self._cluster_host = dict(
                 cid=layout.cluster_of_rows(),
                 real=np.asarray(table.mask) > 0,
@@ -1450,6 +1467,10 @@ class Simulation:
         # a globally-sharded table would fail under true multi-host
         self.host_agent_id = np.asarray(table.agent_id)
         self.host_mask = np.asarray(table.mask)
+        #: [n_agents] final row -> INPUT-table row (-1 = padding): the
+        #: composed host permutation record (see ``origin`` above) —
+        #: dgen_tpu.ensemble aligns cohort entry years through it
+        self.host_row_origin = origin
         # state_idx too: the end-of-run STATE_KW_BOUND check maps each
         # process's addressable carry rows back to states by GLOBAL row
         # index, which only the host copy can serve under multi-host

@@ -102,6 +102,15 @@ class NationalSpec:
     #: grading)
     load_profiles_per_sector: int = 8
     n_cf_profiles: int = 16
+    #: dynamic-population schedule (dgen_tpu.ensemble.cohorts): this
+    #: fraction of rows is future construction, entering uniformly over
+    #: ``cohort_years`` instead of being alive at the start.
+    #: :func:`generate_table` reserves those rows masked;
+    #: :func:`generate_entry_years` hands the aligned entry vector to
+    #: the ensemble driver. 0.0 (the default) draws NO extra RNG, so
+    #: every pre-cohort world regenerates byte-identically.
+    cohort_frac: float = 0.0
+    cohort_years: Tuple[int, int] = (2026, 2040)
 
     def __post_init__(self) -> None:
         if self.n_agents < 1:
@@ -116,11 +125,20 @@ class NationalSpec:
             raise ValueError(f"unknown states {unknown}")
         if abs(sum(self.sector_weights) - 1.0) > 1e-6:
             raise ValueError("sector_weights must sum to 1")
+        if not (0.0 <= self.cohort_frac < 1.0):
+            raise ValueError(
+                f"cohort_frac must be in [0, 1), got {self.cohort_frac}")
+        y0, y1 = self.cohort_years
+        if y0 > y1 or y0 < 1:
+            raise ValueError(
+                f"cohort_years must be an ascending positive pair, "
+                f"got {self.cohort_years}")
 
     def to_json(self) -> dict:
         d = dataclasses.asdict(self)
         d["states"] = list(self.states)
         d["sector_weights"] = list(self.sector_weights)
+        d["cohort_years"] = list(self.cohort_years)
         return d
 
     @classmethod
@@ -128,6 +146,8 @@ class NationalSpec:
         d = dict(d)
         d["states"] = tuple(d["states"])
         d["sector_weights"] = tuple(d["sector_weights"])
+        if "cohort_years" in d:
+            d["cohort_years"] = tuple(d["cohort_years"])
         return cls(**d)
 
 
@@ -266,6 +286,19 @@ def _chunk_columns(spec: NationalSpec, ci: int, bounds: np.ndarray,
     one_time_charge = np.where(
         switch, rng.uniform(100.0, 800.0, n), 0.0).astype(np.float32)
 
+    # cohort entry draws come LAST, and only when cohort_frac > 0: the
+    # call sequence above is byte-frozen (gang shards and world
+    # manifests pin it), and a zero cohort_frac consuming no RNG is
+    # what keeps pre-cohort worlds regenerating bit-identically
+    if spec.cohort_frac > 0.0:
+        y0, y1 = spec.cohort_years
+        is_cohort = rng.random(n) < spec.cohort_frac
+        entry_year = np.where(
+            is_cohort, rng.integers(y0, y1 + 1, n), 0,
+        ).astype(np.float32)
+    else:
+        entry_year = np.zeros(n, np.float32)
+
     return dict(
         state_idx=global_state,
         sector_idx=sector,
@@ -278,14 +311,18 @@ def _chunk_columns(spec: NationalSpec, ci: int, bounds: np.ndarray,
         load_kwh_per_customer_in_bin=load_kwh,
         developable_frac=developable,
         one_time_charge=one_time_charge,
+        entry_year=entry_year,
     )
 
 
-#: generated column order (fixed: world manifests hash in this order)
+#: generated column order (fixed: world manifests hash in this order;
+#: entry_year is appended at the END so manifests of pre-cohort worlds
+#: — which recorded only the first 11 — still verify clean)
 COLUMNS = (
     "state_idx", "sector_idx", "region_idx", "tariff_idx",
     "tariff_switch_idx", "load_idx", "cf_idx", "customers_in_bin",
     "load_kwh_per_customer_in_bin", "developable_frac", "one_time_charge",
+    "entry_year",
 )
 
 
@@ -373,6 +410,19 @@ def column_hashes(spec: NationalSpec) -> Dict[str, str]:
     return {c: h.hexdigest() for c, h in hashers.items()}
 
 
+def _reserve_cohort_rows(table: AgentTable,
+                         entry_year: np.ndarray) -> AgentTable:
+    """Zero the mask on rows with a future entry year: cohort rows ship
+    "reserved" — placed and padded with everyone else, but invisible to
+    a plain Simulation until the ensemble driver's per-year mask update
+    flips them alive (dgen_tpu.ensemble.cohorts)."""
+    import jax.numpy as jnp
+
+    alive = np.array(table.mask, dtype=np.float32)
+    alive[:len(entry_year)] *= (entry_year == 0.0).astype(np.float32)
+    return dataclasses.replace(table, mask=jnp.asarray(alive))
+
+
 def generate_table(
     spec: NationalSpec,
     rows: Optional[Tuple[int, int]] = None,
@@ -381,15 +431,44 @@ def generate_table(
     """Build the :class:`AgentTable` for the whole world, or — with
     ``rows=(start, stop)`` — for one shard of it (a gang worker
     generating only its slice). Shard tables carry GLOBAL agent ids,
-    so shard exports concatenate into exactly the whole-table rows."""
+    so shard exports concatenate into exactly the whole-table rows.
+
+    Cohort rows (``spec.cohort_frac > 0``) come back masked; pair with
+    :func:`generate_entry_years` to schedule their entry."""
     start, stop = rows if rows is not None else (0, spec.n_agents)
     cols = generate_columns(spec, start, stop)
-    return build_agent_table(
+    entry_year = cols.pop("entry_year")
+    table = build_agent_table(
         n_states=N_STATES,
         pad_multiple=pad_multiple,
         agent_id=np.arange(start, stop, dtype=np.int64),
         **cols,
     )
+    if spec.cohort_frac > 0.0:
+        table = _reserve_cohort_rows(table, entry_year)
+    return table
+
+
+def generate_entry_years(
+    spec: NationalSpec,
+    rows: Optional[Tuple[int, int]] = None,
+    pad_multiple: int = 128,
+) -> np.ndarray:
+    """[N_padded] f32 entry-year vector aligned row-for-row with
+    :func:`generate_table`'s output (same pad rule): ``0.0`` = alive at
+    start, a calendar year = cohort entry, ``COHORT_NEVER`` on padding
+    rows — exactly the ``entry_year=`` operand
+    :class:`dgen_tpu.ensemble.EnsembleSimulation` takes."""
+    from dgen_tpu.ensemble.cohorts import COHORT_NEVER
+    from dgen_tpu.models.agents import pad_to_multiple
+
+    start, stop = rows if rows is not None else (0, spec.n_agents)
+    entry = generate_columns(spec, start, stop)["entry_year"]
+    n = stop - start
+    out = np.full(pad_to_multiple(max(n, 1), pad_multiple),
+                  COHORT_NEVER, dtype=np.float32)
+    out[:n] = entry
+    return out
 
 
 def generate_banks(spec: NationalSpec) -> ProfileBank:
@@ -467,6 +546,12 @@ def save_world(
     # reproduce these digests)
     cols = generate_columns(spec)
     col_hashes = _hash_columns(cols)
+    # cohort rows are saved ALIVE: save_population keeps only mask > 0
+    # rows, and the package must carry the full potential population.
+    # The entry schedule is not a package column — it re-derives
+    # bit-exactly from the manifest spec (generate_entry_years), which
+    # the "cohorts" manifest block below points loaders at.
+    entry_year = cols.pop("entry_year")
     table = build_agent_table(
         n_states=N_STATES, pad_multiple=128,
         agent_id=np.arange(spec.n_agents, dtype=np.int64), **cols,
@@ -489,6 +574,18 @@ def save_world(
             f: _file_sha256(os.path.join(out_dir, f)) for f in _PKG_FILES
         },
     }
+    if spec.cohort_frac > 0.0:
+        sel = entry_year > 0.0
+        ys, cs = np.unique(entry_year[sel].astype(np.int64),
+                           return_counts=True)
+        manifest["cohorts"] = {
+            "cohort_frac": float(spec.cohort_frac),
+            "cohort_years": list(spec.cohort_years),
+            "n_cohort_rows": int(sel.sum()),
+            "entry_histogram": {
+                str(int(y)): int(c) for y, c in zip(ys, cs)
+            },
+        }
     if spec.tariff_mix == "mixed":
         # the documented cluster-shape distribution + what the seed
         # actually realized (residential rows only; commercial and
@@ -576,6 +673,7 @@ def shard_rows(spec: NationalSpec, shard: int, n_shards: int,
 # ---------------------------------------------------------------------------
 
 def _spec_from_args(args) -> NationalSpec:
+    y0, y1 = (int(v) for v in args.cohort_years.split(":"))
     return NationalSpec(
         n_agents=args.agents,
         seed=args.seed,
@@ -584,6 +682,8 @@ def _spec_from_args(args) -> NationalSpec:
         n_regions=args.regions,
         rate_switch_frac=args.rate_switch_frac,
         gen_chunk=args.gen_chunk,
+        cohort_frac=args.cohort_frac,
+        cohort_years=(y0, y1),
     )
 
 
@@ -679,6 +779,11 @@ def main(argv=None) -> int:
         sp.add_argument("--regions", type=int, default=10)
         sp.add_argument("--rate-switch-frac", type=float, default=0.0)
         sp.add_argument("--gen-chunk", type=int, default=GEN_CHUNK)
+        sp.add_argument("--cohort-frac", type=float, default=0.0,
+                        help="fraction of rows reserved as future-"
+                             "construction cohorts (dgen_tpu.ensemble)")
+        sp.add_argument("--cohort-years", default="2026:2040",
+                        help="y0:y1 entry-year range for cohort rows")
 
     g = sub.add_parser(
         "generate", help="materialize a world as an agent package "

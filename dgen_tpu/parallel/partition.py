@@ -28,6 +28,12 @@ class Partition:
     shard_sizes: np.ndarray    # [D] real agents per shard
     shard_len: int             # padded per-shard length
     device_of_state: np.ndarray  # [n_states] -> device (primary shard)
+    #: [D*shard_len] ORIGINAL table row behind each padded position
+    #: (-1 for per-shard padding rows) — set by :func:`partition_table`
+    #: so per-row side arrays (e.g. the ensemble's cohort entry years)
+    #: can ride the same permutation without ambiguity; None when the
+    #: partition was built directly from :func:`partition_by_state`
+    gather_rows: np.ndarray | None = None
 
     @property
     def n_devices(self) -> int:
@@ -150,4 +156,7 @@ def partition_table(table, n_devices: int, pad_multiple: int = 128,
     out = jax.tree.map(g, table)
     import jax.numpy as jnp
 
+    part = dataclasses.replace(
+        part, gather_rows=np.where(valid > 0, gather, -1)
+    )
     return dataclasses.replace(out, mask=jnp.asarray(valid)), part

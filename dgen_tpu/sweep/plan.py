@@ -127,6 +127,10 @@ class SweepPlan:
     #: hbm_bytes x mesh size: the global accelerator memory the whole
     #: sweep is budgeted against (None = unknown backend)
     global_hbm_bytes: Optional[int] = None
+    #: Monte-Carlo ensemble members riding each scenario (the
+    #: ``dgen_tpu.ensemble`` member axis): every budget decision above
+    #: was made at ``s * n_members`` batched rows
+    n_members: int = 1
 
     @property
     def max_vmap_width(self) -> int:
@@ -151,6 +155,7 @@ def plan_sweep(
     enforce_budget: bool = True,
     cluster: bool = False,
     agent_pad_multiple: int = 128,
+    n_members: int = 1,
 ) -> SweepPlan:
     """Plan an S-scenario sweep over one shared population.
 
@@ -181,9 +186,19 @@ def plan_sweep(
     ``enforce_budget=False`` returns the best-effort plan instead
     (floor chunks may overshoot the device — the pre-pod behavior,
     kept for deliberately starved what-if planning).
+
+    ``n_members``: Monte-Carlo ensemble members per scenario
+    (``dgen_tpu.ensemble``). The member axis batches exactly like the
+    scenario axis — members of one scenario share the scenario's
+    compile flags by construction (draws never perturb ``nem_cap_kw``)
+    — so every width decision below runs at ``s * n_members`` batched
+    rows: the persistent carry is counted ``s * n_members``-wide, the
+    vmap width cap applies to the product, and loop mode reuses ONE
+    compiled executable member-major when the product doesn't fit.
     """
     scenarios = list(scenarios)
     validate_scenario_statics(scenarios)
+    n_members = max(int(n_members), 1)
     if hbm_bytes == -1:
         hbm_bytes = default_hbm_bytes()
     max_vmap = (
@@ -266,6 +281,9 @@ def plan_sweep(
     chunk: Optional[int] = None
     for nb, idxs in by_flag.items():
         s = len(idxs)
+        # the batched width HBM actually sees: scenarios x ensemble
+        # members (one carry row-set per member per scenario)
+        w = s * n_members
         if mesh is not None and mesh.devices.size > 1:
             # multi-chip: scenario groups ride the existing shard_map
             # layout unchanged — the scenario-major loop reuses the
@@ -288,19 +306,19 @@ def plan_sweep(
                 if c:
                     chunk = c if chunk is None else min(chunk, c)
         elif hbm_bytes is None:
-            mode = MODE_VMAP if s <= max_vmap else MODE_LOOP
+            mode = MODE_VMAP if w <= max_vmap else MODE_LOOP
         else:
-            # budget S x N rows against the device (the same model
-            # auto_agent_chunk uses, with the persistent [S, N] carry
-            # counted S-wide)
+            # budget (S x E) x N rows against the device (the same
+            # model auto_agent_chunk uses, with the persistent
+            # [S*E, N] carry counted (S*E)-wide)
             budget = int(hbm_bytes * (1.0 - _HBM_RESERVE_FRAC))
-            budget -= s * n_local * _PERSISTENT_ROW_BYTES
+            budget -= w * n_local * _PERSISTENT_ROW_BYTES
             rows_fit = max(budget, 0) // per_agent
-            if s <= max_vmap and s * n_local <= rows_fit:
-                mode = MODE_VMAP            # whole table, S-way batched
-            elif s <= max_vmap and rows_fit // s >= _CHUNK_FLOOR_ROWS:
-                mode = MODE_VMAP            # chunked, S-way batched
-                c = (int(rows_fit // s) // _CHUNK_FLOOR_ROWS
+            if w <= max_vmap and w * n_local <= rows_fit:
+                mode = MODE_VMAP            # whole table, (S*E)-way batched
+            elif w <= max_vmap and rows_fit // w >= _CHUNK_FLOOR_ROWS:
+                mode = MODE_VMAP            # chunked, (S*E)-way batched
+                c = (int(rows_fit // w) // _CHUNK_FLOOR_ROWS
                      * _CHUNK_FLOOR_ROWS)
                 chunk = c if chunk is None else min(chunk, c)
             else:
@@ -335,4 +353,5 @@ def plan_sweep(
         mesh_shape=mesh_shape,
         global_hbm_bytes=(
             hbm_bytes * n_dev if hbm_bytes is not None else None),
+        n_members=n_members,
     )

@@ -58,6 +58,12 @@ class SweepResults:
     #: covers the whole sweep — stamped into each scenario's meta.json
     #: and into sweep.json by :meth:`export`
     quarantine: Optional[Dict[str, object]] = None
+    #: Monte-Carlo quantile block (dgen_tpu.ensemble.stats
+    #: .EnsembleStats) when the runs are ensemble members rather than
+    #: policy scenarios: per-year p10/p50/p90 national/state bands.
+    #: :meth:`export` stamps it into sweep.json and writes the long-form
+    #: ``quantiles.parquet`` beside it. None for ordinary sweeps.
+    quantiles: Optional[object] = None
 
     @property
     def n_scenarios(self) -> int:
@@ -216,6 +222,17 @@ class SweepResults:
              "scenarios": [self.labels[i] for i in g.indices]}
             for g in self.plan.groups
         ]
+        if self.quantiles is not None:
+            # ensemble runs: the quantile bands are the headline
+            # surface — into sweep.json verbatim, plus a long-form
+            # parquet (one row per year x quantile) for analysis stacks
+            report["quantiles"] = self.quantiles.to_json()
+            from dgen_tpu.resilience.atomic import atomic_to_parquet
+
+            atomic_to_parquet(
+                self.quantiles.frame(),
+                os.path.join(run_dir, "quantiles.parquet"),
+            )
         from dgen_tpu.resilience.atomic import atomic_write_json
 
         atomic_write_json(

@@ -1,0 +1,469 @@
+"""Ensemble engine tests (ISSUE 20): E=1 zero-width-draw byte parity
+with ``Simulation.run``, restart-stable + mode-invariant draws, cohort
+entry parity against always-alive oracles, device quantiles vs the
+NumPy reference at small E, (member, year) checkpoint resume, and the
+steady-state / cross-member retrace guarantees."""
+
+import dataclasses as dc
+import os
+import shutil
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgen_tpu.config import RunConfig, ScenarioConfig
+from dgen_tpu.ensemble import (
+    COHORT_NEVER,
+    DEFAULT_DRAWS,
+    CohortSchedule,
+    DrawSpec,
+    EnsembleSimulation,
+    EnsembleStats,
+    draw_members,
+)
+from dgen_tpu.ensemble.cohorts import (
+    align_entry,
+    alive_mask_np,
+    cohort_alive_mask,
+    electrified_load_growth,
+    potential_mask,
+)
+from dgen_tpu.ensemble.stats import quantiles_np
+from dgen_tpu.io import synth
+from dgen_tpu.models import scenario as scen
+from dgen_tpu.models.simulation import Simulation
+from dgen_tpu.sweep import MODE_LOOP, MODE_VMAP
+
+CFG = ScenarioConfig(name="ens-t", start_year=2014, end_year=2016,
+                     anchor_years=())
+RC = RunConfig(sizing_iters=6)
+
+
+@pytest.fixture(scope="module")
+def pop():
+    return synth.generate_population(
+        96, states=["DE", "CA"], seed=11, pad_multiple=32
+    )
+
+
+def make_inputs(pop):
+    return scen.uniform_inputs(
+        CFG, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+    )
+
+
+def make_ens(pop, inputs, **kw):
+    return EnsembleSimulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, CFG,
+        kw.pop("run_config", RC), **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Draws: restart stability, zero-width identity, mean preservation
+# ---------------------------------------------------------------------------
+
+def test_zero_draws_return_base_object(pop):
+    """The byte-parity hook: a zero-width spec yields the base inputs
+    OBJECT, not a numerically-equal copy."""
+    inputs = make_inputs(pop)
+    members = draw_members(inputs, DrawSpec(), 3, seed=0)
+    assert all(m is inputs for m in members)
+
+
+def test_draws_are_restart_stable_and_order_free(pop):
+    inputs = make_inputs(pop)
+    a = draw_members(inputs, DEFAULT_DRAWS, 4, seed=123)
+    b = draw_members(inputs, DEFAULT_DRAWS, 4, seed=123)
+    for ma, mb in zip(a, b):
+        for f in dc.fields(ma):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ma, f.name)),
+                np.asarray(getattr(mb, f.name)),
+                err_msg=f.name,
+            )
+    # member m's draws don't depend on how many siblings exist
+    wide = draw_members(inputs, DEFAULT_DRAWS, 8, seed=123)
+    np.testing.assert_array_equal(
+        np.asarray(a[2].bass_p), np.asarray(wide[2].bass_p)
+    )
+    # different seeds actually move the draws
+    c = draw_members(inputs, DEFAULT_DRAWS, 4, seed=124)
+    assert not np.array_equal(
+        np.asarray(a[1].bass_p), np.asarray(c[1].bass_p)
+    )
+
+
+def test_draws_perturb_only_drawn_axes(pop):
+    inputs = make_inputs(pop)
+    (m,) = draw_members(
+        inputs, DrawSpec(bass_p_sd=0.2), 1, seed=5
+    )
+    assert not np.array_equal(
+        np.asarray(m.bass_p), np.asarray(inputs.bass_p)
+    )
+    # undrawn axes are the base arrays; nem_cap_kw is NEVER drawn
+    np.testing.assert_array_equal(
+        np.asarray(m.bass_q), np.asarray(inputs.bass_q)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(m.nem_cap_kw), np.asarray(inputs.nem_cap_kw)
+    )
+
+
+# ---------------------------------------------------------------------------
+# E=1 zero-draw byte parity with Simulation.run
+# ---------------------------------------------------------------------------
+
+def test_e1_zero_draw_matches_single_run_byte_exact(pop):
+    inputs = make_inputs(pop)
+    ref = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, CFG, RC
+    ).run(collect=True)
+    ens = make_ens(pop, inputs, n_members=1, draws=DrawSpec())
+    assert ens.mode == MODE_LOOP          # E=1 is pinned to the loop
+    res = ens.run(collect=True)
+    r1 = res[0]
+    assert list(r1.years) == list(ref.years)
+    for k in ref.agent:
+        np.testing.assert_array_equal(
+            np.asarray(ref.agent[k]), np.asarray(r1.agent[k]),
+            err_msg=k,
+        )
+    # and the quantile block degenerates to the single trajectory
+    band = res.quantiles.band("adopters")
+    m = np.asarray(pop.table.mask)
+    nat = (ref.agent["number_of_adopters"] * m[None, :]).sum(axis=1)
+    np.testing.assert_allclose(band["p50"], nat, rtol=1e-6)
+    np.testing.assert_array_equal(band["p10"], band["p90"])
+
+
+# ---------------------------------------------------------------------------
+# Loop-vs-vmap mode invariance
+# ---------------------------------------------------------------------------
+
+def test_loop_and_vmap_modes_agree(pop):
+    inputs = make_inputs(pop)
+    ens_v = make_ens(pop, inputs, n_members=2, seed=3,
+                     draws=DEFAULT_DRAWS)
+    assert ens_v.mode == MODE_VMAP
+    res_v = ens_v.run(collect=True)
+    # max_vmap_members=1 caps the planner width below E -> loop mode
+    ens_l = make_ens(pop, inputs, n_members=2, seed=3,
+                     draws=DEFAULT_DRAWS, max_vmap_members=1)
+    assert ens_l.mode == MODE_LOOP
+    res_l = ens_l.run(collect=True)
+    for m in range(2):
+        for k in res_v[m].agent:
+            np.testing.assert_allclose(
+                np.asarray(res_v[m].agent[k]),
+                np.asarray(res_l[m].agent[k]),
+                rtol=1e-5, atol=1e-5, err_msg=f"mem{m}:{k}",
+            )
+    for metric in ("adopters", "system_kw_cum"):
+        np.testing.assert_allclose(
+            res_v.quantiles.national[metric],
+            res_l.quantiles.national[metric],
+            rtol=1e-5, atol=1e-3,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Quantiles vs the NumPy reference at small E
+# ---------------------------------------------------------------------------
+
+def test_device_quantiles_match_numpy_reference(pop):
+    inputs = make_inputs(pop)
+    E = 4
+    ens = make_ens(pop, inputs, n_members=E, seed=9, draws=DEFAULT_DRAWS)
+    assert ens.mode == MODE_VMAP          # device-side quantile path
+    res = ens.run(collect=True)
+    mask = np.asarray(res.host_mask)
+    # member curves recomputed from the collected agent outputs
+    curves = np.stack([
+        (res[m].agent["number_of_adopters"] * mask[None, :]).sum(axis=1)
+        for m in range(E)
+    ])                                     # [E, Y]
+    ref = quantiles_np(curves, res.quantiles.quantiles)  # [Q, Y]
+    np.testing.assert_allclose(
+        res.quantiles.national["adopters"], ref.transpose(1, 0),
+        rtol=1e-5, atol=1e-3,
+    )
+    # E members, 4 quantile-ordered columns per metric
+    assert res.quantiles.n_members == E
+    json_rt = EnsembleStats.from_json(res.quantiles.to_json())
+    np.testing.assert_allclose(
+        json_rt.national["adopters"],
+        res.quantiles.national["adopters"], rtol=1e-6,
+    )
+    frame = res.quantiles.frame()
+    assert len(frame) == len(res.quantiles.years) * 3
+    assert "adopters" in frame.columns
+
+
+# ---------------------------------------------------------------------------
+# Cohorts: mask oracle, placement alignment, entry parity
+# ---------------------------------------------------------------------------
+
+def test_cohort_mask_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    mask = (rng.random(64) > 0.2).astype(np.float32)
+    entry = np.where(
+        rng.random(64) < 0.3,
+        rng.integers(2015, 2020, 64),
+        0.0,
+    ).astype(np.float32)
+    mask[-4:] = 0.0                       # padding rows
+    entry[-4:] = COHORT_NEVER
+    for year in (2014.0, 2016.0, 2019.0, 2030.0):
+        got = np.asarray(cohort_alive_mask(
+            jnp.asarray(mask), jnp.asarray(entry),
+            jnp.asarray(year, jnp.float32),
+        ))
+        np.testing.assert_array_equal(
+            got, alive_mask_np(mask, entry, year)
+        )
+    # potential = base-alive OR will-ever-enter
+    pot = potential_mask(mask, entry)
+    will = ((entry > 0.0) & (entry < COHORT_NEVER)).astype(np.float32)
+    np.testing.assert_array_equal(pot, np.maximum(mask, will))
+    # after the last entry year the alive mask IS the potential mask
+    # (modulo never-alive rows)
+    np.testing.assert_array_equal(
+        alive_mask_np(pot, entry, 2025.0), pot * (mask + will > 0)
+    )
+
+
+def test_align_entry_routes_through_row_origin():
+    entry = np.asarray([0.0, 2016.0, 0.0, 2018.0], np.float32)
+    origin = np.asarray([3, -1, 0, 2, 1], np.int64)
+    out = align_entry(entry, origin)
+    np.testing.assert_array_equal(
+        out,
+        np.asarray([2018.0, COHORT_NEVER, 0.0, 0.0, 2016.0], np.float32),
+    )
+
+
+def test_cohort_schedule_validates_and_counts():
+    e = np.zeros(8, np.float32)
+    e[2] = 2016.0
+    e[5] = 2016.0
+    e[6] = COHORT_NEVER
+    cs = CohortSchedule(e)
+    assert cs.n_cohort_rows == 2
+    assert cs.counts_by_year() == {2016: 2}
+    with pytest.raises(ValueError, match="1-D"):
+        CohortSchedule(np.zeros((2, 2), np.float32))
+
+
+def test_cohort_entry_at_start_year_matches_always_alive(pop):
+    """Rows scheduled to enter AT the first model year are alive for
+    the whole horizon — the run must match a plain always-alive run."""
+    inputs = make_inputs(pop)
+    ref = Simulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, CFG, RC
+    ).run(collect=True)
+    entry = np.zeros(pop.table.n_agents, np.float32)
+    alive = np.flatnonzero(np.asarray(pop.table.mask) > 0)
+    entry[alive[-16:]] = float(CFG.start_year)
+    ens = make_ens(pop, inputs, n_members=1, draws=DrawSpec(),
+                   entry_year=entry)
+    res = ens.run(collect=True)
+    for k in ref.agent:
+        np.testing.assert_allclose(
+            np.asarray(ref.agent[k]), np.asarray(res[0].agent[k]),
+            rtol=1e-6, atol=1e-6, err_msg=k,
+        )
+
+
+def test_cohort_entry_freezes_rows_until_entry_year(pop):
+    """Staggered entry: pre-entry rows contribute nothing to the
+    national curve, and flip in exactly at their entry year."""
+    inputs = make_inputs(pop)
+    entry = np.zeros(pop.table.n_agents, np.float32)
+    alive = np.flatnonzero(np.asarray(pop.table.mask) > 0)
+    cohort = alive[-12:]
+    entry[cohort] = 2016.0                # enters at the LAST year
+    ens = make_ens(pop, inputs, n_members=2, seed=1,
+                   draws=DEFAULT_DRAWS, entry_year=entry)
+    res = ens.run(collect=True)
+    # recover the cohort's placed positions through host_agent_id
+    placed_cohort = np.isin(
+        np.asarray(res.host_agent_id), np.asarray(cohort)
+    )
+    mask_pot = np.asarray(res.host_mask)
+    for m in range(2):
+        adopters = np.asarray(res[m].agent["number_of_adopters"])
+        # year 2014: cohort rows masked out -> exact zeros in the sums
+        pre = (adopters[0] * mask_pot * placed_cohort).sum()
+        assert pre == 0.0 or np.allclose(pre, 0.0, atol=1e-6)
+    # the quantile block was computed against the per-year alive mask:
+    # year-0 p50 must equal the alive-only recomputation
+    year0_alive = mask_pot * (~placed_cohort)
+    curves = np.stack([
+        (np.asarray(res[m].agent["number_of_adopters"][0])
+         * year0_alive).sum()
+        for m in range(2)
+    ])
+    got = res.quantiles.national["adopters"][0, 1]     # p50, year 0
+    np.testing.assert_allclose(
+        got, np.quantile(curves, 0.5), rtol=1e-5, atol=1e-3
+    )
+
+
+def test_entry_year_length_mismatch_raises(pop):
+    inputs = make_inputs(pop)
+    with pytest.raises(ValueError, match="entry_year covers"):
+        make_ens(pop, inputs, n_members=1,
+                 entry_year=np.zeros(3, np.float32))
+
+
+def test_electrified_load_growth_compounds_from_start():
+    lg = np.ones((3, 2, 3), np.float32)
+    out = np.asarray(electrified_load_growth(
+        lg, [2020, 2022, 2024], 0.10, sectors=(0,)
+    ))
+    np.testing.assert_allclose(out[:, :, 0], [[1.0] * 2, [1.1 ** 2] * 2,
+                                              [1.1 ** 4] * 2], rtol=1e-6)
+    np.testing.assert_array_equal(out[:, :, 1:], lg[:, :, 1:])
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume at (member, year)
+# ---------------------------------------------------------------------------
+
+def test_ensemble_resumes_at_member_year_loop(pop, tmp_path):
+    from dgen_tpu.io import checkpoint as ckpt
+
+    inputs = make_inputs(pop)
+    d = str(tmp_path / "ens-ckpt")
+    ens = make_ens(pop, inputs, n_members=2, seed=7,
+                   draws=DEFAULT_DRAWS, max_vmap_members=1)
+    assert ens.mode == MODE_LOOP
+    full = ens.run(collect=True, checkpoint_dir=d)
+    # drop member 1's LAST year checkpoint: resume must recompute only
+    # (member 1, 2016) and nothing else
+    m1 = ckpt.member_dir(d, 1)
+    assert ckpt.latest_year(m1) == 2016
+    for sub in os.listdir(m1):
+        if "2016" in sub:
+            shutil.rmtree(os.path.join(m1, sub))
+    assert ckpt.latest_year(m1) == 2014
+
+    ens2 = make_ens(pop, inputs, n_members=2, seed=7,
+                    draws=DEFAULT_DRAWS, max_vmap_members=1)
+    res = ens2.run(collect=True, checkpoint_dir=d, resume=True)
+    assert res.runs[0].years == []          # member 0 fully resumed
+    assert res.runs[1].years == [2016]      # member 1: one new year
+    np.testing.assert_allclose(
+        np.asarray(res.runs[1].agent["number_of_adopters"][0]),
+        np.asarray(full.runs[1].agent["number_of_adopters"][-1]),
+        rtol=1e-6,
+    )
+    # the stats sidecar restores the full horizon despite the partial
+    # re-run — quantiles identical to the uninterrupted run
+    np.testing.assert_allclose(
+        res.quantiles.national["adopters"],
+        full.quantiles.national["adopters"], rtol=1e-6,
+    )
+
+
+def test_ensemble_resumes_vmap_stacked(pop, tmp_path):
+    inputs = make_inputs(pop)
+    d = str(tmp_path / "ens-ckpt-vmap")
+    ens = make_ens(pop, inputs, n_members=2, seed=7, draws=DEFAULT_DRAWS)
+    assert ens.mode == MODE_VMAP
+    full = ens.run(checkpoint_dir=d)
+    ens2 = make_ens(pop, inputs, n_members=2, seed=7,
+                    draws=DEFAULT_DRAWS)
+    res = ens2.run(checkpoint_dir=d, resume=True)
+    assert all(r.years == [] for r in res.runs)  # nothing recomputed
+    np.testing.assert_allclose(
+        res.quantiles.national["adopters"],
+        full.quantiles.national["adopters"], rtol=1e-6,
+    )
+
+
+def test_stale_stats_sidecar_is_ignored(pop, tmp_path):
+    """A sidecar from a different (mode, E, quantiles) configuration
+    must not poison a resumed run."""
+    inputs = make_inputs(pop)
+    d = str(tmp_path / "ens-stale")
+    os.makedirs(d)
+    from dgen_tpu.ensemble.driver import STATS_FILE
+    import json
+
+    with open(os.path.join(d, STATS_FILE), "w") as f:
+        json.dump({"mode": "loop", "n_members": 99,
+                   "quantiles": [0.5]}, f)
+    ens = make_ens(pop, inputs, n_members=2, seed=7, draws=DEFAULT_DRAWS)
+    res = ens.run(checkpoint_dir=d, resume=True)
+    assert not np.isnan(
+        res.quantiles.national["adopters"]
+    ).any()
+
+
+# ---------------------------------------------------------------------------
+# Retrace guarantees
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_ensemble_steady_state_compiles_nothing(pop):
+    """RetraceGuard armed: vmap mode must not compile past year 2, and
+    loop mode must compile nothing after member 0 (cross-member
+    guard). The guards raise inside run() on violation."""
+    cfg = ScenarioConfig(name="ens-g", start_year=2014, end_year=2020,
+                         anchor_years=())
+    inputs = scen.uniform_inputs(
+        cfg, n_groups=pop.table.n_groups, n_regions=pop.n_regions,
+    )
+    rc = RunConfig(sizing_iters=6, guard_retrace=True)
+    entry = np.zeros(pop.table.n_agents, np.float32)
+    alive = np.flatnonzero(np.asarray(pop.table.mask) > 0)
+    entry[alive[-8:]] = 2018.0            # mid-horizon cohort entry
+    EnsembleSimulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc,
+        n_members=3, seed=2, draws=DEFAULT_DRAWS, entry_year=entry,
+    ).run()
+    EnsembleSimulation(
+        pop.table, pop.profiles, pop.tariffs, inputs, cfg, rc,
+        n_members=2, seed=2, draws=DEFAULT_DRAWS,
+        max_vmap_members=1,
+    ).run()
+
+
+# ---------------------------------------------------------------------------
+# Planner integration
+# ---------------------------------------------------------------------------
+
+def test_plan_budgets_member_axis(pop):
+    """plan_sweep's n_members term: a member count that blows the HBM
+    model falls back to loop mode instead of a doomed vmap."""
+    from dgen_tpu.sweep import plan_sweep
+
+    inputs = make_inputs(pop)
+    years = list(CFG.model_years)
+    small = plan_sweep(
+        [inputs], years, table=pop.table, tariffs=pop.tariffs,
+        econ_years=25, sizing_iters=6,
+        hbm_bytes=32 * 1024**3, n_members=2,
+    )
+    assert small.groups[0].mode == MODE_VMAP
+    big = plan_sweep(
+        [inputs], years, table=pop.table, tariffs=pop.tariffs,
+        econ_years=25, sizing_iters=6,
+        hbm_bytes=64 * 1024**2, n_members=512,
+    )
+    assert big.groups[0].mode == MODE_LOOP
+
+
+def test_env_knobs_set_members_and_seed(pop, monkeypatch):
+    from dgen_tpu.ensemble.driver import ENV_MEMBERS, ENV_SEED
+
+    inputs = make_inputs(pop)
+    monkeypatch.setenv(ENV_MEMBERS, "3")
+    monkeypatch.setenv(ENV_SEED, "42")
+    ens = make_ens(pop, inputs)
+    assert ens.n_members == 3
+    assert ens.seed == 42

@@ -350,3 +350,99 @@ def test_gangworker_national_world_knob(monkeypatch):
     w2 = ns.generate_world(dataclasses.replace(spec))
     assert np.array_equal(np.asarray(w.table.customers_in_bin),
                           np.asarray(w2.table.customers_in_bin))
+
+
+# ---------------------------------------------------------------------------
+# cohorts: future-construction rows (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_cohort_frac_zero_is_byte_identical_to_pre_cohort_worlds():
+    """cohort_frac=0 consumes NO RNG and the entry draws come LAST, so
+    every pre-existing column of a cohort world is byte-identical to
+    the same seed's pre-cohort world — old committed worlds regenerate
+    exactly."""
+    base = small_spec()
+    with_cohorts = small_spec(cohort_frac=0.2,
+                              cohort_years=(2026, 2030))
+    a = ns.generate_columns(base)
+    b = ns.generate_columns(with_cohorts)
+    for c in ns.COLUMNS:
+        if c == "entry_year":
+            continue
+        assert np.array_equal(a[c], b[c]), c
+    assert np.all(a["entry_year"] == 0.0)
+    sel = b["entry_year"] > 0
+    assert 0.1 < sel.mean() < 0.3
+    ys = b["entry_year"][sel]
+    assert ys.min() >= 2026 and ys.max() <= 2030
+    # shard==whole determinism extends to the entry column
+    lo = ns.generate_columns(with_cohorts, 0, 1300)
+    hi = ns.generate_columns(with_cohorts, 1300, base.n_agents)
+    assert np.array_equal(
+        np.concatenate([lo["entry_year"], hi["entry_year"]]),
+        b["entry_year"],
+    )
+
+
+def test_cohort_rows_reserved_masked_and_entry_aligned():
+    from dgen_tpu.ensemble.cohorts import COHORT_NEVER
+
+    spec = small_spec(n_agents=1000, cohort_frac=0.25,
+                      cohort_years=(2026, 2028))
+    t = ns.generate_table(spec, pad_multiple=128)
+    entry = ns.generate_entry_years(spec, pad_multiple=128)
+    assert len(entry) == t.n_agents          # padded lengths align
+    mask = np.asarray(t.mask)
+    cols = ns.generate_columns(spec)
+    # cohort rows ship MASKED (reserved); everyone else alive
+    np.testing.assert_array_equal(
+        mask[:1000], (cols["entry_year"] == 0.0).astype(np.float32)
+    )
+    assert np.all(mask[1000:] == 0.0)        # padding stays dead
+    np.testing.assert_array_equal(entry[:1000], cols["entry_year"])
+    assert np.all(entry[1000:] == np.float32(COHORT_NEVER))
+    # a rows= shard slices the same global schedule
+    part = ns.generate_entry_years(spec, rows=(256, 512),
+                                   pad_multiple=128)
+    np.testing.assert_array_equal(part[:256],
+                                  cols["entry_year"][256:512])
+    # entry_year is NOT an agent-table column
+    assert not hasattr(t, "entry_year")
+
+
+def test_cohort_spec_validation():
+    with pytest.raises(ValueError, match="cohort_frac"):
+        small_spec(cohort_frac=1.0)
+    with pytest.raises(ValueError, match="cohort_years"):
+        small_spec(cohort_frac=0.1, cohort_years=(2040, 2030))
+    spec = small_spec(cohort_frac=0.1, cohort_years=(2026, 2040))
+    assert ns.NationalSpec.from_json(spec.to_json()) == spec
+    # old manifests (no cohort keys) load with cohorts off
+    d = spec.to_json()
+    del d["cohort_frac"], d["cohort_years"]
+    old = ns.NationalSpec.from_json(d)
+    assert old.cohort_frac == 0.0
+
+
+def test_cohort_world_manifest_and_roundtrip(tmp_path):
+    from dgen_tpu.io import package
+
+    spec = small_spec(n_agents=512, gen_chunk=256, tariff_mix="nem",
+                      cohort_frac=0.2, cohort_years=(2026, 2027))
+    out = str(tmp_path / "world-cohort")
+    manifest = ns.save_world(spec, out)
+    co = manifest["cohorts"]
+    assert co["cohort_frac"] == 0.2
+    assert co["cohort_years"] == [2026, 2027]
+    n_hist = sum(co["entry_histogram"].values())
+    assert co["n_cohort_rows"] == n_hist > 0
+    assert set(co["entry_histogram"]) <= {"2026", "2027"}
+    assert ns.verify_world(out) == []
+    # saved worlds persist the POTENTIAL population alive (the mask>0
+    # row filter would otherwise drop reserved rows); loaders re-derive
+    # entry/mask from the manifest spec
+    pop = package.load_population(out)
+    assert int(np.sum(np.asarray(pop.table.mask) > 0)) == 512
+    entry = ns.generate_entry_years(
+        ns.NationalSpec.from_json(manifest["spec"]))
+    assert int(np.sum((entry > 0) & (entry < 9e9))) == co["n_cohort_rows"]

@@ -158,6 +158,27 @@ if [ "$mode" != "--test-only" ]; then
     JAX_PLATFORMS=cpu python -m dgen_tpu.ops.tariffcluster --report \
         --agents 4096 --seed 3 --tariff-mix mixed \
         >/tmp/_tariffcluster.json || rc=1
+    # ensemble smoke (docs/ensemble.md): an E=4 Monte-Carlo ensemble
+    # with a mid-horizon cohort on a small world must produce the
+    # p10/p50/p90 quantile block, AND the E=1 zero-width-draw ensemble
+    # must be byte-identical to Simulation.run (--check-parity exits
+    # nonzero on divergence) — the bands and the parity gate cannot
+    # rot between ENSEMBLE_r* rounds
+    echo "== ensemble smoke (python -m dgen_tpu.ensemble --check-parity) =="
+    JAX_PLATFORMS=cpu python -m dgen_tpu.ensemble \
+        --agents 256 --members 4 --end-year 2017 \
+        --cohort-rows 16 --cohort-year 2016 --sizing-iters 6 \
+        --check-parity >/tmp/_ensemble.json || rc=1
+    python - <<'PY' || rc=1
+import json
+d = json.load(open("/tmp/_ensemble.json"))
+assert d["parity"] is True, "E=1 parity gate failed"
+band = d["adopters_band"]
+assert set(band) == {"p10", "p50", "p90"}, band.keys()
+assert len(band["p50"]) == len(d["years"]) > 0
+assert all(a <= b <= c for a, b, c in
+           zip(band["p10"], band["p50"], band["p90"]))
+PY
 fi
 
 if [ "$mode" != "--lint-only" ]; then
